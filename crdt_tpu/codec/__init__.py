@@ -1,0 +1,1 @@
+from crdt_tpu.codec.lib0 import Decoder, Encoder  # noqa: F401

@@ -1,0 +1,2 @@
+from crdt_tpu.core.ids import ID, StateVector, DeleteSet  # noqa: F401
+from crdt_tpu.core.store import ItemStore, ROOT_PARENT, NO_KEY  # noqa: F401

@@ -1,0 +1,26 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX import.
+
+Real hardware in CI is a single TPU chip; multi-chip sharding paths are
+validated on a virtual host-platform mesh instead (see SURVEY.md §7 and
+the driver's dryrun_multichip contract).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import random
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    random.seed(0)
+    np.random.seed(0)

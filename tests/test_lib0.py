@@ -1,0 +1,157 @@
+"""lib0 primitive codec tests: round-trips and golden byte patterns."""
+
+import math
+import random
+
+import pytest
+
+from crdt_tpu.codec.lib0 import UNDEFINED, Decoder, Encoder
+
+
+def roundtrip_uint(n):
+    e = Encoder()
+    e.write_var_uint(n)
+    d = Decoder(e.to_bytes())
+    out = d.read_var_uint()
+    assert not d.has_content()
+    return out
+
+
+def roundtrip_int(n):
+    e = Encoder()
+    e.write_var_int(n)
+    d = Decoder(e.to_bytes())
+    out = d.read_var_int()
+    assert not d.has_content()
+    return out
+
+
+def test_var_uint_golden():
+    # 7-bit boundary behavior of base-128 little-endian varints
+    cases = {
+        0: b"\x00",
+        1: b"\x01",
+        127: b"\x7f",
+        128: b"\x80\x01",
+        300: b"\xac\x02",
+        16383: b"\xff\x7f",
+        16384: b"\x80\x80\x01",
+    }
+    for n, expected in cases.items():
+        e = Encoder()
+        e.write_var_uint(n)
+        assert e.to_bytes() == expected, n
+
+
+def test_var_uint_roundtrip():
+    for n in [0, 1, 63, 64, 127, 128, 255, 2**20, 2**31 - 1, 2**53]:
+        assert roundtrip_uint(n) == n
+    rng = random.Random(7)
+    for _ in range(500):
+        n = rng.getrandbits(rng.randint(1, 53))
+        assert roundtrip_uint(n) == n
+
+
+def test_var_int_roundtrip():
+    for n in [0, 1, -1, 63, -63, 64, -64, 8191, -8192, 2**31 - 1, -(2**31)]:
+        assert roundtrip_int(n) == n
+    rng = random.Random(8)
+    for _ in range(500):
+        n = rng.getrandbits(rng.randint(1, 40)) * rng.choice([1, -1])
+        assert roundtrip_int(n) == n
+
+
+def test_var_int_sign_bit_layout():
+    # -1 => continue=0, sign=0x40, payload 1 => 0x41
+    e = Encoder()
+    e.write_var_int(-1)
+    assert e.to_bytes() == b"\x41"
+    e = Encoder()
+    e.write_var_int(1)
+    assert e.to_bytes() == b"\x01"
+    # 64 needs a second byte: first = 0x80 | (64 & 0x3f) = 0x80, then 1
+    e = Encoder()
+    e.write_var_int(64)
+    assert e.to_bytes() == b"\x80\x01"
+
+
+def test_var_string_roundtrip():
+    for s in ["", "a", "hello", "héllo wörld", "日本語テキスト", "👍🏽emoji", "a" * 1000]:
+        e = Encoder()
+        e.write_var_string(s)
+        d = Decoder(e.to_bytes())
+        assert d.read_var_string() == s
+        assert not d.has_content()
+
+
+@pytest.mark.parametrize(
+    "value",
+    [
+        None,
+        UNDEFINED,
+        True,
+        False,
+        0,
+        1,
+        -1,
+        2**30,
+        -(2**30),
+        2**40,  # bigint path
+        0.5,
+        1.25,  # exact float32
+        0.1,  # needs float64
+        "text",
+        b"\x00\x01\xff",
+        [1, "two", None, [3.5, True]],
+        {"a": 1, "b": {"c": [1, 2, 3]}, "d": None},
+        {"nested": {"deep": {"list": [{"x": 1}]}}},
+    ],
+)
+def test_any_roundtrip(value):
+    e = Encoder()
+    e.write_any(value)
+    d = Decoder(e.to_bytes())
+    out = d.read_any()
+    assert not d.has_content()
+    assert out == value or (value is UNDEFINED and out is UNDEFINED)
+
+
+def test_any_type_bytes():
+    # golden type tags from the lib0 wire format
+    def tag(v):
+        e = Encoder()
+        e.write_any(v)
+        return e.to_bytes()[0]
+
+    assert tag(UNDEFINED) == 127
+    assert tag(None) == 126
+    assert tag(5) == 125
+    assert tag(0.5) == 124
+    assert tag(0.1) == 123
+    assert tag(2**40) == 122
+    assert tag(False) == 121
+    assert tag(True) == 120
+    assert tag("s") == 119
+    assert tag({}) == 118
+    assert tag([]) == 117
+    assert tag(b"") == 116
+
+
+def test_float_precision():
+    e = Encoder()
+    e.write_any(math.pi)
+    d = Decoder(e.to_bytes())
+    assert d.read_any() == math.pi
+
+
+def test_truncated_buffers_raise():
+    e = Encoder()
+    e.write_any({"k": "hello world", "b": b"\x01\x02\x03", "f": 0.1})
+    wire = e.to_bytes()
+    # every strict prefix must raise, never silently decode short
+    for cut in range(len(wire)):
+        with pytest.raises(ValueError):
+            try:
+                Decoder(wire[:cut]).read_any()
+            except Exception as ex:
+                raise ValueError(str(ex)) from ex

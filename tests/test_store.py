@@ -1,0 +1,136 @@
+"""ItemStore structural tests."""
+
+import numpy as np
+import pytest
+
+from crdt_tpu.core.ids import DeleteSet, StateVector
+from crdt_tpu.core.store import K_ANY, K_DELETED, ItemStore
+
+
+def test_interning():
+    s = ItemStore()
+    a = s.intern_root("users")
+    b = s.intern_root("posts")
+    assert a != b
+    assert s.intern_root("users") == a
+    k = s.intern_key("name")
+    assert s.intern_key("name") == k
+    assert s.root_names[a] == "users"
+    assert s.keys[k] == "name"
+
+
+def test_add_and_find():
+    s = ItemStore(capacity=2)
+    rid = s.intern_root("m")
+    kid = s.intern_key("k")
+    rows = []
+    for i in range(100):  # force several growths
+        rows.append(
+            s.add_item(1, i, parent_root=rid, key_id=kid, kind=K_ANY, content=i)
+        )
+    assert len(s) == 100
+    for i, row in enumerate(rows):
+        assert s.find(1, i) == row
+        assert s.content[row] == i
+    assert s.find(1, 100) is None
+    assert s.id_of(rows[5]) == (1, 5)
+
+
+def test_duplicate_id_rejected():
+    s = ItemStore()
+    s.add_item(1, 0)
+    with pytest.raises(ValueError):
+        s.add_item(1, 0)
+
+
+def test_state_vector():
+    s = ItemStore()
+    s.add_item(1, 0)
+    s.add_item(1, 1)
+    s.add_item(2, 5)  # gap: clocks 0-4 of client 2 never seen
+    sv = s.state_vector()
+    assert sv.get(1) == 2  # next clock
+    assert sv.get(2) == 0  # non-contiguous clocks are not claimed
+    assert sv.get(3) == 0
+    # filling the gap makes the prefix visible
+    for k in range(5):
+        s.add_item(2, k)
+    assert s.state_vector().get(2) == 6
+
+
+def test_delete_set():
+    s = ItemStore()
+    s.add_item(1, 0)
+    s.add_item(1, 1)
+    s.add_item(1, 2, kind=K_DELETED)
+    s.mark_deleted(s.find(1, 0))
+    ds = s.delete_set()
+    assert ds.contains(1, 0)
+    assert not ds.contains(1, 1)
+    assert ds.contains(1, 2)
+    # ranges merged? mark 1 too -> one [0,3) range
+    s.mark_deleted(s.find(1, 1))
+    ds = s.delete_set()
+    assert ds.ranges[1] == [(0, 3)]
+
+
+def test_columns_dense():
+    s = ItemStore()
+    for i in range(10):
+        s.add_item(3, i, content=None)
+    cols = s.columns()
+    assert all(len(v) == 10 for v in cols.values())
+    assert np.array_equal(cols["clock"], np.arange(10))
+
+
+def test_statevector_semantics():
+    sv = StateVector()
+    sv.observe(1, 0)
+    assert sv.get(1) == 1
+    assert sv.covers(1, 0)
+    assert not sv.covers(1, 1)
+    sv2 = StateVector({1: 5, 2: 3})
+    merged = sv.merge(sv2)
+    assert merged.get(1) == 5 and merged.get(2) == 3
+    assert sv2.diff_dominates(sv)
+    assert not sv.diff_dominates(sv2)
+    assert StateVector({1: 0}) == StateVector({})
+
+
+def test_deleteset_ops():
+    ds = DeleteSet()
+    ds.add(1, 5, 3)
+    ds.add(1, 7, 2)  # overlaps -> [5,9)
+    ds.add(1, 20)
+    ds.normalize()
+    assert ds.ranges[1] == [(5, 9), (20, 21)]
+    assert ds.contains(1, 8)
+    assert not ds.contains(1, 9)
+    other = DeleteSet()
+    other.add(1, 9)
+    other.add(2, 0)
+    merged = ds.merge(other)
+    assert merged.ranges[1] == [(5, 10), (20, 21)]
+    assert merged.contains(2, 0)
+    assert list(other.iter_all()) == [(1, 9, 1), (2, 0, 1)]
+
+
+def test_deleteset_lazy_normalize():
+    ds = DeleteSet()
+    ds.add(1, 3)
+    ds.add(1, 1)
+    ds.add(1, 9)
+    ds.add(1, 5)
+    # queries between add() and normalize() must still be correct
+    assert ds.contains(1, 5)
+    assert ds.contains(1, 1)
+    assert not ds.contains(1, 2)
+
+
+def test_bigint_out_of_range():
+    from crdt_tpu.codec.lib0 import Encoder
+
+    with pytest.raises(TypeError):
+        Encoder().write_any(2**63)
+    e = Encoder()
+    e.write_any(2**62)  # in-range bigint fine
