@@ -118,7 +118,9 @@ class Encoder:
         elif isinstance(v, bool):  # must precede int check
             self.write_uint8(120 if v else 121)
         elif isinstance(v, int):
-            if -(2**31) <= v < 2**31:
+            # lib0 uses varInt for every JS safe integer; type 122
+            # (fixed int64 BigInt) only beyond Number.MAX_SAFE_INTEGER
+            if -(2**53) < v < 2**53:
                 self.write_uint8(125)
                 self.write_var_int(v)
             elif -(2**63) <= v < 2**63:

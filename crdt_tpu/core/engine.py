@@ -1,0 +1,571 @@
+"""Host integration engine — the exact-semantics oracle.
+
+This is the scalar reference implementation of the CRDT semantics the
+reference library gets from Yjs (``Y.applyUpdate`` at crdt.js:294 is
+the hot merge loop; ``Y.Map.set``/``Y.Array.insert`` at crdt.js:375,527
+are the local op constructors). Every TPU kernel in ``crdt_tpu.ops`` is
+differential-tested against this engine on identical columnar inputs.
+
+Semantics implemented (faithful to the YATA/Yjs behavior):
+
+- Items are unit-length, identified by (client, clock); per-client
+  clocks are contiguous. Remote items whose dependencies (origins,
+  item parent, or preceding clocks) are unknown wait in a pending set
+  — the analogue of Yjs's pending-update stash.
+- Sequences (root arrays and nested arrays) are doubly linked chains
+  including tombstones. Remote integration runs the YATA conflict
+  resolution scan: for a new item with left origin ``o`` and right
+  origin ``r``, scan the chain between them; an existing item with the
+  same left origin and a smaller client goes before the new item; with
+  the same left AND right origin and a larger client the scan stops;
+  items whose origin lies strictly inside the scanned region are
+  skipped or adopted per the items-before-origin rule.
+- Map entries per (parent, key) are chains under the same conflict
+  rule (right origin always null). The chain tail is the visible
+  entry; when a newly integrated item lands at the tail, its left
+  neighbor is tombstoned (Yjs deletes the superseded entry during
+  integrate, which keeps delete sets converging under full-state
+  exchange).
+- Deletions are tombstones recorded in a DeleteSet; remote delete
+  sets apply to known items and wait in pending ranges otherwise.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Tuple
+
+from crdt_tpu.core.ids import DeleteSet, StateVector
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.core.store import (
+    K_ANY,
+    K_DELETED,
+    K_GC,
+    K_TYPE,
+    NO_KEY,
+    NULL,
+    TYPE_ARRAY,
+    TYPE_MAP,
+    ItemStore,
+)
+
+# parent spec: ("root", name_id) or ("item", client, clock)
+ParentSpec = Tuple
+
+
+class Engine:
+    def __init__(self, client_id: int):
+        self.client_id = int(client_id)
+        self.store = ItemStore()
+        # linked chains over store rows
+        self._next: Dict[int, int] = {}  # row -> row | NULL
+        self._prev: Dict[int, int] = {}
+        self._seq_head: Dict[ParentSpec, int] = {}  # sequence chains
+        self._seq_tail: Dict[ParentSpec, int] = {}
+        self._map_head: Dict[Tuple[ParentSpec, int], int] = {}  # key chains
+        self._map_tail: Dict[Tuple[ParentSpec, int], int] = {}
+        # pending remote records / deletes waiting on dependencies
+        self.pending: List[ItemRecord] = []
+        self.pending_deletes = DeleteSet()
+        # per-client next expected clock (contiguity guard)
+        self._next_clock: Dict[int, int] = {}
+        # root name -> kind hint ("map"/"array") from observed items
+        self.root_kinds: Dict[str, str] = {}
+        # batch-local bookkeeping for observers/delta tracking
+        self.last_txn_items: List[int] = []
+        self.last_txn_deletes = DeleteSet()
+
+    # ------------------------------------------------------------------
+    # clock / id helpers
+    # ------------------------------------------------------------------
+    def next_clock(self, client: Optional[int] = None) -> int:
+        c = self.client_id if client is None else client
+        return self._next_clock.get(c, 0)
+
+    def _alloc_clock(self) -> int:
+        c = self._next_clock.get(self.client_id, 0)
+        return c
+
+    def state_vector(self) -> StateVector:
+        return StateVector(dict(self._next_clock))
+
+    def delete_set(self) -> DeleteSet:
+        return self.store.delete_set()
+
+    # ------------------------------------------------------------------
+    # parent / chain helpers
+    # ------------------------------------------------------------------
+    def _parent_spec_of_row(self, row: int) -> ParentSpec:
+        s = self.store
+        if s.parent_root[row] != NULL:
+            return ("root", int(s.parent_root[row]))
+        return ("item", int(s.parent_client[row]), int(s.parent_clock[row]))
+
+    def _chain_of_row(self, row: int):
+        """Return (head_dict, tail_dict, chain_key) for the row's chain."""
+        spec = self._parent_spec_of_row(row)
+        kid = int(self.store.key_id[row])
+        if kid != NO_KEY:
+            return self._map_head, self._map_tail, (spec, kid)
+        return self._seq_head, self._seq_tail, spec
+
+    def _root_spec(self, name: str) -> ParentSpec:
+        return ("root", self.store.intern_root(name))
+
+    # ------------------------------------------------------------------
+    # local operations (construct records, integrate through same path)
+    # ------------------------------------------------------------------
+    def _local_record(self, **kw) -> ItemRecord:
+        rec = ItemRecord(client=self.client_id, clock=self._alloc_clock(), **kw)
+        ok = self._try_integrate(rec)
+        assert ok, "local op must always be integrable"
+        return rec
+
+    def map_set(
+        self, map_name: str, key: str, value: Any, *, parent: Optional[ParentSpec] = None
+    ) -> ItemRecord:
+        """Set key in a (root or nested) map; LWW via key-chain append."""
+        spec = parent if parent is not None else self._root_spec(map_name)
+        kid = self.store.intern_key(key)
+        tail = self._map_tail.get((spec, kid))
+        origin = self.store.id_of(tail) if tail is not None else None
+        return self._local_record(
+            parent_root=map_name if spec[0] == "root" else None,
+            parent_item=(spec[1], spec[2]) if spec[0] == "item" else None,
+            key=key,
+            origin=origin,
+            right=None,
+            kind=K_ANY,
+            content=copy.deepcopy(value),
+        )
+
+    def map_set_type(
+        self, map_name: str, key: str, type_ref: int = TYPE_ARRAY,
+        *, parent: Optional[ParentSpec] = None,
+    ) -> ItemRecord:
+        """Set key to a fresh nested type (Y.Array inside a map, crdt.js:423)."""
+        spec = parent if parent is not None else self._root_spec(map_name)
+        kid = self.store.intern_key(key)
+        tail = self._map_tail.get((spec, kid))
+        origin = self.store.id_of(tail) if tail is not None else None
+        return self._local_record(
+            parent_root=map_name if spec[0] == "root" else None,
+            parent_item=(spec[1], spec[2]) if spec[0] == "item" else None,
+            key=key,
+            origin=origin,
+            right=None,
+            kind=K_TYPE,
+            type_ref=type_ref,
+        )
+
+    def map_delete(self, map_name: str, key: str, *, parent: Optional[ParentSpec] = None) -> bool:
+        """Tombstone the visible entry for key. Returns False if absent."""
+        spec = parent if parent is not None else self._root_spec(map_name)
+        kid = self.store.key_id_of(key)
+        if kid is None:
+            return False
+        tail = self._map_tail.get((spec, kid))
+        if tail is None or self.store.deleted[tail]:
+            return False
+        self._delete_row(tail)
+        return True
+
+    def seq_insert(
+        self, name: str, index: int, values: List[Any], *, parent: Optional[ParentSpec] = None
+    ) -> List[ItemRecord]:
+        """Insert values at index into a (root or nested) sequence."""
+        spec = parent if parent is not None else self._root_spec(name)
+        left = self._visible_left(spec, index)
+        out = []
+        for v in values:
+            right = self._next.get(left, NULL) if left is not None else self._seq_head.get(spec, NULL)
+            rec = self._local_record(
+                parent_root=name if spec[0] == "root" else None,
+                parent_item=(spec[1], spec[2]) if spec[0] == "item" else None,
+                key=None,
+                origin=self.store.id_of(left) if left is not None else None,
+                right=self.store.id_of(right) if right != NULL else None,
+                kind=K_ANY,
+                content=copy.deepcopy(v),
+            )
+            out.append(rec)
+            left = self.store.find(*rec.id)
+        return out
+
+    def seq_insert_type(
+        self, name: str, index: int, type_ref: int = TYPE_ARRAY,
+        *, parent: Optional[ParentSpec] = None,
+    ) -> ItemRecord:
+        """Insert a nested type into a sequence (arrays of arrays)."""
+        spec = parent if parent is not None else self._root_spec(name)
+        left = self._visible_left(spec, index)
+        right = self._next.get(left, NULL) if left is not None else self._seq_head.get(spec, NULL)
+        return self._local_record(
+            parent_root=name if spec[0] == "root" else None,
+            parent_item=(spec[1], spec[2]) if spec[0] == "item" else None,
+            key=None,
+            origin=self.store.id_of(left) if left is not None else None,
+            right=self.store.id_of(right) if right != NULL else None,
+            kind=K_TYPE,
+            type_ref=type_ref,
+        )
+
+    def seq_delete(
+        self, name: str, index: int, length: int, *, parent: Optional[ParentSpec] = None
+    ) -> int:
+        """Tombstone `length` visible items from `index`. Returns count."""
+        spec = parent if parent is not None else self._root_spec(name)
+        row = self._visible_at(spec, index)
+        count = 0
+        while row is not None and count < length:
+            nxt = self._next_visible(row)
+            self._delete_row(row)
+            count += 1
+            row = nxt
+        return count
+
+    def _visible_left(self, spec: ParentSpec, index: int) -> Optional[int]:
+        """Row of the (index-1)-th visible item, or None for index 0."""
+        if index <= 0:
+            return None
+        row = self._seq_head.get(spec, NULL)
+        seen = 0
+        while row != NULL:
+            if self._is_countable(row):
+                seen += 1
+                if seen == index:
+                    return row
+            row = self._next.get(row, NULL)
+        raise IndexError(f"index {index} out of range (len={seen})")
+
+    def _visible_at(self, spec: ParentSpec, index: int) -> Optional[int]:
+        row = self._seq_head.get(spec, NULL)
+        seen = 0
+        while row != NULL:
+            if self._is_countable(row):
+                if seen == index:
+                    return row
+                seen += 1
+            row = self._next.get(row, NULL)
+        return None
+
+    def _next_visible(self, row: int) -> Optional[int]:
+        r = self._next.get(row, NULL)
+        while r != NULL and not self._is_countable(r):
+            r = self._next.get(r, NULL)
+        return r if r != NULL else None
+
+    def _is_countable(self, row: int) -> bool:
+        # ContentFormat is not countable in Yjs (formatting markers carry
+        # no sequence position); deleted/GC rows are tombstones
+        from crdt_tpu.core.store import K_FORMAT
+
+        return not self.store.deleted[row] and self.store.kind[row] not in (
+            K_DELETED,
+            K_GC,
+            K_FORMAT,
+        )
+
+    def _delete_row(self, row: int) -> None:
+        if not self.store.deleted[row]:
+            self.store.mark_deleted(row)
+            self.last_txn_deletes.add(int(self.store.client[row]), int(self.store.clock[row]))
+
+    # ------------------------------------------------------------------
+    # remote integration
+    # ------------------------------------------------------------------
+    def apply_records(
+        self, records: List[ItemRecord], delete_set: Optional[DeleteSet] = None
+    ) -> None:
+        """Integrate a batch of remote records + delete set (applyUpdate)."""
+        self.begin_txn()
+        work = list(records)
+        work.sort(key=lambda r: (r.client, r.clock))
+        progress = True
+        while progress:
+            progress = False
+            still = []
+            for rec in work:
+                if self._try_integrate(rec):
+                    progress = True
+                else:
+                    still.append(rec)
+            work = still
+            if progress and self.pending:
+                # retry previously stashed records too
+                work.extend(self.pending)
+                self.pending = []
+                work.sort(key=lambda r: (r.client, r.clock))
+        self.pending.extend(work)
+        if delete_set is not None:
+            self._apply_delete_set(delete_set)
+        self._retry_pending_deletes()
+
+    def begin_txn(self) -> None:
+        self.last_txn_items = []
+        self.last_txn_deletes = DeleteSet()
+
+    def _apply_delete_set(self, ds: DeleteSet) -> None:
+        for client, clock, length in ds.iter_all():
+            for k in range(clock, clock + length):
+                row = self.store.find(client, k)
+                if row is None:
+                    self.pending_deletes.add(client, k)
+                else:
+                    self._delete_row(row)
+
+    def _retry_pending_deletes(self) -> None:
+        if not self.pending_deletes.ranges:
+            return
+        remaining = DeleteSet()
+        for client, clock, length in self.pending_deletes.iter_all():
+            for k in range(clock, clock + length):
+                row = self.store.find(client, k)
+                if row is None:
+                    remaining.add(client, k)
+                else:
+                    self._delete_row(row)
+        self.pending_deletes = remaining
+
+    def _try_integrate(self, rec: ItemRecord) -> bool:
+        s = self.store
+        # duplicate (already integrated) -> drop (idempotent merge)
+        if s.has(rec.client, rec.clock):
+            return True
+        # clock contiguity per client
+        if rec.clock != self._next_clock.get(rec.client, 0):
+            if rec.clock < self._next_clock.get(rec.client, 0):
+                return True  # stale duplicate below watermark
+            return False
+        # dependencies known?
+        for dep in rec.dep_ids():
+            if not s.has(*dep):
+                return False
+        if rec.kind == K_GC:
+            # positional info is gone; record clock coverage only
+            row = s.add_item(
+                rec.client, rec.clock, kind=K_GC, content=None, deleted=True
+            )
+            self._next_clock[rec.client] = rec.clock + 1
+            self.last_txn_items.append(row)
+            return True
+        # resolve parent
+        if rec.parent_root is not None:
+            spec: ParentSpec = ("root", s.intern_root(rec.parent_root))
+            self.root_kinds.setdefault(
+                rec.parent_root, "map" if rec.key is not None else "array"
+            )
+        elif rec.parent_item is not None:
+            spec = ("item", rec.parent_item[0], rec.parent_item[1])
+        else:
+            # parent implied by origin's parent (Yjs omits parent info when
+            # an origin is present)
+            oid = rec.origin if rec.origin is not None else rec.right
+            assert oid is not None, "record without parent or origin"
+            orow = s.find(*oid)
+            spec = self._parent_spec_of_row(orow)
+            if rec.key is None and s.key_id[orow] != NO_KEY:
+                rec.key = s.keys[int(s.key_id[orow])]
+        row = s.add_item(
+            rec.client,
+            rec.clock,
+            parent_root=spec[1] if spec[0] == "root" else NULL,
+            parent_id=(spec[1], spec[2]) if spec[0] == "item" else (NULL, NULL),
+            key_id=s.intern_key(rec.key) if rec.key is not None else NO_KEY,
+            origin=rec.origin or (NULL, NULL),
+            right=rec.right or (NULL, NULL),
+            kind=rec.kind,
+            type_ref=rec.type_ref if rec.type_ref is not None else NULL,
+            content=rec.content,
+            deleted=rec.kind in (K_DELETED, K_GC),
+        )
+        self._next_clock[rec.client] = rec.clock + 1
+        self.last_txn_items.append(row)
+        self._integrate_into_chain(row, rec)
+        return True
+
+    def _integrate_into_chain(self, row: int, rec: ItemRecord) -> None:
+        """YATA conflict resolution: faithful port of the integrate scan."""
+        s = self.store
+        heads, tails, ckey = self._chain_of_row(row)
+        head = heads.get(ckey, NULL)
+
+        origin_row = s.find(*rec.origin) if rec.origin is not None else None
+        left = origin_row
+        right = s.find(*rec.right) if rec.right is not None else None
+
+        o = self._next.get(left, NULL) if left is not None else head
+        conflicting: set = set()
+        items_before_origin: set = set()
+        while o != NULL and (right is None or o != right):
+            items_before_origin.add(o)
+            conflicting.add(o)
+            o_origin = (int(s.origin_client[o]), int(s.origin_clock[o]))
+            o_origin_row = (
+                s.find(*o_origin) if o_origin != (NULL, NULL) else None
+            )
+            if o_origin_row == origin_row:
+                # case 1: same left origin as ours -> order by client id
+                if int(s.client[o]) < rec.client:
+                    left = o
+                    conflicting.clear()
+                else:
+                    o_right = (int(s.right_client[o]), int(s.right_clock[o]))
+                    my_right = rec.right if rec.right is not None else (NULL, NULL)
+                    if o_right == my_right:
+                        break
+            elif o_origin_row is not None and o_origin_row in items_before_origin:
+                # case 2: o's origin is inside the scanned region
+                if o_origin_row not in conflicting:
+                    left = o
+                    conflicting.clear()
+            else:
+                break
+            o = self._next.get(o, NULL)
+
+        # splice after `left` (or at head)
+        if left is not None:
+            nxt = self._next.get(left, NULL)
+            self._next[left] = row
+            self._prev[row] = left
+        else:
+            nxt = head
+            heads[ckey] = row
+            self._prev[row] = NULL
+        self._next[row] = nxt
+        if nxt != NULL:
+            self._prev[nxt] = row
+        else:
+            tails[ckey] = row
+
+        # map-entry bookkeeping (Yjs Item.integrate): an item landing at
+        # the chain tail becomes the visible entry and tombstones its
+        # left neighbor; an item landing with a right neighbor lost the
+        # race and is tombstoned itself. Both sides of a concurrent set
+        # therefore derive the same delete set from the same op set.
+        if int(s.key_id[row]) != NO_KEY:
+            if self._next[row] == NULL:
+                if left is not None and not s.deleted[left]:
+                    self._delete_row(left)
+            else:
+                self._delete_row(row)
+
+    # ------------------------------------------------------------------
+    # materialization
+    # ------------------------------------------------------------------
+    def _value_of_row(self, row: int) -> Any:
+        s = self.store
+        if s.kind[row] == K_TYPE:
+            spec = ("item", int(s.client[row]), int(s.clock[row]))
+            if s.type_ref[row] == TYPE_MAP:
+                return self._map_json(spec)
+            return self._seq_json(spec)
+        return s.content[row]
+
+    def _map_json(self, spec: ParentSpec) -> Dict[str, Any]:
+        out = {}
+        for (sp, kid), tail in self._map_tail.items():
+            if sp == spec and not self.store.deleted[tail]:
+                out[self.store.keys[kid]] = self._value_of_row(tail)
+        return out
+
+    def _seq_json(self, spec: ParentSpec) -> List[Any]:
+        out = []
+        row = self._seq_head.get(spec, NULL)
+        while row != NULL:
+            if self._is_countable(row):
+                out.append(self._value_of_row(row))
+            row = self._next.get(row, NULL)
+        return out
+
+    def map_json(self, name: str) -> Dict[str, Any]:
+        rid = self.store.root_id(name)
+        if rid is None:
+            return {}
+        return self._map_json(("root", rid))
+
+    def seq_json(self, name: str) -> List[Any]:
+        rid = self.store.root_id(name)
+        if rid is None:
+            return []
+        return self._seq_json(("root", rid))
+
+    def map_get(self, name: str, key: str) -> Any:
+        """Visible value for key, or None (the `get` the README promised
+        but the reference never shipped — SURVEY.md D7)."""
+        rid = self.store.root_id(name)
+        kid = self.store.key_id_of(key)
+        if rid is None or kid is None:
+            return None
+        tail = self._map_tail.get((("root", rid), kid))
+        if tail is None or self.store.deleted[tail]:
+            return None
+        return self._value_of_row(tail)
+
+    def map_entry_spec(self, name: str, key: str) -> Optional[ParentSpec]:
+        """Parent spec of the visible nested type under (name, key)."""
+        rid = self.store.root_id(name)
+        kid = self.store.key_id_of(key)
+        if rid is None or kid is None:
+            return None
+        tail = self._map_tail.get((("root", rid), kid))
+        if tail is None or self.store.deleted[tail]:
+            return None
+        if self.store.kind[tail] != K_TYPE:
+            return None
+        return ("item", int(self.store.client[tail]), int(self.store.clock[tail]))
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, kind in self.root_kinds.items():
+            out[name] = self.map_json(name) if kind == "map" else self.seq_json(name)
+        return out
+
+    # ------------------------------------------------------------------
+    # export for codec / kernels
+    # ------------------------------------------------------------------
+    def records_since(self, sv: Optional[StateVector] = None) -> List[ItemRecord]:
+        """All records with clock >= sv[client] (full state when sv None)."""
+        s = self.store
+        out = []
+        for row in range(s.n):
+            client, clock = int(s.client[row]), int(s.clock[row])
+            if sv is not None and sv.covers(client, clock):
+                continue
+            parent_root = (
+                s.root_names[int(s.parent_root[row])]
+                if s.parent_root[row] != NULL
+                else None
+            )
+            parent_item = (
+                (int(s.parent_client[row]), int(s.parent_clock[row]))
+                if s.parent_root[row] == NULL and s.parent_client[row] != NULL
+                else None
+            )
+            origin = (
+                (int(s.origin_client[row]), int(s.origin_clock[row]))
+                if s.origin_client[row] != NULL
+                else None
+            )
+            right = (
+                (int(s.right_client[row]), int(s.right_clock[row]))
+                if s.right_client[row] != NULL
+                else None
+            )
+            key = s.keys[int(s.key_id[row])] if s.key_id[row] != NO_KEY else None
+            out.append(
+                ItemRecord(
+                    client=client,
+                    clock=clock,
+                    parent_root=parent_root,
+                    parent_item=parent_item,
+                    key=key,
+                    origin=origin,
+                    right=right,
+                    kind=int(s.kind[row]),
+                    type_ref=int(s.type_ref[row]),
+                    content=s.content[row],
+                )
+            )
+        out.sort(key=lambda r: (r.client, r.clock))
+        return out

@@ -39,6 +39,10 @@ K_BINARY = 3
 K_STRING = 4
 K_ANY = 5
 K_TYPE = 6
+# YText/subdoc payloads: carried for codec fidelity, not materialized
+K_EMBED = 7
+K_FORMAT = 8
+K_DOC = 9
 
 # Yjs type refs used by ContentType
 TYPE_ARRAY = 0

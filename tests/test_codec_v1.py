@@ -1,0 +1,208 @@
+"""v1 update codec tests: round-trips, run coalescing, diff updates,
+golden byte layouts, and malformed input."""
+
+import json
+import random
+
+import pytest
+
+from crdt_tpu.codec import v1
+from crdt_tpu.codec.lib0 import Decoder, Encoder
+from crdt_tpu.core.engine import Engine
+from crdt_tpu.core.ids import DeleteSet, StateVector
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.core.store import K_ANY, K_DELETED, K_GC, K_STRING, TYPE_ARRAY
+
+
+def test_state_vector_roundtrip():
+    sv = StateVector({1: 10, 7: 3, 42: 0})
+    out = v1.decode_state_vector(v1.encode_state_vector(sv))
+    assert out == sv
+    assert v1.decode_state_vector(v1.encode_state_vector(StateVector())) == StateVector()
+
+
+def test_state_vector_golden():
+    # one client: n=1, client=1, clock=5
+    assert v1.encode_state_vector(StateVector({1: 5})) == b"\x01\x01\x05"
+
+
+def test_empty_update_roundtrip():
+    blob = v1.encode_update([], None)
+    assert blob == b"\x00\x00"  # zero struct groups, zero ds clients
+    recs, ds = v1.decode_update(blob)
+    assert recs == [] and ds == DeleteSet()
+
+
+def roundtrip_engine(a: Engine) -> Engine:
+    b = Engine(999)
+    v1.apply_update(b, v1.encode_state_as_update(a))
+    return b
+
+
+def test_map_roundtrip():
+    a = Engine(1)
+    a.map_set("users", "alice", {"age": 30, "tags": ["x", "y"]})
+    a.map_set("users", "bob", None)
+    a.map_set("users", "alice", "v2")
+    a.map_delete("users", "bob")
+    b = roundtrip_engine(a)
+    assert b.to_json() == a.to_json()
+    assert b.state_vector() == a.state_vector()
+    assert b.delete_set() == a.delete_set()
+
+
+def test_array_roundtrip_with_runs():
+    a = Engine(1)
+    a.seq_insert("log", 0, list(range(50)))  # one run of 50 on the wire
+    a.seq_insert("log", 10, ["mid"])
+    a.seq_delete("log", 0, 5)
+    blob = v1.encode_state_as_update(a)
+    # run coalescing: 52 unit items must encode as few structs
+    d = Decoder(blob)
+    d.read_var_uint()  # num clients
+    num_structs = d.read_var_uint()
+    assert num_structs <= 4
+    b = roundtrip_engine(a)
+    assert b.seq_json("log") == a.seq_json("log")
+    assert b.delete_set() == a.delete_set()
+
+
+def test_nested_type_roundtrip():
+    a = Engine(1)
+    a.map_set_type("m", "list", TYPE_ARRAY)
+    spec = a.map_entry_spec("m", "list")
+    a.seq_insert("", 0, [1, [2, 3], {"k": "v"}], parent=spec)
+    b = roundtrip_engine(a)
+    assert b.to_json() == a.to_json() == {"m": {"list": [1, [2, 3], {"k": "v"}]}}
+
+
+def test_diff_update():
+    a, b = Engine(1), Engine(2)
+    a.map_set("m", "x", 1)
+    v1.apply_update(b, v1.encode_state_as_update(a))
+    a.map_set("m", "y", 2)
+    a.seq_insert("s", 0, ["new"])
+    # delta vs b's state vector: only the new items
+    delta = v1.encode_state_as_update(a, b.state_vector())
+    full = v1.encode_state_as_update(a)
+    assert len(delta) < len(full)
+    v1.apply_update(b, delta)
+    assert b.to_json() == a.to_json()
+
+
+def test_bidirectional_codec_sync():
+    a, b = Engine(1), Engine(2)
+    a.map_set("m", "k", "a")
+    b.map_set("m", "k", "b")
+    b.seq_insert("s", 0, ["b0"])
+    ua, ub = v1.encode_state_as_update(a), v1.encode_state_as_update(b)
+    v1.apply_update(b, ua)
+    v1.apply_update(a, ub)
+    assert a.to_json() == b.to_json()
+    assert a.map_get("m", "k") == "b"  # higher client wins same-origin
+
+
+def test_reencode_stability():
+    a = Engine(3)
+    a.seq_insert("s", 0, ["a", "b", "c"])
+    a.map_set("m", "k", 1)
+    blob = v1.encode_state_as_update(a)
+    recs, ds = v1.decode_update(blob)
+    blob2 = v1.encode_update(recs, ds)
+    assert blob == blob2  # decode∘encode is a fixpoint
+
+
+def test_gc_and_skip_structs():
+    # hand-build: client 5 with [GC len 3][Skip len 4][Any "x" at clock 7]
+    e = Encoder()
+    e.write_var_uint(1)  # one client group
+    e.write_var_uint(3)  # three structs
+    e.write_var_uint(5)  # client
+    e.write_var_uint(0)  # start clock
+    e.write_uint8(v1.REF_GC)
+    e.write_var_uint(3)
+    e.write_uint8(v1.REF_SKIP)
+    e.write_var_uint(4)
+    e.write_uint8(v1.REF_ANY | 0x20)  # parent + sub follow (no origins)
+    e.write_var_uint(1)  # parent is root
+    e.write_var_string("m")
+    e.write_var_string("k")
+    e.write_var_uint(1)  # one any value
+    e.write_any("x")
+    e.write_var_uint(0)  # empty delete set
+    recs, ds = v1.decode_update(e.to_bytes())
+    assert [r.kind for r in recs] == [K_GC, K_GC, K_GC, K_ANY]
+    assert [r.clock for r in recs] == [0, 1, 2, 7]
+    assert recs[3].key == "k" and recs[3].parent_root == "m"
+    # re-encode preserves the gap with a Skip struct
+    blob2 = v1.encode_update(recs, ds)
+    recs2, _ = v1.decode_update(blob2)
+    assert [(r.clock, r.kind) for r in recs2] == [(r.clock, r.kind) for r in recs]
+
+
+def test_string_content_utf16():
+    # ContentString run with an astral char (2 UTF-16 units -> 2 clocks)
+    e = Encoder()
+    e.write_var_uint(1)
+    e.write_var_uint(1)
+    e.write_var_uint(9)
+    e.write_var_uint(0)
+    e.write_uint8(v1.REF_STRING | 0x20)
+    e.write_var_uint(1)
+    e.write_var_string("t")
+    e.write_var_string("sub")
+    e.write_var_string("a\U0001F600b")
+    e.write_var_uint(0)
+    recs, _ = v1.decode_update(e.to_bytes())
+    assert len(recs) == 4  # 'a', high surrogate, low surrogate, 'b'
+    assert all(r.kind == K_STRING for r in recs)
+    blob2 = v1.encode_update(recs, None)
+    recs2, _ = v1.decode_update(blob2)
+    from crdt_tpu.codec.v1 import _join_utf16
+
+    assert _join_utf16([r.content for r in recs2]) == "a\U0001F600b"
+
+
+def test_delete_set_roundtrip():
+    ds = DeleteSet()
+    ds.add(1, 0, 5)
+    ds.add(1, 10, 1)
+    ds.add(9, 3, 2)
+    blob = v1.encode_update([], ds)
+    _, out = v1.decode_update(blob)
+    assert out == ds
+
+
+def test_malformed_rejected():
+    with pytest.raises(ValueError):
+        v1.decode_update(b"\x01")  # truncated
+    with pytest.raises(ValueError):
+        v1.decode_update(b"\x00\x00\xff")  # trailing bytes
+    # unknown ref id
+    e = Encoder()
+    e.write_var_uint(1)
+    e.write_var_uint(1)
+    e.write_var_uint(1)
+    e.write_var_uint(0)
+    e.write_uint8(31)  # ref 31 unused
+    with pytest.raises(ValueError):
+        v1.decode_update(e.to_bytes())
+
+
+def test_fuzz_codec_convergence():
+    from tests.test_engine import _random_op
+
+    rng = random.Random(77)
+    for _ in range(5):
+        engines = [Engine(i + 1) for i in range(3)]
+        for _ in range(80):
+            _random_op(rng, rng.choice(engines), engines)
+        # sync exclusively through wire blobs
+        for _ in range(2):
+            blobs = [v1.encode_state_as_update(e) for e in engines]
+            for i, e in enumerate(engines):
+                for j, blob in enumerate(blobs):
+                    if i != j:
+                        v1.apply_update(e, blob)
+        jsons = [e.to_json() for e in engines]
+        assert jsons[1] == jsons[0] and jsons[2] == jsons[0]

@@ -96,7 +96,8 @@ def test_var_string_roundtrip():
         -1,
         2**30,
         -(2**30),
-        2**40,  # bigint path
+        2**40,
+        2**54,  # bigint path
         0.5,
         1.25,  # exact float32
         0.1,  # needs float64
@@ -128,7 +129,8 @@ def test_any_type_bytes():
     assert tag(5) == 125
     assert tag(0.5) == 124
     assert tag(0.1) == 123
-    assert tag(2**40) == 122
+    assert tag(2**40) == 125  # safe ints stay varInt
+    assert tag(2**54) == 122
     assert tag(False) == 121
     assert tag(True) == 120
     assert tag("s") == 119
