@@ -515,6 +515,22 @@ class Engine:
             return None
         return ("item", int(self.store.client[tail]), int(self.store.clock[tail]))
 
+    def map_winner_table(self) -> Dict[Tuple, Tuple[Tuple[int, int], bool]]:
+        """{(parent, key): (winner id, visible)} over every map chain —
+        the oracle view the LWW kernel is differential-tested against.
+        Parent is ("root", name) or ("item", client, clock)."""
+        out: Dict[Tuple, Tuple[Tuple[int, int], bool]] = {}
+        for (spec, kid), tail in self._map_tail.items():
+            if spec[0] == "root":
+                parent = ("root", self.store.root_names[spec[1]])
+            else:
+                parent = ("item", spec[1], spec[2])
+            out[(parent, self.store.keys[kid])] = (
+                self.store.id_of(tail),
+                not bool(self.store.deleted[tail]),
+            )
+        return out
+
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         for name, kind in self.root_kinds.items():

@@ -1,0 +1,21 @@
+"""Device (JAX/XLA/Pallas) kernels for the CRDT merge hot path.
+
+The reference's merge hot loop is ``Y.applyUpdate`` (crdt.js:294) —
+a scalar pointer-chasing integrate per item. Here the same semantics
+run as vectorized kernels over columnar op tensors:
+
+- ``lww``       map winner selection (segmented scatter-max over the
+                origin tree + pointer doubling to the chain tail)
+- ``statevec``  state-vector construction / diff masks / merges
+- ``deleteset`` tombstone application from delete ranges
+- ``merge``     end-to-end batched fan-in merge (dedup -> segment ->
+                winner -> visibility) for N-replica convergence
+"""
+
+# Packed item IDs ((client, clock) in one sortable int64 word) need
+# 64-bit integers on device. The library never flips the global
+# jax_enable_x64 flag (that would change dtypes for the whole host
+# application); public wrappers scope it with
+# jax.experimental.enable_x64, and callers invoking the jitted kernels
+# directly must do the same (tests enable it harness-wide).
+from crdt_tpu.ops import deleteset, lww, merge, statevec  # noqa: F401

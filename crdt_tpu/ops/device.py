@@ -1,0 +1,93 @@
+"""Shared device-side helpers: ID packing, lexsort, dense ranks.
+
+Conventions for all kernels in this package:
+
+- Inputs are flat int32/int64/bool arrays of equal length N (static
+  shape; callers pad with ``valid=False`` rows).
+- Item IDs (client, clock) are packed into one int64 so sorting,
+  dedup, and binary search are single-key operations. Limits:
+  client < 2**22, clock < 2**40 — far beyond the workloads the
+  framework targets (the north-star config is 1k replicas x 100k ops).
+- ``NULLI = -1`` marks absent references; packed null IDs sort below
+  every real ID.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NULLI = -1
+_CLOCK_BITS = 40
+
+
+def pack_id(client: jnp.ndarray, clock: jnp.ndarray) -> jnp.ndarray:
+    """(client, clock) -> single sortable int64; null (-1,*) -> -1."""
+    packed = (client.astype(jnp.int64) << _CLOCK_BITS) | clock.astype(jnp.int64)
+    return jnp.where(client < 0, jnp.int64(NULLI), packed)
+
+
+def unpack_id(packed: jnp.ndarray):
+    client = jnp.where(packed < 0, NULLI, packed >> _CLOCK_BITS).astype(jnp.int32)
+    clock = jnp.where(packed < 0, NULLI, packed & ((1 << _CLOCK_BITS) - 1)).astype(
+        jnp.int64
+    )
+    return client, clock
+
+
+def lexsort(keys) -> jnp.ndarray:
+    """argsort by multiple keys; keys[0] is most significant.
+
+    Built from iterated stable argsorts (least-significant first), the
+    classic radix-style composition XLA handles well.
+    """
+    order = jnp.argsort(keys[-1], stable=True)
+    for k in reversed(keys[:-1]):
+        order = order[jnp.argsort(k[order], stable=True)]
+    return order
+
+
+def dense_ranks_sorted(sorted_key: jnp.ndarray) -> jnp.ndarray:
+    """Dense 0..S-1 rank per element of an ALREADY SORTED key array."""
+    new_seg = jnp.concatenate(
+        [
+            jnp.zeros(1, jnp.int32),
+            (sorted_key[1:] != sorted_key[:-1]).astype(jnp.int32),
+        ]
+    )
+    return jnp.cumsum(new_seg).astype(jnp.int32)
+
+
+def searchsorted_ids(sorted_ids: jnp.ndarray, query: jnp.ndarray) -> jnp.ndarray:
+    """Index of each query id in sorted_ids, or NULLI if absent."""
+    pos = jnp.searchsorted(sorted_ids, query)
+    pos_c = jnp.clip(pos, 0, sorted_ids.shape[0] - 1)
+    found = (sorted_ids.shape[0] > 0) & (sorted_ids[pos_c] == query) & (query >= 0)
+    return jnp.where(found, pos_c, NULLI).astype(jnp.int32)
+
+
+def pointer_double(f: jnp.ndarray) -> jnp.ndarray:
+    """Iterate f <- f∘f to a fixpoint. `f` maps node->node with
+    self-loops at terminals; returns the terminal reached from each
+    node in O(log depth) gather rounds.
+
+    The iteration count is hard-bounded at ceil(log2(n))+1: any valid
+    forest converges by then, and a malformed input whose pointers form
+    a cycle (e.g. a hostile update with cyclic origins) terminates
+    instead of spinning the device forever — cycle members simply keep
+    an in-cycle value, which downstream visibility checks treat like
+    any other non-root result."""
+    n = f.shape[0]
+    max_iters = max(1, (max(n, 2) - 1).bit_length() + 1)
+
+    def body(state):
+        g, it, _ = state
+        g2 = g[g]
+        return g2, it + 1, jnp.any(g2 != g)
+
+    def cond(state):
+        _, it, changed = state
+        return changed & (it < max_iters)
+
+    g, _, _ = jax.lax.while_loop(cond, body, (f, jnp.int32(0), jnp.array(True)))
+    return g

@@ -1,0 +1,78 @@
+"""Map last-writer-wins winner kernel.
+
+Semantics being computed (must match ``Engine`` exactly): a map key's
+visible entry is the **tail of its YATA key chain** — the chain is a
+tree (each item's origin is an earlier item of the same key or null),
+siblings are ordered by ascending client id, and the final order is the
+depth-first traversal. The tail is therefore the node reached from the
+virtual root by repeatedly stepping to the **maximum-client child**.
+
+Kernel shape (all vectorized, no data-dependent Python control flow):
+
+1. scatter-max: for every item, pack (client, item_index) and
+   scatter-max into its parent slot -> max-client child per node.
+2. pointer doubling over the max-child function -> rightmost
+   descendant (= chain tail) of every node in O(log depth) rounds.
+3. gather per-segment winner from each segment's virtual root.
+
+This is the "segmented argmax over Lamport clocks" of the north star
+(BASELINE.json), done exactly: a plain per-key argmax over (clock,
+client) would disagree with Yjs whenever concurrent branches of
+different depths exist; the tree argmax + pointer doubling is both
+vectorized and exact.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from crdt_tpu.ops.device import NULLI, pointer_double
+
+
+def map_winners(
+    seg: jnp.ndarray,  # [N] int32 dense segment id per item (-1 = not a map item)
+    client: jnp.ndarray,  # [N] int32
+    origin_idx: jnp.ndarray,  # [N] int32 index of origin item, NULLI if none
+    valid: jnp.ndarray,  # [N] bool
+    num_segments: int,  # static
+):
+    """Winner item index per segment (NULLI for empty segments).
+
+    ``origin_idx`` must point within the same segment (the engine
+    guarantees this for map chains); cross-segment or missing origins
+    are treated as segment roots, matching host integration of items
+    whose origins were garbage-collected.
+    """
+    n = client.shape[0]
+    m = n + num_segments  # item nodes + one virtual root per segment
+    is_map = valid & (seg >= 0)
+
+    # child -> parent edges; roots hang off their segment's virtual root
+    origin_ok = (origin_idx >= 0) & is_map
+    origin_seg = jnp.where(origin_ok, seg[jnp.clip(origin_idx, 0, n - 1)], NULLI)
+    same_seg = origin_ok & (origin_seg == seg)
+    parent = jnp.where(same_seg, origin_idx, n + seg)
+    parent = jnp.where(is_map, parent, 0)  # dummy slot for non-map rows
+
+    # scatter-max of (client, index) packed -> max-client child per node
+    pack = jnp.where(
+        is_map,
+        (client.astype(jnp.int64) << 32) | jnp.arange(n, dtype=jnp.int64),
+        jnp.int64(-1),
+    )
+    best = jnp.full(m, -1, dtype=jnp.int64).at[parent].max(pack, mode="drop")
+
+    # max-child function with self-loops at leaves
+    has_child = best >= 0
+    child_idx = (best & 0xFFFFFFFF).astype(jnp.int32)
+    f = jnp.where(has_child, child_idx, jnp.arange(m, dtype=jnp.int32))
+
+    tail = pointer_double(f)
+
+    root_tail = tail[n:]
+    winners = jnp.where(
+        root_tail == jnp.arange(n, n + num_segments, dtype=jnp.int32),
+        NULLI,
+        root_tail,
+    )
+    return winners

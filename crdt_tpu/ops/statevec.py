@@ -1,0 +1,54 @@
+"""State-vector kernels.
+
+The reference calls ``Y.encodeStateVector`` per sync and diffs docs
+against peer vectors one at a time (crdt.js:239,258-260,288). Here
+state vectors are dense ``[num_clients]`` next-clock arrays and the
+whole replica set is processed at once:
+
+- ``build``     items -> state vector (scatter-max of clock+1)
+- ``diff_mask`` which items a peer above `sv` still needs
+- ``merge``     [R, C] vectors -> componentwise max (anti-entropy join)
+- ``missing``   pairwise [R, R, C] "what does i have that j lacks"
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def build(
+    client: jnp.ndarray, clock: jnp.ndarray, valid: jnp.ndarray, num_clients: int
+) -> jnp.ndarray:
+    """Next-clock per client. Assumes per-client clocks are contiguous
+    (integration enforces this; see ItemStore.state_vector for the
+    host-side gap-honest variant)."""
+    nxt = jnp.where(valid, clock + 1, 0)
+    cl = jnp.where(valid, client, 0)
+    return jnp.zeros(num_clients, clock.dtype).at[cl].max(nxt, mode="drop")
+
+
+def diff_mask(
+    client: jnp.ndarray, clock: jnp.ndarray, valid: jnp.ndarray, sv: jnp.ndarray
+) -> jnp.ndarray:
+    """True for items NOT covered by `sv` — the delta a peer needs
+    (the syncer path, crdt.js:288). A client outside the vector's
+    width is one the peer has never seen: watermark 0."""
+    known = client < sv.shape[0]
+    watermark = jnp.where(known, sv[jnp.clip(client, 0, sv.shape[0] - 1)], 0)
+    return valid & (clock >= watermark)
+
+
+def merge(svs: jnp.ndarray) -> jnp.ndarray:
+    """[R, C] -> [C] componentwise max."""
+    return jnp.max(svs, axis=0)
+
+
+def missing(svs: jnp.ndarray) -> jnp.ndarray:
+    """[R, C] -> [R, R] total clocks replica i has that j lacks.
+
+    The full-mesh generalization of the per-peer handshake: entry
+    (i, j) > 0 means i should send a delta to j.
+    """
+    # deficit[i, j, c] = max(sv[i, c] - sv[j, c], 0)
+    deficit = jnp.maximum(svs[:, None, :] - svs[None, :, :], 0)
+    return deficit.sum(axis=-1)

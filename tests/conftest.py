@@ -20,6 +20,17 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # persistent XLA compilation cache: kernel tests compile each shape
+    # bucket once per machine instead of once per run
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/crdt_tpu_jax_cache")
+    # tests drive the jitted kernels directly with packed int64 ids
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 @pytest.fixture(autouse=True)
 def _seed_rngs():
     random.seed(0)

@@ -1,0 +1,253 @@
+"""Differential tests: device kernels vs the host oracle on the same
+columnar inputs (SURVEY.md §7 stage 3: validate every kernel against
+stage 2)."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.core.engine import Engine
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.ops import deleteset as ds_ops
+from crdt_tpu.ops import statevec
+from crdt_tpu.ops.device import (
+    NULLI,
+    dense_ranks_sorted,
+    lexsort,
+    pack_id,
+    pointer_double,
+    searchsorted_ids,
+    unpack_id,
+)
+from crdt_tpu.ops.merge import merge_records
+
+
+# ---------------------------------------------------------------------------
+# primitive helpers
+# ---------------------------------------------------------------------------
+
+def test_pack_unpack_roundtrip():
+    clients = jnp.array([0, 1, 2**20, -1], jnp.int32)
+    clocks = jnp.array([0, 5, 2**35, -1], jnp.int64)
+    packed = pack_id(clients, clocks)
+    assert int(packed[3]) == NULLI
+    c, k = unpack_id(packed)
+    assert list(c[:3]) == [0, 1, 2**20]
+    assert list(k[:3]) == [0, 5, 2**35]
+    # ordering: (client, clock) lexicographic == packed numeric
+    a = pack_id(jnp.array([1], jnp.int32), jnp.array([2**39], jnp.int64))
+    b = pack_id(jnp.array([2], jnp.int32), jnp.array([0], jnp.int64))
+    assert int(a[0]) < int(b[0])
+
+
+def test_lexsort_matches_numpy():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 5, 100)
+    b = rng.integers(0, 5, 100)
+    c = rng.integers(0, 5, 100)
+    got = np.asarray(lexsort([jnp.asarray(a), jnp.asarray(b), jnp.asarray(c)]))
+    want = np.lexsort((c, b, a))  # numpy: last key most significant
+    assert np.array_equal(got, want)
+
+
+def test_dense_ranks():
+    key = jnp.array([3, 3, 5, 7, 7, 7])
+    assert list(dense_ranks_sorted(key)) == [0, 0, 1, 2, 2, 2]
+
+
+def test_searchsorted_ids():
+    ids = jnp.array([2, 4, 9], jnp.int64)
+    q = jnp.array([4, 3, 9, -1], jnp.int64)
+    assert list(searchsorted_ids(ids, q)) == [1, NULLI, 2, NULLI]
+
+
+def test_pointer_double_chain():
+    # chain 0->1->2->3 (self-loop at 3), plus isolated 4
+    f = jnp.array([1, 2, 3, 3, 4], jnp.int32)
+    assert list(pointer_double(f)) == [3, 3, 3, 3, 4]
+
+
+# ---------------------------------------------------------------------------
+# state vector kernels
+# ---------------------------------------------------------------------------
+
+def test_statevec_build_and_diff():
+    client = jnp.array([0, 0, 1, 2, 0], jnp.int32)
+    clock = jnp.array([0, 1, 0, 4, 99], jnp.int64)
+    valid = jnp.array([1, 1, 1, 1, 0], bool)
+    sv = statevec.build(client, clock, valid, 4)
+    assert list(sv) == [2, 1, 5, 0]
+    mask = statevec.diff_mask(client, clock, valid, jnp.array([1, 0, 5, 0], jnp.int64))
+    assert list(mask) == [False, True, True, False, False]
+
+
+def test_statevec_merge_missing():
+    svs = jnp.array([[3, 0], [1, 2], [0, 0]], jnp.int64)
+    assert list(statevec.merge(svs)) == [3, 2]
+    miss = statevec.missing(svs)
+    # replica 0 has 2 clocks replica 1 lacks; r1 has 2 clocks r0 lacks
+    assert miss[0, 1] == 2 and miss[1, 0] == 2
+    assert miss[0, 2] == 3 and miss[2, 0] == 0
+    assert miss[0, 0] == 0
+
+
+# ---------------------------------------------------------------------------
+# delete-set kernel
+# ---------------------------------------------------------------------------
+
+def test_deleteset_mask_matches_host():
+    rng = random.Random(5)
+    ds = DeleteSet()
+    for _ in range(30):
+        ds.add(rng.randrange(4), rng.randrange(50), rng.randint(1, 5))
+    ds.normalize()
+    items = [(rng.randrange(4), rng.randrange(70)) for _ in range(300)]
+    c, s, e = ds_ops.ranges_to_device(ds)
+    mask = ds_ops.apply_mask(
+        jnp.array([i[0] for i in items], jnp.int32),
+        jnp.array([i[1] for i in items], jnp.int64),
+        jnp.ones(len(items), bool),
+        jnp.array(c, jnp.int32),
+        jnp.array(s, jnp.int64),
+        jnp.array(e, jnp.int64),
+    )
+    for (cl, ck), m in zip(items, np.asarray(mask)):
+        assert bool(m) == ds.contains(cl, ck), (cl, ck)
+
+
+def test_deleteset_mask_empty():
+    mask = ds_ops.apply_mask(
+        jnp.array([0], jnp.int32),
+        jnp.array([0], jnp.int64),
+        jnp.array([True]),
+        jnp.array([], jnp.int32),
+        jnp.array([], jnp.int64),
+        jnp.array([], jnp.int64),
+    )
+    assert not bool(mask[0])
+
+
+# ---------------------------------------------------------------------------
+# LWW merge kernel vs oracle
+# ---------------------------------------------------------------------------
+
+def union_of(engines):
+    """Records + delete-set union as a full-state gossip fan-in would see."""
+    recs, ds = [], DeleteSet()
+    for e in engines:
+        recs.extend(e.records_since(None))
+        ds = ds.merge(e.delete_set())
+    return recs, ds
+
+
+def oracle_merge(engines):
+    o = Engine(10**6)
+    for e in engines:
+        o.apply_records(e.records_since(None), e.delete_set())
+    return o
+
+
+def check_against_oracle(engines):
+    recs, ds = union_of(engines)
+    got = merge_records(recs, ds)
+    oracle = oracle_merge(engines)
+    want = oracle.map_winner_table()
+    got_ids = {k: (v[0].id, v[1]) for k, v in got.items()}
+    assert got_ids == want
+    return got, oracle
+
+
+def test_merge_single_replica():
+    e = Engine(1)
+    e.map_set("m", "a", 1)
+    e.map_set("m", "b", 2)
+    e.map_set("m", "a", 3)
+    e.map_delete("m", "b")
+    check_against_oracle([e])
+
+
+def test_merge_concurrent_two_replicas():
+    a, b = Engine(1), Engine(2)
+    a.map_set("m", "k", "a1")
+    b.map_set("m", "k", "b1")
+    b.map_set("m", "j", "b2")
+    check_against_oracle([a, b])
+
+
+def test_merge_with_causal_chains():
+    a, b = Engine(1), Engine(2)
+    a.map_set("m", "k", "v1")
+    b.apply_records(a.records_since(None), a.delete_set())
+    b.map_set("m", "k", "v2")  # causally after, lower-client-wins check
+    a.map_set("m", "k", "v3")  # concurrent with b's
+    check_against_oracle([a, b])
+
+
+def test_merge_delete_visibility():
+    a, b = Engine(1), Engine(2)
+    a.map_set("m", "k", "v")
+    b.apply_records(a.records_since(None), a.delete_set())
+    b.map_delete("m", "k")
+    got, _ = check_against_oracle([a, b])
+    (rec, visible) = got[(("root", "m"), "k")]
+    assert not visible
+
+
+def test_merge_nested_map_parents():
+    from crdt_tpu.core.store import TYPE_MAP
+
+    a = Engine(1)
+    a.map_set_type("m", "sub", TYPE_MAP)
+    spec = a.map_entry_spec("m", "sub")
+    a.map_set("", "inner", 42, parent=spec)
+    check_against_oracle([a])
+
+
+def test_merge_fuzz_vs_oracle():
+    rng = random.Random(321)
+    for trial in range(10):
+        n = rng.choice([2, 3, 6])
+        engines = [Engine(i + 1) for i in range(n)]
+        for _ in range(150):
+            e = rng.choice(engines)
+            op = rng.randrange(4)
+            if op == 0:
+                e.map_set("m", rng.choice("abcdef"), rng.randrange(1000))
+            elif op == 1:
+                e.map_delete("m", rng.choice("abcdef"))
+            elif op == 2:
+                e.map_set(rng.choice("xyz"), rng.choice("ab"), rng.randrange(10))
+            else:
+                src = rng.choice(engines)
+                if src is not e:
+                    e.apply_records(src.records_since(None), src.delete_set())
+        check_against_oracle(engines)
+
+
+def test_merge_idempotent_duplicates():
+    a, b = Engine(1), Engine(2)
+    a.map_set("m", "k", 1)
+    b.map_set("m", "k", 2)
+    recs, ds = union_of([a, b])
+    got = merge_records(recs + recs + recs, ds)  # triplicate union
+    oracle = oracle_merge([a, b])
+    got_ids = {k: (v[0].id, v[1]) for k, v in got.items()}
+    assert got_ids == oracle.map_winner_table()
+
+
+def test_pointer_double_cycle_terminates():
+    # malformed (cyclic) input must terminate, not hang the device
+    out = pointer_double(jnp.array([1, 2, 0], jnp.int32))
+    assert out.shape == (3,)
+
+
+def test_diff_mask_unknown_client():
+    # a client beyond the peer vector's width has watermark 0
+    m = statevec.diff_mask(
+        jnp.array([5], jnp.int32),
+        jnp.array([2], jnp.int64),
+        jnp.array([True]),
+        jnp.array([3, 1, 0, 7], jnp.int64),
+    )
+    assert bool(m[0])
