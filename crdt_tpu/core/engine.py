@@ -515,16 +515,35 @@ class Engine:
             return None
         return ("item", int(self.store.client[tail]), int(self.store.clock[tail]))
 
+    def _public_parent(self, spec: ParentSpec) -> Tuple:
+        """Interned parent spec -> the symbolic parent key used by the
+        kernel wrappers: ("root", name) or ("item", client, clock)."""
+        if spec[0] == "root":
+            return ("root", self.store.root_names[spec[1]])
+        return ("item", spec[1], spec[2])
+
+    def seq_order_table(self) -> Dict[Tuple, List[Tuple[int, int]]]:
+        """{parent: [item ids in chain order, tombstones included]} for
+        every sequence — the oracle view the YATA kernel is tested
+        against."""
+        out: Dict[Tuple, List[Tuple[int, int]]] = {}
+        for spec, head in self._seq_head.items():
+            parent = self._public_parent(spec)
+            ids = []
+            row = head
+            while row != NULL:
+                ids.append(self.store.id_of(row))
+                row = self._next.get(row, NULL)
+            out[parent] = ids
+        return out
+
     def map_winner_table(self) -> Dict[Tuple, Tuple[Tuple[int, int], bool]]:
         """{(parent, key): (winner id, visible)} over every map chain —
         the oracle view the LWW kernel is differential-tested against.
         Parent is ("root", name) or ("item", client, clock)."""
         out: Dict[Tuple, Tuple[Tuple[int, int], bool]] = {}
         for (spec, kid), tail in self._map_tail.items():
-            if spec[0] == "root":
-                parent = ("root", self.store.root_names[spec[1]])
-            else:
-                parent = ("item", spec[1], spec[2])
+            parent = self._public_parent(spec)
             out[(parent, self.store.keys[kid])] = (
                 self.store.id_of(tail),
                 not bool(self.store.deleted[tail]),
