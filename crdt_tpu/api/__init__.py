@@ -1,0 +1,3 @@
+from crdt_tpu.api.doc import Crdt, ReservedNameError, WrongKindError
+
+__all__ = ["Crdt", "ReservedNameError", "WrongKindError"]

@@ -1,0 +1,456 @@
+"""Document/API layer — the reference's public surface (L4+L5).
+
+Reproduces the op layer and friendly API of the reference
+(`/root/reference/crdt.js:325-702`): named map/array collections over
+one shared document, a plain-JSON read cache ``c`` with attribute
+fallthrough (the reference's Proxy, crdt.js:688-693), a batch queue
+drained by ``exec_batch`` in a single transaction (crdt.js:325-355),
+an index map ``ix`` registering collection kinds (crdt.js:201,205),
+and per-collection observers (crdt.js:620-657).
+
+Documented divergences from the reference (SURVEY.md §6 — all defects
+fixed rather than replicated):
+
+- D1: non-batch ``unshift``/``cut`` actually mutate (the reference's
+  else-branch skips ``operation()``, crdt.js:583-588,609-614).
+- D2: nested-array validation works (the reference calls the
+  nonexistent ``Array.prototype.contains``, crdt.js:411).
+- D3: collections created remotely appear in the cache (the reference
+  iterates its own stale index, crdt.js:297-305).
+- D4: ``exec_batch`` on an empty queue returns instead of hanging
+  (crdt.js:330-331).
+- D7: ``get`` exists (README.md:83 promises it, the code lacks it);
+  ``insert`` takes ``(name, index, value)`` in the README's order
+  (the code's is val-then-index, crdt.js:521).
+- Q1: observers fire on local mutations too, tagged with ``origin``
+  (the reference only fires on remote updates, crdt.js:308-310).
+- Q2: updates emitted per op are true deltas (new items + delete-set
+  delta of the transaction); ``full_state_updates=True`` restores the
+  reference's full-state-per-op broadcast behavior (crdt.js:443).
+"""
+
+from __future__ import annotations
+
+import copy
+from types import MappingProxyType
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from crdt_tpu.codec import v1
+from crdt_tpu.core.engine import Engine, ParentSpec
+from crdt_tpu.core.ids import DeleteSet, StateVector
+from crdt_tpu.core.store import NULL, TYPE_ARRAY
+
+# names the reference refuses to use as collection names (crdt.js:320,365)
+RESERVED_NAMES = ("ix", "doc")
+
+ARRAY_METHODS = ("insert", "push", "unshift", "cut")
+
+
+class ReservedNameError(ValueError):
+    pass
+
+
+class WrongKindError(TypeError):
+    pass
+
+
+class _Observer:
+    __slots__ = ("name", "key", "func")
+
+    def __init__(self, name: str, key: Optional[str], func: Callable):
+        self.name = name
+        self.key = key
+        self.func = func
+
+
+class Crdt:
+    """One replica's document + API.
+
+    Transport and persistence attach through two hooks:
+
+    - ``on_update(update_bytes, meta)`` — called after every non-batch
+      op and every ``exec_batch`` with the encoded v1 update (the
+      reference's persist+propagate tail, crdt.js:442-446).
+    - ``observer_function(event)`` — the reference's coarse observer
+      (crdt.js:308-310), fired with a dict carrying the frozen cache.
+    """
+
+    def __init__(
+        self,
+        client_id: int,
+        *,
+        observer_function: Optional[Callable[[dict], None]] = None,
+        on_update: Optional[Callable[[bytes, dict], None]] = None,
+        full_state_updates: bool = False,
+    ):
+        self.engine = Engine(client_id)
+        self.observer_function = observer_function
+        self.on_update = on_update
+        self.full_state_updates = full_state_updates
+        self._c: Dict[str, Any] = {}
+        self._batched: List[Callable[[], Any]] = []
+        self._observers: List[_Observer] = []
+
+    # ------------------------------------------------------------------
+    # cache / reads (the reference's Proxy + frozen `c`, crdt.js:661-702)
+    # ------------------------------------------------------------------
+    @property
+    def c(self):
+        """Read-only snapshot cache (``Object.freeze({...c})``)."""
+        return MappingProxyType(self._c)
+
+    def __getattr__(self, prop: str) -> Any:
+        # Proxy fallthrough: unknown property reads hit the cache
+        # (crdt.js:691: `return target.c[prop]`)
+        try:
+            return self.__dict__["_c"][prop]
+        except KeyError:
+            raise AttributeError(prop) from None
+
+    def __getitem__(self, prop: str) -> Any:
+        return self._c[prop]
+
+    def __contains__(self, prop: str) -> bool:
+        return prop in self._c
+
+    def __repr__(self) -> str:
+        # the reference's custom inspect prints the cache (crdt.js:696)
+        return f"Crdt(client={self.engine.client_id}, c={self._c!r})"
+
+    def get(self, name: str, key: Optional[str] = None) -> Any:
+        """Visible value — the method README.md:83 documents but the
+        reference never shipped (D7)."""
+        if key is None:
+            return copy.deepcopy(self._c.get(name))
+        return copy.deepcopy(self.engine.map_get(name, key))
+
+    def state_vector(self) -> StateVector:
+        return self.engine.state_vector()
+
+    def encode_state_vector(self) -> bytes:
+        return v1.encode_state_vector_of(self.engine)
+
+    def encode_state_as_update(self, sv: Optional[StateVector] = None) -> bytes:
+        return v1.encode_state_as_update(self.engine, sv)
+
+    # ------------------------------------------------------------------
+    # guards
+    # ------------------------------------------------------------------
+    def _check_name(self, name: str) -> None:
+        if not isinstance(name, str) or not name:
+            raise ValueError("collection name must be a non-empty string")
+        if name in RESERVED_NAMES:
+            raise ReservedNameError(
+                f"'{name}' is reserved (crdt.js:320,365)"
+            )
+
+    def _kind_of(self, name: str) -> Optional[str]:
+        kind = self.engine.map_get("ix", name)
+        if kind is not None:
+            return kind
+        return self.engine.root_kinds.get(name)
+
+    def _check_kind(self, name: str, want: str) -> None:
+        kind = self._kind_of(name)
+        if kind is not None and kind != want:
+            raise WrongKindError(f"'{name}' is a {kind}, not a {want}")
+
+    # ------------------------------------------------------------------
+    # op plumbing (the per-op tail, crdt.js:440-447)
+    # ------------------------------------------------------------------
+    def _run_op(self, batch: bool, operation: Callable[[], Any]) -> Any:
+        if batch:
+            self._batched.append(operation)
+            return None
+        pre_sv = self.engine.state_vector()
+        self.engine.begin_txn()
+        result = operation()
+        self._finish_txn(pre_sv, origin="local")
+        return result
+
+    def _finish_txn(
+        self,
+        pre_sv: StateVector,
+        origin: str,
+        meta: Optional[dict] = None,
+        propagate: bool = True,
+    ) -> Optional[bytes]:
+        eng = self.engine
+        new_records = eng.records_since(pre_sv)
+        txn_deletes = eng.last_txn_deletes
+        touched = self._touched_roots()
+        self._refresh_cache(touched)
+        self._fire_observers(touched, origin)
+        if not new_records and not txn_deletes.ranges:
+            return None
+        if self.full_state_updates:
+            update = v1.encode_state_as_update(eng)  # Q2 compat mode
+        else:
+            update = v1.encode_update(new_records, txn_deletes)
+        if propagate and self.on_update is not None and origin == "local":
+            self.on_update(update, meta or {})
+        return update
+
+    def _touched_roots(self) -> List[str]:
+        eng = self.engine
+        s = eng.store
+        roots = set()
+        rows = list(eng.last_txn_items)
+        for client, clock, length in eng.last_txn_deletes.iter_all():
+            for k in range(clock, clock + length):
+                row = s.find(client, k)
+                if row is not None:
+                    rows.append(row)
+        for row in rows:
+            r = self._root_of_row(row)
+            if r is not None:
+                roots.add(r)
+        return sorted(roots)
+
+    def _root_of_row(self, row: int) -> Optional[str]:
+        s = self.engine.store
+        seen = set()
+        while row is not None and row not in seen:
+            seen.add(row)
+            if s.parent_root[row] != NULL:
+                return s.root_names[int(s.parent_root[row])]
+            if s.parent_client[row] == NULL:
+                return None  # GC filler — no positional info
+            row = s.find(int(s.parent_client[row]), int(s.parent_clock[row]))
+        return None
+
+    def _refresh_cache(self, roots: Optional[Sequence[str]] = None) -> None:
+        eng = self.engine
+        known = set(eng.map_json("ix").keys()) | set(eng.root_kinds.keys())
+        known.discard("ix")
+        if roots is None:
+            roots = known
+        for name in roots:
+            if name == "ix":
+                continue
+            kind = self._kind_of(name)
+            if kind == "array":
+                self._c[name] = eng.seq_json(name)
+            elif kind == "map":
+                self._c[name] = eng.map_json(name)
+        # D3 fix: collections created remotely get cache entries too
+        for name in known:
+            if name not in self._c:
+                kind = self._kind_of(name)
+                self._c[name] = (
+                    eng.seq_json(name) if kind == "array" else eng.map_json(name)
+                )
+
+    def _fire_observers(self, touched: Sequence[str], origin: str) -> None:
+        event = {
+            "origin": origin,
+            "touched": list(touched),
+            "c": self.c,
+        }
+        if self.observer_function is not None:
+            # Q1 fix: fires on local mutations too, origin-tagged
+            self.observer_function(event)
+        for ob in self._observers:
+            if ob.name in touched:
+                if ob.key is not None:
+                    value = self.engine.map_get(ob.name, ob.key)
+                    ob.func({**event, "name": ob.name, "key": ob.key, "value": value})
+                else:
+                    ob.func({**event, "name": ob.name, "value": self._c.get(ob.name)})
+
+    # ------------------------------------------------------------------
+    # collection creation (crdt.js:363-390, 485-512)
+    # ------------------------------------------------------------------
+    def map(self, name: str, batch: bool = False):
+        self._check_name(name)
+        self._check_kind(name, "map")
+
+        def operation():
+            if self.engine.map_get("ix", name) is None:
+                self.engine.map_set("ix", name, "map")
+                self.engine.root_kinds[name] = "map"
+                self._c.setdefault(name, {})
+            return name
+
+        return self._run_op(batch, operation)
+
+    def array(self, name: str, batch: bool = False):
+        self._check_name(name)
+        self._check_kind(name, "array")
+
+        def operation():
+            if self.engine.map_get("ix", name) is None:
+                self.engine.map_set("ix", name, "array")
+                self.engine.root_kinds[name] = "array"
+                self._c.setdefault(name, [])
+            return name
+
+        return self._run_op(batch, operation)
+
+    # ------------------------------------------------------------------
+    # map ops (crdt.js:400-477)
+    # ------------------------------------------------------------------
+    def set(
+        self,
+        name: str,
+        key: str,
+        value: Any = None,
+        *,
+        array_method: Optional[str] = None,
+        index: Optional[int] = None,
+        length: Optional[int] = None,
+        batch: bool = False,
+    ) -> Any:
+        """Set ``key`` in map ``name``; with ``array_method`` operate on a
+        nested array stored under the key (crdt.js:422-432).
+
+        Nested mode (D2 fixed — the reference's validation throws):
+        ``array_method`` ∈ insert/push/unshift/cut; ``index``/``length``
+        qualify insert and cut.
+        """
+        self._check_name(name)
+        if not isinstance(key, str) or not key:
+            raise ValueError("key must be a non-empty string")
+        self._check_kind(name, "map")
+        if array_method is not None and array_method not in ARRAY_METHODS:
+            raise ValueError(f"array_method must be one of {ARRAY_METHODS}")
+        if array_method == "insert" and index is None:
+            raise ValueError("insert requires index")
+        if array_method == "cut" and index is None:
+            raise ValueError("cut requires index")
+
+        def operation():
+            eng = self.engine
+            if eng.map_get("ix", name) is None:
+                eng.map_set("ix", name, "map")  # auto-create (crdt.js:418-421)
+                eng.root_kinds[name] = "map"
+            if array_method is None:
+                eng.map_set(name, key, value)
+                return value
+            spec = eng.map_entry_spec(name, key)
+            if spec is None:
+                rec = eng.map_set_type(name, key, TYPE_ARRAY)
+                spec = ("item", rec.client, rec.clock)
+            if array_method == "insert":
+                vals = value if isinstance(value, list) else [value]
+                eng.seq_insert(name, index, vals, parent=spec)
+            elif array_method == "push":
+                vals = value if isinstance(value, list) else [value]
+                n = len(eng._seq_json(spec))
+                eng.seq_insert(name, n, vals, parent=spec)
+            elif array_method == "unshift":
+                vals = value if isinstance(value, list) else [value]
+                eng.seq_insert(name, 0, vals, parent=spec)
+            else:  # cut
+                eng.seq_delete(name, index, length or 1, parent=spec)
+            return eng.map_get(name, key)
+
+        return self._run_op(batch, operation)
+
+    def delete(self, name: str, key: str, batch: bool = False) -> Any:
+        """Delete ``key`` from map ``name`` (the reference's ``del``,
+        crdt.js:459-477; ``del`` is a Python keyword)."""
+        self._check_name(name)
+        self._check_kind(name, "map")
+
+        def operation():
+            return self.engine.map_delete(name, key)
+
+        return self._run_op(batch, operation)
+
+    # the reference's name, for API parity in dynamic call sites
+    del_ = delete
+
+    # ------------------------------------------------------------------
+    # array ops (crdt.js:485-617)
+    # ------------------------------------------------------------------
+    def _seq_op(self, name: str, batch: bool, body: Callable[[], Any]) -> Any:
+        self._check_name(name)
+        self._check_kind(name, "array")
+
+        def operation():
+            eng = self.engine
+            if eng.map_get("ix", name) is None:
+                eng.map_set("ix", name, "array")
+                eng.root_kinds[name] = "array"
+            return body()
+
+        return self._run_op(batch, operation)
+
+    def insert(self, name: str, index: int, value: Any, batch: bool = False):
+        """Insert at index — README.md:87 argument order (D7; the
+        reference code's is val-then-index, crdt.js:521)."""
+        vals = value if isinstance(value, list) else [value]
+        return self._seq_op(
+            name, batch, lambda: self.engine.seq_insert(name, index, vals) and None
+        )
+
+    def push(self, name: str, value: Any, batch: bool = False):
+        vals = value if isinstance(value, list) else [value]  # crdt.js:554
+
+        def body():
+            n = len(self.engine.seq_json(name))
+            self.engine.seq_insert(name, n, vals)
+
+        return self._seq_op(name, batch, body)
+
+    def unshift(self, name: str, value: Any, batch: bool = False):
+        # D1 fix: the reference's non-batch unshift never mutates
+        vals = value if isinstance(value, list) else [value]
+        return self._seq_op(
+            name, batch, lambda: self.engine.seq_insert(name, 0, vals) and None
+        )
+
+    def cut(self, name: str, index: int, length: int = 1, batch: bool = False):
+        # D1 fix: the reference's non-batch cut never mutates
+        return self._seq_op(
+            name, batch, lambda: self.engine.seq_delete(name, index, length)
+        )
+
+    # ------------------------------------------------------------------
+    # batch queue (crdt.js:325-355)
+    # ------------------------------------------------------------------
+    def exec_batch(self, propagate: bool = True) -> Optional[bytes]:
+        """Drain queued ops in one transaction → one update (one
+        broadcast). Empty queue returns None (D4: the reference hangs).
+
+        ``propagate=False`` mirrors ``throughDatabase`` (crdt.js:350-353):
+        the update is returned without invoking ``on_update``.
+        """
+        if not self._batched:
+            return None
+        ops, self._batched = self._batched, []
+        pre_sv = self.engine.state_vector()
+        self.engine.begin_txn()
+        for op in ops:
+            op()
+        return self._finish_txn(
+            pre_sv, "local", meta={"meta": "batch"}, propagate=propagate
+        )
+
+    @property
+    def pending_batch_size(self) -> int:
+        return len(self._batched)
+
+    # ------------------------------------------------------------------
+    # remote updates (crdt.js:292-311)
+    # ------------------------------------------------------------------
+    def apply_update(self, data: bytes, origin: str = "remote") -> None:
+        records, ds = v1.decode_update(data)
+        self.engine.begin_txn()
+        self.engine.apply_records(records, ds)
+        touched = self._touched_roots()
+        self._refresh_cache(None)  # D3 fix: discover remote collections
+        self._fire_observers(touched, origin)
+
+    # ------------------------------------------------------------------
+    # observers (crdt.js:620-657)
+    # ------------------------------------------------------------------
+    def observe(self, name: str, func: Callable, key: Optional[str] = None):
+        self._observers.append(_Observer(name, key, func))
+        return func
+
+    def unobserve(self, func: Callable) -> bool:
+        before = len(self._observers)
+        self._observers = [o for o in self._observers if o.func is not func]
+        return len(self._observers) < before

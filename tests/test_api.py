@@ -1,0 +1,325 @@
+"""Document/API layer tests — the reference surface with D1-D7 fixed.
+
+Shapes follow the reference README usage example
+(/root/reference/README.md:29-76) and the op-layer behaviors at
+/root/reference/crdt.js:325-657.
+"""
+
+import pytest
+
+from crdt_tpu.api import Crdt, ReservedNameError, WrongKindError
+
+
+def pair(a=1, b=2, **kw):
+    """Two replicas wired directly update->apply (loopback without router)."""
+    docs = {}
+    da = Crdt(a, on_update=lambda u, m: docs["b"].apply_update(u), **kw)
+    db = Crdt(b, on_update=lambda u, m: docs["a"].apply_update(u), **kw)
+    docs["a"], docs["b"] = da, db
+    return da, db
+
+
+# ---------------------------------------------------------------------------
+# map ops
+# ---------------------------------------------------------------------------
+
+class TestMap:
+    def test_set_and_cache(self):
+        d = Crdt(1)
+        d.map("users")
+        d.set("users", "u1", {"age": 30})
+        assert d.c["users"] == {"u1": {"age": 30}}
+        assert d["users"] == {"u1": {"age": 30}}
+        # Proxy fallthrough (crdt.js:691)
+        assert d.users == {"u1": {"age": 30}}
+
+    def test_auto_create_on_set(self):
+        d = Crdt(1)
+        d.set("users", "u1", 5)  # no prior map() call (crdt.js:418-421)
+        assert d.users == {"u1": 5}
+
+    def test_get_method_exists(self):
+        # D7: README documents get; the reference lacks it
+        d = Crdt(1)
+        d.set("users", "u1", {"x": 1})
+        assert d.get("users", "u1") == {"x": 1}
+        assert d.get("users", "missing") is None
+        assert d.get("users") == {"u1": {"x": 1}}
+
+    def test_delete(self):
+        d = Crdt(1)
+        d.set("users", "u1", 1)
+        d.set("users", "u2", 2)
+        d.delete("users", "u1")
+        assert d.users == {"u2": 2}
+
+    def test_lww_overwrite(self):
+        d = Crdt(1)
+        d.set("m", "k", "a")
+        d.set("m", "k", "b")
+        assert d.m == {"k": "b"}
+
+    def test_reserved_names(self):
+        d = Crdt(1)
+        for name in ("ix", "doc"):
+            with pytest.raises(ReservedNameError):
+                d.map(name)
+            with pytest.raises(ReservedNameError):
+                d.set(name, "k", 1)
+
+    def test_kind_mismatch(self):
+        d = Crdt(1)
+        d.map("m")
+        with pytest.raises(WrongKindError):
+            d.push("m", 1)
+        d.array("a")
+        with pytest.raises(WrongKindError):
+            d.set("a", "k", 1)
+
+
+# ---------------------------------------------------------------------------
+# array ops
+# ---------------------------------------------------------------------------
+
+class TestArray:
+    def test_push_insert_order(self):
+        d = Crdt(1)
+        d.array("log")
+        d.push("log", "b")
+        d.push("log", ["c", "d"])
+        d.insert("log", 0, "a")  # README arg order: (name, index, value)
+        assert d.log == ["a", "b", "c", "d"]
+
+    def test_unshift_mutates(self):
+        # D1: the reference's non-batch unshift is a silent no-op
+        d = Crdt(1)
+        d.push("log", "b")
+        d.unshift("log", "a")
+        assert d.log == ["a", "b"]
+
+    def test_cut_mutates(self):
+        # D1: the reference's non-batch cut is a silent no-op
+        d = Crdt(1)
+        d.push("log", ["a", "b", "c", "d"])
+        d.cut("log", 1, 2)
+        assert d.log == ["a", "d"]
+
+    def test_insert_out_of_range(self):
+        d = Crdt(1)
+        d.array("log")
+        with pytest.raises(IndexError):
+            d.insert("log", 5, "x")
+
+
+# ---------------------------------------------------------------------------
+# nested array-in-map (crdt.js:422-432, D2 fixed)
+# ---------------------------------------------------------------------------
+
+class TestNested:
+    def test_nested_push_and_cut(self):
+        d = Crdt(1)
+        d.set("m", "list", "x", array_method="push")
+        d.set("m", "list", ["y", "z"], array_method="push")
+        assert d.m == {"list": ["x", "y", "z"]}
+        d.set("m", "list", None, array_method="cut", index=1, length=1)
+        assert d.m == {"list": ["x", "z"]}
+
+    def test_nested_insert_unshift(self):
+        d = Crdt(1)
+        d.set("m", "l", "c", array_method="push")
+        d.set("m", "l", "a", array_method="unshift")
+        d.set("m", "l", "b", array_method="insert", index=1)
+        assert d.m == {"l": ["a", "b", "c"]}
+
+    def test_nested_validation(self):
+        d = Crdt(1)
+        with pytest.raises(ValueError):
+            d.set("m", "l", "x", array_method="bogus")
+        with pytest.raises(ValueError):
+            d.set("m", "l", "x", array_method="insert")  # no index
+
+    def test_nested_converges(self):
+        da, db = pair()
+        da.set("m", "l", ["a", "b"], array_method="push")
+        db.set("m", "l", "c", array_method="push")
+        assert da.m == db.m
+        assert da.m["l"][:2] == ["a", "b"]
+        assert set(da.m["l"]) == {"a", "b", "c"}
+
+
+# ---------------------------------------------------------------------------
+# batch queue (crdt.js:325-355)
+# ---------------------------------------------------------------------------
+
+class TestBatch:
+    def test_batch_queue_and_exec(self):
+        updates = []
+        d = Crdt(1, on_update=lambda u, m: updates.append((u, m)))
+        d.set("m", "a", 1, batch=True)
+        d.set("m", "b", 2, batch=True)
+        d.push("log", "x", batch=True)
+        assert d.pending_batch_size == 3
+        assert updates == []  # nothing sent yet
+        assert "m" not in d  # nothing applied yet
+        out = d.exec_batch()
+        assert d.pending_batch_size == 0
+        assert d.m == {"a": 1, "b": 2}
+        assert d.log == ["x"]
+        # one update, one broadcast for the whole batch
+        assert len(updates) == 1
+        assert updates[0][1] == {"meta": "batch"}
+        assert out == updates[0][0]
+
+    def test_empty_exec_batch_returns(self):
+        # D4: the reference hangs forever on an empty queue
+        d = Crdt(1)
+        assert d.exec_batch() is None
+
+    def test_through_database_mode(self):
+        updates = []
+        d = Crdt(1, on_update=lambda u, m: updates.append(u))
+        d.set("m", "a", 1, batch=True)
+        out = d.exec_batch(propagate=False)  # throughDatabase (crdt.js:350)
+        assert out is not None and updates == []
+        other = Crdt(2)
+        other.apply_update(out)
+        assert other.m == {"a": 1}
+
+    def test_batch_applies_atomically_to_peer(self):
+        da, db = pair()
+        da.set("m", "a", 1, batch=True)
+        da.push("log", "x", batch=True)
+        da.exec_batch()
+        assert db.m == {"a": 1} and db.log == ["x"]
+
+
+# ---------------------------------------------------------------------------
+# replication through the update hook
+# ---------------------------------------------------------------------------
+
+class TestReplication:
+    def test_two_replica_convergence_delta(self):
+        da, db = pair()
+        da.set("users", "u1", {"n": 1})
+        db.set("users", "u2", {"n": 2})
+        da.push("log", "a")
+        db.push("log", "b")
+        assert da.c == db.c
+        assert da.users == {"u1": {"n": 1}, "u2": {"n": 2}}
+
+    def test_two_replica_convergence_full_state(self):
+        # Q2 compat mode: every update carries full state
+        da, db = pair(full_state_updates=True)
+        da.set("m", "a", 1)
+        db.set("m", "b", 2)
+        da.delete("m", "a")
+        assert da.c == db.c == {"m": {"b": 2}}
+
+    def test_concurrent_set_same_key(self):
+        ua, ub = [], []
+        da = Crdt(1, on_update=lambda u, m: ua.append(u))
+        db = Crdt(2, on_update=lambda u, m: ub.append(u))
+        da.set("m", "k", "from-a")
+        db.set("m", "k", "from-b")
+        for u in ua:
+            db.apply_update(u)
+        for u in ub:
+            da.apply_update(u)
+        assert da.m == db.m  # one deterministic winner
+        assert da.m["k"] in ("from-a", "from-b")
+
+    def test_remote_collection_appears_in_cache(self):
+        # D3: the reference never adds remotely-created collections
+        da, db = pair()
+        da.set("newmap", "k", 1)
+        da.push("newarr", "v")
+        assert db.newmap == {"k": 1}
+        assert db.newarr == ["v"]
+
+    def test_idempotent_redelivery(self):
+        ua = []
+        da = Crdt(1, on_update=lambda u, m: ua.append(u))
+        db = Crdt(2)
+        da.set("m", "k", 1)
+        da.push("l", "x")
+        for u in ua * 3:  # deliver every update three times
+            db.apply_update(u)
+        assert db.c == da.c
+
+    def test_out_of_order_delivery(self):
+        ua = []
+        da = Crdt(1, on_update=lambda u, m: ua.append(u))
+        db = Crdt(2)
+        da.push("l", "a")
+        da.push("l", "b")
+        da.push("l", "c")
+        for u in reversed(ua):  # reversed: deps arrive late -> pending path
+            db.apply_update(u)
+        assert db.l == ["a", "b", "c"]
+
+
+# ---------------------------------------------------------------------------
+# observers (Q1 fixed: local fires too)
+# ---------------------------------------------------------------------------
+
+class TestObservers:
+    def test_observer_function_local_and_remote(self):
+        events = []
+        da = Crdt(1, observer_function=events.append)
+        da.set("m", "k", 1)
+        assert events and events[-1]["origin"] == "local"
+        db = Crdt(2)
+        update = da.encode_state_as_update()
+        events.clear()
+        da.apply_update(db.encode_state_as_update())  # no-op update
+        db.apply_update(update)
+        assert all(e["origin"] == "remote" for e in events)
+
+    def test_collection_observer_scoped(self):
+        d = Crdt(1)
+        seen = []
+        d.observe("m", seen.append)
+        d.set("m", "k", 1)
+        assert seen and seen[-1]["value"] == {"k": 1}
+        d.set("other", "k", 2)
+        assert len(seen) == 1  # only fires for its collection
+
+    def test_key_observer(self):
+        d = Crdt(1)
+        seen = []
+        d.observe("m", seen.append, key="watched")
+        d.set("m", "watched", 42)
+        assert seen[-1]["value"] == 42
+
+    def test_unobserve_detaches(self):
+        d = Crdt(1)
+        seen = []
+
+        def cb(e):
+            seen.append(e)
+
+        d.observe("m", cb)
+        d.set("m", "a", 1)
+        assert d.unobserve(cb) is True
+        d.set("m", "b", 2)
+        assert len(seen) == 1
+
+
+# ---------------------------------------------------------------------------
+# state-vector sync primitives (used by the router layer)
+# ---------------------------------------------------------------------------
+
+class TestSyncPrimitives:
+    def test_sv_diff_update(self):
+        da, db_late = Crdt(1), Crdt(2)
+        da.set("m", "a", 1)
+        da.push("l", "x")
+        # late joiner sends its SV; syncer encodes the diff (crdt.js:288)
+        diff = da.encode_state_as_update(db_late.state_vector())
+        db_late.apply_update(diff)
+        assert db_late.c == da.c
+        # now a delta on top
+        da.set("m", "b", 2)
+        diff2 = da.encode_state_as_update(db_late.state_vector())
+        db_late.apply_update(diff2)
+        assert db_late.c == da.c
