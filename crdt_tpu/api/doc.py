@@ -54,6 +54,12 @@ class WrongKindError(TypeError):
     pass
 
 
+def _as_list(value: Any) -> list:
+    """Scalar -> single-element list (the reference's push wrap,
+    crdt.js:554); lists pass through."""
+    return value if isinstance(value, list) else [value]
+
+
 class _Observer:
     __slots__ = ("name", "key", "func")
 
@@ -90,6 +96,7 @@ class Crdt:
         self._c: Dict[str, Any] = {}
         self._batched: List[Callable[[], Any]] = []
         self._observers: List[_Observer] = []
+        self._known_len = 0  # root_kinds size at last D3 backfill
 
     # ------------------------------------------------------------------
     # cache / reads (the reference's Proxy + frozen `c`, crdt.js:661-702)
@@ -162,39 +169,59 @@ class Crdt:
         if batch:
             self._batched.append(operation)
             return None
-        pre_sv = self.engine.state_vector()
         self.engine.begin_txn()
-        result = operation()
-        self._finish_txn(pre_sv, origin="local")
+        try:
+            result = operation()
+        except BaseException:
+            # a throwing op still commits what it integrated (Yjs txn
+            # semantics): the records exist with allocated clocks, so
+            # not broadcasting them would wedge every peer on a
+            # per-client clock gap forever — but the op's own error
+            # must win over any broadcast-tail error
+            try:
+                self._finish_txn(origin="local")
+            except Exception:
+                pass
+            raise
+        self._finish_txn(origin="local")
         return result
 
     def _finish_txn(
         self,
-        pre_sv: StateVector,
         origin: str,
         meta: Optional[dict] = None,
         propagate: bool = True,
+        want_update: bool = False,
     ) -> Optional[bytes]:
         eng = self.engine
-        new_records = eng.records_since(pre_sv)
+        # last_txn_items lists exactly this txn's rows: O(txn), not the
+        # O(doc) scan records_since would do
+        new_records = eng.records_for_rows(eng.last_txn_items)
         txn_deletes = eng.last_txn_deletes
-        touched = self._touched_roots()
+        touched, touched_keys = self._touched_roots()
         self._refresh_cache(touched)
-        self._fire_observers(touched, origin)
-        if not new_records and not txn_deletes.ranges:
-            return None
-        if self.full_state_updates:
-            update = v1.encode_state_as_update(eng)  # Q2 compat mode
-        else:
-            update = v1.encode_update(new_records, txn_deletes)
-        if propagate and self.on_update is not None and origin == "local":
-            self.on_update(update, meta or {})
+        update = None
+        emitting = propagate and self.on_update is not None and origin == "local"
+        if (new_records or txn_deletes.ranges) and (emitting or want_update):
+            if self.full_state_updates:
+                update = v1.encode_state_as_update(eng)  # Q2 compat mode
+            else:
+                update = v1.encode_update(new_records, txn_deletes)
+            # broadcast BEFORE observers: a throwing observer must not
+            # abort the emission, or peers wedge on the clock gap
+            if emitting:
+                self.on_update(update, meta or {})
+        self._fire_observers(touched, touched_keys, origin)
         return update
 
-    def _touched_roots(self) -> List[str]:
+    def _touched_roots(self) -> Tuple[List[str], Dict[str, set]]:
+        """Roots touched by the last txn, plus per-root changed top-level
+        keys (the key of the item directly under the root — nested
+        edits roll up to the map key holding the nested type)."""
         eng = self.engine
         s = eng.store
-        roots = set()
+        roots: set = set()
+        keys: Dict[str, set] = {}
         rows = list(eng.last_txn_items)
         for client, clock, length in eng.last_txn_deletes.iter_all():
             for k in range(clock, clock + length):
@@ -202,50 +229,75 @@ class Crdt:
                 if row is not None:
                     rows.append(row)
         for row in rows:
-            r = self._root_of_row(row)
-            if r is not None:
-                roots.add(r)
-        return sorted(roots)
+            root, key = self._classify_row(row)
+            if root is not None:
+                roots.add(root)
+                if key is not None:
+                    keys.setdefault(root, set()).add(key)
+        return sorted(roots), keys
 
-    def _root_of_row(self, row: int) -> Optional[str]:
+    def _classify_row(self, row: int) -> Tuple[Optional[str], Optional[str]]:
+        """(root name, top-level map key) of a row, walking up nested
+        parents; key is None for sequence members of a root array."""
+        from crdt_tpu.core.store import NO_KEY
+
         s = self.engine.store
         seen = set()
         while row is not None and row not in seen:
             seen.add(row)
             if s.parent_root[row] != NULL:
-                return s.root_names[int(s.parent_root[row])]
+                root = s.root_names[int(s.parent_root[row])]
+                kid = int(s.key_id[row])
+                return root, (s.keys[kid] if kid != NO_KEY else None)
             if s.parent_client[row] == NULL:
-                return None  # GC filler — no positional info
+                return None, None  # GC filler — no positional info
             row = s.find(int(s.parent_client[row]), int(s.parent_clock[row]))
-        return None
+        return None, None
 
-    def _refresh_cache(self, roots: Optional[Sequence[str]] = None) -> None:
+    def _refresh_cache(self, roots: Sequence[str]) -> None:
         eng = self.engine
-        known = set(eng.map_json("ix").keys()) | set(eng.root_kinds.keys())
-        known.discard("ix")
-        if roots is None:
-            roots = known
         for name in roots:
             if name == "ix":
                 continue
             kind = self._kind_of(name)
+            # deep-copied: cache values must not alias live store
+            # content, or `crdt.c['m']['k'].append(...)` would mutate
+            # CRDT state without an op and diverge replicas
             if kind == "array":
-                self._c[name] = eng.seq_json(name)
+                self._c[name] = copy.deepcopy(eng.seq_json(name))
             elif kind == "map":
-                self._c[name] = eng.map_json(name)
-        # D3 fix: collections created remotely get cache entries too
-        for name in known:
-            if name not in self._c:
-                kind = self._kind_of(name)
-                self._c[name] = (
-                    eng.seq_json(name) if kind == "array" else eng.map_json(name)
-                )
+                self._c[name] = copy.deepcopy(eng.map_json(name))
+        # D3 fix: collections created remotely get cache entries too.
+        # New collections only appear when the txn touched the index
+        # map or integrated items under a new root, so the O(known)
+        # backfill is skipped on hot single-collection txns.
+        if "ix" in roots or len(eng.root_kinds) != self._known_len:
+            self._known_len = len(eng.root_kinds)
+            known = set(eng.map_json("ix").keys()) | set(eng.root_kinds.keys())
+            known.discard("ix")
+            for name in known:
+                if name not in self._c:
+                    kind = self._kind_of(name)
+                    self._c[name] = copy.deepcopy(
+                        eng.seq_json(name) if kind == "array" else eng.map_json(name)
+                    )
 
-    def _fire_observers(self, touched: Sequence[str], origin: str) -> None:
+    def _fire_observers(
+        self,
+        touched: Sequence[str],
+        touched_keys: Dict[str, set],
+        origin: str,
+    ) -> None:
+        if not touched:
+            return  # no-op txns (incl. failed ops) emit no events
         event = {
             "origin": origin,
             "touched": list(touched),
-            "c": self.c,
+            # snapshot, not a live view: later txns rebind cache
+            # entries and must not retroactively mutate stored events
+            # (the reference freezes a copy too: Object.freeze({...c}),
+            # crdt.js:668-670)
+            "c": MappingProxyType(dict(self._c)),
         }
         if self.observer_function is not None:
             # Q1 fix: fires on local mutations too, origin-tagged
@@ -253,19 +305,33 @@ class Crdt:
         for ob in self._observers:
             if ob.name in touched:
                 if ob.key is not None:
-                    value = self.engine.map_get(ob.name, ob.key)
+                    # per-key observers fire only when their key changed
+                    # (the reference attaches to h[name][key],
+                    # crdt.js:622-638)
+                    if ob.key not in touched_keys.get(ob.name, ()):
+                        continue
+                    # deep-copied: observers must not be able to mutate
+                    # live store content (see _refresh_cache)
+                    value = copy.deepcopy(self.engine.map_get(ob.name, ob.key))
                     ob.func({**event, "name": ob.name, "key": ob.key, "value": value})
                 else:
-                    ob.func({**event, "name": ob.name, "value": self._c.get(ob.name)})
+                    # deep-copied like the key path: observers must not
+                    # mutate the cached snapshot. (event["c"] itself is
+                    # the shallow-frozen view, matching the reference's
+                    # Object.freeze({...c}) — crdt.js:668-670.)
+                    value = copy.deepcopy(self._c.get(ob.name))
+                    ob.func({**event, "name": ob.name, "value": value})
 
     # ------------------------------------------------------------------
     # collection creation (crdt.js:363-390, 485-512)
     # ------------------------------------------------------------------
     def map(self, name: str, batch: bool = False):
         self._check_name(name)
-        self._check_kind(name, "map")
 
         def operation():
+            # kind check at execution time: a queued or remote op may
+            # have registered the name since this op was queued
+            self._check_kind(name, "map")
             if self.engine.map_get("ix", name) is None:
                 self.engine.map_set("ix", name, "map")
                 self.engine.root_kinds[name] = "map"
@@ -276,9 +342,9 @@ class Crdt:
 
     def array(self, name: str, batch: bool = False):
         self._check_name(name)
-        self._check_kind(name, "array")
 
         def operation():
+            self._check_kind(name, "array")
             if self.engine.map_get("ix", name) is None:
                 self.engine.map_set("ix", name, "array")
                 self.engine.root_kinds[name] = "array"
@@ -311,7 +377,6 @@ class Crdt:
         self._check_name(name)
         if not isinstance(key, str) or not key:
             raise ValueError("key must be a non-empty string")
-        self._check_kind(name, "map")
         if array_method is not None and array_method not in ARRAY_METHODS:
             raise ValueError(f"array_method must be one of {ARRAY_METHODS}")
         if array_method == "insert" and index is None:
@@ -321,6 +386,7 @@ class Crdt:
 
         def operation():
             eng = self.engine
+            self._check_kind(name, "map")  # execution-time (see map())
             if eng.map_get("ix", name) is None:
                 eng.map_set("ix", name, "map")  # auto-create (crdt.js:418-421)
                 eng.root_kinds[name] = "map"
@@ -332,18 +398,20 @@ class Crdt:
                 rec = eng.map_set_type(name, key, TYPE_ARRAY)
                 spec = ("item", rec.client, rec.clock)
             if array_method == "insert":
-                vals = value if isinstance(value, list) else [value]
-                eng.seq_insert(name, index, vals, parent=spec)
+                eng.seq_insert(name, index, _as_list(value), parent=spec)
             elif array_method == "push":
-                vals = value if isinstance(value, list) else [value]
-                n = len(eng._seq_json(spec))
-                eng.seq_insert(name, n, vals, parent=spec)
+                n = eng.seq_len(parent=spec)
+                eng.seq_insert(name, n, _as_list(value), parent=spec)
             elif array_method == "unshift":
-                vals = value if isinstance(value, list) else [value]
-                eng.seq_insert(name, 0, vals, parent=spec)
+                eng.seq_insert(name, 0, _as_list(value), parent=spec)
             else:  # cut
-                eng.seq_delete(name, index, length or 1, parent=spec)
-            return eng.map_get(name, key)
+                eng.seq_delete(
+                    name,
+                    index,
+                    length if length is not None else 1,
+                    parent=spec,
+                )
+            return copy.deepcopy(eng.map_get(name, key))
 
         return self._run_op(batch, operation)
 
@@ -351,9 +419,9 @@ class Crdt:
         """Delete ``key`` from map ``name`` (the reference's ``del``,
         crdt.js:459-477; ``del`` is a Python keyword)."""
         self._check_name(name)
-        self._check_kind(name, "map")
 
         def operation():
+            self._check_kind(name, "map")
             return self.engine.map_delete(name, key)
 
         return self._run_op(batch, operation)
@@ -366,10 +434,10 @@ class Crdt:
     # ------------------------------------------------------------------
     def _seq_op(self, name: str, batch: bool, body: Callable[[], Any]) -> Any:
         self._check_name(name)
-        self._check_kind(name, "array")
 
         def operation():
             eng = self.engine
+            self._check_kind(name, "array")  # execution-time (see map())
             if eng.map_get("ix", name) is None:
                 eng.map_set("ix", name, "array")
                 eng.root_kinds[name] = "array"
@@ -380,23 +448,23 @@ class Crdt:
     def insert(self, name: str, index: int, value: Any, batch: bool = False):
         """Insert at index — README.md:87 argument order (D7; the
         reference code's is val-then-index, crdt.js:521)."""
-        vals = value if isinstance(value, list) else [value]
+        vals = _as_list(value)
         return self._seq_op(
             name, batch, lambda: self.engine.seq_insert(name, index, vals) and None
         )
 
     def push(self, name: str, value: Any, batch: bool = False):
-        vals = value if isinstance(value, list) else [value]  # crdt.js:554
+        vals = _as_list(value)
 
         def body():
-            n = len(self.engine.seq_json(name))
+            n = self.engine.seq_len(name)
             self.engine.seq_insert(name, n, vals)
 
         return self._seq_op(name, batch, body)
 
     def unshift(self, name: str, value: Any, batch: bool = False):
         # D1 fix: the reference's non-batch unshift never mutates
-        vals = value if isinstance(value, list) else [value]
+        vals = _as_list(value)
         return self._seq_op(
             name, batch, lambda: self.engine.seq_insert(name, 0, vals) and None
         )
@@ -420,12 +488,28 @@ class Crdt:
         if not self._batched:
             return None
         ops, self._batched = self._batched, []
-        pre_sv = self.engine.state_vector()
         self.engine.begin_txn()
-        for op in ops:
-            op()
+        try:
+            for op in ops:
+                op()
+        except BaseException:
+            # partial batches commit what ran before the throw (see
+            # _run_op: unbroadcast records would wedge peers)
+            try:
+                self._finish_txn(
+                    "local",
+                    meta={"meta": "batch"},
+                    propagate=propagate,
+                    want_update=True,
+                )
+            except Exception:
+                pass
+            raise
         return self._finish_txn(
-            pre_sv, "local", meta={"meta": "batch"}, propagate=propagate
+            "local",
+            meta={"meta": "batch"},
+            propagate=propagate,
+            want_update=True,
         )
 
     @property
@@ -437,11 +521,10 @@ class Crdt:
     # ------------------------------------------------------------------
     def apply_update(self, data: bytes, origin: str = "remote") -> None:
         records, ds = v1.decode_update(data)
-        self.engine.begin_txn()
-        self.engine.apply_records(records, ds)
-        touched = self._touched_roots()
-        self._refresh_cache(None)  # D3 fix: discover remote collections
-        self._fire_observers(touched, origin)
+        self.engine.apply_records(records, ds)  # begins its own txn
+        touched, touched_keys = self._touched_roots()
+        self._refresh_cache(touched)  # + D3 backfill of new collections
+        self._fire_observers(touched, touched_keys, origin)
 
     # ------------------------------------------------------------------
     # observers (crdt.js:620-657)

@@ -64,6 +64,9 @@ class Engine:
         self._seq_tail: Dict[ParentSpec, int] = {}
         self._map_head: Dict[Tuple[ParentSpec, int], int] = {}  # key chains
         self._map_tail: Dict[Tuple[ParentSpec, int], int] = {}
+        # spec -> ordered set of key ids with chains (dict-as-set), so
+        # materializing one map is O(its keys), not O(all map keys)
+        self._map_kids: Dict[ParentSpec, Dict[int, None]] = {}
         # pending remote records / deletes waiting on dependencies
         self.pending: List[ItemRecord] = []
         self.pending_deletes = DeleteSet()
@@ -248,6 +251,24 @@ class Engine:
                 seen += 1
             row = self._next.get(row, NULL)
         return None
+
+    def seq_len(self, name: Optional[str] = None, *, parent: Optional[ParentSpec] = None) -> int:
+        """Visible length of a sequence — chain count only, no JSON
+        materialization (push's append-index lookup)."""
+        if parent is not None:
+            spec = parent
+        else:
+            rid = self.store.root_id(name)
+            if rid is None:
+                return 0
+            spec = ("root", rid)
+        n = 0
+        row = self._seq_head.get(spec, NULL)
+        while row != NULL:
+            if self._is_countable(row):
+                n += 1
+            row = self._next.get(row, NULL)
+        return n
 
     def _next_visible(self, row: int) -> Optional[int]:
         r = self._next.get(row, NULL)
@@ -444,6 +465,7 @@ class Engine:
         # race and is tombstoned itself. Both sides of a concurrent set
         # therefore derive the same delete set from the same op set.
         if int(s.key_id[row]) != NO_KEY:
+            self._map_kids.setdefault(ckey[0], {})[ckey[1]] = None
             if self._next[row] == NULL:
                 if left is not None and not s.deleted[left]:
                     self._delete_row(left)
@@ -464,8 +486,9 @@ class Engine:
 
     def _map_json(self, spec: ParentSpec) -> Dict[str, Any]:
         out = {}
-        for (sp, kid), tail in self._map_tail.items():
-            if sp == spec and not self.store.deleted[tail]:
+        for kid in self._map_kids.get(spec, ()):
+            tail = self._map_tail.get((spec, kid))
+            if tail is not None and not self.store.deleted[tail]:
                 out[self.store.keys[kid]] = self._value_of_row(tail)
         return out
 
@@ -559,48 +582,57 @@ class Engine:
     # ------------------------------------------------------------------
     # export for codec / kernels
     # ------------------------------------------------------------------
+    def record_of_row(self, row: int) -> ItemRecord:
+        """Symbolic record for one store row."""
+        s = self.store
+        parent_root = (
+            s.root_names[int(s.parent_root[row])]
+            if s.parent_root[row] != NULL
+            else None
+        )
+        parent_item = (
+            (int(s.parent_client[row]), int(s.parent_clock[row]))
+            if s.parent_root[row] == NULL and s.parent_client[row] != NULL
+            else None
+        )
+        origin = (
+            (int(s.origin_client[row]), int(s.origin_clock[row]))
+            if s.origin_client[row] != NULL
+            else None
+        )
+        right = (
+            (int(s.right_client[row]), int(s.right_clock[row]))
+            if s.right_client[row] != NULL
+            else None
+        )
+        key = s.keys[int(s.key_id[row])] if s.key_id[row] != NO_KEY else None
+        return ItemRecord(
+            client=int(s.client[row]),
+            clock=int(s.clock[row]),
+            parent_root=parent_root,
+            parent_item=parent_item,
+            key=key,
+            origin=origin,
+            right=right,
+            kind=int(s.kind[row]),
+            type_ref=int(s.type_ref[row]),
+            content=s.content[row],
+        )
+
+    def records_for_rows(self, rows) -> List[ItemRecord]:
+        """Records for specific rows, (client, clock)-sorted — O(len)
+        txn-delta extraction (vs records_since's full-store scan)."""
+        out = [self.record_of_row(row) for row in rows]
+        out.sort(key=lambda r: (r.client, r.clock))
+        return out
+
     def records_since(self, sv: Optional[StateVector] = None) -> List[ItemRecord]:
         """All records with clock >= sv[client] (full state when sv None)."""
         s = self.store
-        out = []
-        for row in range(s.n):
-            client, clock = int(s.client[row]), int(s.clock[row])
-            if sv is not None and sv.covers(client, clock):
-                continue
-            parent_root = (
-                s.root_names[int(s.parent_root[row])]
-                if s.parent_root[row] != NULL
-                else None
-            )
-            parent_item = (
-                (int(s.parent_client[row]), int(s.parent_clock[row]))
-                if s.parent_root[row] == NULL and s.parent_client[row] != NULL
-                else None
-            )
-            origin = (
-                (int(s.origin_client[row]), int(s.origin_clock[row]))
-                if s.origin_client[row] != NULL
-                else None
-            )
-            right = (
-                (int(s.right_client[row]), int(s.right_clock[row]))
-                if s.right_client[row] != NULL
-                else None
-            )
-            key = s.keys[int(s.key_id[row])] if s.key_id[row] != NO_KEY else None
-            out.append(
-                ItemRecord(
-                    client=client,
-                    clock=clock,
-                    parent_root=parent_root,
-                    parent_item=parent_item,
-                    key=key,
-                    origin=origin,
-                    right=right,
-                    kind=int(s.kind[row]),
-                    type_ref=int(s.type_ref[row]),
-                    content=s.content[row],
-                )
-            )
+        out = [
+            self.record_of_row(row)
+            for row in range(s.n)
+            if sv is None or not sv.covers(int(s.client[row]), int(s.clock[row]))
+        ]
         out.sort(key=lambda r: (r.client, r.clock))
         return out

@@ -175,7 +175,7 @@ def order_sequences(records):
     import numpy as np
 
     from crdt_tpu.core.store import K_GC
-    from crdt_tpu.ops.merge import resolve_parents
+    from crdt_tpu.ops.merge import _pad_to, resolve_parents
 
     records = resolve_parents(records)
     uniq = {}
@@ -204,12 +204,28 @@ def order_sequences(records):
             continue  # unresolvable parent (origin outside batch)
         seg[i] = seq_specs.setdefault(spec, len(seq_specs))
         if r.origin is not None and r.origin in row_of:
-            orow = row_of[r.origin]
-            if seg[orow] == seg[i] or seg[orow] == -1:
-                parent_idx[i] = orow
+            parent_idx[i] = row_of[r.origin]
         key1[i] = r.client
         key2[i] = r.clock
         seq_rows.append(i)
+
+    # Drop items whose in-batch origin is not a live member of the same
+    # sequence (a GC filler or a non-sequence row): the engine splices
+    # such items after a chain-less row, so its head walk never emits
+    # them (seq_order_table omits them). Dropping cascades to the
+    # orphaned subtree.
+    changed = True
+    while changed:
+        changed = False
+        kept = []
+        for i in seq_rows:
+            p = parent_idx[i]
+            if p >= 0 and seg[p] != seg[i]:
+                seg[i] = -1
+                changed = True
+            else:
+                kept.append(i)
+        seq_rows = kept
 
     # group members by origin-tree parent; detect attachment groups
     groups: Dict[Tuple[int, int], List[int]] = {}
@@ -236,20 +252,17 @@ def order_sequences(records):
             key1[row_of[sid]] = rank_pos
             key2[row_of[sid]] = 0
 
-    num_segments = max(1, len(seq_specs))
+    # power-of-two buckets for BOTH static dims so jit compiles once
+    # per bucket, not once per (record count, sequence count) pair
+    num_segments = 1 << max(3, (max(1, len(seq_specs)) - 1).bit_length())
     pad = 1 << max(9, (n - 1).bit_length())
-
-    def padded(a, fill):
-        out = np.full(pad, fill, a.dtype)
-        out[:n] = a
-        return out
 
     with jax.enable_x64(True):
         rank, _ = tree_order_ranks(
-            jnp.asarray(padded(seg, -1)),
-            jnp.asarray(padded(parent_idx, -1)),
-            jnp.asarray(padded(key1, 0)),
-            jnp.asarray(padded(key2, 0)),
+            jnp.asarray(_pad_to(seg, pad, -1)),
+            jnp.asarray(_pad_to(parent_idx, pad, -1)),
+            jnp.asarray(_pad_to(key1, pad, 0)),
+            jnp.asarray(_pad_to(key2, pad, 0)),
             jnp.asarray(np.arange(pad) < n),
             num_segments=num_segments,
         )

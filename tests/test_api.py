@@ -323,3 +323,103 @@ class TestSyncPrimitives:
         diff2 = da.encode_state_as_update(db_late.state_vector())
         db_late.apply_update(diff2)
         assert db_late.c == da.c
+
+
+# ---------------------------------------------------------------------------
+# regression tests for review findings (exception safety, aliasing, guards)
+# ---------------------------------------------------------------------------
+
+class TestTxnSafety:
+    def test_throwing_op_still_broadcasts_partial_txn(self):
+        # an op that raises mid-txn must broadcast what it integrated,
+        # or peers wedge forever on the client's clock gap
+        ua = []
+        da = Crdt(1, on_update=lambda u, m: ua.append(u))
+        db = Crdt(2)
+        da.push("a", "x", batch=True)
+        da.insert("a", 99, "y", batch=True)  # raises IndexError
+        with pytest.raises(IndexError):
+            da.exec_batch()
+        da.push("a", "z")  # later ops must still replicate
+        for u in ua:
+            db.apply_update(u)
+        assert db.a == ["x", "z"]
+        assert not db.engine.pending  # nothing stuck on a clock gap
+
+    def test_throwing_nonbatch_op_keeps_replicas_consistent(self):
+        ua = []
+        da = Crdt(1, on_update=lambda u, m: ua.append(u))
+        db = Crdt(2)
+        with pytest.raises(IndexError):
+            da.insert("arr", 5, "x")  # auto-created 'arr' must ship
+        da.push("arr", "ok")
+        for u in ua:
+            db.apply_update(u)
+        assert db.arr == ["ok"] and not db.engine.pending
+
+    def test_cache_mutation_cannot_corrupt_state(self):
+        da = Crdt(1)
+        da.set("m", "k", [1])
+        da.c["m"]["k"].append(2)  # mutating the cache view
+        assert da.get("m", "k") == [1]  # engine state untouched
+        db = Crdt(2)
+        db.apply_update(da.encode_state_as_update())
+        assert db.m == {"k": [1]}
+
+    def test_nested_cut_length_zero_is_noop(self):
+        d = Crdt(1)
+        d.set("m", "k", [10, 20, 30], array_method="insert", index=0)
+        d.set("m", "k", array_method="cut", index=0, length=0)
+        assert d.m == {"k": [10, 20, 30]}
+
+    def test_kind_guard_at_execution_time(self):
+        d = Crdt(1)
+        d.array("x", batch=True)
+        d.set("x", "k", 1, batch=True)  # queued before kind known
+        with pytest.raises(WrongKindError):
+            d.exec_batch()
+        assert d.x == []  # no hidden map entry under the array root
+
+    def test_throwing_observer_does_not_block_broadcast(self):
+        ua = []
+
+        def bad_observer(e):
+            raise RuntimeError("observer bug")
+
+        da = Crdt(1, observer_function=bad_observer,
+                  on_update=lambda u, m: ua.append(u))
+        with pytest.raises(RuntimeError):
+            da.set("m", "k", 1)
+        assert len(ua) == 1  # update shipped before the observer blew up
+        db = Crdt(2)
+        db.apply_update(ua[0])
+        assert db.m == {"k": 1}
+
+    def test_observer_event_cache_is_snapshot(self):
+        events = []
+        da = Crdt(1, observer_function=events.append)
+        da.set("m", "a", 1)
+        da.set("m", "b", 2)
+        assert events[0]["c"]["m"] == {"a": 1}  # not retroactively mutated
+        assert events[1]["c"]["m"] == {"a": 1, "b": 2}
+
+    def test_key_observer_ignores_other_keys(self):
+        d = Crdt(1)
+        seen = []
+        d.observe("m", seen.append, key="watched")
+        d.set("m", "other", 1)  # unrelated key: no event
+        assert seen == []
+        d.set("m", "watched", 42)
+        assert len(seen) == 1 and seen[-1]["value"] == 42
+        d.delete("m", "watched")
+        assert len(seen) == 2 and seen[-1]["value"] is None
+        d.set("m", "nested", "x", array_method="push")  # other key again
+        assert len(seen) == 2
+
+    def test_key_observer_fires_for_nested_edits_under_key(self):
+        d = Crdt(1)
+        seen = []
+        d.observe("m", seen.append, key="list")
+        d.set("m", "list", "a", array_method="push")
+        d.set("m", "list", "b", array_method="push")
+        assert [e["value"] for e in seen] == [["a"], ["a", "b"]]

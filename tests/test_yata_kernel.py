@@ -139,3 +139,23 @@ def test_fuzz_mixed_maps_and_sequences():
         for _ in range(150):
             _random_op(rng, rng.choice(engines), engines)
         check(engines)
+
+
+def test_gc_origin_items_are_dropped():
+    """An item whose origin is a GC filler never joins the chain.
+
+    The engine splices it after a chain-less row (its head walk omits
+    it); the kernel must drop it — and its subtree — rather than rank
+    it against the segment root.
+    """
+    from crdt_tpu.core.records import ItemRecord
+    from crdt_tpu.core.store import K_GC
+
+    recs = [
+        ItemRecord(client=1, clock=0, kind=K_GC),  # GC'd history
+        ItemRecord(client=1, clock=1, parent_root="s", origin=(1, 0)),
+        ItemRecord(client=1, clock=2, parent_root="s", origin=(1, 1)),
+        ItemRecord(client=2, clock=0, parent_root="s", content="live"),
+    ]
+    got = order_sequences(recs)
+    assert got == {("root", "s"): [(2, 0)]}
