@@ -1,0 +1,10 @@
+from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+from crdt_tpu.net.replica import MemoryPersistence, Replica, ypear_crdt
+
+__all__ = [
+    "LoopbackNetwork",
+    "LoopbackRouter",
+    "MemoryPersistence",
+    "Replica",
+    "ypear_crdt",
+]

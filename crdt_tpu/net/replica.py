@@ -1,0 +1,335 @@
+"""Replica = document + sync protocol over a router (crdt.js:166-317).
+
+``ypear_crdt(router, topic=...)`` mirrors the reference factory: it
+wires a :class:`crdt_tpu.api.Crdt` document to a router implementing
+the contract in :mod:`crdt_tpu.net.router`, registers the per-topic
+sync contract (crdt.js:234-277), and dispatches inbound messages the
+way the reference's ``onData`` does (crdt.js:279-312):
+
+- ``{message}``            -> observer passthrough (crdt.js:280-284)
+- ``{meta:'cleanup'}``     -> peer_close (crdt.js:285)
+- ``{meta:'ready', ...}``  -> if synced, act as syncer: encode the diff
+                              against the requester's state vector and
+                              unicast ``{update, meta:'sync'}``
+                              (crdt.js:286-291 — the one true delta in
+                              the reference; every update here is one)
+- ``{update}``             -> apply, persist, flip ``synced`` on
+                              ``meta:'sync'`` (crdt.js:292-311)
+
+Divergences (documented, SURVEY.md §6): broadcasts are per-transaction
+deltas, not full state (Q2); a replica whose topic has no peers starts
+synced (the reference's heuristic covers only ``-db`` topics and its
+50 ms poll loop otherwise hangs a solo first node); collections
+created remotely appear in the cache (D3).
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from crdt_tpu.api.doc import Crdt
+from crdt_tpu.codec import v1
+from crdt_tpu.core.ids import StateVector
+
+
+class MemoryPersistence:
+    """In-RAM stand-in for the update-log store (stage-6 interface).
+
+    Mirrors the reference keyspace semantics (`doc_<name>_update_<ts>`,
+    `_sv`, `_meta` — crdt.js:41-71) with monotonic sequence numbers
+    instead of `Date.now()` keys (fix D6) and caller-supplied state
+    vectors (fix D5: the reference recomputes SVs on an empty doc and
+    stores garbage).
+    """
+
+    def __init__(self):
+        self._updates: Dict[str, List[bytes]] = {}
+        self._sv: Dict[str, bytes] = {}
+        self._meta: Dict[str, dict] = {}
+        self.closed = False
+
+    def store_update(self, doc_name: str, update: bytes, sv: Optional[bytes] = None):
+        if not isinstance(update, (bytes, bytearray)):
+            raise TypeError("update must be bytes")  # crdt.js:29-31
+        self._updates.setdefault(doc_name, []).append(bytes(update))
+        if sv is not None:
+            self._sv[doc_name] = sv
+        self._meta[doc_name] = {
+            "last_updated": time.time(),
+            "size": sum(len(u) for u in self._updates[doc_name]),
+            "count": len(self._updates[doc_name]),
+        }
+
+    def get_all_updates(self, doc_name: str) -> List[bytes]:
+        return list(self._updates.get(doc_name, []))
+
+    def get_state_vector(self, doc_name: str) -> Optional[bytes]:
+        return self._sv.get(doc_name)
+
+    def get_meta(self, doc_name: str) -> Optional[dict]:
+        return self._meta.get(doc_name)
+
+    def compact(self, doc_name: str, snapshot: bytes, sv: Optional[bytes] = None):
+        """Replace the update log with one snapshot update (the
+        compaction the reference lacks — SURVEY.md Q3)."""
+        self._updates[doc_name] = [bytes(snapshot)]
+        if sv is not None:
+            self._sv[doc_name] = sv
+        self._meta[doc_name] = {
+            "last_updated": time.time(),
+            "size": len(snapshot),
+            "count": 1,
+        }
+
+    def open(self):
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+def _random_client_id() -> int:
+    # Yjs randomizes the client id per doc *instance* — a deterministic
+    # identity-derived id is unsafe: a restart without persistence
+    # restarts the clock at 0, so new ops fall below peers' watermarks
+    # and are silently discarded as stale duplicates, and any id
+    # collision between two identities diverges replicas permanently
+    return random.getrandbits(31)
+
+
+class Replica:
+    """One peer: document + transport verbs + sync state."""
+
+    def __init__(
+        self,
+        router,
+        topic: str,
+        *,
+        client_id: Optional[int] = None,
+        persistence=None,
+        observer_function: Optional[Callable[[dict], None]] = None,
+        full_state_updates: bool = False,
+        compact_every: Optional[int] = None,
+    ):
+        if not getattr(router, "is_ypear_router", False):
+            raise TypeError("router is not a ypear router")  # crdt.js:172
+        self.router = router
+        self.topic = topic
+        self.persistence = persistence
+        self.observer_function = observer_function
+        self.compact_every = compact_every
+        self.synced = False
+        self.closed = False
+        self.peer_state_vectors: Dict[str, StateVector] = {}
+
+        cid = client_id if client_id is not None else _random_client_id()
+        self.doc = Crdt(
+            cid,
+            observer_function=observer_function,
+            on_update=self._on_local_update,
+            full_state_updates=full_state_updates,
+        )
+
+        # load from the update log (crdt.js:193-217): replay every
+        # logged update into the fresh doc
+        if persistence is not None:
+            if getattr(persistence, "closed", False):
+                persistence.open()  # restart after self_close
+            for update in persistence.get_all_updates(topic):
+                self.doc.apply_update(update, origin="load")
+
+        if not router.started:
+            router.start(router.options.get("network_name"))  # crdt.js:231
+
+        (
+            self._propagate,
+            self._broadcast,
+            self.for_peers,
+            self._to_peer,
+        ) = router.alow(topic, self._on_data)
+        # the per-topic sync contract the router drives (crdt.js:234-277)
+        # — registered after `alow` so a topology-triggered sync() never
+        # runs before the transport verbs exist
+        router.update_options_cache(
+            {
+                topic: {
+                    "synced": False,
+                    "sync": self.sync,
+                    "peer_state_vectors": self.peer_state_vectors,
+                    "update_state_vector": self._update_own_sv,
+                    "set_peer_state_vector": self.set_peer_state_vector,
+                    "peer_close": self.peer_close,
+                    "self_close": self.self_close,
+                }
+            }
+        )
+
+        if not router.peers_on(topic):
+            # solo first node: nobody can answer a ready probe
+            self._set_synced(True)
+        else:
+            self.sync()
+
+    # ------------------------------------------------------------------
+    # sync contract (crdt.js:234-277)
+    # ------------------------------------------------------------------
+    def sync(self) -> None:
+        """Anti-entropy entry point: announce readiness with our SV
+        (crdt.js:237-244). Peers answer with a diff update."""
+        if self.synced or self.closed:
+            return
+        if not self.router.peers_on(self.topic):
+            # the last peer left before answering: a solo replica is
+            # synced by definition (same rule as construction; without
+            # it a topic whose synced members all departed would wedge
+            # every remaining and future replica forever)
+            self._set_synced(True)
+            return
+        self._broadcast(
+            {
+                "meta": "ready",
+                "public_key": self.router.public_key,
+                "state_vector": self.doc.encode_state_vector(),
+            }
+        )
+
+    def _set_synced(self, value: bool) -> None:
+        self.synced = value
+        self.router.options["cache"].setdefault(self.topic, {})["synced"] = value
+
+    def _update_own_sv(self) -> bytes:
+        return self.doc.encode_state_vector()
+
+    def set_peer_state_vector(self, public_key: str, sv_bytes: bytes) -> None:
+        self.peer_state_vectors[public_key] = v1.decode_state_vector(sv_bytes)
+
+    def peer_close(self, public_key: str) -> None:
+        self.peer_state_vectors.pop(public_key, None)  # crdt.js:266-270
+
+    def self_close(self) -> None:
+        """Close persistence and announce cleanup (crdt.js:272-275)."""
+        if self.closed:
+            return
+        self.closed = True
+        if self.persistence is not None:
+            self.persistence.close()
+        self._propagate({"meta": "cleanup", "public_key": self.router.public_key})
+        self.router.unsubscribe(self.topic)
+
+    # ------------------------------------------------------------------
+    # local update tail: persist + broadcast (crdt.js:442-446)
+    # ------------------------------------------------------------------
+    def _on_local_update(self, update: bytes, meta: dict) -> None:
+        self._persist(update)
+        if not self.closed:
+            self._propagate({"update": update, **meta})
+
+    def _persist(self, update: bytes) -> None:
+        if self.persistence is None or self.persistence.closed:
+            return
+        self.persistence.store_update(
+            self.topic, update, sv=self.doc.encode_state_vector()
+        )
+        if self.compact_every:
+            meta = self.persistence.get_meta(self.topic)
+            if meta and meta.get("count", 0) >= self.compact_every:
+                self.compact()
+
+    def compact(self) -> None:
+        """Squash the update log into one full-state snapshot."""
+        if self.persistence is None:
+            return
+        eng = self.doc.engine
+        if eng.pending or eng.pending_deletes.ranges:
+            # stashed updates exist only in the raw log; a snapshot of
+            # integrated state would drop them across a restart
+            return
+        self.persistence.compact(
+            self.topic,
+            self.doc.encode_state_as_update(),
+            sv=self.doc.encode_state_vector(),
+        )
+
+    # ------------------------------------------------------------------
+    # receive path (crdt.js:279-312)
+    # ------------------------------------------------------------------
+    def _on_data(self, msg: dict, from_pk: str) -> None:
+        if self.closed:
+            return
+        if "message" in msg:
+            # free-form payload passthrough (crdt.js:280-284)
+            if self.observer_function is not None:
+                self.observer_function(msg)
+            return
+        meta = msg.get("meta")
+        if meta == "cleanup":
+            self.peer_close(msg.get("public_key", from_pk))
+            return
+        if meta == "ready":
+            # act as syncer (crdt.js:286-291). Unlike the reference,
+            # unsynced replicas answer too: two unsynced peers exchange
+            # what they have and both converge (the reference's
+            # synced-only gate deadlocks a topic whose synced members
+            # all left). The reply carries our own SV so the requester
+            # can return a back-diff — the reference's handshake is
+            # one-way and silently strands the requester's surplus
+            # state (e.g. ops replayed from its local log).
+            requester = msg["public_key"]
+            sv = v1.decode_state_vector(msg["state_vector"])
+            self.peer_state_vectors[requester] = sv
+            diff = self.doc.encode_state_as_update(sv)
+            self._to_peer(
+                requester,
+                {
+                    "update": diff,
+                    "meta": "sync",
+                    "state_vector": self.doc.encode_state_vector(),
+                },
+            )
+            return
+        if "update" in msg:
+            update = msg["update"]
+            self.doc.apply_update(update, origin="sync" if meta == "sync" else "remote")
+            self._persist(update)
+            if meta == "sync":
+                self._set_synced(True)  # crdt.js:306
+                if "state_vector" in msg:
+                    # second leg of the handshake: ship the syncer
+                    # whatever we hold beyond its state vector. Sent
+                    # unconditionally — an SV-dominance check would
+                    # strand tombstone-only surplus, since delete sets
+                    # live outside state vectors (diffs always carry
+                    # the full delete set, like Yjs)
+                    their_sv = v1.decode_state_vector(msg["state_vector"])
+                    back = self.doc.encode_state_as_update(their_sv)
+                    self._to_peer(from_pk, {"update": back})
+
+    # ------------------------------------------------------------------
+    # convenience passthroughs to the document API
+    # ------------------------------------------------------------------
+    @property
+    def c(self):
+        return self.doc.c
+
+    def __getattr__(self, prop: str) -> Any:
+        doc = self.__dict__.get("doc")
+        if doc is not None:
+            try:
+                return getattr(doc, prop)
+            except AttributeError:
+                pass
+        raise AttributeError(prop)
+
+    def send_message(self, payload: Any) -> None:
+        """Broadcast a non-CRDT message to peers (observer passthrough)."""
+        self._propagate({"message": payload, "public_key": self.router.public_key})
+
+
+def ypear_crdt(router, **options) -> Replica:
+    """Factory mirroring ``ypearCRDT(router, options)`` (crdt.js:166)."""
+    topic = options.pop("topic", None)
+    if not topic:
+        raise ValueError("options.topic is required")
+    return Replica(router, topic, **options)
