@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""North-star benchmark: replica fan-in convergence, device vs scalar.
+
+Workload (BASELINE.json north star, scaled by env): R replicas
+concurrently write K map ops each (same shape as the 1k-replica fan-in
+config); a fraction are deletes. Baseline is the stock-Yjs-semantics
+scalar integrate loop (crdt_tpu.core.engine — the faithful port of the
+reference's ``Y.applyUpdate`` hot loop, crdt.js:294). Device path is
+the batched ``converge_maps`` kernel: the whole union merged in one
+dispatch.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+where value is device convergence throughput (ops/s) and vs_baseline
+is the speedup over the scalar loop on the identical op set.
+
+Env knobs: BENCH_REPLICAS (default 128), BENCH_OPS (ops per replica,
+default 256), BENCH_ITERS (timed kernel reps, default 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def build_workload(R: int, K: int, seed: int = 0):
+    """Concurrent map-set records from R replicas + a delete set."""
+    from crdt_tpu.core.ids import DeleteSet
+    from crdt_tpu.core.records import ItemRecord
+
+    rng = np.random.default_rng(seed)
+    num_maps = 8
+    keys_per_map = max(64, (R * K) // 64)
+    maps = rng.integers(0, num_maps, (R, K))
+    keys = rng.integers(0, keys_per_map, (R, K))
+    records = []
+    for r in range(R):
+        client = r + 1
+        for k in range(K):
+            records.append(
+                ItemRecord(
+                    client=client,
+                    clock=k,
+                    parent_root=f"m{maps[r, k]}",
+                    key=f"k{keys[r, k]}",
+                    content=int(r * K + k),
+                )
+            )
+    ds = DeleteSet()
+    n_del = (R * K) // 20  # 5% tombstones
+    for i in rng.choice(R * K, size=n_del, replace=False):
+        ds.add(int(i // K) + 1, int(i % K))
+    return records, ds
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from crdt_tpu.core.engine import Engine
+    from crdt_tpu.ops import deleteset as ds_ops
+    from crdt_tpu.ops.merge import Interner, converge_maps, records_to_columns
+
+    R = int(os.environ.get("BENCH_REPLICAS", 128))
+    K = int(os.environ.get("BENCH_OPS", 256))
+    iters = int(os.environ.get("BENCH_ITERS", 5))
+    total = R * K
+    log(f"workload: {R} replicas x {K} ops = {total} ops on {jax.devices()[0].platform}")
+
+    records, ds = build_workload(R, K)
+
+    # ---- scalar baseline: the reference's one-at-a-time merge loop ----
+    eng = Engine(0)
+    t0 = time.perf_counter()
+    eng.apply_records(records, ds)
+    t_scalar = time.perf_counter() - t0
+    oracle = eng.map_winner_table()
+    log(f"scalar integrate: {t_scalar:.3f}s ({total / t_scalar:,.0f} ops/s)")
+
+    # ---- device path: one batched convergence dispatch ---------------
+    interner = Interner()
+    pad = 1 << max(9, (total - 1).bit_length())
+    cols = records_to_columns(records, interner, pad=pad)
+    d_client, d_start, d_end = ds_ops.ranges_to_device(ds)
+    dpad = 1 << max(6, (len(d_client) - 1).bit_length())
+    d_client = np.asarray(list(d_client) + [-1] * (dpad - len(d_client)), np.int32)
+    d_start = np.asarray(list(d_start) + [-1] * (dpad - len(d_start)), np.int64)
+    d_end = np.asarray(list(d_end) + [-1] * (dpad - len(d_end)), np.int64)
+
+    args = (
+        jnp.asarray(cols["client"]),
+        jnp.asarray(cols["clock"]),
+        jnp.asarray(cols["parent_is_root"]),
+        jnp.asarray(cols["parent_a"]),
+        jnp.asarray(cols["parent_b"]),
+        jnp.asarray(cols["key_id"]),
+        jnp.asarray(cols["origin_client"]),
+        jnp.asarray(cols["origin_clock"]),
+        jnp.asarray(cols["valid"]),
+        jnp.asarray(d_client),
+        jnp.asarray(d_start),
+        jnp.asarray(d_end),
+    )
+    fn = partial(converge_maps, num_segments=pad)
+
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    log(f"compile+first run: {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t_device = (time.perf_counter() - t0) / iters
+    log(f"device converge: {t_device * 1e3:.2f}ms ({total / t_device:,.0f} ops/s)")
+
+    # ---- correctness: device winners == scalar oracle ----------------
+    order, seg, winners, visible, _, _ = (np.asarray(x) for x in out)
+    got = {}
+    for w, vis in zip(winners, visible):
+        if w < 0:
+            continue
+        rec = records[order[w]] if order[w] < total else None
+        if rec is None:
+            continue
+        got[(("root", rec.parent_root), rec.key)] = (rec.id, bool(vis))
+    want = {k: v for k, v in oracle.items()}
+    mismatch = sum(1 for k, v in want.items() if got.get(k) != v)
+    assert mismatch == 0, f"{mismatch}/{len(want)} winners diverge from oracle"
+    log(f"correctness: {len(want)} map keys, 0 divergent")
+
+    print(
+        json.dumps(
+            {
+                "metric": "map_converge_throughput",
+                "value": round(total / t_device),
+                "unit": "ops/s",
+                "vs_baseline": round(t_scalar / t_device, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

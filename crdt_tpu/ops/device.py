@@ -89,5 +89,10 @@ def pointer_double(f: jnp.ndarray) -> jnp.ndarray:
         _, it, changed = state
         return changed & (it < max_iters)
 
-    g, _, _ = jax.lax.while_loop(cond, body, (f, jnp.int32(0), jnp.array(True)))
+    # initial `changed` is derived from f (not a constant) so the carry
+    # carries f's varying-axes type under shard_map, and an input
+    # already at fixpoint exits immediately
+    g, _, _ = jax.lax.while_loop(
+        cond, body, (f, jnp.int32(0), jnp.any(f[f] != f))
+    )
     return g

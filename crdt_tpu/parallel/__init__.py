@@ -1,0 +1,3 @@
+from crdt_tpu.parallel.gossip import make_gossip_step, make_mesh
+
+__all__ = ["make_gossip_step", "make_mesh"]

@@ -1,0 +1,192 @@
+"""Replica-parallel gossip over a device mesh — ICI as the swarm fabric.
+
+The reference's one parallelism axis is replica parallelism: N peers
+full-mesh-gossiping updates over Hyperswarm and converging by CRDT
+merge (SURVEY.md §2.2: `propagate` at crdt.js:385,445,...; the
+ready/sync handshake at crdt.js:237-291). On TPU that maps to:
+
+- replicas = a sharded batch dimension over a 1D ``Mesh`` axis;
+- ``propagate`` (full-mesh gossip) = ``all_gather`` of the replicas'
+  op columns over ICI;
+- the merge every peer performs on receipt (``Y.applyUpdate``,
+  crdt.js:294) = one vectorized ``converge_maps`` over the gathered
+  union, computed replicated on every device — exactly the CRDT
+  model, where each replica merges the same op set and reaches the
+  same state;
+- the state-vector handshake = per-replica SV build (scatter-max) +
+  all-gather + the pairwise ``missing`` deficit matrix, replacing the
+  reference's one-peer-at-a-time `encodeStateVector` exchange.
+
+No tensor/pipeline/expert axes are invented: the reference has no
+model compute to shard (SURVEY.md §2.2 parallelism census); the honest
+scale story is replicas × ops, and ops scale inside each device's
+static-shape columns.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from crdt_tpu.ops import statevec
+from crdt_tpu.ops.merge import converge_maps
+
+REPLICA_AXIS = "replicas"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = REPLICA_AXIS) -> Mesh:
+    """1D replica mesh over the first `n_devices` devices (all when
+    None). Multi-host meshes work the same way: jax.devices() spans
+    hosts and the collectives ride ICI within a slice / DCN across."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
+    """Build the jitted full gossip+merge step for `mesh`.
+
+    Step inputs (all sharded over the replica axis, shapes [R, N]):
+    the op columns of each replica's pending update batch, plus
+    replicated delete ranges ([D] triples). Outputs:
+
+    - ``sv_local``  [R, C] per-replica state vectors (sharded)
+    - ``global_sv`` [C] merged swarm state vector (replicated)
+    - ``deficit``   [R, R] pairwise missing-clock totals (replicated)
+      — the anti-entropy plan: entry (i, j) > 0 means i must send to j
+    - ``winners``/``winner_visible`` [S] converged map winners over
+      the whole union (replicated; indices into id-sorted union space)
+    """
+    axis = mesh.axis_names[0]
+    nd = mesh.devices.size
+
+    col_specs = (P(axis, None),) * 9
+    del_specs = (P(), P(), P())
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=col_specs + del_specs,
+        out_specs=(P(axis, None), P(), P(), P(), P()),
+        # the replicated outputs derive only from all_gather'd values,
+        # but the vma checker cannot prove that through converge_maps's
+        # while_loop (pointer doubling); the P() specs are correct
+        check_vma=False,
+    )
+    def step(
+        client,
+        clock,
+        parent_is_root,
+        parent_a,
+        parent_b,
+        key_id,
+        origin_client,
+        origin_clock,
+        valid,
+        d_client,
+        d_start,
+        d_end,
+    ):
+        # per-replica state vectors: scatter-max over the local shard
+        sv_local = jax.vmap(
+            lambda c, k, v: statevec.build(c, k, v, num_clients)
+        )(client, clock, valid)
+
+        # handshake fan-in: all-gather every replica's SV, derive the
+        # merged swarm vector and the pairwise anti-entropy plan
+        svs = jax.lax.all_gather(sv_local, axis).reshape(-1, num_clients)
+        global_sv = statevec.merge(svs)
+        deficit = statevec.missing(svs)
+
+        # gossip fan-in: all-gather the op columns into the union every
+        # replica would hold after a full propagate round
+        def gather_flat(x):
+            return jax.lax.all_gather(x, axis).reshape(-1)
+
+        (
+            u_client,
+            u_clock,
+            u_root,
+            u_pa,
+            u_pb,
+            u_key,
+            u_oc,
+            u_ok,
+            u_valid,
+        ) = (
+            gather_flat(x)
+            for x in (
+                client,
+                clock,
+                parent_is_root,
+                parent_a,
+                parent_b,
+                key_id,
+                origin_client,
+                origin_clock,
+                valid,
+            )
+        )
+
+        # every replica merges the same union -> replicated converge
+        _, _, winners, winner_visible, _, _ = converge_maps(
+            u_client,
+            u_clock,
+            u_root,
+            u_pa,
+            u_pb,
+            u_key,
+            u_oc,
+            u_ok,
+            u_valid,
+            d_client,
+            d_start,
+            d_end,
+            num_segments=num_segments,
+        )
+        return sv_local, global_sv, deficit, winners, winner_visible
+
+    return jax.jit(step)
+
+
+def synth_columns(
+    n_replicas: int,
+    ops_per_replica: int,
+    *,
+    num_maps: int = 4,
+    keys_per_map: int = 64,
+    seed: int = 0,
+):
+    """Synthetic replica-parallel LWW workload as padded columns.
+
+    Each replica r (client id r+1) writes `ops_per_replica` map sets
+    over `num_maps` root maps × `keys_per_map` interned keys — the
+    1k-replica fan-in shape of the north star. Returns a dict of
+    [R, N] arrays plus empty delete ranges.
+    """
+    rng = np.random.default_rng(seed)
+    R, N = n_replicas, ops_per_replica
+    cols = {
+        "client": np.repeat(np.arange(1, R + 1, dtype=np.int32)[:, None], N, 1),
+        "clock": np.repeat(np.arange(N, dtype=np.int64)[None, :], R, 0),
+        "parent_is_root": np.ones((R, N), bool),
+        "parent_a": rng.integers(0, num_maps, (R, N)).astype(np.int64),
+        "parent_b": np.full((R, N), -1, np.int64),
+        "key_id": rng.integers(0, keys_per_map, (R, N)).astype(np.int32),
+        "origin_client": np.full((R, N), -1, np.int32),
+        "origin_clock": np.full((R, N), -1, np.int64),
+        "valid": np.ones((R, N), bool),
+    }
+    dels = (
+        np.full(16, -1, np.int32),
+        np.full(16, -1, np.int64),
+        np.full(16, -1, np.int64),
+    )
+    return cols, dels

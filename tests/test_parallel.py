@@ -1,0 +1,88 @@
+"""Sharded gossip step on the 8-device virtual CPU mesh.
+
+Validates the multi-chip path the driver dry-runs (SURVEY.md §5
+distributed backend): replica-sharded op columns, all-gather fan-in,
+replicated union convergence, SV handshake collectives.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from crdt_tpu.parallel.gossip import make_gossip_step, make_mesh, synth_columns
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    return make_mesh(8)
+
+
+def run_step(mesh, cols, dels, num_segments, num_clients):
+    step = make_gossip_step(mesh, num_segments=num_segments, num_clients=num_clients)
+    args = [jnp.asarray(cols[k]) for k in (
+        "client", "clock", "parent_is_root", "parent_a", "parent_b",
+        "key_id", "origin_client", "origin_clock", "valid",
+    )] + [jnp.asarray(d) for d in dels]
+    return [np.asarray(x) for x in step(*args)]
+
+
+def test_gossip_step_shapes_and_svs(mesh):
+    R, N = 16, 32
+    C = R + 2
+    cols, dels = synth_columns(R, N, num_maps=2, keys_per_map=16)
+    sv_local, global_sv, deficit, winners, visible = run_step(mesh, cols, dels, 256, C)
+    assert sv_local.shape == (R, C)
+    # replica r knows exactly its own clocks before gossip
+    for r in range(R):
+        assert sv_local[r, r + 1] == N
+        assert sv_local[r].sum() == N
+    # merged vector knows everyone
+    assert all(global_sv[r + 1] == N for r in range(R))
+    # anti-entropy plan: every pair owes the other N clocks
+    assert deficit[0, 0] == 0 and deficit[3, 5] == N and deficit[5, 3] == N
+
+
+def test_gossip_winners_match_host_kernel(mesh):
+    """The sharded union converge must equal the single-device kernel
+    on the flattened union."""
+    from functools import partial
+
+    from crdt_tpu.ops.merge import converge_maps
+
+    R, N = 16, 32
+    cols, dels = synth_columns(R, N, num_maps=2, keys_per_map=16, seed=3)
+    _, _, _, winners, visible = run_step(mesh, cols, dels, 256, R + 2)
+
+    flat = {k: np.asarray(v).reshape(-1) for k, v in cols.items()}
+    out = partial(converge_maps, num_segments=256)(
+        jnp.asarray(flat["client"]),
+        jnp.asarray(flat["clock"]),
+        jnp.asarray(flat["parent_is_root"]),
+        jnp.asarray(flat["parent_a"]),
+        jnp.asarray(flat["parent_b"]),
+        jnp.asarray(flat["key_id"]),
+        jnp.asarray(flat["origin_client"]),
+        jnp.asarray(flat["origin_clock"]),
+        jnp.asarray(flat["valid"]),
+        jnp.asarray(dels[0]),
+        jnp.asarray(dels[1]),
+        jnp.asarray(dels[2]),
+    )
+    ref_winners, ref_visible = np.asarray(out[2]), np.asarray(out[3])
+    np.testing.assert_array_equal(winners, ref_winners)
+    np.testing.assert_array_equal(visible, ref_visible)
+
+
+def test_gossip_with_deletes(mesh):
+    R, N = 8, 16
+    cols, _ = synth_columns(R, N, num_maps=1, keys_per_map=4, seed=5)
+    # tombstone all of replica 1's ops
+    dels = (
+        np.asarray([1] + [-1] * 15, np.int32),
+        np.asarray([0] + [-1] * 15, np.int64),
+        np.asarray([N] + [-1] * 15, np.int64),
+    )
+    _, _, _, winners, visible = run_step(mesh, cols, dels, 64, R + 2)
+    assert (winners >= 0).sum() > 0
