@@ -7,12 +7,24 @@ the driver's dryrun_multichip contract).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# forced, not setdefault: CI shells export JAX_PLATFORMS for the real
+# TPU tunnel, which would put the suite on the 1-chip device and break
+# every 8-device mesh test
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# the image's sitecustomize imports jax at interpreter startup (TPU
+# plugin registration), so the env vars above are already baked into
+# jax.config — override the lazy-read config value too; backends have
+# not initialized yet at conftest time, so this still takes effect
+if "jax" in __import__("sys").modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import random
 
