@@ -1,0 +1,6 @@
+"""Persistence layer — native ordered-KV update log (SURVEY.md §7 stage 6)."""
+
+from crdt_tpu.storage.kv import KvLog
+from crdt_tpu.storage.persistence import LogPersistence
+
+__all__ = ["KvLog", "LogPersistence"]

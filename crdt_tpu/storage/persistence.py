@@ -1,0 +1,178 @@
+"""Durable update log with the reference's keyspace semantics.
+
+Mirrors `CRDTPersistence` (/root/reference/crdt.js:5-141) over the
+native kvlog store instead of LevelDB:
+
+  doc_<name>_update_<seq>  append-only update log   (crdt.js:41-42,61)
+  doc_<name>_sv            latest state vector      (crdt.js:62)
+  doc_<name>_meta          JSON {last_updated,size} (crdt.js:63-70)
+
+All three written in one atomic batch per update, like the reference's
+3-key LevelDB batch (crdt.js:60-71). Documented fixes (SURVEY.md §6):
+
+- D5: the stored state vector is the caller's *accumulated* vector —
+  the reference recomputes it by diffing an empty doc and stores
+  garbage (crdt.js:54-59).
+- D6: log keys are zero-padded monotonic sequence numbers, not
+  `Date.now()` — two updates in the same millisecond no longer
+  overwrite each other (crdt.js:41-42).
+- Q3: `compact()` exists — squashes the log to a single snapshot
+  update so startup replay is O(state), not O(history). The reference
+  replays its entire unbounded log (crdt.js:79-98).
+
+Updates are validated by decoding before hitting the log (the
+reference applies each update to a throwaway Y.Doc for the same
+purpose, crdt.js:33-40).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+from crdt_tpu.storage.kv import Batch, KvLog
+
+
+def _esc(doc: str) -> str:
+    # doc names are caller-chosen: a raw name containing "_update_"
+    # would collide with another doc's log prefix (e.g. doc "a" vs doc
+    # "a_update_0"). Percent-escape "_" so the literal separators below
+    # are the only underscores in any key.
+    return doc.replace("%", "%25").replace("_", "%5f")
+
+
+def _update_key(doc: str, seq: int) -> bytes:
+    # 20 digits: lexicographic order == numeric order for any int64
+    return f"doc_{_esc(doc)}_update_{seq:020d}".encode()
+
+
+def _update_prefix(doc: str) -> bytes:
+    return f"doc_{_esc(doc)}_update_".encode()
+
+
+def _sv_key(doc: str) -> bytes:
+    return f"doc_{_esc(doc)}_sv".encode()
+
+
+def _meta_key(doc: str) -> bytes:
+    return f"doc_{_esc(doc)}_meta".encode()
+
+
+class LogPersistence:
+    """Drop-in for :class:`crdt_tpu.net.replica.MemoryPersistence`,
+    backed by the native store. One kvlog file may hold many docs (the
+    reference opens one LevelDB per path; the keyspace is already
+    doc-prefixed so sharing is safe and cheaper)."""
+
+    def __init__(self, path: str, *, validate: bool = True):
+        self.path = str(path)
+        self.validate = validate
+        self._kv: Optional[KvLog] = KvLog(self.path)
+        self._next_seq: dict = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def closed(self) -> bool:
+        return self._kv is None
+
+    def open(self) -> None:
+        if self._kv is None:
+            self._kv = KvLog(self.path)
+            self._next_seq.clear()
+
+    def close(self) -> None:
+        if self._kv is not None:
+            self._kv.sync()
+            self._kv.close()
+            self._kv = None
+
+    def _require(self) -> KvLog:
+        if self._kv is None:
+            raise RuntimeError("persistence is closed")
+        return self._kv
+
+    def _seq_for(self, doc: str) -> int:
+        seq = self._next_seq.get(doc)
+        if seq is None:
+            # resume after the highest logged sequence (scan once)
+            seq = 0
+            last = None
+            for k, _ in self._require().scan_prefix(_update_prefix(doc)):
+                last = k
+            if last is not None:
+                seq = int(last.rsplit(b"_", 1)[1]) + 1
+        self._next_seq[doc] = seq + 1
+        return seq
+
+    # -- the CRDTPersistence surface --------------------------------------
+    def store_update(self, doc_name: str, update: bytes, sv: Optional[bytes] = None) -> None:
+        if not isinstance(update, (bytes, bytearray)):
+            raise TypeError("update must be bytes")  # crdt.js:29-31
+        update = bytes(update)
+        if self.validate:
+            from crdt_tpu.codec import v1
+
+            v1.decode_update(update)  # raises on malformed input
+        kv = self._require()
+        seq = self._seq_for(doc_name)
+        batch = Batch()
+        batch.put(_update_key(doc_name, seq), update)
+        if sv is not None:
+            batch.put(_sv_key(doc_name), bytes(sv))
+        meta = self.get_meta(doc_name) or {"size": 0, "count": 0}
+        batch.put(
+            _meta_key(doc_name),
+            json.dumps(
+                {
+                    "last_updated": time.time(),
+                    "size": meta["size"] + len(update),
+                    "count": meta["count"] + 1,
+                }
+            ).encode(),
+        )
+        kv.write(batch)
+
+    def get_all_updates(self, doc_name: str) -> List[bytes]:
+        return [v for _, v in self._require().scan_prefix(_update_prefix(doc_name))]
+
+    def get_state_vector(self, doc_name: str) -> Optional[bytes]:
+        return self._require().get(_sv_key(doc_name))
+
+    def get_meta(self, doc_name: str) -> Optional[dict]:
+        raw = self._require().get(_meta_key(doc_name))
+        return json.loads(raw) if raw is not None else None
+
+    def compact(self, doc_name: str, snapshot: bytes, sv: Optional[bytes] = None) -> None:
+        """Replace the doc's update log with one snapshot update, then
+        drop dead log history from disk."""
+        kv = self._require()
+        batch = Batch()
+        for k in kv.keys(_update_prefix(doc_name)):
+            batch.delete(k)
+        batch.put(_update_key(doc_name, 0), bytes(snapshot))
+        if sv is not None:
+            batch.put(_sv_key(doc_name), bytes(sv))
+        batch.put(
+            _meta_key(doc_name),
+            json.dumps(
+                {"last_updated": time.time(), "size": len(snapshot), "count": 1}
+            ).encode(),
+        )
+        kv.write(batch)
+        self._next_seq[doc_name] = 1
+        # reclaim disk only when dead history dominates: kv.compact()
+        # rewrites the WHOLE shared store, so an unconditional call
+        # would make N docs' auto-compaction O(store) each — amortize
+        # against live size instead (LevelDB's own trigger is
+        # similarly ratio-based)
+        if kv.log_size > 4 * max(kv.live_size, 1):
+            kv.compact()
+
+    # -- maintenance -------------------------------------------------------
+    def sync(self) -> None:
+        self._require().sync()
+
+    @property
+    def log_size(self) -> int:
+        return self._require().log_size

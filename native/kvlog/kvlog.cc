@@ -1,0 +1,407 @@
+// kvlog — log-structured ordered KV store (the rebuild's LevelDB).
+//
+// The reference persists through `level` -> `leveldown`, a C++ LevelDB
+// binding (/root/reference/package.json:13, crdt.js:18-20), used for:
+// atomic multi-key batch writes (crdt.js:60-71), point gets
+// (crdt.js:47), ordered prefix range scans (crdt.js:111-130), and
+// close (crdt.js:134). This store implements exactly that capability
+// surface natively:
+//
+//   - append-only write-ahead log, every record CRC32-guarded; a torn
+//     or corrupt tail (crash mid-write) is detected and discarded on
+//     open, everything before it replays — LevelDB's WAL recovery
+//     contract
+//   - atomic batches: one batch = one WAL record; it either fully
+//     replays or (torn) fully disappears — the reference relies on
+//     this for its update+sv+meta triple (crdt.js:60-71)
+//   - in-memory ordered index (std::map) rebuilt on open = the
+//     memtable; point get O(log n), ordered range scan via iterator
+//   - compaction: rewrite live entries to a fresh log, fsync, atomic
+//     rename over the old one — dropping overwritten/deleted history
+//     (the snapshot-compaction hook the reference lacks, SURVEY.md Q3)
+//
+// Exposed as a flat C ABI for ctypes (no pybind11 in the image).
+// Thread-safe behind one mutex: the access pattern is single-writer
+// (one replica process per store, like the reference's one LevelDB
+// dir per doc).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// crc32 (IEEE, table-driven)
+// ---------------------------------------------------------------------------
+
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* buf, size_t len) {
+  crc_init();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++) c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// little-endian helpers
+// ---------------------------------------------------------------------------
+
+void put_u32(std::string& out, uint32_t v) {
+  out.push_back(static_cast<char>(v & 0xFF));
+  out.push_back(static_cast<char>((v >> 8) & 0xFF));
+  out.push_back(static_cast<char>((v >> 16) & 0xFF));
+  out.push_back(static_cast<char>((v >> 24) & 0xFF));
+}
+
+uint32_t get_u32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+// WAL record: [u32 payload_len][u32 crc32(payload)][payload]
+// payload: sequence of ops, op = [u8 kind][u32 klen][u32 vlen][key][val]
+// kind: 0 = put, 1 = delete (vlen == 0)
+constexpr uint8_t OP_PUT = 0;
+constexpr uint8_t OP_DEL = 1;
+
+struct Store {
+  std::mutex mu;
+  std::string path;
+  int fd = -1;
+  std::map<std::string, std::string> index;  // the memtable
+  uint64_t log_bytes = 0;
+  uint64_t live_bytes = 0;
+
+  ~Store() {
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+void set_err(char* errbuf, int errlen, const char* msg) {
+  if (errbuf && errlen > 0) {
+    std::snprintf(errbuf, static_cast<size_t>(errlen), "%s", msg);
+  }
+}
+
+// Apply one decoded payload to the index. Returns false on malformed
+// payload (only possible via API misuse — CRC already passed).
+bool apply_payload(Store* s, const uint8_t* p, size_t len) {
+  size_t off = 0;
+  while (off < len) {
+    if (off + 9 > len) return false;
+    uint8_t kind = p[off];
+    uint32_t klen = get_u32(p + off + 1);
+    uint32_t vlen = get_u32(p + off + 5);
+    off += 9;
+    if (off + klen + vlen > len) return false;
+    std::string key(reinterpret_cast<const char*>(p + off), klen);
+    off += klen;
+    if (kind == OP_PUT) {
+      std::string val(reinterpret_cast<const char*>(p + off), vlen);
+      off += vlen;
+      auto it = s->index.find(key);
+      if (it != s->index.end()) s->live_bytes -= it->first.size() + it->second.size();
+      s->live_bytes += key.size() + val.size();
+      s->index[key] = std::move(val);
+    } else if (kind == OP_DEL) {
+      if (vlen != 0) return false;
+      auto it = s->index.find(key);
+      if (it != s->index.end()) {
+        s->live_bytes -= it->first.size() + it->second.size();
+        s->index.erase(it);
+      }
+    } else {
+      return false;
+    }
+  }
+  return off == len;
+}
+
+// Replay the log at fd into the index. Truncates a torn/corrupt tail.
+bool replay_log(Store* s, char* errbuf, int errlen) {
+  off_t size = ::lseek(s->fd, 0, SEEK_END);
+  if (size < 0) {
+    set_err(errbuf, errlen, "lseek failed");
+    return false;
+  }
+  std::vector<uint8_t> buf(static_cast<size_t>(size));
+  if (size > 0) {
+    ssize_t rd = ::pread(s->fd, buf.data(), buf.size(), 0);
+    if (rd != size) {
+      set_err(errbuf, errlen, "short read replaying log");
+      return false;
+    }
+  }
+  size_t off = 0;
+  size_t good = 0;  // bytes of fully-valid records
+  while (off + 8 <= buf.size()) {
+    uint32_t plen = get_u32(buf.data() + off);
+    uint32_t want_crc = get_u32(buf.data() + off + 4);
+    if (off + 8 + plen > buf.size()) break;  // torn tail
+    const uint8_t* payload = buf.data() + off + 8;
+    if (crc32(payload, plen) != want_crc) break;  // corrupt tail
+    if (!apply_payload(s, payload, plen)) break;
+    off += 8 + plen;
+    good = off;
+  }
+  if (good < static_cast<size_t>(size)) {
+    // discard the torn tail so the next append starts at a record
+    // boundary (LevelDB logs the same "dropping N bytes" recovery)
+    if (::ftruncate(s->fd, static_cast<off_t>(good)) != 0) {
+      set_err(errbuf, errlen, "ftruncate of torn tail failed");
+      return false;
+    }
+  }
+  s->log_bytes = good;
+  return true;
+}
+
+// Append one framed record; returns 0 on success.
+int append_record(Store* s, const std::string& payload) {
+  std::string frame;
+  frame.reserve(8 + payload.size());
+  put_u32(frame, static_cast<uint32_t>(payload.size()));
+  put_u32(frame, crc32(reinterpret_cast<const uint8_t*>(payload.data()),
+                       payload.size()));
+  frame += payload;
+  size_t done = 0;
+  while (done < frame.size()) {
+    ssize_t wr = ::pwrite(s->fd, frame.data() + done, frame.size() - done,
+                          static_cast<off_t>(s->log_bytes + done));
+    if (wr < 0) {
+      if (errno == EINTR) continue;
+      // roll back the partial write so the in-file tail stays at a
+      // record boundary for this process; crash recovery would drop
+      // it anyway via CRC
+      ::ftruncate(s->fd, static_cast<off_t>(s->log_bytes));
+      return -1;
+    }
+    done += static_cast<size_t>(wr);
+  }
+  s->log_bytes += frame.size();
+  return 0;
+}
+
+void encode_op(std::string& payload, uint8_t kind, const uint8_t* key,
+               uint32_t klen, const uint8_t* val, uint32_t vlen) {
+  payload.push_back(static_cast<char>(kind));
+  put_u32(payload, klen);
+  put_u32(payload, vlen);
+  payload.append(reinterpret_cast<const char*>(key), klen);
+  if (vlen) payload.append(reinterpret_cast<const char*>(val), vlen);
+}
+
+uint8_t* dup_bytes(const std::string& s) {
+  uint8_t* p = static_cast<uint8_t*>(std::malloc(s.size() ? s.size() : 1));
+  if (p && !s.empty()) std::memcpy(p, s.data(), s.size());
+  return p;
+}
+
+struct Iter {
+  // snapshot of the matching range at creation time: iteration stays
+  // valid across concurrent writes (same isolation the reference gets
+  // from LevelDB's createReadStream snapshot, crdt.js:111-130)
+  std::vector<std::pair<std::string, std::string>> rows;
+  size_t pos = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+typedef Store kv_t;
+typedef Iter kv_iter_t;
+
+kv_t* kv_open(const char* path, char* errbuf, int errlen) {
+  Store* s = new (std::nothrow) Store();
+  if (!s) {
+    set_err(errbuf, errlen, "out of memory");
+    return nullptr;
+  }
+  s->path = path;
+  s->fd = ::open(path, O_RDWR | O_CREAT, 0644);
+  if (s->fd < 0) {
+    set_err(errbuf, errlen, std::strerror(errno));
+    delete s;
+    return nullptr;
+  }
+  if (!replay_log(s, errbuf, errlen)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void kv_close(kv_t* s) { delete s; }
+
+int kv_put(kv_t* s, const uint8_t* key, uint32_t klen, const uint8_t* val,
+           uint32_t vlen) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string payload;
+  encode_op(payload, OP_PUT, key, klen, val, vlen);
+  if (append_record(s, payload) != 0) return -1;
+  return apply_payload(s, reinterpret_cast<const uint8_t*>(payload.data()),
+                       payload.size())
+             ? 0
+             : -1;
+}
+
+int kv_del(kv_t* s, const uint8_t* key, uint32_t klen) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string payload;
+  encode_op(payload, OP_DEL, key, klen, nullptr, 0);
+  if (append_record(s, payload) != 0) return -1;
+  return apply_payload(s, reinterpret_cast<const uint8_t*>(payload.data()),
+                       payload.size())
+             ? 0
+             : -1;
+}
+
+// buf = concatenated ops in the payload format; applied atomically
+// (single WAL record).
+int kv_batch(kv_t* s, const uint8_t* buf, uint32_t len) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  // validate before writing: a malformed batch must not reach the log
+  {
+    Store probe;  // throwaway index; cheap for validation-sized batches
+    if (!apply_payload(&probe, buf, len)) return -2;
+  }
+  std::string payload(reinterpret_cast<const char*>(buf), len);
+  if (append_record(s, payload) != 0) return -1;
+  return apply_payload(s, buf, len) ? 0 : -1;
+}
+
+int kv_get(kv_t* s, const uint8_t* key, uint32_t klen, uint8_t** val,
+           uint32_t* vlen) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->index.find(std::string(reinterpret_cast<const char*>(key), klen));
+  if (it == s->index.end()) return 1;
+  *val = dup_bytes(it->second);
+  if (!*val) return -1;
+  *vlen = static_cast<uint32_t>(it->second.size());
+  return 0;
+}
+
+void kv_free(uint8_t* p) { std::free(p); }
+
+// Ordered scan over [start, end); empty end = to the last key.
+kv_iter_t* kv_scan(kv_t* s, const uint8_t* start, uint32_t slen,
+                   const uint8_t* end, uint32_t elen) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  Iter* it = new (std::nothrow) Iter();
+  if (!it) return nullptr;
+  std::string lo(reinterpret_cast<const char*>(start), slen);
+  std::string hi(reinterpret_cast<const char*>(end), elen);
+  if (elen && hi <= lo) return it;  // inverted/empty range
+  auto b = s->index.lower_bound(lo);
+  auto e = elen ? s->index.lower_bound(hi) : s->index.end();
+  for (; b != e; ++b) it->rows.emplace_back(b->first, b->second);
+  return it;
+}
+
+int kv_iter_next(kv_iter_t* it, uint8_t** key, uint32_t* klen, uint8_t** val,
+                 uint32_t* vlen) {
+  if (it->pos >= it->rows.size()) return 1;
+  const auto& kv = it->rows[it->pos++];
+  *key = dup_bytes(kv.first);
+  *val = dup_bytes(kv.second);
+  if (!*key || !*val) return -1;
+  *klen = static_cast<uint32_t>(kv.first.size());
+  *vlen = static_cast<uint32_t>(kv.second.size());
+  return 0;
+}
+
+void kv_iter_close(kv_iter_t* it) { delete it; }
+
+int kv_sync(kv_t* s) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  return ::fsync(s->fd) == 0 ? 0 : -1;
+}
+
+// Rewrite live entries to <path>.compact, fsync, rename over the log.
+int kv_compact(kv_t* s) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  std::string tmp_path = s->path + ".compact";
+  int tfd = ::open(tmp_path.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (tfd < 0) return -1;
+  // one record per entry keeps records small and the tail torn-safe
+  uint64_t off = 0;
+  for (const auto& kv : s->index) {
+    std::string payload;
+    encode_op(payload, OP_PUT,
+              reinterpret_cast<const uint8_t*>(kv.first.data()),
+              static_cast<uint32_t>(kv.first.size()),
+              reinterpret_cast<const uint8_t*>(kv.second.data()),
+              static_cast<uint32_t>(kv.second.size()));
+    std::string frame;
+    put_u32(frame, static_cast<uint32_t>(payload.size()));
+    put_u32(frame, crc32(reinterpret_cast<const uint8_t*>(payload.data()),
+                         payload.size()));
+    frame += payload;
+    size_t done = 0;
+    while (done < frame.size()) {
+      ssize_t wr = ::pwrite(tfd, frame.data() + done, frame.size() - done,
+                            static_cast<off_t>(off + done));
+      if (wr < 0) {
+        if (errno == EINTR) continue;
+        ::close(tfd);
+        ::unlink(tmp_path.c_str());
+        return -1;
+      }
+      done += static_cast<size_t>(wr);
+    }
+    off += frame.size();
+  }
+  if (::fsync(tfd) != 0 || ::rename(tmp_path.c_str(), s->path.c_str()) != 0) {
+    ::close(tfd);
+    ::unlink(tmp_path.c_str());
+    return -1;
+  }
+  ::close(s->fd);
+  s->fd = tfd;
+  s->log_bytes = off;
+  return 0;
+}
+
+uint64_t kv_count(kv_t* s) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->index.size();
+}
+
+uint64_t kv_log_size(kv_t* s) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->log_bytes;
+}
+
+uint64_t kv_live_size(kv_t* s) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->live_bytes;
+}
+
+}  // extern "C"

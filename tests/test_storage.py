@@ -1,0 +1,307 @@
+"""Native kvlog store + LogPersistence (SURVEY.md §7 stage 6).
+
+Covers the capability surface the reference gets from LevelDB
+(crdt.js:18-20,47,60-71,111-130,134) plus the crash-recovery and
+compaction behavior the rebuild adds: torn-tail WAL recovery, atomic
+batches, ordered prefix scans, monotonic update keys (D6 fix), stored
+accumulated SVs (D5 fix), and log squashing (Q3 fix).
+"""
+
+import json
+import os
+
+import pytest
+
+from crdt_tpu.net.replica import Replica
+from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+from crdt_tpu.storage import KvLog, LogPersistence
+from crdt_tpu.storage.kv import Batch
+
+
+@pytest.fixture
+def path(tmp_path):
+    return str(tmp_path / "store.kvlog")
+
+
+# ---------------------------------------------------------------------------
+# KvLog
+# ---------------------------------------------------------------------------
+
+
+def test_put_get_delete_roundtrip(path):
+    with KvLog(path) as kv:
+        kv.put(b"a", b"1")
+        kv.put(b"b", b"\x00\xff" * 100)
+        assert kv.get(b"a") == b"1"
+        assert kv.get(b"b") == b"\x00\xff" * 100
+        assert kv.get(b"missing") is None
+        kv.delete(b"a")
+        assert kv.get(b"a") is None
+        assert len(kv) == 1
+
+
+def test_reopen_replays_log(path):
+    with KvLog(path) as kv:
+        for i in range(100):
+            kv.put(f"k{i:03d}".encode(), f"v{i}".encode())
+        kv.put(b"k000", b"overwritten")
+        kv.delete(b"k001")
+    with KvLog(path) as kv:
+        assert len(kv) == 99
+        assert kv.get(b"k000") == b"overwritten"
+        assert kv.get(b"k001") is None
+        assert kv.get(b"k099") == b"v99"
+
+
+def test_ordered_scan_and_prefix(path):
+    with KvLog(path) as kv:
+        kv.put(b"doc_a_update_002", b"u2")
+        kv.put(b"doc_a_update_000", b"u0")
+        kv.put(b"doc_b_update_000", b"x")
+        kv.put(b"doc_a_update_001", b"u1")
+        kv.put(b"doc_a_sv", b"sv")
+        rows = list(kv.scan_prefix(b"doc_a_update_"))
+        assert [k for k, _ in rows] == [
+            b"doc_a_update_000", b"doc_a_update_001", b"doc_a_update_002",
+        ]
+        assert [v for _, v in rows] == [b"u0", b"u1", b"u2"]
+        # half-open range
+        rows = list(kv.scan(b"doc_a_update_001", b"doc_b"))
+        assert [k for k, _ in rows] == [b"doc_a_update_001", b"doc_a_update_002"]
+
+
+def test_scan_is_snapshot(path):
+    with KvLog(path) as kv:
+        kv.put(b"a", b"1")
+        kv.put(b"b", b"2")
+        it = kv.scan()
+        kv.put(b"c", b"3")  # must not appear in the open iterator
+        assert [k for k, _ in it] == [b"a", b"b"]
+
+
+def test_batch_is_atomic_across_crash(path):
+    kv = KvLog(path)
+    kv.put(b"before", b"x")
+    batch = Batch()
+    batch.put(b"doc_update_0", b"u" * 50)
+    batch.put(b"doc_sv", b"s" * 10)
+    batch.put(b"doc_meta", b"m" * 10)
+    kv.write(batch)
+    kv.close()
+
+    # torn tail: chop bytes off the last (batch) record — recovery must
+    # drop the WHOLE batch, never a prefix of it
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 7)
+    with KvLog(path) as kv:
+        assert kv.get(b"before") == b"x"
+        assert kv.get(b"doc_update_0") is None
+        assert kv.get(b"doc_sv") is None
+        assert kv.get(b"doc_meta") is None
+        # the store stays writable after tail truncation
+        kv.put(b"after", b"y")
+    with KvLog(path) as kv:
+        assert kv.get(b"after") == b"y"
+
+
+def test_corrupt_tail_is_dropped(path):
+    with KvLog(path) as kv:
+        kv.put(b"good", b"1")
+        kv.put(b"bad", b"2")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:  # flip a payload byte of the last record
+        f.seek(size - 1)
+        f.write(bytes([f.read(0) == b"" and 0x5A]))
+    with KvLog(path) as kv:
+        assert kv.get(b"good") == b"1"
+        assert kv.get(b"bad") is None
+
+
+def test_compact_drops_history(path):
+    with KvLog(path) as kv:
+        for i in range(200):
+            kv.put(b"hot", f"v{i}".encode())
+        kv.put(b"cold", b"keep")
+        before = kv.log_size
+        kv.compact()
+        assert kv.log_size < before
+        assert kv.get(b"hot") == b"v199"
+        assert kv.get(b"cold") == b"keep"
+    with KvLog(path) as kv:  # compacted log replays correctly
+        assert kv.get(b"hot") == b"v199"
+        assert len(kv) == 2
+
+
+def test_closed_store_raises_instead_of_segfaulting(path):
+    kv = KvLog(path)
+    kv.put(b"a", b"1")
+    kv.close()
+    with pytest.raises(RuntimeError):
+        kv.get(b"a")
+    with pytest.raises(RuntimeError):
+        kv.put(b"b", b"2")
+    with pytest.raises(RuntimeError):
+        list(kv.scan())
+    kv.close()  # double close is a no-op
+
+
+def test_inverted_scan_range_is_empty(path):
+    with KvLog(path) as kv:
+        kv.put(b"a", b"1")
+        kv.put(b"b", b"2")
+        kv.put(b"c", b"3")
+        assert list(kv.scan(b"c", b"b")) == []
+        assert list(kv.scan(b"b", b"b")) == []
+
+
+def test_doc_names_with_separator_do_not_collide(path):
+    p = LogPersistence(path)
+    ua, ub = _mk_update(1), _mk_update(2)
+    p.store_update("a", ua)
+    p.store_update("a_update_0", ub)  # raw prefix of doc 'a's keyspace
+    assert p.get_all_updates("a") == [ua]
+    assert p.get_all_updates("a_update_0") == [ub]
+    assert p.get_meta("a")["count"] == 1
+    p.close()
+
+
+def test_empty_values_and_binary_keys(path):
+    with KvLog(path) as kv:
+        kv.put(b"\x00\x01\xfe", b"")
+        assert kv.get(b"\x00\x01\xfe") == b""
+    with KvLog(path) as kv:
+        assert kv.get(b"\x00\x01\xfe") == b""
+
+
+# ---------------------------------------------------------------------------
+# LogPersistence
+# ---------------------------------------------------------------------------
+
+
+def _mk_update(client, n_ops=3):
+    """A real v1 update: n_ops map sets from one client."""
+    from crdt_tpu.api.doc import Crdt
+
+    doc = Crdt(client)
+    for i in range(n_ops):
+        doc.map("m", batch=True)
+        doc.set("m", f"k{i}", i, batch=True)
+    return doc.exec_batch(propagate=False)
+
+
+def test_store_and_replay_updates(path):
+    p = LogPersistence(path)
+    u1, u2 = _mk_update(1), _mk_update(2)
+    p.store_update("topic", u1, sv=b"\x01")
+    p.store_update("topic", u2, sv=b"\x02")
+    assert p.get_all_updates("topic") == [u1, u2]
+    assert p.get_state_vector("topic") == b"\x02"  # D5: accumulated, not garbage
+    meta = p.get_meta("topic")
+    assert meta["count"] == 2 and meta["size"] == len(u1) + len(u2)
+    p.close()
+    # restart: sequence numbers continue after the logged ones (D6)
+    p = LogPersistence(path)
+    u3 = _mk_update(3)
+    p.store_update("topic", u3)
+    assert p.get_all_updates("topic") == [u1, u2, u3]
+    p.close()
+
+
+def test_docs_are_isolated(path):
+    p = LogPersistence(path)
+    ua, ub = _mk_update(1), _mk_update(2)
+    p.store_update("a", ua)
+    p.store_update("b", ub)
+    assert p.get_all_updates("a") == [ua]
+    assert p.get_all_updates("b") == [ub]
+    assert p.get_meta("a")["count"] == 1
+    p.close()
+
+
+def test_rejects_malformed_updates(path):
+    p = LogPersistence(path)
+    with pytest.raises(TypeError):
+        p.store_update("t", "not bytes")  # crdt.js:29-31
+    with pytest.raises(Exception):
+        p.store_update("t", b"\xff\xff garbage \x00")
+    assert p.get_all_updates("t") == []
+    p.close()
+
+
+def test_compact_replaces_log(path):
+    p = LogPersistence(path)
+    for c in range(1, 6):
+        p.store_update("t", _mk_update(c))
+    assert p.get_meta("t")["count"] == 5
+    snapshot = _mk_update(9, n_ops=1)
+    p.compact("t", snapshot, sv=b"\x07")
+    assert p.get_all_updates("t") == [snapshot]
+    assert p.get_state_vector("t") == b"\x07"
+    assert p.get_meta("t")["count"] == 1
+    # post-compaction appends land after the snapshot
+    u = _mk_update(10)
+    p.store_update("t", u)
+    assert p.get_all_updates("t") == [snapshot, u]
+    p.close()
+
+
+# ---------------------------------------------------------------------------
+# Replica integration: durable restart (crdt.js:193-217 load path)
+# ---------------------------------------------------------------------------
+
+
+def test_replica_restart_replays_native_log(path):
+    net = LoopbackNetwork()
+    r1 = Replica(
+        LoopbackRouter(net, "pk1"), "room",
+        client_id=1, persistence=LogPersistence(path),
+    )
+    r1.map("users")
+    r1.set("users", "alice", {"age": 30})
+    r1.push("feed", ["hello", "world"])
+    net.run()
+    expect = dict(r1.c)
+    r1.self_close()
+    net.run()
+
+    # cold restart from the same file — state comes back from the log
+    r2 = Replica(
+        LoopbackRouter(LoopbackNetwork(), "pk1"), "room",
+        client_id=1, persistence=LogPersistence(path),
+    )
+    assert dict(r2.c) == expect
+    assert r2.c["users"] == {"alice": {"age": 30}}
+    assert r2.c["feed"] == ["hello", "world"]
+
+
+def test_replica_auto_compaction_threshold(path):
+    net = LoopbackNetwork()
+    r = Replica(
+        LoopbackRouter(net, "pk1"), "room",
+        client_id=1, persistence=LogPersistence(path), compact_every=5,
+    )
+    for i in range(12):
+        r.set("m", f"k{i}", i)
+    net.run()
+    meta = r.persistence.get_meta("room")
+    assert meta["count"] < 12  # log was squashed at least once
+    r.self_close()
+    # the squashed log still restores full state
+    r2 = Replica(
+        LoopbackRouter(LoopbackNetwork(), "pk2"), "room",
+        client_id=2, persistence=LogPersistence(path),
+    )
+    assert r2.c["m"] == {f"k{i}": i for i in range(12)}
+
+
+def test_meta_is_json(path):
+    p = LogPersistence(path)
+    p.store_update("t", _mk_update(1))
+    raw = KvLog(path)
+    try:
+        meta = json.loads(raw.get(b"doc_t_meta"))
+        assert set(meta) == {"last_updated", "size", "count"}
+    finally:
+        raw.close()
+        p.close()
