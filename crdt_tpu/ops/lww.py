@@ -3,17 +3,23 @@
 Semantics being computed (must match ``Engine`` exactly): a map key's
 visible entry is the **tail of its YATA key chain** — the chain is a
 tree (each item's origin is an earlier item of the same key or null),
-siblings are ordered by ascending client id, and the final order is the
-depth-first traversal. The tail is therefore the node reached from the
-virtual root by repeatedly stepping to the **maximum-client child**.
+and the final order is the depth-first traversal. Sibling order under
+one parent follows the Yjs conflict scan: ascending client id, and
+within one client id DESCENDING clock — a later same-client sibling
+with the same (null) origin and right origin hits the scan's break
+rule and is placed BEFORE its predecessor (the reference's engine
+inherits this from yjs Item.integrate). The tail is therefore the node
+reached from the virtual root by repeatedly stepping to the
+**(max client, min clock)** child.
 
 Kernel shape (all vectorized, no data-dependent Python control flow):
 
-1. scatter-max: for every item, pack (client, item_index) and
-   scatter-max into its parent slot -> max-client child per node.
-2. pointer doubling over the max-child function -> rightmost
+1. scatter-max: for every item, pack (client, ~clock) and scatter-max
+   into its parent slot -> last-child key per node.
+2. scatter the index of each node's last child (key match).
+3. pointer doubling over the last-child function -> rightmost
    descendant (= chain tail) of every node in O(log depth) rounds.
-3. gather per-segment winner from each segment's virtual root.
+4. gather per-segment winner from each segment's virtual root.
 
 This is the "segmented argmax over Lamport clocks" of the north star
 (BASELINE.json), done exactly: a plain per-key argmax over (clock,
@@ -26,12 +32,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from crdt_tpu.ops.device import NULLI, pointer_double
+from crdt_tpu.ops.device import _CLOCK_BITS, NULLI, pointer_double
 
 
 def map_winners(
     seg: jnp.ndarray,  # [N] int32 dense segment id per item (-1 = not a map item)
     client: jnp.ndarray,  # [N] int32
+    clock: jnp.ndarray,  # [N] int64
     origin_idx: jnp.ndarray,  # [N] int32 index of origin item, NULLI if none
     valid: jnp.ndarray,  # [N] bool
     num_segments: int,  # static
@@ -46,6 +53,7 @@ def map_winners(
     n = client.shape[0]
     m = n + num_segments  # item nodes + one virtual root per segment
     is_map = valid & (seg >= 0)
+    idx_n = jnp.arange(n, dtype=jnp.int32)
 
     # child -> parent edges; roots hang off their segment's virtual root
     origin_ok = (origin_idx >= 0) & is_map
@@ -54,18 +62,26 @@ def map_winners(
     parent = jnp.where(same_seg, origin_idx, n + seg)
     parent = jnp.where(is_map, parent, 0)  # dummy slot for non-map rows
 
-    # scatter-max of (client, index) packed -> max-client child per node
+    # scatter-max of (client, inverted clock) -> last-child key per node
+    inv_clock = ((1 << _CLOCK_BITS) - 1) - clock.astype(jnp.int64)
     pack = jnp.where(
         is_map,
-        (client.astype(jnp.int64) << 32) | jnp.arange(n, dtype=jnp.int64),
+        (client.astype(jnp.int64) << _CLOCK_BITS) | inv_clock,
         jnp.int64(-1),
     )
     best = jnp.full(m, -1, dtype=jnp.int64).at[parent].max(pack, mode="drop")
 
-    # max-child function with self-loops at leaves
-    has_child = best >= 0
-    child_idx = (best & 0xFFFFFFFF).astype(jnp.int32)
-    f = jnp.where(has_child, child_idx, jnp.arange(m, dtype=jnp.int32))
+    # index of each node's last child: ids are unique after dedup, so
+    # exactly one child matches its parent's best key
+    is_last_child = is_map & (best[parent] == pack)
+    child_idx = (
+        jnp.full(m, NULLI, jnp.int32)
+        .at[jnp.where(is_last_child, parent, 0)]
+        .max(jnp.where(is_last_child, idx_n, NULLI), mode="drop")
+    )
+
+    # last-child function with self-loops at leaves
+    f = jnp.where(child_idx >= 0, child_idx, jnp.arange(m, dtype=jnp.int32))
 
     tail = pointer_double(f)
 

@@ -105,7 +105,7 @@ def converge_maps(
     seg = jnp.where(is_map, seg, NULLI)
 
     # -- 4. per-segment winners ----------------------------------------
-    winners = map_winners(seg, client, origin_idx, is_map, num_segments)
+    winners = map_winners(seg, client, clock, origin_idx, is_map, num_segments)
 
     # -- 5. tombstones --------------------------------------------------
     del_mask = ds_ops.apply_mask(client, clock, uniq_valid, d_client, d_start, d_end)

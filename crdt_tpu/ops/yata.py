@@ -236,7 +236,12 @@ def order_sequences(records):
         has_attachment = any(
             records[i].right in member_ids for i in rows if records[i].right
         )
-        if not has_attachment:
+        # same-client duplicates need the exact scan too: Yjs places a
+        # later same-client same-origin sibling BEFORE its predecessor
+        # (the integrate break rule), so the client-asc/clock-asc device
+        # key would order them backwards
+        has_dup_client = len({records[i].client for i in rows}) != len(rows)
+        if not (has_attachment or has_dup_client):
             continue  # client-asc keys already set
         sibs = [
             {

@@ -236,6 +236,107 @@ def test_merge_idempotent_duplicates():
     assert got_ids == oracle.map_winner_table()
 
 
+def test_merge_same_client_null_origin_duplicates():
+    """Raw records (not API-generated): one client sets the same key
+    twice with NULL origins. The Yjs integrate break rule places the
+    later write BEFORE the earlier one, so the chain tail — the winner
+    — is the max client's MINIMUM clock. Regression for the bench-scale
+    divergence (kernel picked max clock)."""
+    from crdt_tpu.core.records import ItemRecord
+
+    recs = [
+        ItemRecord(client=5, clock=0, parent_root="m", key="k", content="a"),
+        ItemRecord(client=5, clock=1, parent_root="m", key="k", content="b"),
+        ItemRecord(client=3, clock=0, parent_root="m", key="k", content="c"),
+        ItemRecord(client=5, clock=2, parent_root="m", key="k", content="d"),
+    ]
+    oracle = Engine(10**6)
+    oracle.apply_records(recs, DeleteSet())
+    want = oracle.map_winner_table()
+    got = merge_records(recs)
+    got_ids = {k: (v[0].id, v[1]) for k, v in got.items()}
+    assert got_ids == want
+    assert want[(("root", "m"), "k")][0] == (5, 0)
+
+
+def test_sequence_same_client_null_origin_duplicates():
+    """Same break-rule shape for sequences: two same-client items with
+    the same null origin order by DESCENDING clock, so the host replay
+    (not the client-asc device key) must rank the group."""
+    from crdt_tpu.core.records import ItemRecord
+    from crdt_tpu.ops.yata import order_sequences
+
+    recs = [
+        ItemRecord(client=2, clock=0, parent_root="arr", content="x"),
+        ItemRecord(client=2, clock=1, parent_root="arr", content="y"),
+        ItemRecord(client=1, clock=0, parent_root="arr", content="z"),
+    ]
+    oracle = Engine(10**6)
+    oracle.apply_records(recs, DeleteSet())
+    want = [pid for pid in oracle.seq_order_table().get(("root", "arr"), [])]
+    got = order_sequences(recs)[("root", "arr")]
+    assert got == want
+    assert got == [(1, 0), (2, 1), (2, 0)]
+
+
+def test_merge_fuzz_same_client_duplicates_vs_oracle():
+    """Fuzz raw MAP record streams where clients repeat (random origins
+    within the key chain or null). Sequence-side dup-client coverage:
+    the prepend-storm fuzz below and tests/test_yata_kernel.py."""
+    from crdt_tpu.core.records import ItemRecord
+
+    rng = random.Random(99)
+    for trial in range(5):
+        recs = []
+        clocks = {}
+        for _ in range(120):
+            client = rng.randrange(1, 5)
+            clock = clocks.get(client, 0)
+            clocks[client] = clock + 1
+            key = rng.choice("ab")
+            prior = [r for r in recs if r.key == key and r.parent_root == "m"]
+            origin = rng.choice([None] + [p.id for p in prior[-3:]])
+            recs.append(
+                ItemRecord(
+                    client=client, clock=clock, parent_root="m", key=key,
+                    origin=origin, content=clock,
+                )
+            )
+        oracle = Engine(10**6)
+        oracle.apply_records(recs, DeleteSet())
+        got = merge_records(recs)
+        got_ids = {k: (v[0].id, v[1]) for k, v in got.items()}
+        assert got_ids == oracle.map_winner_table(), f"trial {trial}"
+
+
+def test_sequence_fuzz_prepend_storm_vs_oracle():
+    """Dup-client groups WITH right-origin attachments: repeated
+    prepends from few clients make every origin group contain multiple
+    items per client whose rights are members (the host-replay path
+    the dup-client routing must take)."""
+    from crdt_tpu.ops.yata import order_sequences
+
+    rng = random.Random(777)
+    for trial in range(5):
+        engines = [Engine(i + 1) for i in range(3)]
+        for step in range(60):
+            e = rng.choice(engines)
+            if rng.random() < 0.6:
+                e.seq_insert("arr", 0, [f"s{step}"])  # prepend storm
+            else:
+                n = e.seq_len("arr")
+                e.seq_insert("arr", rng.randrange(n + 1), [f"s{step}"])
+            if rng.random() < 0.3:
+                src = rng.choice(engines)
+                if src is not e:
+                    e.apply_records(src.records_since(None), src.delete_set())
+        recs, ds = union_of(engines)
+        oracle = oracle_merge(engines)
+        want = oracle.seq_order_table()[("root", "arr")]
+        got = order_sequences(recs)[("root", "arr")]
+        assert got == want, f"trial {trial}"
+
+
 def test_pointer_double_cycle_terminates():
     # malformed (cyclic) input must terminate, not hang the device
     out = pointer_double(jnp.array([1, 2, 0], jnp.int32))
