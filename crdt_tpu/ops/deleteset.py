@@ -31,9 +31,19 @@ def apply_mask(
     d_start: jnp.ndarray,  # [D]
     d_end: jnp.ndarray,  # [D]
 ) -> jnp.ndarray:
-    """True where item falls inside any delete range."""
+    """True where item falls inside any delete range.
+
+    On TPU (or with CRDT_TPU_PALLAS=interpret) small range sets go
+    through the fused Pallas kernel — ranges in SMEM, one VMEM pass
+    over the item columns; the jnp binary search remains the path for
+    large D and non-TPU backends.
+    """
     if d_client.shape[0] == 0:
         return jnp.zeros_like(valid)
+    from crdt_tpu.ops import pallas_kernels as _pk
+
+    if _pk.use_pallas() and d_client.shape[0] <= _pk._DS_MAX_RANGES:
+        return _pk.ds_mask(client, clock, valid, d_client, d_start, d_end)
     # pack range starts and item ids on one axis; ranges never cross a
     # client boundary so a single searchsorted suffices
     rkey = pack_id(d_client, d_start)

@@ -1,0 +1,283 @@
+"""Pallas TPU kernels for the merge hot path (SURVEY.md §7 stage 3).
+
+Two ops earn hand-written kernels; everything else in :mod:`crdt_tpu.ops`
+is already one fused XLA expression (sorts, scans, pointer doubling)
+that Mosaic could not schedule better:
+
+- ``ds_mask``   — delete-set membership for every item. The jnp path
+  is a packed binary search (O(N log D)); this kernel is the fused
+  dense compare (O(N·D)) that wins when D is small (the common case:
+  a transaction's delete set holds a handful of ranges) because the
+  ranges live in SMEM and the item columns stream through VMEM once —
+  no [N, D] broadcast ever hits HBM.
+- ``sv_deficit`` — the pairwise anti-entropy plan ``missing`` over
+  [R, C] state vectors. The jnp path materializes the full [R, R, C]
+  deficit tensor in HBM (4 GB at the north-star 1k replicas × 1k
+  clients); this kernel tiles (i, j, c-chunk) over the grid so HBM
+  holds only the [R, R] result and VMEM only (tile × chunk) blocks.
+
+Both kernels run in interpret mode off-TPU so the differential tests
+(tests/test_pallas.py) exercise the same code path on the CPU mesh.
+
+Dtype strategy: the framework's clocks are int64 with < 2**40 packing
+headroom (ops/device.py), but Mosaic wants 32-bit lanes. ``ds_mask``
+is EXACT over the full 2**40 range via hi/lo split compares (clock ->
+(clock >> 31, clock & 0x7fffffff), lexicographic i32 compares).
+``sv_deficit`` subtracts the per-column minimum before narrowing —
+deficits are invariant to per-column shifts, so the i32 magnitude
+limit applies to the clock SPREAD between replicas (how far apart two
+replicas' views are), not to absolute clock values; per-pair deficit
+totals likewise accumulate in i32 (exact while a pair's total lag is
+below 2**31 ops — the north-star workload's entire history is 1e8).
+
+The reference has no analogue of any of this — its merge is the
+scalar Yjs integrate loop (/root/reference/crdt.js:294) and its sync
+handshake diffs one peer at a time (crdt.js:286-291).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# SMEM budget for the delete-range quintuple (5 arrays × _DS_MAX_RANGES
+# int32). Above this the jnp binary search is the right tool anyway.
+_DS_MAX_RANGES = 2048
+
+_LANES = 128
+_DS_BLOCK_ROWS = 64  # rows of 128 lanes per program: 8192 items
+
+_LO_BITS = 31
+_LO_MASK = (1 << _LO_BITS) - 1
+
+
+def backend() -> str:
+    return jax.default_backend()
+
+
+def use_pallas() -> bool:
+    """Trace-time dispatch: pallas on TPU, jnp elsewhere.
+
+    CRDT_TPU_PALLAS=0 forces jnp everywhere; =interpret forces the
+    pallas kernels in interpreter mode (how the CPU-mesh tests run);
+    =1 forces compiled pallas (TPU only).
+    """
+    flag = os.environ.get("CRDT_TPU_PALLAS", "auto")
+    if flag == "0":
+        return False
+    if flag in ("1", "interpret"):
+        return True
+    return backend() == "tpu"
+
+
+def _interpret() -> bool:
+    if os.environ.get("CRDT_TPU_PALLAS") == "interpret":
+        return True
+    return backend() != "tpu"
+
+
+def _pad_len(n: int, mult: int) -> int:
+    return max(mult, ((n + mult - 1) // mult) * mult)
+
+
+def _split_hi_lo(x: jnp.ndarray) -> tuple:
+    """int64 -> (hi, lo) int32 with lexicographic order preserved for
+    non-negative values; negatives (null sentinels) map to (-1, -1)."""
+    hi = jnp.where(x < 0, -1, x >> _LO_BITS).astype(jnp.int32)
+    lo = jnp.where(x < 0, -1, x & _LO_MASK).astype(jnp.int32)
+    return hi, lo
+
+
+# ---------------------------------------------------------------------------
+# delete-set membership
+# ---------------------------------------------------------------------------
+
+
+def _ds_mask_kernel(
+    dcl_ref, dsh_ref, dsl_ref, deh_ref, delo_ref, cl_ref, ckh_ref, ckl_ref, out_ref
+):
+    """One program = one (rows, 128) item block vs ALL ranges.
+
+    Ranges sit in SMEM (scalar memory) and are walked with a
+    fori_loop; each step is a full-block VPU compare, so the work is
+    D vector ops over an 8192-lane block with zero HBM traffic beyond
+    streaming the item columns once. Clocks are (hi, lo) i32 pairs;
+    the lexicographic compares are exact over the full int64 range.
+    """
+    cl = cl_ref[:]
+    ckh = ckh_ref[:]
+    ckl = ckl_ref[:]
+    acc = jnp.zeros(cl.shape, jnp.int32)
+    num_ranges = dcl_ref.shape[0]
+
+    def body(d, acc):
+        dc = dcl_ref[d]
+        sh, sl = dsh_ref[d], dsl_ref[d]
+        eh, el = deh_ref[d], delo_ref[d]
+        ge_start = (ckh > sh) | ((ckh == sh) & (ckl >= sl))
+        lt_end = (ckh < eh) | ((ckh == eh) & (ckl < el))
+        hit = (cl == dc) & ge_start & lt_end
+        return acc | hit.astype(jnp.int32)
+
+    # int32 bounds: the framework traces under x64, and an i64 loop
+    # index fails Mosaic legalization
+    out_ref[:] = jax.lax.fori_loop(jnp.int32(0), jnp.int32(num_ranges), body, acc)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _ds_mask_call(cl2, ckh2, ckl2, dcl, dsh, dsl, deh, delo, interpret):
+    rows = cl2.shape[0]
+    grid = (rows // _DS_BLOCK_ROWS,)
+    block = (_DS_BLOCK_ROWS, _LANES)
+    # trace with x64 off: the framework traces under x64 and the
+    # promoted i64 literals (index maps, reductions) fail Mosaic
+    # legalization; every input here is already explicit int32
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+            _ds_mask_kernel,
+            out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
+            grid=grid,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 5
+            + [
+                pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM),
+            interpret=interpret,
+        )(dcl, dsh, dsl, deh, delo, cl2, ckh2, ckl2)
+
+
+def ds_mask(
+    client: jnp.ndarray,  # [N] int32
+    clock: jnp.ndarray,  # [N] int64/int32
+    valid: jnp.ndarray,  # [N] bool
+    d_client: jnp.ndarray,  # [D] int32
+    d_start: jnp.ndarray,  # [D] int64/int32
+    d_end: jnp.ndarray,  # [D] int64/int32
+) -> jnp.ndarray:
+    """Pallas counterpart of :func:`crdt_tpu.ops.deleteset.apply_mask`.
+
+    Returns the same [N] bool mask, exact over the framework's full
+    clock range. Requires D <= _DS_MAX_RANGES; callers dispatch via
+    :func:`use_pallas` and fall back to the jnp path otherwise.
+    """
+    n = client.shape[0]
+    d = d_client.shape[0]
+    if d == 0:
+        return jnp.zeros_like(valid)
+    if d > _DS_MAX_RANGES:
+        raise ValueError(f"ds_mask: {d} ranges > SMEM budget {_DS_MAX_RANGES}")
+
+    npad = _pad_len(n, _DS_BLOCK_ROWS * _LANES)
+    ckh, ckl = _split_hi_lo(clock.astype(jnp.int64))
+    # padded item slots get client/clock -1: a real range never has
+    # client -1, and a null (-1) range filler's half-open compare
+    # rejects even the (-1, -1) padded clock (start == end)
+    cl = jnp.full(npad, -1, jnp.int32).at[:n].set(client.astype(jnp.int32))
+    ch = jnp.full(npad, -1, jnp.int32).at[:n].set(ckh)
+    cg = jnp.full(npad, -1, jnp.int32).at[:n].set(ckl)
+    dsh, dsl = _split_hi_lo(d_start.astype(jnp.int64))
+    deh, delo = _split_hi_lo(d_end.astype(jnp.int64))
+
+    out2 = _ds_mask_call(
+        cl.reshape(-1, _LANES),
+        ch.reshape(-1, _LANES),
+        cg.reshape(-1, _LANES),
+        d_client.astype(jnp.int32),
+        dsh,
+        dsl,
+        deh,
+        delo,
+        _interpret(),
+    )
+    return out2.reshape(-1)[:n].astype(bool) & valid
+
+
+# ---------------------------------------------------------------------------
+# pairwise state-vector deficit (the anti-entropy plan)
+# ---------------------------------------------------------------------------
+
+_DEF_TI = 8  # i-tile (sublane batch)
+_DEF_TJ = _LANES  # j-tile
+_DEF_TC = _LANES  # C chunk per grid step
+
+
+def _sv_deficit_kernel(svi_ref, svj_ref, out_ref):
+    """One program = an (8 × 128) tile of [R, R] for ONE 128-wide C
+    chunk; the innermost grid dimension walks C and accumulates into
+    the same output tile (index map ignores the chunk index).
+
+    deficit[i, j] = sum_c max(sv[i, c] - sv[j, c], 0): the broadcasts
+    ride the two non-lane axes (i over the batch dim, j over the
+    second-minor dim) so no relayout is needed.
+    """
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    a = svi_ref[:]  # [TI, TC]
+    b = svj_ref[:]  # [TJ, TC]
+    diff = a[:, None, :] - b[None, :, :]  # [TI, TJ, TC]
+    out_ref[:] += jnp.maximum(diff, 0).sum(axis=2)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _sv_deficit_call(svs, interpret):
+    r, c = svs.shape
+    grid = (r // _DEF_TI, r // _DEF_TJ, c // _DEF_TC)
+    with jax.enable_x64(False):  # see _ds_mask_call
+        return pl.pallas_call(
+            _sv_deficit_kernel,
+            out_shape=jax.ShapeDtypeStruct((r, r), jnp.int32),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (_DEF_TI, _DEF_TC),
+                    lambda i, j, k: (i, k),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (_DEF_TJ, _DEF_TC),
+                    lambda i, j, k: (j, k),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (_DEF_TI, _DEF_TJ), lambda i, j, k: (i, j), memory_space=pltpu.VMEM
+            ),
+            interpret=interpret,
+        )(svs, svs)
+
+
+def sv_deficit(svs: jnp.ndarray) -> jnp.ndarray:
+    """Pallas counterpart of :func:`crdt_tpu.ops.statevec.missing`.
+
+    [R, C] state vectors -> [R, R] total clocks i holds that j lacks,
+    without the [R, R, C] HBM intermediate the jnp path builds.
+
+    Exactness: deficits are invariant to subtracting any per-column
+    offset, so the per-column minimum is removed before narrowing to
+    i32 — absolute clocks may use the full int64 range; only the
+    SPREAD between the most- and least-advanced replica per client
+    must stay below 2**31 (i.e. no replica lags another by 2e9 ops on
+    one client), and a pair's summed deficit below 2**31.
+    """
+    r, c = svs.shape
+    centered = svs.astype(jnp.int64) - jnp.min(svs, axis=0, keepdims=True).astype(
+        jnp.int64
+    )
+    rpad = _pad_len(r, _DEF_TJ)
+    cpad = _pad_len(c, _DEF_TC)
+    # zero-padding is semantically neutral: phantom clients contribute
+    # max(0-0, 0)=0, phantom replicas produce rows/cols sliced away
+    p = jnp.zeros((rpad, cpad), jnp.int32)
+    p = p.at[:r, :c].set(centered.astype(jnp.int32))
+    out = _sv_deficit_call(p, _interpret())
+    return out[:r, :r].astype(svs.dtype)

@@ -48,7 +48,15 @@ def missing(svs: jnp.ndarray) -> jnp.ndarray:
 
     The full-mesh generalization of the per-peer handshake: entry
     (i, j) > 0 means i should send a delta to j.
+
+    On TPU this is the tiled Pallas kernel (streams C through VMEM,
+    HBM holds only the [R, R] result); the jnp path materializes the
+    [R, R, C] deficit tensor — 4 GB at the north-star 1k×1k scale.
     """
+    from crdt_tpu.ops import pallas_kernels as _pk
+
+    if _pk.use_pallas():
+        return _pk.sv_deficit(svs)
     # deficit[i, j, c] = max(sv[i, c] - sv[j, c], 0)
     deficit = jnp.maximum(svs[:, None, :] - svs[None, :, :], 0)
     return deficit.sum(axis=-1)
