@@ -14,8 +14,9 @@ Prints ONE JSON line:
 where value is device convergence throughput (ops/s) and vs_baseline
 is the speedup over the scalar loop on the identical op set.
 
-Env knobs: BENCH_REPLICAS (default 128), BENCH_OPS (ops per replica,
-default 256), BENCH_ITERS (timed kernel reps, default 5).
+Env knobs: BENCH_REPLICAS (default 1000), BENCH_OPS (ops per replica,
+default 100 — defaults match the north-star "1k replicas, 100k ops"
+fan-in config), BENCH_ITERS (timed kernel reps, default 5).
 """
 
 from __future__ import annotations
@@ -73,8 +74,8 @@ def main():
     from crdt_tpu.ops import deleteset as ds_ops
     from crdt_tpu.ops.merge import Interner, converge_maps, records_to_columns
 
-    R = int(os.environ.get("BENCH_REPLICAS", 128))
-    K = int(os.environ.get("BENCH_OPS", 256))
+    R = int(os.environ.get("BENCH_REPLICAS", 1000))
+    K = int(os.environ.get("BENCH_OPS", 100))
     iters = int(os.environ.get("BENCH_ITERS", 5))
     total = R * K
     log(f"workload: {R} replicas x {K} ops = {total} ops on {jax.devices()[0].platform}")
