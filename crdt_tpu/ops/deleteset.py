@@ -36,13 +36,15 @@ def apply_mask(
     On TPU (or with CRDT_TPU_PALLAS=interpret) small range sets go
     through the fused Pallas kernel — ranges in SMEM, one VMEM pass
     over the item columns; the jnp binary search remains the path for
-    large D and non-TPU backends.
+    large D and non-TPU backends. The dispatch threshold is the
+    measured performance crossover (pallas_kernels._DS_PALLAS_CROSSOVER),
+    not the kernel's SMEM capacity cap.
     """
     if d_client.shape[0] == 0:
         return jnp.zeros_like(valid)
     from crdt_tpu.ops import pallas_kernels as _pk
 
-    if _pk.use_pallas() and d_client.shape[0] <= _pk._DS_MAX_RANGES:
+    if _pk.use_pallas() and d_client.shape[0] <= _pk._DS_PALLAS_CROSSOVER:
         return _pk.ds_mask(client, clock, valid, d_client, d_start, d_end)
     # pack range starts and item ids on one axis; ranges never cross a
     # client boundary so a single searchsorted suffices

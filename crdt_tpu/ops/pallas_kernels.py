@@ -27,8 +27,10 @@ is EXACT over the full 2**40 range via hi/lo split compares (clock ->
 deficits are invariant to per-column shifts, so the i32 magnitude
 limit applies to the clock SPREAD between replicas (how far apart two
 replicas' views are), not to absolute clock values; per-pair deficit
-totals likewise accumulate in i32 (exact while a pair's total lag is
-below 2**31 ops — the north-star workload's entire history is 1e8).
+totals likewise accumulate in i32. The envelope is ENFORCED, not
+assumed: a traced bound check routes batches whose spread/total could
+reach 2**31 to the exact int64 scan fallback (lax.cond, so the check
+works under jit/shard_map where gossip calls it).
 
 The reference has no analogue of any of this — its merge is the
 scalar Yjs integrate loop (/root/reference/crdt.js:294) and its sync
@@ -46,8 +48,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 # SMEM budget for the delete-range quintuple (5 arrays × _DS_MAX_RANGES
-# int32). Above this the jnp binary search is the right tool anyway.
+# int32) — a hard capacity limit, NOT the dispatch heuristic.
 _DS_MAX_RANGES = 2048
+
+# Dispatch crossover, measured on a real chip (N=131072 items, jitted
+# callers like converge_maps): both paths are dispatch-bound ~20-30us
+# up to D=64; beyond that the kernel's sequential D-step fori_loop
+# only loses ground to the searchsorted path (1.7x slower at D=2048).
+# Callers use pallas for D <= this and the jnp binary search above it.
+_DS_PALLAS_CROSSOVER = 64
 
 _LANES = 128
 _DS_BLOCK_ROWS = 64  # rows of 128 lanes per program: 8192 items
@@ -65,7 +74,9 @@ def use_pallas() -> bool:
 
     CRDT_TPU_PALLAS=0 forces jnp everywhere; =interpret forces the
     pallas kernels in interpreter mode (how the CPU-mesh tests run);
-    =1 forces compiled pallas (TPU only).
+    =1 forces the pallas kernels — compiled on TPU, interpreter mode
+    on any other backend (off-TPU there is nothing for Mosaic to
+    compile, so =1 and =interpret coincide there).
     """
     flag = os.environ.get("CRDT_TPU_PALLAS", "auto")
     if flag == "0":
@@ -264,20 +275,35 @@ def sv_deficit(svs: jnp.ndarray) -> jnp.ndarray:
 
     Exactness: deficits are invariant to subtracting any per-column
     offset, so the per-column minimum is removed before narrowing to
-    i32 — absolute clocks may use the full int64 range; only the
-    SPREAD between the most- and least-advanced replica per client
-    must stay below 2**31 (i.e. no replica lags another by 2e9 ops on
-    one client), and a pair's summed deficit below 2**31.
+    i32 — absolute clocks may use the full int64 range. The i32 tile
+    math is exact while the summed per-column spread stays below
+    2**31; that bound is CHECKED on the traced values and batches
+    beyond it (a replica lagging another by ~2e9 ops) fall back to
+    the exact scan (:func:`crdt_tpu.ops.statevec.exact_missing`), so
+    the anti-entropy plan is never silently wrapped.
     """
+    from crdt_tpu.ops import statevec
+
     r, c = svs.shape
     centered = svs.astype(jnp.int64) - jnp.min(svs, axis=0, keepdims=True).astype(
         jnp.int64
     )
-    rpad = _pad_len(r, _DEF_TJ)
-    cpad = _pad_len(c, _DEF_TC)
-    # zero-padding is semantically neutral: phantom clients contribute
-    # max(0-0, 0)=0, phantom replicas produce rows/cols sliced away
-    p = jnp.zeros((rpad, cpad), jnp.int32)
-    p = p.at[:r, :c].set(centered.astype(jnp.int32))
-    out = _sv_deficit_call(p, _interpret())
-    return out[:r, :r].astype(svs.dtype)
+    # sum of per-column max spreads bounds every pair's deficit AND
+    # (since all terms are >= 0) every single column's spread
+    safe = jnp.sum(jnp.max(centered, axis=0)) < jnp.int64(2**31)
+
+    def _pallas(cent):
+        rpad = _pad_len(r, _DEF_TJ)
+        cpad = _pad_len(c, _DEF_TC)
+        # zero-padding is semantically neutral: phantom clients
+        # contribute max(0-0, 0)=0, phantom replicas produce rows/cols
+        # sliced away
+        p = jnp.zeros((rpad, cpad), jnp.int32)
+        p = p.at[:r, :c].set(cent.astype(jnp.int32))
+        out = _sv_deficit_call(p, _interpret())
+        return out[:r, :r].astype(svs.dtype)
+
+    def _exact(cent):
+        return statevec.exact_missing(cent).astype(svs.dtype)
+
+    return jax.lax.cond(safe, _pallas, _exact, centered)

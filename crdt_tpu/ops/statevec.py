@@ -8,11 +8,15 @@ whole replica set is processed at once:
 - ``build``     items -> state vector (scatter-max of clock+1)
 - ``diff_mask`` which items a peer above `sv` still needs
 - ``merge``     [R, C] vectors -> componentwise max (anti-entropy join)
-- ``missing``   pairwise [R, R, C] "what does i have that j lacks"
+- ``missing``   pairwise [R, R] deficit "what does i have that j lacks"
+  (pallas-tiled on TPU, exact scan elsewhere)
+- ``exact_missing`` the scan path: exact in the input dtype, O(R·C)
+  live memory
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -43,6 +47,19 @@ def merge(svs: jnp.ndarray) -> jnp.ndarray:
     return jnp.max(svs, axis=0)
 
 
+def exact_missing(svs: jnp.ndarray) -> jnp.ndarray:
+    """Exact [R, R] deficit matrix in the input dtype, O(R·C) live
+    memory: a scan over rows keeps one [R, C] broadcast alive per step
+    instead of materializing [R, R, C] (4 GB at the north-star
+    1k replicas × 1k clients)."""
+
+    def row(_, sv_i):
+        return None, jnp.maximum(sv_i[None, :] - svs, 0).sum(axis=-1)
+
+    _, out = jax.lax.scan(row, None, svs)
+    return out
+
+
 def missing(svs: jnp.ndarray) -> jnp.ndarray:
     """[R, C] -> [R, R] total clocks replica i has that j lacks.
 
@@ -50,13 +67,12 @@ def missing(svs: jnp.ndarray) -> jnp.ndarray:
     (i, j) > 0 means i should send a delta to j.
 
     On TPU this is the tiled Pallas kernel (streams C through VMEM,
-    HBM holds only the [R, R] result); the jnp path materializes the
-    [R, R, C] deficit tensor — 4 GB at the north-star 1k×1k scale.
+    HBM holds only the [R, R] result, with a traced-bound fallback to
+    :func:`exact_missing` when i32 tiles could wrap); elsewhere it is
+    the exact scan.
     """
     from crdt_tpu.ops import pallas_kernels as _pk
 
     if _pk.use_pallas():
         return _pk.sv_deficit(svs)
-    # deficit[i, j, c] = max(sv[i, c] - sv[j, c], 0)
-    deficit = jnp.maximum(svs[:, None, :] - svs[None, :, :], 0)
-    return deficit.sum(axis=-1)
+    return exact_missing(svs)

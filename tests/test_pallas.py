@@ -7,6 +7,8 @@ path). Each test drives the pallas function directly against the pure
 jnp implementation on identical inputs.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -20,21 +22,20 @@ def _force_interpret(monkeypatch):
     monkeypatch.setenv("CRDT_TPU_PALLAS", "interpret")
 
 
-def _jnp_ds_mask(client, clock, valid, dc, ds, de):
-    """The searchsorted path, inlined so the dispatch in
-    deleteset.apply_mask can't accidentally hand us pallas back."""
-    from crdt_tpu.ops.device import _CLOCK_BITS, pack_id
+def _jnp_ds_mask(*args):
+    """The REAL searchsorted path of deleteset.apply_mask, reached by
+    pinning the dispatch env var (no hand-inlined copy to drift)."""
+    from crdt_tpu.ops import deleteset
 
-    rkey = pack_id(dc, ds)
-    order = jnp.argsort(rkey)
-    rkey = rkey[order]
-    rend = pack_id(dc[order], de[order])
-    ikey = pack_id(client, clock)
-    pos = jnp.searchsorted(rkey, ikey, side="right") - 1
-    pos_c = jnp.clip(pos, 0, rkey.shape[0] - 1)
-    inside = (pos >= 0) & (ikey >= rkey[pos_c]) & (ikey < rend[pos_c])
-    same_client = (ikey >> _CLOCK_BITS) == (rkey[pos_c] >> _CLOCK_BITS)
-    return valid & inside & same_client
+    saved = os.environ.get("CRDT_TPU_PALLAS")
+    os.environ["CRDT_TPU_PALLAS"] = "0"
+    try:
+        return deleteset.apply_mask(*args)
+    finally:
+        if saved is None:
+            os.environ.pop("CRDT_TPU_PALLAS", None)
+        else:
+            os.environ["CRDT_TPU_PALLAS"] = saved
 
 
 def _random_ds_case(rng, n, d, num_clients=40, max_clock=2000):
@@ -188,3 +189,41 @@ def test_missing_dispatch_equivalence(monkeypatch):
     monkeypatch.setenv("CRDT_TPU_PALLAS", "interpret")
     got = statevec.missing(svs)
     assert bool(jnp.all(ref == got))
+
+
+def test_exact_missing_matches_dense():
+    from crdt_tpu.ops import statevec
+
+    rng = np.random.default_rng(9)
+    svs = jnp.asarray(rng.integers(0, 5000, (13, 29)).astype(np.int64))
+    assert bool(jnp.all(statevec.exact_missing(svs) == _jnp_missing(svs)))
+
+
+def test_sv_deficit_overflow_falls_back_exact():
+    """Spreads past 2**31 (one replica lagging another by >2e9 clocks
+    on one client) must take the exact int64 path, not wrap in i32."""
+    lag = 2**31 + 12345
+    svs = jnp.asarray(np.array([[lag, 5], [0, 5], [7, 5]], np.int64))
+    got = pk.sv_deficit(svs)
+    ref = _jnp_missing(svs)
+    assert bool(jnp.all(got == ref))
+    assert int(got[0, 1]) == lag  # the value an i32 kernel would wrap
+
+
+def test_apply_mask_crossover_uses_jnp_for_large_d(monkeypatch):
+    """Dispatch sends D > _DS_PALLAS_CROSSOVER to the searchsorted
+    path even when pallas is enabled (the SMEM cap is not the
+    performance crossover)."""
+    from crdt_tpu.ops import deleteset
+
+    calls = []
+    real = pk.ds_mask
+    monkeypatch.setattr(pk, "ds_mask", lambda *a: calls.append(1) or real(*a))
+    rng = np.random.default_rng(11)
+    big = _random_ds_case(rng, 256, pk._DS_PALLAS_CROSSOVER + 1)
+    small = _random_ds_case(rng, 256, pk._DS_PALLAS_CROSSOVER)
+    monkeypatch.setenv("CRDT_TPU_PALLAS", "interpret")
+    deleteset.apply_mask(*big)
+    assert not calls
+    deleteset.apply_mask(*small)
+    assert calls
