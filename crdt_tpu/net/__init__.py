@@ -1,10 +1,13 @@
 from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
 from crdt_tpu.net.replica import MemoryPersistence, Replica, ypear_crdt
+from crdt_tpu.net.udp_router import UdpRouter, pump
 
 __all__ = [
     "LoopbackNetwork",
     "LoopbackRouter",
     "MemoryPersistence",
     "Replica",
+    "UdpRouter",
+    "pump",
     "ypear_crdt",
 ]

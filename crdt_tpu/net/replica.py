@@ -162,6 +162,12 @@ class Replica:
                     "set_peer_state_vector": self.set_peer_state_vector,
                     "peer_close": self.peer_close,
                     "self_close": self.self_close,
+                    # async-transport hook (e.g. the UDP router): a
+                    # peer subscribing to our topic AFTER construction
+                    # triggers a directed anti-entropy probe even when
+                    # we are already synced — on a real network peers
+                    # appear at any time and both sides must reconcile
+                    "peer_joined": self.probe,
                 }
             }
         )
@@ -187,13 +193,23 @@ class Replica:
             # every remaining and future replica forever)
             self._set_synced(True)
             return
-        self._broadcast(
-            {
-                "meta": "ready",
-                "public_key": self.router.public_key,
-                "state_vector": self.doc.encode_state_vector(),
-            }
-        )
+        self.probe()
+
+    def probe(self, public_key: Optional[str] = None) -> None:
+        """Unconditional ready probe (unlike :meth:`sync`, which is a
+        no-op once synced): ask one peer — or everyone — for whatever
+        we lack. The two-way handshake then reconciles both sides."""
+        if self.closed:
+            return
+        msg = {
+            "meta": "ready",
+            "public_key": self.router.public_key,
+            "state_vector": self.doc.encode_state_vector(),
+        }
+        if public_key is not None:
+            self._to_peer(public_key, msg)
+        else:
+            self._broadcast(msg)
 
     def _set_synced(self, value: bool) -> None:
         self.synced = value

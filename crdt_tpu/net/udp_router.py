@@ -1,0 +1,281 @@
+"""Multi-process router: the ypear router contract over native UDP.
+
+The reference's router is Hyperswarm — DHT topic discovery plus
+Noise-encrypted peer streams over udx (SURVEY.md §2.2). This router
+implements the same contract surface the CRDT layer consumes
+(``is_ypear_router``, ``options``, ``update_options[_cache]``,
+``start``/``started``/``peers``, ``alow`` -> the four verbs,
+crdt.js:172-317) over the native transport seam
+(:mod:`crdt_tpu.net.transport`): reliable-datagram UDP + X25519 /
+XChaCha20-Poly1305 encrypted peer links.
+
+Documented divergence: peer discovery is an explicit bootstrap list
+(``add_peer``) instead of a global DHT — the rebuild targets
+datacenter fabrics where peers are known addresses; DHT walking is
+out of scope. Everything after discovery (key exchange, encrypted
+links, topic membership, the four verbs, the sync handshake riding
+them) matches the reference's shape.
+
+Wire protocol (each transport message, after reassembly):
+  kind 0x00  plaintext hello       {pk: hex, ack: bool}
+  kind 0x01  encrypted envelope    sender_pk(32 raw) || SecureBox
+             payload (AAD = sender pk), decrypting to one lib0 `any`:
+             {t:'topics', topics:[...]} | {t:'m', topic, msg}
+
+Like the loopback fabric, nothing is delivered until ``poll()`` runs —
+single-threaded, event-loop style (udx's own model).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from crdt_tpu.codec.lib0 import Decoder, Encoder
+from crdt_tpu.net.transport import SecureBox, UdpEndpoint, keypair
+
+_HELLO = 0
+_ENVELOPE = 1
+
+
+def _pack_any(v: Any) -> bytes:
+    enc = Encoder()
+    enc.write_any(v)
+    return enc.to_bytes()
+
+
+def _unpack_any(data: bytes) -> Any:
+    return Decoder(data).read_any()
+
+
+class _Peer:
+    __slots__ = ("pk_hex", "pk_raw", "addr", "topics", "box")
+
+    def __init__(self, pk_hex: str, addr: Tuple[str, int], box: SecureBox):
+        self.pk_hex = pk_hex
+        self.pk_raw = bytes.fromhex(pk_hex)
+        self.addr = addr
+        self.topics: Set[str] = set()
+        self.box = box
+
+
+class UdpRouter:
+    """One peer's router over a real socket (multi-process capable)."""
+
+    is_ypear_router = True  # crdt.js:172's validation flag
+
+    def __init__(
+        self,
+        *,
+        bind_ip: str = "127.0.0.1",
+        port: int = 0,
+        seed: Optional[bytes] = None,
+        username: Optional[str] = None,
+    ):
+        self.endpoint = UdpEndpoint(bind_ip, port)
+        pub, sec = keypair(seed)
+        self._secret = sec
+        pk_hex = pub.hex()
+        self.options: Dict[str, Any] = {
+            "public_key": pk_hex,
+            "username": username or pk_hex[:8],
+            "cache": {},
+        }
+        self.started = False
+        self._handlers: Dict[str, Callable] = {}
+        self._peers: Dict[str, _Peer] = {}  # pk_hex -> peer
+        self._hello_sent: Set[Tuple[str, int]] = set()
+
+    # -- options bag (crdt.js:175-180) ----------------------------------
+    def update_options(self, opts: Dict[str, Any]) -> None:
+        self.options.update(opts)
+
+    def update_options_cache(self, per_topic: Dict[str, dict]) -> None:
+        for topic, contract in per_topic.items():
+            self.options["cache"].setdefault(topic, {}).update(contract)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self, network_name: Optional[str] = None) -> None:
+        self.options.setdefault("network_name", network_name)
+        self.started = True
+
+    def close(self) -> None:
+        self.endpoint.close()
+
+    @property
+    def public_key(self) -> str:
+        return self.options["public_key"]
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.endpoint.bind_ip, self.endpoint.port)
+
+    # -- discovery (bootstrap list; the DHT-walk divergence) -------------
+    def add_peer(self, ip: str, port: int) -> None:
+        """Dial a known address: plaintext hello carrying our identity;
+        the reply completes the key exchange."""
+        self._hello_sent.add((ip, port))
+        self._send_hello(ip, port, ack=False)
+
+    def _send_hello(self, ip: str, port: int, *, ack: bool) -> None:
+        payload = bytes([_HELLO]) + _pack_any(
+            {"pk": self.public_key, "ack": ack}
+        )
+        self.endpoint.send(ip, port, payload)
+
+    # -- peer/topic views ------------------------------------------------
+    @property
+    def peers(self) -> List[str]:
+        return list(self._peers)
+
+    def peers_on(self, topic: str) -> List[str]:
+        return [pk for pk, p in self._peers.items() if topic in p.topics]
+
+    # -- the four verbs (crdt.js:315-317) --------------------------------
+    def alow(self, topic: str, handler: Callable) -> Tuple[
+        Callable, Callable, Callable, Callable
+    ]:
+        self._handlers[topic] = handler
+        self._announce_topics()
+
+        def propagate(msg: dict) -> None:
+            for p in list(self._peers.values()):
+                if topic in p.topics:
+                    self._send_envelope(p, {"t": "m", "topic": topic, "msg": msg})
+
+        broadcast = propagate  # the reference uses them interchangeably
+
+        def for_peers(fn: Callable[[str], None]) -> None:
+            for pk in self.peers_on(topic):
+                fn(pk)
+
+        def to_peer(public_key: str, msg: dict) -> None:
+            p = self._peers.get(public_key)
+            if p is not None and topic in p.topics:
+                self._send_envelope(p, {"t": "m", "topic": topic, "msg": msg})
+
+        return propagate, broadcast, for_peers, to_peer
+
+    def unsubscribe(self, topic: str) -> None:
+        self._handlers.pop(topic, None)
+        self._announce_topics()
+
+    # -- wire ------------------------------------------------------------
+    def _send_envelope(self, peer: _Peer, payload: Any) -> None:
+        me = bytes.fromhex(self.public_key)
+        body = peer.box.encrypt(_pack_any(payload), aad=me)
+        self.endpoint.send(peer.addr[0], peer.addr[1], bytes([_ENVELOPE]) + me + body)
+
+    def _announce_topics(self) -> None:
+        for p in list(self._peers.values()):
+            self._send_envelope(p, {"t": "topics", "topics": sorted(self._handlers)})
+
+    def _ensure_peer(self, pk_hex: str, addr: Tuple[str, int]) -> _Peer:
+        p = self._peers.get(pk_hex)
+        if p is None:
+            p = _Peer(pk_hex, addr, SecureBox(self._secret, bytes.fromhex(pk_hex)))
+            self._peers[pk_hex] = p
+        else:
+            p.addr = addr  # peer may rebind (restart); trust latest source
+        return p
+
+    def poll(self) -> int:
+        """One pump: transport poll + dispatch every complete message.
+        Returns the number of router-level messages handled."""
+        self.endpoint.poll()
+        handled = 0
+        for src_ip, src_port, data in self.endpoint.recv_all():
+            if not data:
+                continue
+            kind, body = data[0], data[1:]
+            if kind == _HELLO:
+                self._on_hello(body, (src_ip, src_port))
+                handled += 1
+            elif kind == _ENVELOPE and len(body) > 32:
+                if self._on_envelope(body, (src_ip, src_port)):
+                    handled += 1
+        return handled
+
+    def _on_hello(self, body: bytes, addr: Tuple[str, int]) -> None:
+        try:
+            info = _unpack_any(body)
+            # normalize case so the envelope lookup (raw.hex(), always
+            # lowercase) can never miss a peer registered from a hello
+            pk_hex = info["pk"].lower()
+            if len(bytes.fromhex(pk_hex)) != 32:
+                return  # an X25519 public key is exactly 32 bytes
+        except (ValueError, KeyError, TypeError, AttributeError):
+            return
+        if pk_hex == self.public_key:
+            return
+        self._ensure_peer(pk_hex, addr)
+        if not info.get("ack"):
+            self._send_hello(addr[0], addr[1], ack=True)
+        # key exchange is done on both ends; exchange topic sets
+        self._announce_topics()
+
+    def _on_envelope(self, body: bytes, addr: Tuple[str, int]) -> bool:
+        sender_raw, sealed = body[:32], body[32:]
+        pk_hex = sender_raw.hex()
+        peer = self._peers.get(pk_hex)
+        if peer is None:
+            # envelope from an unknown peer (e.g. we restarted): redo
+            # the handshake; the CRDT layer's anti-entropy recovers
+            # whatever this message carried
+            self._send_hello(addr[0], addr[1], ack=False)
+            return False
+        try:
+            payload = _unpack_any(peer.box.decrypt(sealed, aad=sender_raw))
+        except ValueError:
+            return False  # forged or corrupted
+        t = payload.get("t") if isinstance(payload, dict) else None
+        if t == "topics":
+            before = set(peer.topics)
+            peer.topics = set(payload.get("topics", ()))
+            for topic in peer.topics - before:
+                if topic in self._handlers:
+                    self._on_peer_joined_topic(topic, pk_hex)
+        elif t == "m":
+            handler = self._handlers.get(payload.get("topic"))
+            if handler is not None:
+                handler(payload.get("msg"), pk_hex)
+        return True
+
+    # -- topology hook driving the injected sync contract ----------------
+    def _on_peer_joined_topic(self, topic: str, pk_hex: str) -> None:
+        contract = self.options["cache"].get(topic)
+        if not contract:
+            return
+        probe = contract.get("peer_joined")
+        if probe is not None:
+            probe(pk_hex)  # anti-entropy probe regardless of synced
+        elif not contract.get("synced") and "sync" in contract:
+            contract["sync"]()
+
+
+def pump(routers: List[UdpRouter], *, quiet_rounds: int = 5,
+         timeout_s: float = 10.0, sleep_s: float = 0.002) -> None:
+    """Poll a set of in-process routers until the fabric is quiet:
+    no router handles a message and no endpoint has unacked sends for
+    `quiet_rounds` consecutive sweeps. Raises on timeout (undelivered
+    traffic after transport-level retries = a real failure)."""
+    deadline = time.monotonic() + timeout_s
+    quiet = 0
+    failed0 = sum(r.endpoint.failed for r in routers)
+    while quiet < quiet_rounds:
+        if time.monotonic() > deadline:
+            pend = [(r.public_key[:8], r.endpoint.pending) for r in routers]
+            raise TimeoutError(f"fabric not quiet: pending={pend}")
+        handled = sum(r.poll() for r in routers)
+        pending = sum(r.endpoint.pending for r in routers)
+        failed = sum(r.endpoint.failed for r in routers)
+        if failed > failed0:
+            # a message burned every retransmit: the fabric would look
+            # quiet, but traffic was lost — that is a failure, not quiet
+            raise RuntimeError(f"{failed - failed0} message(s) dropped "
+                               "after exhausting transport retries")
+        if handled == 0 and pending == 0:
+            quiet += 1
+        else:
+            quiet = 0
+        time.sleep(sleep_s)

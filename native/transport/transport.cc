@@ -1,0 +1,894 @@
+// TPU-framework native transport: reliable-datagram UDP + crypto.
+//
+// The reference's swarm stack bottoms out in two native libraries
+// (SURVEY.md §2.2 native-code census): udx-native (C, reliable streams
+// over UDP) and sodium-native (C, libsodium crypto for the encrypted
+// peer links). This file is their equivalent seam for the rebuild:
+//
+//  - crypto: X25519 (RFC 7748) key agreement, HChaCha20 subkey
+//    derivation, ChaCha20-Poly1305 AEAD (RFC 8439) and its
+//    XChaCha20-Poly1305 extended-nonce form — the same primitive
+//    family libsodium uses for crypto_box/secretstream. Implemented
+//    from the RFCs; test vectors in tests/test_transport.py.
+//  - transport: a poll-driven (event-loop, like udx) UDP endpoint
+//    carrying arbitrary-size messages: fragmentation to sub-MTU
+//    datagrams, per-fragment acks, timed retransmit with exponential
+//    backoff, reassembly, duplicate suppression. No threads: the
+//    caller pumps udp_poll(), exactly how udx rides libuv.
+//
+// Flat C ABI (ctypes on the Python side; the image has no pybind11).
+// Single file, no dependencies beyond POSIX sockets.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <deque>
+#include <fcntl.h>
+#include <map>
+#include <netinet/in.h>
+#include <set>
+#include <string>
+#include <sys/random.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+
+// ===========================================================================
+// crypto: ChaCha20 (RFC 8439 §2.3)
+// ===========================================================================
+
+static inline uint32_t rotl32(uint32_t x, int n) {
+  return (x << n) | (x >> (32 - n));
+}
+
+static inline uint32_t load32le(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+static inline void store32le(uint8_t* p, uint32_t v) {
+  p[0] = v & 0xff; p[1] = (v >> 8) & 0xff;
+  p[2] = (v >> 16) & 0xff; p[3] = (v >> 24) & 0xff;
+}
+
+#define QR(a, b, c, d)                                               \
+  a += b; d ^= a; d = rotl32(d, 16);                                 \
+  c += d; b ^= c; b = rotl32(b, 12);                                 \
+  a += b; d ^= a; d = rotl32(d, 8);                                  \
+  c += d; b ^= c; b = rotl32(b, 7);
+
+static void chacha20_rounds(uint32_t x[16]) {
+  for (int i = 0; i < 10; i++) {
+    QR(x[0], x[4], x[8], x[12]);
+    QR(x[1], x[5], x[9], x[13]);
+    QR(x[2], x[6], x[10], x[14]);
+    QR(x[3], x[7], x[11], x[15]);
+    QR(x[0], x[5], x[10], x[15]);
+    QR(x[1], x[6], x[11], x[12]);
+    QR(x[2], x[7], x[8], x[13]);
+    QR(x[3], x[4], x[9], x[14]);
+  }
+}
+
+static void chacha20_init_state(uint32_t s[16], const uint8_t key[32],
+                                uint32_t counter, const uint8_t nonce[12]) {
+  s[0] = 0x61707865; s[1] = 0x3320646e; s[2] = 0x79622d32; s[3] = 0x6b206574;
+  for (int i = 0; i < 8; i++) s[4 + i] = load32le(key + 4 * i);
+  s[12] = counter;
+  for (int i = 0; i < 3; i++) s[13 + i] = load32le(nonce + 4 * i);
+}
+
+static void chacha20_block(const uint8_t key[32], uint32_t counter,
+                           const uint8_t nonce[12], uint8_t out[64]) {
+  uint32_t s[16], x[16];
+  chacha20_init_state(s, key, counter, nonce);
+  memcpy(x, s, sizeof(s));
+  chacha20_rounds(x);
+  for (int i = 0; i < 16; i++) store32le(out + 4 * i, x[i] + s[i]);
+}
+
+static void chacha20_xor(const uint8_t key[32], uint32_t counter,
+                         const uint8_t nonce[12], const uint8_t* in,
+                         uint8_t* out, size_t len) {
+  uint8_t block[64];
+  for (size_t off = 0; off < len; off += 64, counter++) {
+    chacha20_block(key, counter, nonce, block);
+    size_t n = len - off < 64 ? len - off : 64;
+    for (size_t i = 0; i < n; i++) out[off + i] = in[off + i] ^ block[i];
+  }
+}
+
+// HChaCha20 (draft-irtf-cfrg-xchacha §2.2): the rounds WITHOUT the
+// final state addition; output = words 0-3 and 12-15.
+void ct_hchacha20(uint8_t out[32], const uint8_t key[32],
+                  const uint8_t nonce[16]) {
+  uint32_t x[16];
+  x[0] = 0x61707865; x[1] = 0x3320646e; x[2] = 0x79622d32; x[3] = 0x6b206574;
+  for (int i = 0; i < 8; i++) x[4 + i] = load32le(key + 4 * i);
+  for (int i = 0; i < 4; i++) x[12 + i] = load32le(nonce + 4 * i);
+  chacha20_rounds(x);
+  for (int i = 0; i < 4; i++) store32le(out + 4 * i, x[i]);
+  for (int i = 0; i < 4; i++) store32le(out + 16 + 4 * i, x[12 + i]);
+}
+
+// ===========================================================================
+// crypto: Poly1305 (RFC 8439 §2.5)
+// ===========================================================================
+
+typedef struct {
+  uint32_t r[5];
+  uint32_t h[5];
+  uint32_t pad[4];
+} poly1305_state;
+
+static void poly1305_init(poly1305_state* st, const uint8_t key[32]) {
+  // r with the required clamping, split into 26-bit limbs
+  st->r[0] = load32le(key + 0) & 0x3ffffff;
+  st->r[1] = (load32le(key + 3) >> 2) & 0x3ffff03;
+  st->r[2] = (load32le(key + 6) >> 4) & 0x3ffc0ff;
+  st->r[3] = (load32le(key + 9) >> 6) & 0x3f03fff;
+  st->r[4] = (load32le(key + 12) >> 8) & 0x00fffff;
+  memset(st->h, 0, sizeof(st->h));
+  for (int i = 0; i < 4; i++) st->pad[i] = load32le(key + 16 + 4 * i);
+}
+
+static void poly1305_blocks(poly1305_state* st, const uint8_t* m, size_t len,
+                            int final_partial) {
+  const uint32_t hibit = final_partial ? 0 : (1 << 24);
+  uint32_t r0 = st->r[0], r1 = st->r[1], r2 = st->r[2], r3 = st->r[3],
+           r4 = st->r[4];
+  uint32_t s1 = r1 * 5, s2 = r2 * 5, s3 = r3 * 5, s4 = r4 * 5;
+  uint32_t h0 = st->h[0], h1 = st->h[1], h2 = st->h[2], h3 = st->h[3],
+           h4 = st->h[4];
+
+  while (len > 0) {
+    uint8_t block[16];
+    size_t n = len < 16 ? len : 16;
+    uint32_t hb = hibit;
+    if (n < 16) {
+      memset(block, 0, 16);
+      memcpy(block, m, n);
+      block[n] = 1;
+      hb = 0;
+      m = block;
+    }
+    h0 += load32le(m + 0) & 0x3ffffff;
+    h1 += (load32le(m + 3) >> 2) & 0x3ffffff;
+    h2 += (load32le(m + 6) >> 4) & 0x3ffffff;
+    h3 += (load32le(m + 9) >> 6) & 0x3ffffff;
+    h4 += (load32le(m + 12) >> 8) | hb;
+
+    uint64_t d0 = (uint64_t)h0 * r0 + (uint64_t)h1 * s4 + (uint64_t)h2 * s3 +
+                  (uint64_t)h3 * s2 + (uint64_t)h4 * s1;
+    uint64_t d1 = (uint64_t)h0 * r1 + (uint64_t)h1 * r0 + (uint64_t)h2 * s4 +
+                  (uint64_t)h3 * s3 + (uint64_t)h4 * s2;
+    uint64_t d2 = (uint64_t)h0 * r2 + (uint64_t)h1 * r1 + (uint64_t)h2 * r0 +
+                  (uint64_t)h3 * s4 + (uint64_t)h4 * s3;
+    uint64_t d3 = (uint64_t)h0 * r3 + (uint64_t)h1 * r2 + (uint64_t)h2 * r1 +
+                  (uint64_t)h3 * r0 + (uint64_t)h4 * s4;
+    uint64_t d4 = (uint64_t)h0 * r4 + (uint64_t)h1 * r3 + (uint64_t)h2 * r2 +
+                  (uint64_t)h3 * r1 + (uint64_t)h4 * r0;
+
+    uint64_t c;
+    c = d0 >> 26; h0 = d0 & 0x3ffffff; d1 += c;
+    c = d1 >> 26; h1 = d1 & 0x3ffffff; d2 += c;
+    c = d2 >> 26; h2 = d2 & 0x3ffffff; d3 += c;
+    c = d3 >> 26; h3 = d3 & 0x3ffffff; d4 += c;
+    c = d4 >> 26; h4 = d4 & 0x3ffffff;
+    h0 += (uint32_t)(c * 5);
+    c = h0 >> 26; h0 &= 0x3ffffff;
+    h1 += (uint32_t)c;
+
+    if (n == 16) m += 16;
+    len -= n;
+  }
+  st->h[0] = h0; st->h[1] = h1; st->h[2] = h2; st->h[3] = h3; st->h[4] = h4;
+}
+
+static void poly1305_finish(poly1305_state* st, uint8_t tag[16]) {
+  uint32_t h0 = st->h[0], h1 = st->h[1], h2 = st->h[2], h3 = st->h[3],
+           h4 = st->h[4];
+  uint32_t c;
+  c = h1 >> 26; h1 &= 0x3ffffff; h2 += c;
+  c = h2 >> 26; h2 &= 0x3ffffff; h3 += c;
+  c = h3 >> 26; h3 &= 0x3ffffff; h4 += c;
+  c = h4 >> 26; h4 &= 0x3ffffff; h0 += c * 5;
+  c = h0 >> 26; h0 &= 0x3ffffff; h1 += c;
+
+  // compute h + -p
+  uint32_t g0 = h0 + 5; c = g0 >> 26; g0 &= 0x3ffffff;
+  uint32_t g1 = h1 + c; c = g1 >> 26; g1 &= 0x3ffffff;
+  uint32_t g2 = h2 + c; c = g2 >> 26; g2 &= 0x3ffffff;
+  uint32_t g3 = h3 + c; c = g3 >> 26; g3 &= 0x3ffffff;
+  uint32_t g4 = h4 + c - (1 << 26);
+
+  // select h if h < p, else h - p
+  uint32_t mask = (g4 >> 31) - 1;
+  g0 &= mask; g1 &= mask; g2 &= mask; g3 &= mask; g4 &= mask;
+  mask = ~mask;
+  h0 = (h0 & mask) | g0; h1 = (h1 & mask) | g1; h2 = (h2 & mask) | g2;
+  h3 = (h3 & mask) | g3; h4 = (h4 & mask) | g4;
+
+  // h = h % 2^128, then h += pad
+  h0 = (h0 | (h1 << 26)) & 0xffffffff;
+  h1 = ((h1 >> 6) | (h2 << 20)) & 0xffffffff;
+  h2 = ((h2 >> 12) | (h3 << 14)) & 0xffffffff;
+  h3 = ((h3 >> 18) | (h4 << 8)) & 0xffffffff;
+
+  uint64_t f;
+  f = (uint64_t)h0 + st->pad[0]; h0 = (uint32_t)f;
+  f = (uint64_t)h1 + st->pad[1] + (f >> 32); h1 = (uint32_t)f;
+  f = (uint64_t)h2 + st->pad[2] + (f >> 32); h2 = (uint32_t)f;
+  f = (uint64_t)h3 + st->pad[3] + (f >> 32); h3 = (uint32_t)f;
+
+  store32le(tag + 0, h0); store32le(tag + 4, h1);
+  store32le(tag + 8, h2); store32le(tag + 12, h3);
+}
+
+static void poly1305_mac(const uint8_t key[32], const uint8_t* m, size_t len,
+                         uint8_t tag[16]) {
+  poly1305_state st;
+  poly1305_init(&st, key);
+  size_t full = len - (len % 16);
+  if (full) poly1305_blocks(&st, m, full, 0);
+  if (len % 16) poly1305_blocks(&st, m + full, len % 16, 1);
+  poly1305_finish(&st, tag);
+}
+
+// ===========================================================================
+// crypto: ChaCha20-Poly1305 AEAD (RFC 8439 §2.8)
+// ===========================================================================
+
+static void aead_mac(const uint8_t otk[32], const uint8_t* aad, size_t aad_len,
+                     const uint8_t* ct, size_t ct_len, uint8_t tag[16]) {
+  // mac_data = aad | pad16 | ct | pad16 | len(aad) LE64 | len(ct) LE64
+  poly1305_state st;
+  poly1305_init(&st, otk);
+  uint8_t lens[16];
+  if (aad_len) {
+    size_t full = aad_len - (aad_len % 16);
+    if (full) poly1305_blocks(&st, aad, full, 0);
+    if (aad_len % 16) {
+      uint8_t block[16] = {0};
+      memcpy(block, aad + full, aad_len % 16);
+      poly1305_blocks(&st, block, 16, 0);
+    }
+  }
+  if (ct_len) {
+    size_t full = ct_len - (ct_len % 16);
+    if (full) poly1305_blocks(&st, ct, full, 0);
+    if (ct_len % 16) {
+      uint8_t block[16] = {0};
+      memcpy(block, ct + full, ct_len % 16);
+      poly1305_blocks(&st, block, 16, 0);
+    }
+  }
+  for (int i = 0; i < 8; i++) {
+    lens[i] = (uint8_t)((uint64_t)aad_len >> (8 * i));
+    lens[8 + i] = (uint8_t)((uint64_t)ct_len >> (8 * i));
+  }
+  poly1305_blocks(&st, lens, 16, 0);
+  poly1305_finish(&st, tag);
+}
+
+int ct_aead_encrypt(const uint8_t key[32], const uint8_t nonce[12],
+                    const uint8_t* aad, uint32_t aad_len, const uint8_t* pt,
+                    uint32_t pt_len, uint8_t* out /* pt_len + 16 */) {
+  uint8_t otk[64];
+  chacha20_block(key, 0, nonce, otk);  // poly key = first 32 bytes of block 0
+  chacha20_xor(key, 1, nonce, pt, out, pt_len);
+  aead_mac(otk, aad, aad_len, out, pt_len, out + pt_len);
+  return 0;
+}
+
+int ct_aead_decrypt(const uint8_t key[32], const uint8_t nonce[12],
+                    const uint8_t* aad, uint32_t aad_len, const uint8_t* ct,
+                    uint32_t ct_len, uint8_t* out /* ct_len - 16 */) {
+  if (ct_len < 16) return -1;
+  uint32_t body = ct_len - 16;
+  uint8_t otk[64], tag[16];
+  chacha20_block(key, 0, nonce, otk);
+  aead_mac(otk, aad, aad_len, ct, body, tag);
+  uint8_t diff = 0;
+  for (int i = 0; i < 16; i++) diff |= tag[i] ^ ct[body + i];
+  if (diff) return -1;
+  chacha20_xor(key, 1, nonce, ct, out, body);
+  return 0;
+}
+
+// XChaCha20-Poly1305 (draft-irtf-cfrg-xchacha §2): HChaCha20 subkey
+// from the first 16 nonce bytes, then RFC 8439 with nonce
+// 0x00000000 | last 8 nonce bytes.
+int ct_xaead_encrypt(const uint8_t key[32], const uint8_t nonce[24],
+                     const uint8_t* aad, uint32_t aad_len, const uint8_t* pt,
+                     uint32_t pt_len, uint8_t* out) {
+  uint8_t subkey[32], n12[12] = {0};
+  ct_hchacha20(subkey, key, nonce);
+  memcpy(n12 + 4, nonce + 16, 8);
+  return ct_aead_encrypt(subkey, n12, aad, aad_len, pt, pt_len, out);
+}
+
+int ct_xaead_decrypt(const uint8_t key[32], const uint8_t nonce[24],
+                     const uint8_t* aad, uint32_t aad_len, const uint8_t* ct,
+                     uint32_t ct_len, uint8_t* out) {
+  uint8_t subkey[32], n12[12] = {0};
+  ct_hchacha20(subkey, key, nonce);
+  memcpy(n12 + 4, nonce + 16, 8);
+  return ct_aead_decrypt(subkey, n12, aad, aad_len, ct, ct_len, out);
+}
+
+// ===========================================================================
+// crypto: X25519 (RFC 7748) — field arithmetic mod 2^255-19, 5x51-bit
+// limbs with unsigned __int128 products
+// ===========================================================================
+
+typedef uint64_t fe[5];
+static const uint64_t MASK51 = 0x7ffffffffffffULL;
+
+static void fe_copy(fe h, const fe f) { memcpy(h, f, sizeof(fe)); }
+static void fe_0(fe h) { memset(h, 0, sizeof(fe)); }
+static void fe_1(fe h) { fe_0(h); h[0] = 1; }
+
+static void fe_add(fe h, const fe f, const fe g) {
+  for (int i = 0; i < 5; i++) h[i] = f[i] + g[i];
+}
+
+static void fe_sub(fe h, const fe f, const fe g) {
+  // add 2p first so limbs stay non-negative
+  h[0] = f[0] + 0xfffffffffffdaULL - g[0];
+  h[1] = f[1] + 0xffffffffffffeULL - g[1];
+  h[2] = f[2] + 0xffffffffffffeULL - g[2];
+  h[3] = f[3] + 0xffffffffffffeULL - g[3];
+  h[4] = f[4] + 0xffffffffffffeULL - g[4];
+}
+
+static void fe_carry(fe h) {
+  uint64_t c;
+  c = h[0] >> 51; h[0] &= MASK51; h[1] += c;
+  c = h[1] >> 51; h[1] &= MASK51; h[2] += c;
+  c = h[2] >> 51; h[2] &= MASK51; h[3] += c;
+  c = h[3] >> 51; h[3] &= MASK51; h[4] += c;
+  c = h[4] >> 51; h[4] &= MASK51; h[0] += c * 19;
+  c = h[0] >> 51; h[0] &= MASK51; h[1] += c;
+}
+
+static void fe_mul(fe h, const fe f, const fe g) {
+  unsigned __int128 r0, r1, r2, r3, r4;
+  uint64_t f0 = f[0], f1 = f[1], f2 = f[2], f3 = f[3], f4 = f[4];
+  uint64_t g0 = g[0], g1 = g[1], g2 = g[2], g3 = g[3], g4 = g[4];
+  uint64_t g1_19 = g1 * 19, g2_19 = g2 * 19, g3_19 = g3 * 19, g4_19 = g4 * 19;
+
+  r0 = (unsigned __int128)f0 * g0 + (unsigned __int128)f1 * g4_19 +
+       (unsigned __int128)f2 * g3_19 + (unsigned __int128)f3 * g2_19 +
+       (unsigned __int128)f4 * g1_19;
+  r1 = (unsigned __int128)f0 * g1 + (unsigned __int128)f1 * g0 +
+       (unsigned __int128)f2 * g4_19 + (unsigned __int128)f3 * g3_19 +
+       (unsigned __int128)f4 * g2_19;
+  r2 = (unsigned __int128)f0 * g2 + (unsigned __int128)f1 * g1 +
+       (unsigned __int128)f2 * g0 + (unsigned __int128)f3 * g4_19 +
+       (unsigned __int128)f4 * g3_19;
+  r3 = (unsigned __int128)f0 * g3 + (unsigned __int128)f1 * g2 +
+       (unsigned __int128)f2 * g1 + (unsigned __int128)f3 * g0 +
+       (unsigned __int128)f4 * g4_19;
+  r4 = (unsigned __int128)f0 * g4 + (unsigned __int128)f1 * g3 +
+       (unsigned __int128)f2 * g2 + (unsigned __int128)f3 * g1 +
+       (unsigned __int128)f4 * g0;
+
+  uint64_t c;
+  uint64_t h0 = (uint64_t)r0 & MASK51; c = (uint64_t)(r0 >> 51);
+  r1 += c;
+  uint64_t h1 = (uint64_t)r1 & MASK51; c = (uint64_t)(r1 >> 51);
+  r2 += c;
+  uint64_t h2 = (uint64_t)r2 & MASK51; c = (uint64_t)(r2 >> 51);
+  r3 += c;
+  uint64_t h3 = (uint64_t)r3 & MASK51; c = (uint64_t)(r3 >> 51);
+  r4 += c;
+  uint64_t h4 = (uint64_t)r4 & MASK51; c = (uint64_t)(r4 >> 51);
+  h0 += c * 19;
+  c = h0 >> 51; h0 &= MASK51; h1 += c;
+  h[0] = h0; h[1] = h1; h[2] = h2; h[3] = h3; h[4] = h4;
+}
+
+static void fe_sq(fe h, const fe f) { fe_mul(h, f, f); }
+
+static void fe_mul121665(fe h, const fe f) {
+  unsigned __int128 r;
+  uint64_t c = 0;
+  for (int i = 0; i < 5; i++) {
+    r = (unsigned __int128)f[i] * 121665 + c;
+    h[i] = (uint64_t)r & MASK51;
+    c = (uint64_t)(r >> 51);
+  }
+  h[0] += c * 19;
+  c = h[0] >> 51; h[0] &= MASK51; h[1] += c;
+}
+
+static void fe_cswap(fe f, fe g, uint64_t b) {
+  uint64_t mask = (uint64_t)0 - b;
+  for (int i = 0; i < 5; i++) {
+    uint64_t x = mask & (f[i] ^ g[i]);
+    f[i] ^= x;
+    g[i] ^= x;
+  }
+}
+
+static void fe_frombytes(fe h, const uint8_t s[32]) {
+  uint64_t w[4];
+  for (int i = 0; i < 4; i++) {
+    w[i] = 0;
+    for (int j = 0; j < 8; j++) w[i] |= (uint64_t)s[8 * i + j] << (8 * j);
+  }
+  h[0] = w[0] & MASK51;
+  h[1] = ((w[0] >> 51) | (w[1] << 13)) & MASK51;
+  h[2] = ((w[1] >> 38) | (w[2] << 26)) & MASK51;
+  h[3] = ((w[2] >> 25) | (w[3] << 39)) & MASK51;
+  h[4] = (w[3] >> 12) & MASK51;  // top bit of the point masked per RFC
+}
+
+static void fe_tobytes(uint8_t s[32], const fe f) {
+  fe h;
+  fe_copy(h, f);
+  fe_carry(h);
+  fe_carry(h);
+  // canonical reduction: q = 1 iff h >= p
+  uint64_t q = (h[0] + 19) >> 51;
+  q = (h[1] + q) >> 51;
+  q = (h[2] + q) >> 51;
+  q = (h[3] + q) >> 51;
+  q = (h[4] + q) >> 51;
+  h[0] += 19 * q;
+  uint64_t c;
+  c = h[0] >> 51; h[0] &= MASK51; h[1] += c;
+  c = h[1] >> 51; h[1] &= MASK51; h[2] += c;
+  c = h[2] >> 51; h[2] &= MASK51; h[3] += c;
+  c = h[3] >> 51; h[3] &= MASK51; h[4] += c;
+  h[4] &= MASK51;
+
+  uint64_t w0 = h[0] | (h[1] << 51);
+  uint64_t w1 = (h[1] >> 13) | (h[2] << 38);
+  uint64_t w2 = (h[2] >> 26) | (h[3] << 25);
+  uint64_t w3 = (h[3] >> 39) | (h[4] << 12);
+  uint64_t w[4] = {w0, w1, w2, w3};
+  for (int i = 0; i < 4; i++)
+    for (int j = 0; j < 8; j++) s[8 * i + j] = (uint8_t)(w[i] >> (8 * j));
+}
+
+static void fe_invert(fe out, const fe z) {
+  // z^(p-2), p-2 = 2^255 - 21; square-and-multiply over the fixed
+  // exponent (handshake-only path, simplicity over speed)
+  static const uint8_t exp_bytes[32] = {
+      // little-endian p-2
+      0xeb, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+      0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0x7f};
+  fe result, base;
+  fe_1(result);
+  fe_copy(base, z);
+  for (int i = 254; i >= 0; i--) {
+    fe_sq(result, result);
+    if ((exp_bytes[i / 8] >> (i % 8)) & 1) fe_mul(result, result, base);
+  }
+  fe_copy(out, result);
+}
+
+void ct_x25519_scalarmult(uint8_t out[32], const uint8_t scalar[32],
+                          const uint8_t point[32]) {
+  uint8_t e[32];
+  memcpy(e, scalar, 32);
+  e[0] &= 248;
+  e[31] &= 127;
+  e[31] |= 64;
+
+  fe x1, x2, z2, x3, z3;
+  fe_frombytes(x1, point);
+  fe_1(x2);
+  fe_0(z2);
+  fe_copy(x3, x1);
+  fe_1(z3);
+
+  uint64_t swap = 0;
+  for (int t = 254; t >= 0; t--) {
+    uint64_t k_t = (e[t / 8] >> (t % 8)) & 1;
+    swap ^= k_t;
+    fe_cswap(x2, x3, swap);
+    fe_cswap(z2, z3, swap);
+    swap = k_t;
+
+    fe a, aa, b, bb, eph, cc, d, da, cb, tmp;
+    fe_add(a, x2, z2);
+    fe_carry(a);
+    fe_sq(aa, a);
+    fe_sub(b, x2, z2);
+    fe_carry(b);
+    fe_sq(bb, b);
+    fe_sub(eph, aa, bb);
+    fe_carry(eph);
+    fe_add(cc, x3, z3);
+    fe_carry(cc);
+    fe_sub(d, x3, z3);
+    fe_carry(d);
+    fe_mul(da, d, a);
+    fe_mul(cb, cc, b);
+
+    fe_add(tmp, da, cb);
+    fe_carry(tmp);
+    fe_sq(x3, tmp);
+    fe_sub(tmp, da, cb);
+    fe_carry(tmp);
+    fe_sq(tmp, tmp);
+    fe_mul(z3, x1, tmp);
+
+    fe_mul(x2, aa, bb);
+    fe_mul121665(tmp, eph);
+    fe_add(tmp, aa, tmp);
+    fe_carry(tmp);
+    fe_mul(z2, eph, tmp);
+  }
+  fe_cswap(x2, x3, swap);
+  fe_cswap(z2, z3, swap);
+
+  fe zinv, res;
+  fe_invert(zinv, z2);
+  fe_mul(res, x2, zinv);
+  fe_tobytes(out, res);
+}
+
+int ct_x25519(uint8_t out[32], const uint8_t scalar[32],
+              const uint8_t point[32]) {
+  ct_x25519_scalarmult(out, scalar, point);
+  uint8_t acc = 0;
+  for (int i = 0; i < 32; i++) acc |= out[i];
+  return acc ? 0 : -1;  // all-zero = low-order input point
+}
+
+void ct_x25519_base(uint8_t out[32], const uint8_t scalar[32]) {
+  uint8_t base[32] = {9};
+  ct_x25519_scalarmult(out, scalar, base);
+}
+
+void ct_randombytes(uint8_t* out, uint32_t n) {
+  // getrandom(2) first (no fd churn on the per-envelope nonce path);
+  // fall back to a /dev/urandom fd opened once, like libsodium
+  uint32_t off = 0;
+  while (off < n) {
+    ssize_t got = getrandom(out + off, n - off, 0);
+    if (got < 0) {
+      if (errno == EINTR) continue;
+      break;  // ENOSYS etc. -> urandom fallback
+    }
+    off += (uint32_t)got;
+  }
+  if (off == n) return;
+  static int urandom_fd = -1;
+  if (urandom_fd < 0) urandom_fd = open("/dev/urandom", O_RDONLY);
+  while (urandom_fd >= 0 && off < n) {
+    ssize_t got = read(urandom_fd, out + off, n - off);
+    if (got < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    off += (uint32_t)got;
+  }
+  if (off == n) return;
+  // system randomness is part of the platform contract; fail loudly
+  // rather than emit weak keys
+  fprintf(stderr, "ct_randombytes: no system randomness available\n");
+  abort();
+}
+
+void ct_free(uint8_t* p) { free(p); }
+
+// ===========================================================================
+// transport: poll-driven reliable-datagram UDP endpoint
+// ===========================================================================
+
+static const uint8_t WIRE_MAGIC = 0xC7;
+static const uint8_t T_DATA = 0;
+static const uint8_t T_ACK = 1;
+static const size_t FRAG_PAYLOAD = 1200;  // conservative sub-MTU
+static const size_t HDR = 1 + 1 + 4 + 2 + 2;  // magic type msg_id idx cnt
+static const int MAX_RETRIES = 30;
+static const uint64_t RTO_MS = 40;       // initial retransmit timeout
+static const uint64_t RTO_MAX_MS = 1000;
+static const uint64_t DONE_TTL_MS = 30000;  // re-ack window for dups
+
+static uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000 + ts.tv_nsec / 1000000;
+}
+
+struct Addr {
+  uint32_t ip;    // network byte order
+  uint16_t port;  // host byte order
+  bool operator<(const Addr& o) const {
+    return ip != o.ip ? ip < o.ip : port < o.port;
+  }
+};
+
+struct OutMsg {
+  Addr dest;
+  std::vector<std::string> frags;  // full datagrams (header included)
+  std::vector<bool> acked;
+  size_t n_acked = 0;
+  uint64_t last_send = 0;
+  uint64_t rto = RTO_MS;
+  int retries = 0;
+};
+
+struct InKey {
+  Addr src;
+  uint32_t msg_id;
+  bool operator<(const InKey& o) const {
+    if (src < o.src) return true;
+    if (o.src < src) return false;
+    return msg_id < o.msg_id;
+  }
+};
+
+struct InMsg {
+  std::vector<std::string> frags;
+  std::vector<bool> have;
+  size_t n_have = 0;
+  uint64_t first_ms = 0;  // for expiring abandoned reassemblies
+};
+
+struct Done {
+  Addr src;
+  uint32_t ip;
+  uint16_t port;
+  std::string payload;
+};
+
+struct Endpoint {
+  int fd = -1;
+  uint16_t port = 0;
+  uint32_t next_msg_id = 1;
+  std::map<uint32_t, OutMsg> outgoing;
+  std::map<InKey, InMsg> incoming;
+  std::map<InKey, uint64_t> completed;  // re-ack window
+  std::deque<Done> done;
+  uint64_t failed = 0;
+  // loss injection (tests): permille of outbound datagrams dropped
+  int loss_permille = 0;
+  uint64_t loss_state = 0x9e3779b97f4a7c15ULL;
+};
+
+static bool lose(Endpoint* ep) {
+  if (!ep->loss_permille) return false;
+  // xorshift64* — deterministic per seed
+  uint64_t x = ep->loss_state;
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  ep->loss_state = x;
+  return (x * 0x2545F4914F6CDD1DULL >> 32) % 1000 < (uint64_t)ep->loss_permille;
+}
+
+static void raw_send(Endpoint* ep, const Addr& to, const std::string& dgram) {
+  if (lose(ep)) return;
+  struct sockaddr_in sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sin_family = AF_INET;
+  sa.sin_addr.s_addr = to.ip;
+  sa.sin_port = htons(to.port);
+  sendto(ep->fd, dgram.data(), dgram.size(), 0, (struct sockaddr*)&sa,
+         sizeof(sa));
+}
+
+void* udp_create(const char* bind_ip, int port, char* err, int errlen) {
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) {
+    snprintf(err, errlen, "socket: %s", strerror(errno));
+    return nullptr;
+  }
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  struct sockaddr_in sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons((uint16_t)port);
+  if (!bind_ip || !*bind_ip) {
+    sa.sin_addr.s_addr = htonl(INADDR_ANY);
+  } else if (inet_pton(AF_INET, bind_ip, &sa.sin_addr) != 1) {
+    snprintf(err, errlen, "bad bind ip %s", bind_ip);
+    close(fd);
+    return nullptr;
+  }
+  if (bind(fd, (struct sockaddr*)&sa, sizeof(sa)) != 0) {
+    snprintf(err, errlen, "bind: %s", strerror(errno));
+    close(fd);
+    return nullptr;
+  }
+  socklen_t slen = sizeof(sa);
+  getsockname(fd, (struct sockaddr*)&sa, &slen);
+  Endpoint* ep = new Endpoint();
+  ep->fd = fd;
+  ep->port = ntohs(sa.sin_port);
+  return ep;
+}
+
+int udp_port(void* h) { return ((Endpoint*)h)->port; }
+
+void udp_close(void* h) {
+  Endpoint* ep = (Endpoint*)h;
+  if (ep->fd >= 0) close(ep->fd);
+  delete ep;
+}
+
+void udp_set_loss(void* h, int permille, uint64_t seed) {
+  Endpoint* ep = (Endpoint*)h;
+  ep->loss_permille = permille;
+  ep->loss_state = seed ? seed : 0x9e3779b97f4a7c15ULL;
+}
+
+long udp_send(void* h, const char* ip, int port, const uint8_t* buf,
+              uint32_t len) {
+  Endpoint* ep = (Endpoint*)h;
+  Addr to;
+  struct in_addr ia;
+  if (inet_pton(AF_INET, ip, &ia) != 1) return -1;
+  to.ip = ia.s_addr;
+  to.port = (uint16_t)port;
+
+  uint32_t id = ep->next_msg_id++;
+  size_t n_frags = len == 0 ? 1 : (len + FRAG_PAYLOAD - 1) / FRAG_PAYLOAD;
+  if (n_frags > 0xffff) return -1;  // > ~78 MB message
+
+  OutMsg om;
+  om.dest = to;
+  om.frags.reserve(n_frags);
+  for (size_t i = 0; i < n_frags; i++) {
+    size_t off = i * FRAG_PAYLOAD;
+    size_t n = len - off < FRAG_PAYLOAD ? len - off : FRAG_PAYLOAD;
+    std::string d;
+    d.reserve(HDR + n);
+    d.push_back((char)WIRE_MAGIC);
+    d.push_back((char)T_DATA);
+    uint8_t hdr[8];
+    store32le(hdr, id);
+    hdr[4] = i & 0xff; hdr[5] = (i >> 8) & 0xff;
+    hdr[6] = n_frags & 0xff; hdr[7] = (n_frags >> 8) & 0xff;
+    d.append((const char*)hdr, 8);
+    d.append((const char*)buf + off, n);
+    om.frags.push_back(std::move(d));
+  }
+  om.acked.assign(n_frags, false);
+  om.last_send = now_ms();
+  for (auto& f : om.frags) raw_send(ep, to, f);
+  ep->outgoing.emplace(id, std::move(om));
+  return (long)id;
+}
+
+static void send_ack(Endpoint* ep, const Addr& to, uint32_t msg_id,
+                     uint16_t idx) {
+  std::string d;
+  d.push_back((char)WIRE_MAGIC);
+  d.push_back((char)T_ACK);
+  uint8_t hdr[8];
+  store32le(hdr, msg_id);
+  hdr[4] = idx & 0xff; hdr[5] = (idx >> 8) & 0xff;
+  hdr[6] = 0; hdr[7] = 0;
+  d.append((const char*)hdr, 8);
+  raw_send(ep, to, d);
+}
+
+int udp_poll(void* h) {
+  Endpoint* ep = (Endpoint*)h;
+  uint64_t now = now_ms();
+  int processed = 0;
+  uint8_t buf[2048];
+
+  for (;;) {
+    struct sockaddr_in sa;
+    socklen_t slen = sizeof(sa);
+    ssize_t n =
+        recvfrom(ep->fd, buf, sizeof(buf), 0, (struct sockaddr*)&sa, &slen);
+    if (n < 0) break;  // EAGAIN — drained
+    if (n < (ssize_t)HDR || buf[0] != WIRE_MAGIC) continue;
+    processed++;
+    Addr src{sa.sin_addr.s_addr, ntohs(sa.sin_port)};
+    uint8_t type = buf[1];
+    uint32_t msg_id = load32le(buf + 2);
+    uint16_t idx = (uint16_t)(buf[6] | (buf[7] << 8));
+    uint16_t cnt = (uint16_t)(buf[8] | (buf[9] << 8));
+
+    if (type == T_ACK) {
+      auto it = ep->outgoing.find(msg_id);
+      if (it != ep->outgoing.end() && idx < it->second.acked.size() &&
+          !it->second.acked[idx]) {
+        it->second.acked[idx] = true;
+        if (++it->second.n_acked == it->second.frags.size())
+          ep->outgoing.erase(it);
+      }
+      continue;
+    }
+    if (type != T_DATA || cnt == 0 || idx >= cnt) continue;
+
+    InKey key{src, msg_id};
+    send_ack(ep, src, msg_id, idx);  // always, covers lost acks
+    if (ep->completed.count(key)) continue;  // dup of a done message
+
+    auto& im = ep->incoming[key];
+    if (im.frags.empty()) {
+      im.frags.resize(cnt);
+      im.have.assign(cnt, false);
+      im.first_ms = now;
+    }
+    if (cnt != im.frags.size() || im.have[idx]) continue;
+    im.frags[idx].assign((const char*)buf + HDR, n - HDR);
+    im.have[idx] = true;
+    if (++im.n_have == im.frags.size()) {
+      std::string payload;
+      for (auto& f : im.frags) payload += f;
+      ep->done.push_back(Done{src, src.ip, src.port, std::move(payload)});
+      ep->incoming.erase(key);
+      ep->completed[key] = now;
+    }
+  }
+
+  // retransmit
+  for (auto it = ep->outgoing.begin(); it != ep->outgoing.end();) {
+    OutMsg& om = it->second;
+    if (now - om.last_send >= om.rto) {
+      if (++om.retries > MAX_RETRIES) {
+        ep->failed++;
+        it = ep->outgoing.erase(it);
+        continue;
+      }
+      for (size_t i = 0; i < om.frags.size(); i++)
+        if (!om.acked[i]) raw_send(ep, om.dest, om.frags[i]);
+      om.last_send = now;
+      om.rto = om.rto * 2 > RTO_MAX_MS ? RTO_MAX_MS : om.rto * 2;
+    }
+    ++it;
+  }
+
+  // expire the re-ack window
+  for (auto it = ep->completed.begin(); it != ep->completed.end();) {
+    if (now - it->second > DONE_TTL_MS)
+      it = ep->completed.erase(it);
+    else
+      ++it;
+  }
+  // expire abandoned partial reassemblies (sender gave up after
+  // MAX_RETRIES, or a bogus source claimed a huge frag count) —
+  // without this, half-arrived messages leak for the endpoint's life
+  for (auto it = ep->incoming.begin(); it != ep->incoming.end();) {
+    if (now - it->second.first_ms > DONE_TTL_MS)
+      it = ep->incoming.erase(it);
+    else
+      ++it;
+  }
+  return processed;
+}
+
+int udp_recv(void* h, char* src_ip /* >= 64 bytes */, int* src_port,
+             uint8_t** out, uint32_t* out_len) {
+  Endpoint* ep = (Endpoint*)h;
+  if (ep->done.empty()) return 1;
+  Done& d = ep->done.front();
+  struct in_addr ia;
+  ia.s_addr = d.ip;
+  inet_ntop(AF_INET, &ia, src_ip, 64);
+  *src_port = d.port;
+  *out = (uint8_t*)malloc(d.payload.size() ? d.payload.size() : 1);
+  memcpy(*out, d.payload.data(), d.payload.size());
+  *out_len = (uint32_t)d.payload.size();
+  ep->done.pop_front();
+  return 0;
+}
+
+int udp_pending(void* h) { return (int)((Endpoint*)h)->outgoing.size(); }
+
+uint64_t udp_failed(void* h) { return ((Endpoint*)h)->failed; }
+
+}  // extern "C"

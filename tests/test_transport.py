@@ -1,0 +1,492 @@
+"""Native transport: crypto test vectors, reliable UDP, router contract.
+
+Crypto primitives are checked against their published vectors
+(RFC 8439 for ChaCha20-Poly1305, RFC 7748 for X25519,
+draft-irtf-cfrg-xchacha for HChaCha20) — the implementation lives in
+native/transport/transport.cc and must match the specs bit-for-bit.
+Transport tests run real sockets on 127.0.0.1, including forced
+datagram loss (retransmit path) and a genuine second process.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from crdt_tpu.net import transport as t
+
+
+# ---------------------------------------------------------------------------
+# crypto vectors
+# ---------------------------------------------------------------------------
+
+
+class TestAeadRfc8439:
+    KEY = bytes(range(0x80, 0xA0))
+    NONCE = bytes.fromhex("070000004041424344454647")
+    AAD = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+    PT = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    CT = bytes.fromhex(
+        "d31a8d34648e60db7b86afbc53ef7ec2"
+        "a4aded51296e08fea9e2b5a736ee62d6"
+        "3dbea45e8ca9671282fafb69da92728b"
+        "1a71de0a9e060b2905d6a5b67ecd3b36"
+        "92ddbd7f2d778b8c9803aee328091b58"
+        "fab324e4fad675945585808b4831d7bc"
+        "3ff4def08e4b7a9de576d26586cec64b"
+        "6116"
+    )
+    TAG = bytes.fromhex("1ae10b594f09e26a7e902ecbd0600691")
+
+    def test_encrypt_matches_rfc(self):
+        out = t.aead_encrypt(self.KEY, self.NONCE, self.PT, aad=self.AAD)
+        assert out[:-16] == self.CT
+        assert out[-16:] == self.TAG
+
+    def test_decrypt_roundtrip_and_vector(self):
+        assert (
+            t.aead_decrypt(self.KEY, self.NONCE, self.CT + self.TAG, aad=self.AAD)
+            == self.PT
+        )
+
+    def test_tamper_detected(self):
+        sealed = bytearray(self.CT + self.TAG)
+        sealed[3] ^= 1
+        with pytest.raises(ValueError, match="authentication"):
+            t.aead_decrypt(self.KEY, self.NONCE, bytes(sealed), aad=self.AAD)
+
+    def test_wrong_aad_detected(self):
+        with pytest.raises(ValueError, match="authentication"):
+            t.aead_decrypt(self.KEY, self.NONCE, self.CT + self.TAG, aad=b"x")
+
+    def test_empty_plaintext(self):
+        sealed = t.aead_encrypt(self.KEY, self.NONCE, b"", aad=b"meta")
+        assert len(sealed) == 16
+        assert t.aead_decrypt(self.KEY, self.NONCE, sealed, aad=b"meta") == b""
+
+
+def _py_hchacha20(key: bytes, nonce: bytes) -> bytes:
+    """Independent pure-Python HChaCha20 (draft-irtf-cfrg-xchacha §2.2:
+    the ChaCha rounds WITHOUT the final state addition; output words
+    0-3 and 12-15) — the differential oracle for the C kernel."""
+    import struct
+
+    def rotl(x, n):
+        return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+    def qr(s, a, b, c, d):
+        s[a] = (s[a] + s[b]) & 0xFFFFFFFF; s[d] ^= s[a]; s[d] = rotl(s[d], 16)
+        s[c] = (s[c] + s[d]) & 0xFFFFFFFF; s[b] ^= s[c]; s[b] = rotl(s[b], 12)
+        s[a] = (s[a] + s[b]) & 0xFFFFFFFF; s[d] ^= s[a]; s[d] = rotl(s[d], 8)
+        s[c] = (s[c] + s[d]) & 0xFFFFFFFF; s[b] ^= s[c]; s[b] = rotl(s[b], 7)
+
+    x = [0x61707865, 0x3320646E, 0x79622D32, 0x6B206574]
+    x += list(struct.unpack("<8I", key))
+    x += list(struct.unpack("<4I", nonce))
+    for _ in range(10):
+        qr(x, 0, 4, 8, 12); qr(x, 1, 5, 9, 13)
+        qr(x, 2, 6, 10, 14); qr(x, 3, 7, 11, 15)
+        qr(x, 0, 5, 10, 15); qr(x, 1, 6, 11, 12)
+        qr(x, 2, 7, 8, 13); qr(x, 3, 4, 9, 14)
+    return struct.pack("<4I", *x[0:4]) + struct.pack("<4I", *x[12:16])
+
+
+class TestHChaCha20:
+    def test_draft_input_regression(self):
+        # the draft-irtf-cfrg-xchacha §2.2.1 input; expected value
+        # pinned from two independent implementations of the spec
+        # (this C kernel and _py_hchacha20). The underlying ChaCha
+        # rounds are vector-checked by TestAeadRfc8439.
+        key = bytes(range(32))
+        nonce = bytes.fromhex("000000090000004a0000000031415927")
+        out = t.hchacha20(key, nonce)
+        assert out == _py_hchacha20(key, nonce)
+        assert out == bytes.fromhex(
+            "82413b4227b27bfed30e42508a877d73a0f9e4d58a74a853c12ec41326d3ecdc"
+        )
+
+    def test_differential_random(self):
+        for i in range(16):
+            key, nonce = os.urandom(32), os.urandom(16)
+            assert t.hchacha20(key, nonce) == _py_hchacha20(key, nonce)
+
+
+class TestX25519Rfc7748:
+    def test_vector_1(self):
+        k = bytes.fromhex(
+            "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+        )
+        u = bytes.fromhex(
+            "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+        )
+        assert t.x25519(k, u) == bytes.fromhex(
+            "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+        )
+
+    def test_vector_2(self):
+        k = bytes.fromhex(
+            "4b66e9d4d1b4673c5ad22691957d6af5c11b6421e0ea01d42ca4169e7918ba0d"
+        )
+        u = bytes.fromhex(
+            "e5210f12786811d3f4b7959d0538ae2c31dbe7106fc03c3efc4cd549c715a493"
+        )
+        assert t.x25519(k, u) == bytes.fromhex(
+            "95cbde9476e8907d7aade45cb4b873f88b595a68799fa152e6f8f7647aac7957"
+        )
+
+    def test_dh_commutes_rfc_keys(self):
+        # RFC 7748 §6.1 Diffie-Hellman vector
+        a_priv = bytes.fromhex(
+            "77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a"
+        )
+        b_priv = bytes.fromhex(
+            "5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb"
+        )
+        a_pub, _ = t.keypair(a_priv)
+        b_pub, _ = t.keypair(b_priv)
+        assert a_pub == bytes.fromhex(
+            "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+        )
+        assert b_pub == bytes.fromhex(
+            "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+        )
+        shared = bytes.fromhex(
+            "4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742"
+        )
+        assert t.x25519(a_priv, b_pub) == shared
+        assert t.x25519(b_priv, a_pub) == shared
+
+    def test_low_order_point_rejected(self):
+        with pytest.raises(ValueError, match="low-order"):
+            t.x25519(os.urandom(32), bytes(32))
+
+    def test_differential_vs_openssl(self):
+        """Random-key agreement must match the platform's production
+        X25519 (cryptography/OpenSSL) in both directions."""
+        from cryptography.hazmat.primitives.asymmetric.x25519 import (
+            X25519PrivateKey,
+        )
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, NoEncryption, PrivateFormat, PublicFormat,
+        )
+
+        for _ in range(8):
+            ossl_priv = X25519PrivateKey.generate()
+            ossl_pub = ossl_priv.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw
+            )
+            ours_pub, ours_sec = t.keypair()
+            shared_ours = t.x25519(ours_sec, ossl_pub)
+            from cryptography.hazmat.primitives.asymmetric.x25519 import (
+                X25519PublicKey,
+            )
+            shared_ossl = ossl_priv.exchange(X25519PublicKey.from_public_bytes(ours_pub))
+            assert shared_ours == shared_ossl
+            # and our public key derivation matches theirs
+            raw = ossl_priv.private_bytes(
+                Encoding.Raw, PrivateFormat.Raw, NoEncryption()
+            )
+            pub_from_ours, _ = t.keypair(raw)
+            assert pub_from_ours == ossl_pub
+
+
+class TestSecureBox:
+    def test_both_directions(self):
+        a_pub, a_sec = t.keypair()
+        b_pub, b_sec = t.keypair()
+        ab = t.SecureBox(a_sec, b_pub)
+        ba = t.SecureBox(b_sec, a_pub)
+        msg = b"swarm update \x00\x01" * 100
+        assert ba.decrypt(ab.encrypt(msg)) == msg
+        assert ab.decrypt(ba.encrypt(msg, aad=b"id"), aad=b"id") == msg
+
+    def test_third_party_cannot_decrypt(self):
+        a_pub, a_sec = t.keypair()
+        b_pub, b_sec = t.keypair()
+        _, eve_sec = t.keypair()
+        sealed = t.SecureBox(a_sec, b_pub).encrypt(b"secret")
+        with pytest.raises(ValueError):
+            t.SecureBox(eve_sec, a_pub).decrypt(sealed)
+
+    def test_nonce_randomized(self):
+        a_pub, a_sec = t.keypair()
+        box = t.SecureBox(a_sec, a_pub)
+        assert box.encrypt(b"x") != box.encrypt(b"x")
+
+
+# ---------------------------------------------------------------------------
+# reliable UDP endpoint
+# ---------------------------------------------------------------------------
+
+
+def _pump(endpoints, *, until, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while not until():
+        if time.monotonic() > deadline:
+            raise TimeoutError("endpoints did not settle")
+        for ep in endpoints:
+            ep.poll()
+        time.sleep(0.002)
+
+
+class TestUdpEndpoint:
+    def test_small_message(self):
+        with t.UdpEndpoint() as a, t.UdpEndpoint() as b:
+            a.send("127.0.0.1", b.port, b"hello swarm")
+            got = []
+            _pump([a, b], until=lambda: got or not (got.extend(b.recv_all()) or True) or got)
+            ip, port, data = got[0]
+            assert data == b"hello swarm"
+            assert port == a.port
+            _pump([a, b], until=lambda: a.pending == 0)
+
+    def test_large_message_fragments(self):
+        payload = os.urandom(300_000)  # 250 fragments
+        with t.UdpEndpoint() as a, t.UdpEndpoint() as b:
+            a.send("127.0.0.1", b.port, payload)
+            got = []
+            _pump([a, b], until=lambda: bool(got.extend(b.recv_all()) or got))
+            assert got[0][2] == payload
+            _pump([a, b], until=lambda: a.pending == 0)
+
+    def test_empty_message(self):
+        with t.UdpEndpoint() as a, t.UdpEndpoint() as b:
+            a.send("127.0.0.1", b.port, b"")
+            got = []
+            _pump([a, b], until=lambda: bool(got.extend(b.recv_all()) or got))
+            assert got[0][2] == b""
+
+    def test_delivery_under_heavy_loss(self):
+        """25% of outbound datagrams dropped on BOTH sides (data and
+        acks): retransmit must still deliver everything exactly once."""
+        msgs = [os.urandom(5000) for _ in range(10)]
+        with t.UdpEndpoint() as a, t.UdpEndpoint() as b:
+            a.set_loss(250, seed=1)
+            b.set_loss(250, seed=2)
+            for m in msgs:
+                a.send("127.0.0.1", b.port, m)
+            got = []
+            _pump(
+                [a, b],
+                until=lambda: len(got) >= len(msgs)
+                or not (got.extend(b.recv_all()) or True)
+                or len(got) >= len(msgs),
+                timeout_s=30.0,
+            )
+            assert sorted(d for _, _, d in got) == sorted(msgs)
+            assert a.failed == 0
+
+    def test_duplicate_suppression(self):
+        """Re-sent datagrams (lost acks) must not duplicate messages."""
+        with t.UdpEndpoint() as a, t.UdpEndpoint() as b:
+            b.set_loss(400, seed=7)  # b's ACKS get dropped -> a re-sends
+            a.send("127.0.0.1", b.port, b"once only")
+            got = []
+            deadline = time.monotonic() + 20
+            while a.pending and time.monotonic() < deadline:
+                a.poll(), b.poll()
+                got.extend(b.recv_all())
+                time.sleep(0.002)
+            got.extend(b.recv_all())
+            assert [d for _, _, d in got] == [b"once only"]
+
+
+# ---------------------------------------------------------------------------
+# router contract over UDP + replica convergence
+# ---------------------------------------------------------------------------
+
+
+from crdt_tpu.net.replica import Replica  # noqa: E402
+from crdt_tpu.net.udp_router import UdpRouter, pump  # noqa: E402
+
+
+def _mesh(n):
+    routers = [UdpRouter() for _ in range(n)]
+    for i, r in enumerate(routers):
+        for other in routers[:i]:
+            r.add_peer(*other.addr)
+    pump(routers)
+    return routers
+
+
+class TestUdpRouter:
+    def test_handshake_builds_full_mesh(self):
+        routers = _mesh(3)
+        try:
+            for r in routers:
+                assert len(r.peers) == 2
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_two_replicas_converge(self):
+        routers = _mesh(2)
+        try:
+            r1 = Replica(routers[0], topic="room", client_id=1)
+            r2 = Replica(routers[1], topic="room", client_id=2)
+            pump(routers)
+            r1.set("users", "alice", {"role": "admin"})
+            r2.set("users", "bob", {"role": "guest"})
+            pump(routers)
+            assert r1.c == r2.c
+            assert r1.c["users"]["alice"] == {"role": "admin"}
+            assert r1.c["users"]["bob"] == {"role": "guest"}
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_late_joiner_syncs_existing_state(self):
+        routers = _mesh(2)
+        try:
+            r1 = Replica(routers[0], topic="room", client_id=1)
+            pump(routers)
+            r1.set("cfg", "mode", "dark")
+            r1.push("log", ["a", "b"])
+            pump(routers)
+
+            late = UdpRouter()
+            routers.append(late)
+            r3 = Replica(late, topic="room", client_id=3)
+            late.add_peer(*routers[0].addr)
+            pump(routers)
+            assert r3.c == r1.c
+            assert r3.c["cfg"]["mode"] == "dark"
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_convergence_under_loss(self):
+        routers = _mesh(2)
+        try:
+            for r in routers:
+                r.endpoint.set_loss(150, seed=11)
+            r1 = Replica(routers[0], topic="room", client_id=1)
+            r2 = Replica(routers[1], topic="room", client_id=2)
+            pump(routers, timeout_s=30.0)
+            for i in range(10):
+                (r1 if i % 2 else r2).set("kv", f"k{i}", i)
+            pump(routers, timeout_s=30.0)
+            assert r1.c == r2.c
+            assert len(r1.c["kv"]) == 10
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_malformed_hello_rejected(self):
+        """A hello with a short / non-hex / uppercase pk must not
+        poison the peer table (short keys would hand the native x25519
+        an undersized buffer)."""
+        from crdt_tpu.codec.lib0 import Encoder
+
+        def hello(pk):
+            enc = Encoder()
+            enc.write_any({"pk": pk, "ack": True})
+            return bytes([0]) + enc.to_bytes()
+
+        with t.UdpEndpoint() as attacker:
+            target = UdpRouter()
+            try:
+                for bad in ("aa", "", "zz" * 32, 123):
+                    attacker.send("127.0.0.1", target.endpoint.port, hello(bad))
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline and (
+                    attacker.pending or target.endpoint.pending
+                ):
+                    attacker.poll(); target.poll()
+                    time.sleep(0.002)
+                target.poll()
+                assert target.peers == []
+                # uppercase hex of a REAL key is accepted, normalized
+                pub, _ = t.keypair()
+                attacker.send(
+                    "127.0.0.1", target.endpoint.port, hello(pub.hex().upper())
+                )
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline and not target.peers:
+                    attacker.poll(); target.poll()
+                    time.sleep(0.002)
+                assert target.peers == [pub.hex()]  # lowercase key
+            finally:
+                target.close()
+
+    def test_envelope_from_unknown_peer_triggers_rehandshake(self):
+        routers = _mesh(2)
+        try:
+            r1 = Replica(routers[0], topic="room", client_id=1)
+            r2 = Replica(routers[1], topic="room", client_id=2)
+            pump(routers)
+            r1.set("m", "k", 1)
+            pump(routers)
+            # simulate a restart losing peer state on router 0
+            routers[0]._peers.clear()
+            r2.set("m", "k2", 2)  # arrives as envelope from unknown
+            pump(routers)
+            assert r1.c == r2.c
+        finally:
+            for r in routers:
+                r.close()
+
+
+_CHILD = r"""
+import sys, time
+sys.path.insert(0, "@REPO@")
+from crdt_tpu.net.replica import Replica
+from crdt_tpu.net.udp_router import UdpRouter, pump
+
+parent_ip, parent_port = sys.argv[1], int(sys.argv[2])
+router = UdpRouter()
+rep = Replica(router, topic="xproc", client_id=77)
+router.add_peer(parent_ip, parent_port)
+deadline = time.monotonic() + 15
+while time.monotonic() < deadline:
+    router.poll()
+    if rep.c.get("handshake", {}).get("from_parent") == "hi":
+        rep.set("handshake", "from_child", "hello back")
+        break
+    time.sleep(0.002)
+else:
+    sys.exit(3)
+# keep pumping until the parent has surely received our write
+end = time.monotonic() + 5
+while time.monotonic() < end and router.endpoint.pending:
+    router.poll()
+    time.sleep(0.002)
+sys.exit(0)
+"""
+
+
+class TestCrossProcess:
+    def test_two_os_processes_converge(self, tmp_path):
+        """A real second interpreter over real sockets — the closest
+        in-tree stand-in for the reference's two-machine swarm."""
+        repo = str(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        script = tmp_path / "child.py"
+        script.write_text(_CHILD.replace("@REPO@", repo))
+
+        router = UdpRouter()
+        rep = Replica(router, topic="xproc", client_id=1)
+        rep.set("handshake", "from_parent", "hi")
+
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        child = subprocess.Popen(
+            [sys.executable, str(script), "127.0.0.1", str(router.endpoint.port)],
+            env=env,
+        )
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                router.poll()
+                if rep.c.get("handshake", {}).get("from_child") == "hello back":
+                    break
+                time.sleep(0.005)
+            assert rep.c["handshake"]["from_child"] == "hello back"
+            assert child.wait(timeout=15) == 0
+        finally:
+            if child.poll() is None:
+                child.kill()
+            router.close()
