@@ -23,3 +23,16 @@ A from-scratch rebuild of the capability surface of ypear/crdt
 __version__ = "0.1.0"
 
 from crdt_tpu.core.ids import ID, StateVector, DeleteSet  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy subpackage access without importing jax at package import
+    if name in ("ReplicaFleet", "FleetStep"):
+        from crdt_tpu import models
+
+        return getattr(models, name)
+    if name == "Tracer":
+        from crdt_tpu.utils import Tracer
+
+        return Tracer
+    raise AttributeError(name)

@@ -1,0 +1,3 @@
+from crdt_tpu.models.fleet import FleetStep, ReplicaFleet
+
+__all__ = ["FleetStep", "ReplicaFleet"]

@@ -1,0 +1,134 @@
+"""ReplicaFleet — the flagship batched convergence model.
+
+The reference's scale axis is replica parallelism: N peers full-mesh
+gossiping updates and converging by CRDT merge (propagate at
+/root/reference/crdt.js:385,445,...; merge-on-receipt at crdt.js:294;
+the state-vector handshake at crdt.js:237-291). This model is that
+entire swarm round as ONE jitted program over a device mesh:
+
+    fleet = ReplicaFleet(n_replicas=1024, ops_per_replica=128)
+    out = fleet.step(cols, dels)      # one gossip + merge round
+
+- each replica's pending ops live as [R, N] columnar tensors sharded
+  over the mesh's replica axis;
+- ``propagate`` = all_gather of the op columns over ICI;
+- every peer's ``applyUpdate`` = one batched LWW/YATA convergence over
+  the gathered union, computed replicated (the CRDT property: every
+  replica merging the same op set reaches the same state);
+- the sync handshake = per-replica state vectors + the pairwise
+  deficit matrix (the anti-entropy plan).
+
+The driver's ``dryrun_multichip`` and the benchmark both drive this
+model; the host-side swarm (crdt_tpu.net) is the trickle path for the
+same semantics, this is the firehose path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from crdt_tpu.parallel.gossip import (
+    REPLICA_AXIS,
+    make_gossip_step,
+    make_mesh,
+    synth_columns,
+)
+from crdt_tpu.utils.trace import get_tracer
+
+
+class FleetStep(NamedTuple):
+    """Outputs of one gossip+merge round."""
+
+    sv_local: np.ndarray        # [R, C] per-replica state vectors (sharded)
+    global_sv: np.ndarray       # [C] merged swarm vector (replicated)
+    deficit: np.ndarray         # [R, R] anti-entropy plan (replicated)
+    winners: np.ndarray         # [S] converged LWW winner indices
+    winner_visible: np.ndarray  # [S] winner not tombstoned
+
+
+class ReplicaFleet:
+    """A batch of replicas sharded over a 1-D device mesh.
+
+    Static shapes (XLA traces once): `n_replicas` x `ops_per_replica`
+    op columns, `num_clients`-wide state vectors, `num_segments`
+    convergence slots. Replicas-per-device = n_replicas / mesh size
+    (must divide evenly — pad the replica batch, not the mesh).
+    """
+
+    def __init__(
+        self,
+        n_replicas: int,
+        ops_per_replica: int,
+        *,
+        mesh=None,
+        n_devices: Optional[int] = None,
+        num_clients: Optional[int] = None,
+        num_segments: Optional[int] = None,
+    ):
+        import jax
+
+        # item ids pack (client, clock) into int64 (ops/device.py); a
+        # fleet traced without x64 silently truncates clocks
+        jax.config.update("jax_enable_x64", True)
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        nd = self.mesh.devices.size
+        if n_replicas % nd:
+            raise ValueError(
+                f"n_replicas={n_replicas} must divide over {nd} devices"
+            )
+        self.n_replicas = n_replicas
+        self.ops_per_replica = ops_per_replica
+        self.num_clients = num_clients or n_replicas + 2
+        total = n_replicas * ops_per_replica
+        self.num_segments = num_segments or (1 << max(9, (total - 1).bit_length()))
+        self._step = make_gossip_step(
+            self.mesh, num_segments=self.num_segments, num_clients=self.num_clients
+        )
+
+    @property
+    def axis(self) -> str:
+        return self.mesh.axis_names[0] if self.mesh.axis_names else REPLICA_AXIS
+
+    def synth(self, *, num_maps: int = 4, keys_per_map: int = 64, seed: int = 0):
+        """Synthetic concurrent-write workload in this fleet's shape."""
+        return synth_columns(
+            self.n_replicas,
+            self.ops_per_replica,
+            num_maps=num_maps,
+            keys_per_map=keys_per_map,
+            seed=seed,
+        )
+
+    def step(
+        self,
+        cols: Dict[str, np.ndarray],
+        dels: Tuple[np.ndarray, np.ndarray, np.ndarray],
+    ) -> FleetStep:
+        """One full gossip round: fan-in, converge, handshake."""
+        import jax
+        import jax.numpy as jnp
+
+        tracer = get_tracer()
+        with tracer.span("fleet.step"):
+            out = self._step(
+                jnp.asarray(cols["client"]),
+                jnp.asarray(cols["clock"]),
+                jnp.asarray(cols["parent_is_root"]),
+                jnp.asarray(cols["parent_a"]),
+                jnp.asarray(cols["parent_b"]),
+                jnp.asarray(cols["key_id"]),
+                jnp.asarray(cols["origin_client"]),
+                jnp.asarray(cols["origin_clock"]),
+                jnp.asarray(cols["valid"]),
+                jnp.asarray(dels[0]),
+                jnp.asarray(dels[1]),
+                jnp.asarray(dels[2]),
+            )
+            jax.block_until_ready(out)
+        if tracer.enabled:  # the mask reduction isn't free at 100M ops
+            tracer.count(
+                "fleet.ops_converged", int(np.asarray(cols["valid"]).sum())
+            )
+        return FleetStep(*(np.asarray(x) for x in out))

@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional
 from crdt_tpu.api.doc import Crdt
 from crdt_tpu.codec import v1
 from crdt_tpu.core.ids import StateVector
+from crdt_tpu.utils.trace import get_tracer
 
 
 class MemoryPersistence:
@@ -245,9 +246,12 @@ class Replica:
     def _persist(self, update: bytes) -> None:
         if self.persistence is None or self.persistence.closed:
             return
-        self.persistence.store_update(
-            self.topic, update, sv=self.doc.encode_state_vector()
-        )
+        tracer = get_tracer()
+        with tracer.span("replica.persist"):
+            self.persistence.store_update(
+                self.topic, update, sv=self.doc.encode_state_vector()
+            )
+        tracer.count("replica.bytes_persisted", len(update))
         if self.compact_every:
             meta = self.persistence.get_meta(self.topic)
             if meta and meta.get("count", 0) >= self.compact_every:
@@ -262,11 +266,12 @@ class Replica:
             # stashed updates exist only in the raw log; a snapshot of
             # integrated state would drop them across a restart
             return
-        self.persistence.compact(
-            self.topic,
-            self.doc.encode_state_as_update(),
-            sv=self.doc.encode_state_vector(),
-        )
+        with get_tracer().span("replica.compact"):
+            self.persistence.compact(
+                self.topic,
+                self.doc.encode_state_as_update(),
+                sv=self.doc.encode_state_vector(),
+            )
 
     # ------------------------------------------------------------------
     # receive path (crdt.js:279-312)
@@ -307,7 +312,13 @@ class Replica:
             return
         if "update" in msg:
             update = msg["update"]
-            self.doc.apply_update(update, origin="sync" if meta == "sync" else "remote")
+            tracer = get_tracer()
+            with tracer.span("replica.apply_update"):
+                self.doc.apply_update(
+                    update, origin="sync" if meta == "sync" else "remote"
+                )
+            tracer.count("replica.updates_applied")
+            tracer.count("replica.bytes_received", len(update))
             self._persist(update)
             if meta == "sync":
                 self._set_synced(True)  # crdt.js:306

@@ -49,14 +49,24 @@ def _unpack_any(data: bytes) -> Any:
 
 
 class _Peer:
-    __slots__ = ("pk_hex", "pk_raw", "addr", "topics", "box")
+    __slots__ = ("pk_hex", "addr", "topics", "topics_v", "inst", "box")
 
-    def __init__(self, pk_hex: str, addr: Tuple[str, int], box: SecureBox):
+    def __init__(self, pk_hex: str, addr: Tuple[str, int], inst: str,
+                 box: SecureBox):
         self.pk_hex = pk_hex
-        self.pk_raw = bytes.fromhex(pk_hex)
         self.addr = addr
         self.topics: Set[str] = set()
+        self.topics_v = -1  # last applied announcement version
+        self.inst = inst  # incarnation token: resets topics_v on restart
         self.box = box
+
+    def new_incarnation(self, inst: str) -> None:
+        """A restarted process announces from version 1 again; carrying
+        the dead incarnation's version watermark would reject every
+        announcement of the new one."""
+        self.inst = inst
+        self.topics_v = -1
+        self.topics = set()
 
 
 class UdpRouter:
@@ -84,7 +94,24 @@ class UdpRouter:
         self.started = False
         self._handlers: Dict[str, Callable] = {}
         self._peers: Dict[str, _Peer] = {}  # pk_hex -> peer
-        self._hello_sent: Set[Tuple[str, int]] = set()
+        # announcement version: bumped when OUR topic set changes, so a
+        # delayed retransmit of an older announcement can never regress
+        # a peer's view of our topics (transport is reliable but not
+        # ordered across messages)
+        self._topics_v = 0
+        # per-process incarnation token, carried in hellos: lets peers
+        # distinguish a restart (reset announcement watermark) from a
+        # delayed retransmit of an old announcement
+        import os as _os
+
+        self._inst = _os.urandom(8).hex()
+        # address-rebind challenges: pk_hex -> (nonce, challenged addr,
+        # claimed inst). A hello claiming a known identity from a NEW
+        # address must prove key possession (decrypt the ping, echo the
+        # nonce FROM THAT ADDRESS) before we reroute traffic —
+        # otherwise any host could blackhole a peer by replaying its
+        # public key
+        self._rebind_nonce: Dict[str, Tuple[str, Tuple[str, int], str]] = {}
 
     # -- options bag (crdt.js:175-180) ----------------------------------
     def update_options(self, opts: Dict[str, Any]) -> None:
@@ -114,12 +141,11 @@ class UdpRouter:
     def add_peer(self, ip: str, port: int) -> None:
         """Dial a known address: plaintext hello carrying our identity;
         the reply completes the key exchange."""
-        self._hello_sent.add((ip, port))
         self._send_hello(ip, port, ack=False)
 
     def _send_hello(self, ip: str, port: int, *, ack: bool) -> None:
         payload = bytes([_HELLO]) + _pack_any(
-            {"pk": self.public_key, "ack": ack}
+            {"pk": self.public_key, "ack": ack, "inst": self._inst}
         )
         self.endpoint.send(ip, port, payload)
 
@@ -136,6 +162,7 @@ class UdpRouter:
         Callable, Callable, Callable, Callable
     ]:
         self._handlers[topic] = handler
+        self._topics_v += 1
         self._announce_topics()
 
         def propagate(msg: dict) -> None:
@@ -158,26 +185,55 @@ class UdpRouter:
 
     def unsubscribe(self, topic: str) -> None:
         self._handlers.pop(topic, None)
+        self._topics_v += 1
         self._announce_topics()
 
     # -- wire ------------------------------------------------------------
-    def _send_envelope(self, peer: _Peer, payload: Any) -> None:
+    def _send_envelope(
+        self, peer: _Peer, payload: Any,
+        addr: Optional[Tuple[str, int]] = None,
+    ) -> None:
         me = bytes.fromhex(self.public_key)
         body = peer.box.encrypt(_pack_any(payload), aad=me)
-        self.endpoint.send(peer.addr[0], peer.addr[1], bytes([_ENVELOPE]) + me + body)
+        ip, port = addr if addr is not None else peer.addr
+        self.endpoint.send(ip, port, bytes([_ENVELOPE]) + me + body)
 
-    def _announce_topics(self) -> None:
-        for p in list(self._peers.values()):
-            self._send_envelope(p, {"t": "topics", "topics": sorted(self._handlers)})
+    def _announce_topics(self, peer: Optional[_Peer] = None) -> None:
+        msg = {
+            "t": "topics",
+            "v": self._topics_v,
+            "topics": sorted(self._handlers),
+        }
+        targets = [peer] if peer is not None else list(self._peers.values())
+        for p in targets:
+            self._send_envelope(p, msg)
 
-    def _ensure_peer(self, pk_hex: str, addr: Tuple[str, int]) -> _Peer:
-        p = self._peers.get(pk_hex)
-        if p is None:
-            p = _Peer(pk_hex, addr, SecureBox(self._secret, bytes.fromhex(pk_hex)))
-            self._peers[pk_hex] = p
-        else:
-            p.addr = addr  # peer may rebind (restart); trust latest source
+    def _register_peer(
+        self, pk_hex: str, addr: Tuple[str, int], inst: str
+    ) -> Optional[_Peer]:
+        """Create a peer entry for a previously unknown identity.
+        Returns None for keys no secure channel can be built with."""
+        try:
+            box = SecureBox(self._secret, bytes.fromhex(pk_hex))
+        except ValueError:
+            return None  # low-order key
+        p = _Peer(pk_hex, addr, inst, box)
+        self._peers[pk_hex] = p
         return p
+
+    def _challenge_rebind(
+        self, peer: _Peer, addr: Tuple[str, int], inst: str
+    ) -> None:
+        """A hello is unauthenticated: before rerouting a KNOWN peer's
+        traffic to a new address, ping that address under the peer's
+        key — only the real key holder can echo the nonce back, and
+        only from the challenged address (the pong's source is
+        checked, so a copied pong from elsewhere proves nothing)."""
+        import os as _os
+
+        nonce = _os.urandom(16).hex()
+        self._rebind_nonce[peer.pk_hex] = (nonce, addr, inst)
+        self._send_envelope(peer, {"t": "ping", "n": nonce}, addr=addr)
 
     def poll(self) -> int:
         """One pump: transport poll + dispatch every complete message.
@@ -208,11 +264,33 @@ class UdpRouter:
             return
         if pk_hex == self.public_key:
             return
-        self._ensure_peer(pk_hex, addr)
+        inst = info.get("inst", "")
+        peer = self._peers.get(pk_hex)
+        if peer is None:
+            peer = self._register_peer(pk_hex, addr, inst)
+            if peer is None:
+                return  # rejected key
+        elif peer.addr != addr:
+            # identity known but source moved: answer the hello (a
+            # restarted peer must be able to learn us, or the
+            # challenge below can never be decrypted) but don't
+            # reroute until the new address proves key possession
+            if not info.get("ack"):
+                self._send_hello(addr[0], addr[1], ack=True)
+            self._challenge_rebind(peer, addr, inst)
+            return
+        elif inst != peer.inst:
+            # same address, new process: drop the dead incarnation's
+            # announcement watermark so the fresh one isn't rejected
+            # as a stale retransmit (a spoofed hello can at worst
+            # transiently clear the view; the ack below prompts the
+            # real peer to re-announce and restore it)
+            peer.new_incarnation(inst)
         if not info.get("ack"):
             self._send_hello(addr[0], addr[1], ack=True)
-        # key exchange is done on both ends; exchange topic sets
-        self._announce_topics()
+        # key exchange is done on both ends; tell THIS peer our topics
+        # (announcing to everyone here would be O(N^2) per join wave)
+        self._announce_topics(peer)
 
     def _on_envelope(self, body: bytes, addr: Tuple[str, int]) -> bool:
         sender_raw, sealed = body[:32], body[32:]
@@ -230,6 +308,10 @@ class UdpRouter:
             return False  # forged or corrupted
         t = payload.get("t") if isinstance(payload, dict) else None
         if t == "topics":
+            v = payload.get("v", 0)
+            if v < peer.topics_v:
+                return True  # stale retransmit must not regress the set
+            peer.topics_v = v
             before = set(peer.topics)
             peer.topics = set(payload.get("topics", ()))
             for topic in peer.topics - before:
@@ -239,6 +321,28 @@ class UdpRouter:
             handler = self._handlers.get(payload.get("topic"))
             if handler is not None:
                 handler(payload.get("msg"), pk_hex)
+        elif t == "ping":
+            # address-rebind challenge: echo the nonce so the sender
+            # learns this address really holds our key
+            self._send_envelope(peer, {"t": "pong", "n": payload.get("n")},
+                                addr=addr)
+        elif t == "pong":
+            pending = self._rebind_nonce.get(pk_hex)
+            if (
+                pending is not None
+                and payload.get("n") == pending[0]
+                and addr == pending[1]  # nonce is bound to the
+                # challenged address: a pong copied and re-sent from
+                # elsewhere must not redirect traffic there
+            ):
+                del self._rebind_nonce[pk_hex]
+                peer.addr = addr  # proven: reroute to the new address
+                if pending[2] != peer.inst:
+                    peer.new_incarnation(pending[2])
+                    # prompt the new incarnation to (re)announce its
+                    # topics to us; ours go out right below
+                    self._send_hello(addr[0], addr[1], ack=True)
+                self._announce_topics(peer)
         return True
 
     # -- topology hook driving the injected sync contract ----------------

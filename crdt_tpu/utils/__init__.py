@@ -1,0 +1,3 @@
+from crdt_tpu.utils.trace import Tracer, get_tracer, jax_profile, set_tracer
+
+__all__ = ["Tracer", "get_tracer", "jax_profile", "set_tracer"]

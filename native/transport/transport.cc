@@ -713,6 +713,12 @@ void* udp_create(const char* bind_ip, int port, char* err, int errlen) {
   Endpoint* ep = new Endpoint();
   ep->fd = fd;
   ep->port = ntohs(sa.sin_port);
+  // random initial msg id: a process restarting on the same port
+  // within the peer's re-ack window must not collide with its former
+  // self's ids, or its first messages are acked-but-dropped as dups
+  uint32_t r = 0;
+  ct_randombytes((uint8_t*)&r, sizeof(r));
+  ep->next_msg_id = (r & 0x7fffffffu) | 1u;
   return ep;
 }
 

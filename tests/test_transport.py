@@ -432,6 +432,107 @@ class TestUdpRouter:
                 r.close()
 
 
+class TestRebindChallenge:
+    def test_spoofed_hello_does_not_reroute(self):
+        """An attacker replaying a victim's public key from its own
+        address must not capture the victim's traffic."""
+        routers = _mesh(2)
+        a, b = routers
+        try:
+            r1 = Replica(a, topic="room", client_id=1)
+            r2 = Replica(b, topic="room", client_id=2)
+            pump(routers)
+            victim_addr = a._peers[b.public_key].addr
+
+            from crdt_tpu.codec.lib0 import Encoder
+
+            enc = Encoder()
+            enc.write_any({"pk": b.public_key, "ack": True})
+            with t.UdpEndpoint() as attacker:
+                attacker.send("127.0.0.1", a.endpoint.port, bytes([0]) + enc.to_bytes())
+                deadline = time.monotonic() + 3
+                while time.monotonic() < deadline and (
+                    attacker.pending or a.endpoint.pending
+                ):
+                    attacker.poll(); a.poll(); b.poll()
+                    time.sleep(0.002)
+                # the attacker cannot answer the encrypted challenge:
+                # the peer's address must be unchanged
+                assert a._peers[b.public_key].addr == victim_addr
+            r1.set("m", "k", 1)
+            pump(routers)
+            assert r2.c == r1.c  # traffic still reaches the real peer
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_genuine_restart_reroutes_after_proof(self):
+        """The same identity (seeded keypair) rebinding to a new port
+        passes the challenge and traffic follows it."""
+        seed_b = os.urandom(32)
+        a = UdpRouter()
+        b1 = UdpRouter(seed=seed_b)
+        b1.add_peer(*a.addr)
+        pump([a, b1])
+        r_a = Replica(a, topic="room", client_id=1)
+        r_b1 = Replica(b1, topic="room", client_id=2)
+        pump([a, b1])
+        r_a.set("m", "k", 1)
+        pump([a, b1])
+        assert r_b1.c == r_a.c
+        old_addr = a._peers[b1.public_key].addr
+        b1.close()
+
+        b2 = UdpRouter(seed=seed_b)  # same identity, fresh port
+        try:
+            assert b2.public_key == b1.public_key
+            r_b2 = Replica(b2, topic="room", client_id=3)
+            b2.add_peer(*a.addr)
+            pump([a, b2], timeout_s=15.0)
+            assert a._peers[b2.public_key].addr == b2.addr
+            assert a._peers[b2.public_key].addr != old_addr
+            r_a.set("m", "k2", 2)
+            pump([a, b2], timeout_s=15.0)
+            assert r_b2.c["m"] == r_a.c["m"]
+        finally:
+            a.close()
+            b2.close()
+
+
+    def test_same_port_restart_resets_topic_watermark(self):
+        """A restarted process on the SAME address announces from v=1
+        again; the old incarnation's higher watermark must not make
+        its announcements look like stale retransmits."""
+        seed_b = os.urandom(32)
+        a = UdpRouter()
+        b1 = UdpRouter(seed=seed_b)
+        port_b = b1.endpoint.port
+        b1.add_peer(*a.addr)
+        pump([a, b1])
+        # inflate b1's announcement version past the next incarnation's
+        for topic in ("t1", "t2", "t3"):
+            b1.alow(topic, lambda m, f: None)
+        b1.unsubscribe("t1")
+        pump([a, b1])
+        assert a._peers[b1.public_key].topics_v >= 4
+        b1.close()
+
+        b2 = UdpRouter(seed=seed_b, port=port_b)  # same identity+address
+        try:
+            r_b2 = Replica(b2, topic="room", client_id=5)  # announces v=1
+            b2.add_peer(*a.addr)
+            pump([a, b2], timeout_s=15.0)
+            assert "room" in a._peers[b2.public_key].topics
+            r_a = Replica(a, topic="room", client_id=6)
+            pump([a, b2], timeout_s=15.0)
+            r_a.set("m", "k", 1)
+            pump([a, b2], timeout_s=15.0)
+            assert r_b2.c == r_a.c
+        finally:
+            a.close()
+            b2.close()
+
+
 _CHILD = r"""
 import sys, time
 sys.path.insert(0, "@REPO@")

@@ -1,0 +1,112 @@
+"""Tracer / metrics subsystem (SURVEY.md §5 rebuild requirement)."""
+
+import json
+import time
+
+from crdt_tpu.utils import Tracer, get_tracer, set_tracer
+
+
+class TestTracer:
+    def test_disabled_is_noop(self):
+        tr = Tracer(enabled=False)
+        with tr.span("merge"):
+            pass
+        tr.count("ops", 5)
+        tr.gauge("pending", 3)
+        rep = tr.report()
+        assert rep["spans"] == {} and rep["counters"] == {} and rep["gauges"] == {}
+
+    def test_span_aggregates(self):
+        tr = Tracer(enabled=True)
+        for _ in range(3):
+            with tr.span("merge"):
+                time.sleep(0.001)
+        s = tr.report()["spans"]["merge"]
+        assert s["count"] == 3
+        assert s["total_s"] >= 0.003
+        assert s["max_s"] <= s["total_s"]
+        assert abs(s["mean_s"] - s["total_s"] / 3) < 1e-12
+
+    def test_span_records_on_exception(self):
+        tr = Tracer(enabled=True)
+        try:
+            with tr.span("boom"):
+                raise RuntimeError("x")
+        except RuntimeError:
+            pass
+        assert tr.report()["spans"]["boom"]["count"] == 1
+
+    def test_counters_and_gauges(self):
+        tr = Tracer(enabled=True)
+        tr.count("ops")
+        tr.count("ops", 9)
+        tr.gauge("pending", 4)
+        tr.gauge("pending", 2)
+        rep = tr.report()
+        assert rep["counters"]["ops"] == 10
+        assert rep["gauges"]["pending"] == 2
+
+    def test_json_and_reset(self):
+        tr = Tracer(enabled=True)
+        tr.count("x")
+        assert json.loads(tr.to_json())["counters"]["x"] == 1
+        tr.reset()
+        assert tr.report()["counters"] == {}
+
+    def test_global_install(self):
+        old = get_tracer()
+        try:
+            mine = set_tracer(Tracer(enabled=True))
+            assert get_tracer() is mine
+        finally:
+            set_tracer(old)
+
+
+class TestReplicaIntegration:
+    def test_phases_recorded_across_sync(self):
+        from crdt_tpu.net import (
+            LoopbackNetwork, LoopbackRouter, MemoryPersistence, Replica,
+        )
+
+        old = get_tracer()
+        tr = set_tracer(Tracer(enabled=True))
+        try:
+            net = LoopbackNetwork()
+            r1 = Replica(
+                LoopbackRouter(net, "a"), topic="t", client_id=1,
+                persistence=MemoryPersistence(),
+            )
+            r2 = Replica(LoopbackRouter(net, "b"), topic="t", client_id=2)
+            net.run()
+            r1.set("m", "k", 1)
+            r2.set("m", "k2", 2)
+            net.run()
+            assert r1.c == r2.c
+            rep = tr.report()
+            assert rep["counters"]["replica.updates_applied"] >= 2
+            assert rep["counters"]["replica.bytes_received"] > 0
+            assert rep["counters"]["replica.bytes_persisted"] > 0
+            assert rep["spans"]["replica.apply_update"]["count"] >= 2
+            assert rep["spans"]["replica.persist"]["count"] >= 1
+        finally:
+            set_tracer(old)
+
+    def test_compact_span(self):
+        from crdt_tpu.net import (
+            LoopbackNetwork, LoopbackRouter, MemoryPersistence, Replica,
+        )
+
+        old = get_tracer()
+        tr = set_tracer(Tracer(enabled=True))
+        try:
+            net = LoopbackNetwork()
+            r1 = Replica(
+                LoopbackRouter(net, "a"), topic="t", client_id=1,
+                persistence=MemoryPersistence(), compact_every=2,
+            )
+            for i in range(5):
+                r1.set("m", f"k{i}", i)
+            net.run()
+            assert tr.report()["spans"]["replica.compact"]["count"] >= 1
+        finally:
+            set_tracer(old)
