@@ -192,7 +192,7 @@ def main():
 
     eng2 = Engine(0)
     t0 = time.perf_counter()
-    eng2.apply_records(seq_records, type(ds)())
+    eng2.apply_records(seq_records)
     t_scalar_seq = time.perf_counter() - t0
     seq_oracle = eng2.seq_order_table()
     log(f"scalar seq integrate: {t_scalar_seq:.3f}s "
@@ -201,11 +201,13 @@ def main():
     # timed: the ordering kernel on the prepared columns
     spad = 1 << max(9, (s_total - 1).bit_length())
     num_seq = 1 << max(3, int(seg_col.max()).bit_length())
+    from crdt_tpu.ops.merge import _pad_to
+
     sargs = (
-        jnp.asarray(np.concatenate([seg_col, np.full(spad - s_total, -1, np.int32)])),
-        jnp.asarray(np.concatenate([parent_col, np.full(spad - s_total, -1, np.int32)])),
-        jnp.asarray(np.concatenate([k1_col, np.zeros(spad - s_total, np.int64)])),
-        jnp.asarray(np.concatenate([k2_col, np.zeros(spad - s_total, np.int64)])),
+        jnp.asarray(_pad_to(seg_col, spad, -1)),
+        jnp.asarray(_pad_to(parent_col, spad, -1)),
+        jnp.asarray(_pad_to(k1_col, spad, 0)),
+        jnp.asarray(_pad_to(k2_col, spad, 0)),
         jnp.asarray(np.arange(spad) < s_total),
     )
     sfn = partial(tree_order_ranks, num_segments=num_seq)

@@ -232,16 +232,6 @@ static void poly1305_finish(poly1305_state* st, uint8_t tag[16]) {
   store32le(tag + 8, h2); store32le(tag + 12, h3);
 }
 
-static void poly1305_mac(const uint8_t key[32], const uint8_t* m, size_t len,
-                         uint8_t tag[16]) {
-  poly1305_state st;
-  poly1305_init(&st, key);
-  size_t full = len - (len % 16);
-  if (full) poly1305_blocks(&st, m, full, 0);
-  if (len % 16) poly1305_blocks(&st, m + full, len % 16, 1);
-  poly1305_finish(&st, tag);
-}
-
 // ===========================================================================
 // crypto: ChaCha20-Poly1305 AEAD (RFC 8439 §2.8)
 // ===========================================================================
