@@ -48,6 +48,11 @@ def _build_so() -> None:
     try:
         subprocess.run(cmd, check=True, capture_output=True)
         os.replace(tmp, _SO)
+    except subprocess.CalledProcessError as e:
+        stderr = e.stderr.decode(errors="replace") if e.stderr else "(no output)"
+        raise RuntimeError(
+            f"native transport build failed ({' '.join(cmd)}):\n{stderr}"
+        ) from e
     finally:
         if tmp.exists():
             tmp.unlink()

@@ -105,13 +105,18 @@ class UdpRouter:
         import os as _os
 
         self._inst = _os.urandom(8).hex()
-        # address-rebind challenges: pk_hex -> (nonce, challenged addr,
-        # claimed inst). A hello claiming a known identity from a NEW
-        # address must prove key possession (decrypt the ping, echo the
-        # nonce FROM THAT ADDRESS) before we reroute traffic —
-        # otherwise any host could blackhole a peer by replaying its
-        # public key
-        self._rebind_nonce: Dict[str, Tuple[str, Tuple[str, int], str]] = {}
+        # liveness challenges: pk_hex -> (nonce, challenged addr). A
+        # hello claiming a known identity from a NEW address — or any
+        # hint that the peer's incarnation changed — must prove key
+        # possession NOW (decrypt the ping, echo the nonce FROM THAT
+        # ADDRESS) before we reroute traffic or reset announcement
+        # watermarks. The pong carries the responder's CURRENT inst,
+        # and that fresh-nonce-bound value is the only way peer.inst
+        # ever changes: trusting the plaintext hello's inst would let
+        # a replayed old hello wedge topic membership permanently
+        # (set peer.inst to a dead token that no genuine announcement
+        # matches)
+        self._rebind_nonce: Dict[str, Tuple[str, Tuple[str, int]]] = {}
 
     # -- options bag (crdt.js:175-180) ----------------------------------
     def update_options(self, opts: Dict[str, Any]) -> None:
@@ -202,6 +207,12 @@ class UdpRouter:
         msg = {
             "t": "topics",
             "v": self._topics_v,
+            # incarnation-bound: the static per-pair SecureBox key means
+            # a captured announcement from a previous process life would
+            # otherwise replay cleanly; a high replayed `v` would set the
+            # watermark above the new incarnation's counter and wedge
+            # topic membership until v caught up
+            "inst": self._inst,
             "topics": sorted(self._handlers),
         }
         targets = [peer] if peer is not None else list(self._peers.values())
@@ -221,18 +232,20 @@ class UdpRouter:
         self._peers[pk_hex] = p
         return p
 
-    def _challenge_rebind(
-        self, peer: _Peer, addr: Tuple[str, int], inst: str
+    def _challenge_liveness(
+        self, peer: _Peer, addr: Tuple[str, int]
     ) -> None:
         """A hello is unauthenticated: before rerouting a KNOWN peer's
-        traffic to a new address, ping that address under the peer's
-        key — only the real key holder can echo the nonce back, and
-        only from the challenged address (the pong's source is
-        checked, so a copied pong from elsewhere proves nothing)."""
+        traffic to a new address, or believing its incarnation
+        changed, ping that address under the peer's key — only the
+        real key holder can echo the nonce back, and only from the
+        challenged address (the pong's source is checked, so a copied
+        pong from elsewhere proves nothing). The pong also reports the
+        responder's live inst."""
         import os as _os
 
         nonce = _os.urandom(16).hex()
-        self._rebind_nonce[peer.pk_hex] = (nonce, addr, inst)
+        self._rebind_nonce[peer.pk_hex] = (nonce, addr)
         self._send_envelope(peer, {"t": "ping", "n": nonce}, addr=addr)
 
     def poll(self) -> int:
@@ -270,24 +283,24 @@ class UdpRouter:
             peer = self._register_peer(pk_hex, addr, inst)
             if peer is None:
                 return  # rejected key
-        elif peer.addr != addr:
-            # identity known but source moved: answer the hello (a
-            # restarted peer must be able to learn us, or the
-            # challenge below can never be decrypted) but don't
-            # reroute until the new address proves key possession
-            if not info.get("ack"):
-                self._send_hello(addr[0], addr[1], ack=True)
-            self._challenge_rebind(peer, addr, inst)
-            return
-        elif inst != peer.inst:
-            # same address, new process: drop the dead incarnation's
-            # announcement watermark so the fresh one isn't rejected
-            # as a stale retransmit (a spoofed hello can at worst
-            # transiently clear the view; the ack below prompts the
-            # real peer to re-announce and restore it)
-            peer.new_incarnation(inst)
+        # every continuing path answers a non-ack hello: a restarted
+        # peer must be able to learn us, or the encrypted challenges
+        # below could never be decrypted
         if not info.get("ack"):
             self._send_hello(addr[0], addr[1], ack=True)
+        if peer.addr != addr:
+            # identity known but source moved: don't reroute until the
+            # new address proves key possession
+            self._challenge_liveness(peer, addr)
+            return
+        if inst != peer.inst:
+            # same address, different claimed incarnation: do NOT
+            # adopt it from an unauthenticated hello (a replayed old
+            # hello would set a dead inst that no genuine announcement
+            # matches, wedging topic membership). Challenge instead;
+            # the pong reports the live inst
+            self._challenge_liveness(peer, peer.addr)
+            return
         # key exchange is done on both ends; tell THIS peer our topics
         # (announcing to everyone here would be O(N^2) per join wave)
         self._announce_topics(peer)
@@ -308,6 +321,19 @@ class UdpRouter:
             return False  # forged or corrupted
         t = payload.get("t") if isinstance(payload, dict) else None
         if t == "topics":
+            if payload.get("inst") != peer.inst:
+                # replayed from a dead incarnation — or our recorded
+                # inst is the stale one (bootstrap raced a restart, or
+                # a spoofed hello poisoned it). Never adopt an inst
+                # from a replayable envelope; challenge instead: the
+                # fresh-nonce pong reports the live inst, after which
+                # the peer's re-announce applies. Self-healing either
+                # way, wedge-proof both ways. Challenged at the
+                # envelope's source (peer.addr may be a dead pre-
+                # restart socket; the pong's source-binding keeps a
+                # spoofed source harmless).
+                self._challenge_liveness(peer, addr)
+                return True
             v = payload.get("v", 0)
             if v < peer.topics_v:
                 return True  # stale retransmit must not regress the set
@@ -322,10 +348,14 @@ class UdpRouter:
             if handler is not None:
                 handler(payload.get("msg"), pk_hex)
         elif t == "ping":
-            # address-rebind challenge: echo the nonce so the sender
-            # learns this address really holds our key
-            self._send_envelope(peer, {"t": "pong", "n": payload.get("n")},
-                                addr=addr)
+            # liveness challenge: echo the nonce (proving this address
+            # holds our key, NOW — the nonce is fresh) and report our
+            # current incarnation, the only trusted source for it
+            self._send_envelope(
+                peer,
+                {"t": "pong", "n": payload.get("n"), "inst": self._inst},
+                addr=addr,
+            )
         elif t == "pong":
             pending = self._rebind_nonce.get(pk_hex)
             if (
@@ -337,10 +367,13 @@ class UdpRouter:
             ):
                 del self._rebind_nonce[pk_hex]
                 peer.addr = addr  # proven: reroute to the new address
-                if pending[2] != peer.inst:
-                    peer.new_incarnation(pending[2])
-                    # prompt the new incarnation to (re)announce its
-                    # topics to us; ours go out right below
+                live_inst = payload.get("inst", peer.inst)
+                if live_inst != peer.inst:
+                    # fresh-nonce-proven incarnation change: reset the
+                    # announcement watermark and prompt the new
+                    # incarnation to (re)announce its topics to us;
+                    # ours go out right below
+                    peer.new_incarnation(live_inst)
                     self._send_hello(addr[0], addr[1], ack=True)
                 self._announce_topics(peer)
         return True

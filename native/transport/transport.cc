@@ -580,11 +580,20 @@ void ct_free(uint8_t* p) { free(p); }
 // transport: poll-driven reliable-datagram UDP endpoint
 // ===========================================================================
 
-static const uint8_t WIRE_MAGIC = 0xC7;
+// 0xC8: header gained the 4-byte ack token (old builds must drop new
+// frames immediately rather than misparse payload offsets)
+static const uint8_t WIRE_MAGIC = 0xC8;
 static const uint8_t T_DATA = 0;
 static const uint8_t T_ACK = 1;
 static const size_t FRAG_PAYLOAD = 1200;  // conservative sub-MTU
-static const size_t HDR = 1 + 1 + 4 + 2 + 2;  // magic type msg_id idx cnt
+// magic type msg_id idx cnt token — the token is a per-message random
+// value echoed in every ack: an ack is honored only when it carries
+// the message's token, which only the destination (or an on-path
+// observer, who can spoof source addresses anyway) has seen. A source
+// == destination address check would add nothing on top and breaks
+// multihomed / INADDR_ANY receivers, whose kernel may stamp ack
+// replies with a different source IP than the one the sender dialed.
+static const size_t HDR = 1 + 1 + 4 + 2 + 2 + 4;
 static const int MAX_RETRIES = 30;
 static const uint64_t RTO_MS = 40;       // initial retransmit timeout
 static const uint64_t RTO_MAX_MS = 1000;
@@ -606,6 +615,7 @@ struct Addr {
 
 struct OutMsg {
   Addr dest;
+  uint32_t token = 0;  // random per message; acks must echo it
   std::vector<std::string> frags;  // full datagrams (header included)
   std::vector<bool> acked;
   size_t n_acked = 0;
@@ -629,6 +639,7 @@ struct InMsg {
   std::vector<bool> have;
   size_t n_have = 0;
   uint64_t first_ms = 0;  // for expiring abandoned reassemblies
+  uint32_t token = 0;     // first-seen token; mismatching frames dropped
 };
 
 struct Done {
@@ -741,6 +752,7 @@ long udp_send(void* h, const char* ip, int port, const uint8_t* buf,
 
   OutMsg om;
   om.dest = to;
+  ct_randombytes((uint8_t*)&om.token, sizeof(om.token));
   om.frags.reserve(n_frags);
   for (size_t i = 0; i < n_frags; i++) {
     size_t off = i * FRAG_PAYLOAD;
@@ -749,11 +761,12 @@ long udp_send(void* h, const char* ip, int port, const uint8_t* buf,
     d.reserve(HDR + n);
     d.push_back((char)WIRE_MAGIC);
     d.push_back((char)T_DATA);
-    uint8_t hdr[8];
+    uint8_t hdr[12];
     store32le(hdr, id);
     hdr[4] = i & 0xff; hdr[5] = (i >> 8) & 0xff;
     hdr[6] = n_frags & 0xff; hdr[7] = (n_frags >> 8) & 0xff;
-    d.append((const char*)hdr, 8);
+    store32le(hdr + 8, om.token);
+    d.append((const char*)hdr, 12);
     d.append((const char*)buf + off, n);
     om.frags.push_back(std::move(d));
   }
@@ -765,15 +778,16 @@ long udp_send(void* h, const char* ip, int port, const uint8_t* buf,
 }
 
 static void send_ack(Endpoint* ep, const Addr& to, uint32_t msg_id,
-                     uint16_t idx) {
+                     uint16_t idx, uint32_t token) {
   std::string d;
   d.push_back((char)WIRE_MAGIC);
   d.push_back((char)T_ACK);
-  uint8_t hdr[8];
+  uint8_t hdr[12];
   store32le(hdr, msg_id);
   hdr[4] = idx & 0xff; hdr[5] = (idx >> 8) & 0xff;
   hdr[6] = 0; hdr[7] = 0;
-  d.append((const char*)hdr, 8);
+  store32le(hdr + 8, token);
+  d.append((const char*)hdr, 12);
   raw_send(ep, to, d);
 }
 
@@ -796,11 +810,15 @@ int udp_poll(void* h) {
     uint32_t msg_id = load32le(buf + 2);
     uint16_t idx = (uint16_t)(buf[6] | (buf[7] << 8));
     uint16_t cnt = (uint16_t)(buf[8] | (buf[9] << 8));
+    uint32_t token = load32le(buf + 10);
 
     if (type == T_ACK) {
       auto it = ep->outgoing.find(msg_id);
-      if (it != ep->outgoing.end() && idx < it->second.acked.size() &&
-          !it->second.acked[idx]) {
+      // an ack counts only with the message's token echoed — forged
+      // acks (guessed msg_id, spoofed source) cannot suppress
+      // retransmission (see HDR comment for why token-only)
+      if (it != ep->outgoing.end() && token == it->second.token &&
+          idx < it->second.acked.size() && !it->second.acked[idx]) {
         it->second.acked[idx] = true;
         if (++it->second.n_acked == it->second.frags.size())
           ep->outgoing.erase(it);
@@ -810,16 +828,28 @@ int udp_poll(void* h) {
     if (type != T_DATA || cnt == 0 || idx >= cnt) continue;
 
     InKey key{src, msg_id};
-    send_ack(ep, src, msg_id, idx);  // always, covers lost acks
-    if (ep->completed.count(key)) continue;  // dup of a done message
+    if (ep->completed.count(key)) {  // dup of a done message
+      send_ack(ep, src, msg_id, idx, token);  // re-ack (lost-ack case)
+      continue;
+    }
 
     auto& im = ep->incoming[key];
     if (im.frags.empty()) {
       im.frags.resize(cnt);
       im.have.assign(cnt, false);
       im.first_ms = now;
+      im.token = token;
     }
-    if (cnt != im.frags.size() || im.have[idx]) continue;
+    // a reassembly is bound to its first-seen token: a spoofed DATA
+    // frame (predictable msg_id, forged source) must neither inject
+    // bytes into an in-flight message nor elicit an ack that makes the
+    // real sender stop retransmitting that fragment. If a forger wins
+    // the first-frame race the real frames are dropped unacked, the
+    // sender burns its retries and reports the message failed —
+    // a visible failure, never silent corruption.
+    if (cnt != im.frags.size() || token != im.token) continue;
+    send_ack(ep, src, msg_id, idx, token);  // covers lost acks too
+    if (im.have[idx]) continue;
     im.frags[idx].assign((const char*)buf + HDR, n - HDR);
     im.have[idx] = true;
     if (++im.n_have == im.frags.size()) {
