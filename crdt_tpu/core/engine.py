@@ -627,12 +627,26 @@ class Engine:
         return out
 
     def records_since(self, sv: Optional[StateVector] = None) -> List[ItemRecord]:
-        """All records with clock >= sv[client] (full state when sv None)."""
+        """All records with clock >= sv[client] (full state when sv None).
+
+        O(delta) via the store's per-client clock-sorted row index: a
+        ready-probe on a large doc touches only the rows the requester
+        lacks, not the whole store (the reference's syncer re-encodes a
+        full diff per probe, crdt.js:288)."""
+        from bisect import bisect_left
+
         s = self.store
-        out = [
-            self.record_of_row(row)
-            for row in range(s.n)
-            if sv is None or not sv.covers(int(s.client[row]), int(s.clock[row]))
-        ]
+        if sv is None:
+            out = [self.record_of_row(row) for row in range(s.n)]
+        else:
+            out = []
+            for client, rows in s.client_rows.items():
+                wm = sv.get(client)
+                if not wm:
+                    out.extend(self.record_of_row(r) for r in rows)
+                    continue
+                # rows are clock-ascending per client
+                start = bisect_left(rows, wm, key=lambda r: int(s.clock[r]))
+                out.extend(self.record_of_row(r) for r in rows[start:])
         out.sort(key=lambda r: (r.client, r.clock))
         return out

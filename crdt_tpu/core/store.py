@@ -84,6 +84,13 @@ class ItemStore:
         self.keys: List[str] = []
         self._key_ids: Dict[str, int] = {}
         self._id_index: Dict[Tuple[int, int], int] = {}
+        # client -> rows in clock-ascending order (integration adds each
+        # client's items with monotonically increasing clocks), so an
+        # SV-diff can binary-search per client instead of scanning the
+        # whole store (the reference recomputes full-doc diffs per sync,
+        # crdt.js:288; at 100k items that is the difference between an
+        # O(delta) and an O(doc) ready-probe)
+        self.client_rows: Dict[int, List[int]] = {}
 
     # -- interning ---------------------------------------------------------
     def intern_root(self, name: str) -> int:
@@ -151,6 +158,7 @@ class ItemStore:
         self.deleted[i] = 1 if (deleted or kind in (K_DELETED, K_GC)) else 0
         self.content.append(content)
         self._id_index[(client, clock)] = i
+        self.client_rows.setdefault(client, []).append(i)
         return i
 
     def find(self, client: int, clock: int) -> Optional[int]:
