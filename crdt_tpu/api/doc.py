@@ -88,11 +88,21 @@ class Crdt:
         observer_function: Optional[Callable[[dict], None]] = None,
         on_update: Optional[Callable[[bytes, dict], None]] = None,
         full_state_updates: bool = False,
+        device_merge: Optional[bool] = None,
     ):
         self.engine = Engine(client_id)
         self.observer_function = observer_function
         self.on_update = on_update
         self.full_state_updates = full_state_updates
+        if device_merge is None:
+            # CRDT_TPU_DEVICE=1 routes every remote merge through the
+            # TPU kernels (the device-side applyUpdate of crdt.js:294)
+            import os
+
+            device_merge = os.environ.get("CRDT_TPU_DEVICE", "0") not in (
+                "", "0", "false", "False",
+            )
+        self.device_merge = device_merge
         self._c: Dict[str, Any] = {}
         self._batched: List[Callable[[], Any]] = []
         self._observers: List[_Observer] = []
@@ -520,8 +530,35 @@ class Crdt:
     # remote updates (crdt.js:292-311)
     # ------------------------------------------------------------------
     def apply_update(self, data: bytes, origin: str = "remote") -> None:
-        records, ds = v1.decode_update(data)
-        self.engine.apply_records(records, ds)  # begins its own txn
+        self.apply_updates([data], origin)
+
+    def apply_updates(self, datas: Sequence[bytes], origin: str = "remote") -> None:
+        """Apply a batch of encoded updates as ONE merge transaction.
+
+        This is the buffering gate of the north star: a sync backlog,
+        a persistence log replay, or a gossip round's worth of updates
+        decodes into one record union and pays one integration pass —
+        and in device mode (``CRDT_TPU_DEVICE=1`` or
+        ``device_merge=True``) that pass runs on the TPU kernels
+        (admit on host, chain rebuild via converge_maps +
+        tree_order_ranks; see crdt_tpu.core.device_apply), replacing
+        the reference's per-update scalar loop (crdt.js:294).
+        """
+        if not datas:
+            return
+        all_records: List[Any] = []
+        all_ds = DeleteSet()
+        for data in datas:
+            records, ds = v1.decode_update(data)
+            all_records.extend(records)
+            for c, clk, length in ds.iter_all():
+                all_ds.add(c, clk, length)
+        if self.device_merge:
+            from crdt_tpu.core.device_apply import apply_records_device
+
+            apply_records_device(self.engine, all_records, all_ds)
+        else:
+            self.engine.apply_records(all_records, all_ds)  # own txn
         touched, touched_keys = self._touched_roots()
         self._refresh_cache(touched)  # + D3 backfill of new collections
         self._fire_observers(touched, touched_keys, origin)

@@ -1,0 +1,307 @@
+"""Device-gated remote merge — the TPU hot path of ``Crdt.apply_update``.
+
+The reference merges every incoming update through Yjs's scalar
+integrate loop (``Y.applyUpdate``, crdt.js:294). Here the same batch is
+split into two phases:
+
+1. **Admit** (host): dedup, per-client clock contiguity, dependency
+   checks, pending stash, parent resolution, store append — pure
+   bookkeeping, one dict/append pass per record via
+   :meth:`Engine._try_admit`. No chain scans.
+2. **Rebuild** (device): recompute ALL chain-derived state from the
+   columnar store in two kernel dispatches —
+   :func:`crdt_tpu.ops.merge.converge_maps` for map (parent, key)
+   winners (tree argmax + pointer doubling) and
+   :func:`crdt_tpu.ops.yata.tree_order_ranks` for sequence document
+   order (DFS ranking via lexsort + Wyllie) — then materialize the
+   winners/order back into the engine's chain dicts.
+
+The result is bit-identical engine state to the scalar path
+(``Engine.apply_records``): same visible values, same chain order, same
+delete set, same pending semantics — asserted by the differential tests
+in tests/test_device_merge.py and by running the BASELINE acceptance
+configs in both modes.
+
+Buffering is the point: ``Crdt.apply_updates`` admits a whole batch of
+updates (a sync backlog, a persistence log replay, a gossip round) and
+pays ONE rebuild — the north-star gate ("incoming peer updates buffered
+into columnar tensors and applied as one vectorized applyUpdate").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.core.store import K_GC, NO_KEY, NULL
+from crdt_tpu.ops.device import _CLOCK_BITS, NULLI
+
+
+def apply_records_device(engine, records: List[ItemRecord],
+                         delete_set: Optional[DeleteSet] = None) -> None:
+    """Device-path equivalent of :meth:`Engine.apply_records`: the
+    shared admission loop in admit-only mode, then one kernel-driven
+    chain rebuild (begins its own txn, like the scalar path)."""
+    engine.apply_batch(records, delete_set, chain_integrate=False)
+    if not engine.last_txn_items and not engine.last_txn_deletes.ranges:
+        # nothing admitted, nothing deleted (e.g. an at-least-once
+        # transport redelivering a duplicate): derived chain state is
+        # unchanged — skip the O(doc) rebuild the scalar path never
+        # pays for duplicates either
+        return
+    rebuild_chains(engine)
+
+
+# ---------------------------------------------------------------------------
+# chain rebuild from the columnar store
+# ---------------------------------------------------------------------------
+
+
+def _origin_rows(client, clock, ocl, ock) -> np.ndarray:
+    """Row index of each row's origin (-1 if none/absent), vectorized:
+    packed-id sort + binary search instead of n dict lookups."""
+    n = len(client)
+    pack = (client.astype(np.int64) << _CLOCK_BITS) | clock.astype(np.int64)
+    order = np.argsort(pack)
+    spack = pack[order]
+    opack = np.where(
+        ocl >= 0,
+        (ocl.astype(np.int64) << _CLOCK_BITS) | ock.astype(np.int64),
+        np.int64(-1),
+    )
+    pos = np.searchsorted(spack, opack)
+    posc = np.clip(pos, 0, max(n - 1, 0))
+    found = (opack >= 0) & (spack[posc] == opack)
+    return np.where(found, order[posc], -1).astype(np.int32)
+
+
+def _bucket(n: int, floor: int = 9) -> int:
+    """Power-of-two pad so jit compiles once per bucket."""
+    return 1 << max(floor, (max(n, 1) - 1).bit_length())
+
+
+def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
+    out = np.full(size, fill, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+def rebuild_chains(engine) -> None:
+    """Recompute every chain-derived structure from the store via the
+    device kernels: ``_map_tail``/``_map_kids`` + LWW loser tombstones
+    from ``converge_maps``; ``_seq_head``/``_next``/``_prev`` sequence
+    links from ``tree_order_ranks``."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops.merge import converge_maps
+    from crdt_tpu.ops.yata import tree_order_ranks
+
+    s = engine.store
+    n = s.n
+    # chain state is derived; everything below rebuilds it from rows
+    engine._next.clear()
+    engine._prev.clear()
+    engine._seq_head.clear()
+    engine._seq_tail.clear()
+    engine._map_head.clear()
+    engine._map_tail.clear()
+    engine._map_kids.clear()
+    if n == 0:
+        return
+
+    raw_client = s.client[:n]
+    clock = s.clock[:n]
+    proot = s.parent_root[:n]
+    pcl = s.parent_client[:n]
+    pck = s.parent_clock[:n]
+    kid = s.key_id[:n].astype(np.int32)
+    kind = s.kind[:n]
+    raw_ocl = s.origin_client[:n]
+    ock = s.origin_clock[:n]
+    rcl = s.right_client[:n]
+    rck = s.right_clock[:n]
+
+    # Dense, order-preserving client remap: real client ids are random
+    # 31-bit values (net/replica.py:_random_client_id), which overflow
+    # the kernels' packed (client << 40 | clock) int64 ids — and every
+    # YATA/LWW rule only ever COMPARES client ids, so a rank-dense
+    # relabeling leaves all outcomes unchanged. Origin clients always
+    # name admitted rows (dependency check), so the same table maps
+    # them; -1 stays -1.
+    uniq_clients, client = np.unique(raw_client, return_inverse=True)
+    client = client.astype(np.int32)
+    ocl = np.where(
+        raw_ocl >= 0,
+        np.searchsorted(uniq_clients, np.clip(raw_ocl, 0, None)),
+        -1,
+    ).astype(np.int32)
+
+    origin_idx = _origin_rows(client, clock, ocl, ock)
+    live = kind != K_GC
+    is_map = live & (kid != NO_KEY)
+    is_seq = live & (kid == NO_KEY)
+
+    pad = _bucket(n)
+
+    # ---- maps: winner (= chain tail) per (parent, key) segment --------
+    if is_map.any():
+        with jax.enable_x64(True):
+            order_k, seg_k, winners, _, _, _ = converge_maps(
+                jnp.asarray(_pad(client, pad, 0)),
+                jnp.asarray(_pad(clock.astype(np.int64), pad, 0)),
+                jnp.asarray(_pad(proot != NULL, pad, False)),
+                jnp.asarray(_pad(np.where(proot != NULL, proot, pcl), pad, -2)),
+                jnp.asarray(_pad(np.where(proot != NULL, -1, pck), pad, -2)),
+                jnp.asarray(_pad(kid, pad, -1)),
+                jnp.asarray(_pad(ocl, pad, -1)),
+                jnp.asarray(_pad(ock.astype(np.int64), pad, -1)),
+                jnp.asarray(np.arange(pad) < n),
+                jnp.asarray(np.full(16, -1, np.int32)),
+                jnp.asarray(np.full(16, -1, np.int64)),
+                jnp.asarray(np.full(16, -1, np.int64)),
+                num_segments=pad,
+            )
+        order_k = np.asarray(order_k)
+        seg_sorted = np.asarray(seg_k)
+        winners = np.asarray(winners)
+        # kernel outputs live in id-sorted space; map back to rows
+        seg_row = np.full(pad, NULLI, np.int32)
+        seg_row[order_k] = seg_sorted
+        winner_of_seg: Dict[int, int] = {}
+        for sid in np.unique(seg_row[:n][is_map]):
+            w = winners[sid]
+            if w != NULLI:
+                winner_of_seg[int(sid)] = int(order_k[w])
+        for i in np.flatnonzero(is_map):
+            i = int(i)
+            sid = int(seg_row[i])
+            w = winner_of_seg.get(sid)
+            spec = engine._parent_spec_of_row(i)
+            k = int(kid[i])
+            engine._map_kids.setdefault(spec, {})[k] = None
+            if w == i:
+                engine._map_tail[(spec, k)] = i
+            elif not s.deleted[i]:
+                # LWW loser: the scalar integrate tombstones every
+                # non-tail map entry (crdt.js via yjs Item.integrate);
+                # enforcing the same invariant post-hoc yields the
+                # identical delete set
+                engine._delete_row(i)
+
+    # ---- sequences: document order per parent -------------------------
+    seq_rows = np.flatnonzero(is_seq)
+    if len(seq_rows):
+        spec_ids: Dict[Tuple, int] = {}
+        seg = np.full(n, -1, np.int32)
+        parent_arr = np.full(n, -1, np.int32)
+        key1 = np.zeros(n, np.int64)
+        key2 = np.zeros(n, np.int64)
+        for i in seq_rows:
+            i = int(i)
+            spec = engine._parent_spec_of_row(i)
+            seg[i] = spec_ids.setdefault(spec, len(spec_ids))
+            if origin_idx[i] >= 0:
+                parent_arr[i] = origin_idx[i]
+            # raw client ids are safe here: sibling keys are plain
+            # int64 lexsort keys, never packed
+            key1[i] = raw_client[i]
+            key2[i] = clock[i]
+
+        # drop items whose origin is not a live member of the same
+        # sequence (GC fillers, foreign rows): the scalar engine splices
+        # them after a chain-less row so the head walk never emits them;
+        # the drop cascades to the orphaned subtree
+        seq_list = [int(i) for i in seq_rows]
+        changed = True
+        while changed:
+            changed = False
+            kept = []
+            for i in seq_list:
+                p = parent_arr[i]
+                if p >= 0 and seg[p] != seg[i]:
+                    seg[i] = -1
+                    changed = True
+                else:
+                    kept.append(i)
+            seq_list = kept
+
+        # groups whose sibling order the client-asc key cannot express:
+        # right-origin attachments and same-client duplicates run the
+        # exact group-local scan on host (see ops/yata.py)
+        _rank_conflict_groups(
+            engine, seq_list, seg, parent_arr, key1, key2,
+            raw_client, clock, rcl, rck,
+        )
+
+        num_segments = _bucket(len(spec_ids), floor=3)
+        with jax.enable_x64(True):
+            rank, _ = tree_order_ranks(
+                jnp.asarray(_pad(seg, pad, -1)),
+                jnp.asarray(_pad(parent_arr, pad, -1)),
+                jnp.asarray(_pad(key1, pad, 0)),
+                jnp.asarray(_pad(key2, pad, 0)),
+                jnp.asarray(np.arange(pad) < n),
+                num_segments=num_segments,
+            )
+        rank = np.asarray(rank)[:n]
+
+        by_seg: Dict[int, List[Tuple[int, int]]] = {}
+        for i in seq_list:
+            by_seg.setdefault(int(seg[i]), []).append((int(rank[i]), i))
+        inv = {sid: spec for spec, sid in spec_ids.items()}
+        for sid, pairs in by_seg.items():
+            pairs.sort()
+            spec = inv[sid]
+            prev = None
+            for _, row in pairs:
+                if prev is None:
+                    engine._seq_head[spec] = row
+                    engine._prev[row] = NULL
+                else:
+                    engine._next[prev] = row
+                    engine._prev[row] = prev
+                prev = row
+            engine._next[prev] = NULL
+            engine._seq_tail[spec] = prev
+
+
+def _rank_conflict_groups(
+    engine, seq_list, seg, parent_arr, key1, key2, client, clock, rcl, rck
+) -> None:
+    """Replace (client, clock) sibling keys with exact scan ranks for
+    groups containing right-origin attachments or same-client
+    duplicates (the cases where client-asc order diverges from the Yjs
+    integrate scan)."""
+    from crdt_tpu.ops.yata import _simulate_group
+
+    groups: Dict[Tuple[int, int], List[int]] = {}
+    for i in seq_list:
+        groups.setdefault((int(seg[i]), int(parent_arr[i])), []).append(i)
+    for rows in groups.values():
+        ids = {(int(client[i]), int(clock[i])) for i in rows}
+        has_attachment = any(
+            rcl[i] != NULL and (int(rcl[i]), int(rck[i])) in ids for i in rows
+        )
+        has_dup_client = len({int(client[i]) for i in rows}) != len(rows)
+        if not (has_attachment or has_dup_client):
+            continue
+        sibs = [
+            {
+                "id": (int(client[i]), int(clock[i])),
+                "client": int(client[i]),
+                "clock": int(clock[i]),
+                "right": (
+                    (int(rcl[i]), int(rck[i])) if rcl[i] != NULL else None
+                ),
+            }
+            for i in rows
+        ]
+        ordered = _simulate_group(sibs, ids)
+        row_of = {(int(client[i]), int(clock[i])): i for i in rows}
+        for pos, sid in enumerate(ordered):
+            key1[row_of[sid]] = pos
+            key2[row_of[sid]] = 0

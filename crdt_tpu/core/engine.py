@@ -299,7 +299,26 @@ class Engine:
         self, records: List[ItemRecord], delete_set: Optional[DeleteSet] = None
     ) -> None:
         """Integrate a batch of remote records + delete set (applyUpdate)."""
+        self.apply_batch(records, delete_set, chain_integrate=True)
+
+    def apply_batch(
+        self,
+        records: List[ItemRecord],
+        delete_set: Optional[DeleteSet] = None,
+        *,
+        chain_integrate: bool,
+    ) -> None:
+        """Shared admission loop for both merge paths: (client, clock)-
+        sorted causal retry with pending stash, then delete-set
+        application. ``chain_integrate=False`` is the device path's
+        admit-only mode (chains are rebuilt by kernels afterwards);
+        keeping one loop guarantees both modes share identical
+        admission/pending semantics."""
         self.begin_txn()
+        if chain_integrate:
+            step = self._try_integrate
+        else:
+            step = lambda rec: self._try_admit(rec)[0]  # noqa: E731
         work = list(records)
         work.sort(key=lambda r: (r.client, r.clock))
         progress = True
@@ -307,7 +326,7 @@ class Engine:
             progress = False
             still = []
             for rec in work:
-                if self._try_integrate(rec):
+                if step(rec):
                     progress = True
                 else:
                     still.append(rec)
@@ -349,19 +368,34 @@ class Engine:
         self.pending_deletes = remaining
 
     def _try_integrate(self, rec: ItemRecord) -> bool:
+        handled, row = self._try_admit(rec)
+        if handled and row is not None:
+            self._integrate_into_chain(row, rec)
+        return handled
+
+    def _try_admit(self, rec: ItemRecord) -> Tuple[bool, Optional[int]]:
+        """Admission bookkeeping without chain integration: dedup, clock
+        contiguity, dependency check, parent resolution, store append.
+
+        Returns (handled, row): ``handled`` False means the record must
+        wait (missing deps / clock gap); ``row`` is the new store row,
+        or None when nothing needs chain integration (duplicates, GC
+        fillers). The device merge path admits whole batches through
+        this and rebuilds chain state with the kernels instead of the
+        per-record scan (crdt.js:294's loop, vectorized)."""
         s = self.store
         # duplicate (already integrated) -> drop (idempotent merge)
         if s.has(rec.client, rec.clock):
-            return True
+            return True, None
         # clock contiguity per client
         if rec.clock != self._next_clock.get(rec.client, 0):
             if rec.clock < self._next_clock.get(rec.client, 0):
-                return True  # stale duplicate below watermark
-            return False
+                return True, None  # stale duplicate below watermark
+            return False, None
         # dependencies known?
         for dep in rec.dep_ids():
             if not s.has(*dep):
-                return False
+                return False, None
         if rec.kind == K_GC:
             # positional info is gone; record clock coverage only
             row = s.add_item(
@@ -369,7 +403,7 @@ class Engine:
             )
             self._next_clock[rec.client] = rec.clock + 1
             self.last_txn_items.append(row)
-            return True
+            return True, None
         # resolve parent
         if rec.parent_root is not None:
             spec: ParentSpec = ("root", s.intern_root(rec.parent_root))
@@ -402,8 +436,7 @@ class Engine:
         )
         self._next_clock[rec.client] = rec.clock + 1
         self.last_txn_items.append(row)
-        self._integrate_into_chain(row, rec)
-        return True
+        return True, row
 
     def _integrate_into_chain(self, row: int, rec: ItemRecord) -> None:
         """YATA conflict resolution: faithful port of the integrate scan."""

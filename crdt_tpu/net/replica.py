@@ -113,6 +113,7 @@ class Replica:
         observer_function: Optional[Callable[[dict], None]] = None,
         full_state_updates: bool = False,
         compact_every: Optional[int] = None,
+        device_merge: Optional[bool] = None,
     ):
         if not getattr(router, "is_ypear_router", False):
             raise TypeError("router is not a ypear router")  # crdt.js:172
@@ -131,15 +132,18 @@ class Replica:
             observer_function=observer_function,
             on_update=self._on_local_update,
             full_state_updates=full_state_updates,
+            device_merge=device_merge,
         )
 
-        # load from the update log (crdt.js:193-217): replay every
-        # logged update into the fresh doc
+        # load from the update log (crdt.js:193-217): the whole log
+        # replays as ONE batched merge (one observer flush; in device
+        # mode, one kernel dispatch instead of one per logged update)
         if persistence is not None:
             if getattr(persistence, "closed", False):
                 persistence.open()  # restart after self_close
-            for update in persistence.get_all_updates(topic):
-                self.doc.apply_update(update, origin="load")
+            self.doc.apply_updates(
+                persistence.get_all_updates(topic), origin="load"
+            )
 
         if not router.started:
             router.start(router.options.get("network_name"))  # crdt.js:231

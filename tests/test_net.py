@@ -17,6 +17,14 @@ from crdt_tpu.net import (
 )
 
 
+@pytest.fixture(params=[False, True], ids=["scalar", "device"])
+def device_mode(request):
+    """Acceptance configs run in BOTH merge modes: the scalar integrate
+    loop and the TPU kernel path (CRDT_TPU_DEVICE semantics) must
+    converge to identical state (VERDICT r1 item #1)."""
+    return request.param
+
+
 def make_swarm(n, topic="t", net=None, **options):
     net = net or LoopbackNetwork()
     reps = []
@@ -129,9 +137,9 @@ class TestSyncHandshake:
 
 
 class TestAcceptanceConfigs:
-    def test_config1_two_replica_map_set_del(self):
+    def test_config1_two_replica_map_set_del(self, device_mode):
         # config #1: 2-replica Y.Map, set/del, no persistence
-        net, (a, b) = make_swarm(2)
+        net, (a, b) = make_swarm(2, device_merge=device_mode)
         for i in range(100):
             a.set("users", f"a{i}", i)
             b.set("users", f"b{i}", i)
@@ -144,9 +152,9 @@ class TestAcceptanceConfigs:
         assert len(state["users"]) == 100
         assert state["users"]["a1"] == 1 and "a0" not in state["users"]
 
-    def test_config2_four_replica_array_ops(self):
+    def test_config2_four_replica_array_ops(self, device_mode):
         # config #2: concurrent push/insert/cut, 4 replicas
-        net, reps = make_swarm(4)
+        net, reps = make_swarm(4, device_merge=device_mode)
         for i, r in enumerate(reps):
             r.push("log", [f"p{i}-{j}" for j in range(5)])
         net.run()
@@ -159,7 +167,7 @@ class TestAcceptanceConfigs:
         state = assert_converged(reps)
         assert len(state["log"]) == 4 * 5 + 4 - 4
 
-    def test_config3_sixteen_replica_batch_with_persistence(self):
+    def test_config3_sixteen_replica_batch_with_persistence(self, device_mode):
         # config #3: execBatch mixed Map+Array, 16 replicas, store on
         net = LoopbackNetwork()
         stores = [MemoryPersistence() for _ in range(16)]
@@ -170,6 +178,7 @@ class TestAcceptanceConfigs:
                     LoopbackRouter(net, f"pk{i}"),
                     topic="t",
                     persistence=stores[i],
+                    device_merge=device_mode,
                 )
             )
         net.run()
@@ -196,9 +205,9 @@ class TestAcceptanceConfigs:
         # different topic: nothing stored under t2 -> no replay crash
         assert stores[3].get_meta("t")["count"] > 0
 
-    def test_config4_nested_array_in_map_64_replicas(self):
+    def test_config4_nested_array_in_map_64_replicas(self, device_mode):
         # config #4: nested Array-in-Map, 64 replicas, interleaved edits
-        net, reps = make_swarm(64)
+        net, reps = make_swarm(64, device_merge=device_mode)
         reps[0].set("doc0", "items", "seed", array_method="push")
         net.run()
         for i, r in enumerate(reps):
@@ -212,12 +221,13 @@ class TestAcceptanceConfigs:
 
 
 class TestAdversarialDelivery:
-    def test_reorder_and_duplicate(self):
+    def test_reorder_and_duplicate(self, device_mode):
         net = LoopbackNetwork(seed=7, reorder=True, duplicate=0.5)
         reps = []
         for i in range(6):
             reps.append(
-                ypear_crdt(LoopbackRouter(net, f"pk{i}"), topic="t")
+                ypear_crdt(LoopbackRouter(net, f"pk{i}"), topic="t",
+                           device_merge=device_mode)
             )
         net.run()
         for i, r in enumerate(reps):
@@ -229,7 +239,7 @@ class TestAdversarialDelivery:
         state = assert_converged(reps)
         assert len(state["log"]) == 6 + 3
 
-    def test_reorder_seeds_all_converge(self):
+    def test_reorder_seeds_all_converge(self, device_mode):
         finals = []
         for seed in range(5):
             net = LoopbackNetwork(seed=seed, reorder=True)
@@ -237,7 +247,8 @@ class TestAdversarialDelivery:
             # seeds for the final states to be comparable
             reps = [
                 ypear_crdt(
-                    LoopbackRouter(net, f"pk{i}"), topic="t", client_id=i + 1
+                    LoopbackRouter(net, f"pk{i}"), topic="t", client_id=i + 1,
+                    device_merge=device_mode,
                 )
                 for i in range(4)
             ]
