@@ -46,11 +46,12 @@ def apply_records_device(engine, records: List[ItemRecord],
     shared admission loop in admit-only mode, then one kernel-driven
     chain rebuild (begins its own txn, like the scalar path)."""
     engine.apply_batch(records, delete_set, chain_integrate=False)
-    if not engine.last_txn_items and not engine.last_txn_deletes.ranges:
-        # nothing admitted, nothing deleted (e.g. an at-least-once
-        # transport redelivering a duplicate): derived chain state is
-        # unchanged — skip the O(doc) rebuild the scalar path never
-        # pays for duplicates either
+    if not engine.last_txn_items:
+        # no rows admitted (duplicate redelivery, or a delete-only
+        # batch): chain-derived state — links, heads, tails, winners —
+        # depends only on which rows EXIST, not on deleted flags, so
+        # the O(doc) rebuild would reproduce it bit-identically.
+        # Deletes were already applied to the flags above.
         return
     rebuild_chains(engine)
 
@@ -214,20 +215,32 @@ def rebuild_chains(engine) -> None:
         # drop items whose origin is not a live member of the same
         # sequence (GC fillers, foreign rows): the scalar engine splices
         # them after a chain-less row so the head walk never emits them;
-        # the drop cascades to the orphaned subtree
-        seq_list = [int(i) for i in seq_rows]
-        changed = True
-        while changed:
-            changed = False
-            kept = []
-            for i in seq_list:
-                p = parent_arr[i]
-                if p >= 0 and seg[p] != seg[i]:
-                    seg[i] = -1
-                    changed = True
-                else:
-                    kept.append(i)
-            seq_list = kept
+        # the drop cascades to the orphaned subtree. One topological
+        # pass (children after parents) instead of fixpoint rescans —
+        # a row is kept iff its origin-ancestor path reaches a chain
+        # root without crossing a segment boundary.
+        children: Dict[int, List[int]] = {}
+        roots: List[int] = []
+        for i in (int(i) for i in seq_rows):
+            p = int(parent_arr[i])
+            if p < 0:
+                roots.append(i)
+            else:
+                children.setdefault(p, []).append(i)
+        kept_mask = np.zeros(n, bool)
+        stack = roots
+        while stack:
+            i = stack.pop()
+            kept_mask[i] = True
+            for c in children.get(i, ()):
+                if seg[c] == seg[i]:
+                    stack.append(c)
+        seq_list = []
+        for i in (int(i) for i in seq_rows):
+            if kept_mask[i]:
+                seq_list.append(i)
+            else:
+                seg[i] = -1
 
         # groups whose sibling order the client-asc key cannot express:
         # right-origin attachments and same-client duplicates run the

@@ -46,6 +46,10 @@ class FleetStep(NamedTuple):
     deficit: np.ndarray         # [R, R] anti-entropy plan (replicated)
     winners: np.ndarray         # [S] converged LWW winner indices
     winner_visible: np.ndarray  # [S] winner not tombstoned
+    seq_order: np.ndarray       # [R*N] id-sort permutation (union rows)
+    seq_seg: np.ndarray         # [R*N] dense sequence id (id-sorted space)
+    seq_rank: np.ndarray        # [R*N] YATA document rank (id-sorted space)
+    seq_len: np.ndarray         # [S] per-sequence lengths
 
 
 class ReplicaFleet:
@@ -91,13 +95,23 @@ class ReplicaFleet:
     def axis(self) -> str:
         return self.mesh.axis_names[0] if self.mesh.axis_names else REPLICA_AXIS
 
-    def synth(self, *, num_maps: int = 4, keys_per_map: int = 64, seed: int = 0):
+    def synth(
+        self,
+        *,
+        num_maps: int = 4,
+        keys_per_map: int = 64,
+        num_lists: int = 0,
+        seq_fraction: float = 0.5,
+        seed: int = 0,
+    ):
         """Synthetic concurrent-write workload in this fleet's shape."""
         return synth_columns(
             self.n_replicas,
             self.ops_per_replica,
             num_maps=num_maps,
             keys_per_map=keys_per_map,
+            num_lists=num_lists,
+            seq_fraction=seq_fraction,
             seed=seed,
         )
 

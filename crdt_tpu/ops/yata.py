@@ -41,7 +41,13 @@ from typing import Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from crdt_tpu.ops.device import NULLI, lexsort, pointer_double
+from crdt_tpu.ops.device import (
+    NULLI,
+    lexsort,
+    pack_id,
+    pointer_double,
+    searchsorted_ids,
+)
 
 
 @partial(jax.jit, static_argnames=("num_segments",))
@@ -118,6 +124,89 @@ def tree_order_ranks(
         jnp.int32
     )
     return rank, dist_to_end[n:]
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def converge_sequences(
+    client,  # [N] int32
+    clock,  # [N] int64
+    parent_is_root,  # [N] bool
+    parent_a,  # [N] int64  root name id | parent item client
+    parent_b,  # [N] int64  -1           | parent item clock
+    key_id,  # [N] int32  -1 for sequence rows (map rows are skipped)
+    origin_client,  # [N] int32
+    origin_clock,  # [N] int64
+    valid,  # [N] bool
+    num_segments: int,
+):
+    """Union-level sequence ordering, entirely on device: dedup by
+    packed id, dense per-parent segments, origin resolution by binary
+    search, then the DFS rank kernel. The union-side counterpart of
+    :func:`crdt_tpu.ops.merge.converge_maps` — together they are the
+    full device ``applyUpdate`` of a gossip round (crdt.js:294).
+
+    Returns ``(order, seg, rank, seq_len)``; all but ``order`` live in
+    id-sorted space and ``order[i]`` maps sorted position i back to the
+    caller's row. Sibling order within an origin group is ascending
+    (client, clock) — exact for attachment-free unions (concurrent
+    appends, the gossip fan-in shape); right-origin attachment groups
+    and same-client duplicates are the host path's job
+    (:func:`order_sequences`, ``core.device_apply``).
+    """
+    n = client.shape[0]
+    ikey = jnp.where(valid, pack_id(client, clock), jnp.int64(2**62))
+    order = jnp.argsort(ikey, stable=True)
+    ikey = ikey[order]
+    client = client[order]
+    clock = clock[order]
+    parent_is_root = parent_is_root[order]
+    parent_a = parent_a[order]
+    parent_b = parent_b[order]
+    key_id = key_id[order]
+    origin_client = origin_client[order]
+    origin_clock = origin_clock[order]
+    valid = valid[order]
+    dup = jnp.concatenate([jnp.zeros(1, bool), ikey[1:] == ikey[:-1]])
+    uniq_valid = valid & ~dup
+    is_seq = uniq_valid & (key_id < 0)
+
+    # dense per-parent segments (same composite-change scheme as
+    # converge_maps, restricted to sequence rows)
+    segkey = [
+        (~is_seq).astype(jnp.int32),
+        parent_is_root.astype(jnp.int32),
+        jnp.where(is_seq, parent_a, jnp.int64(-2)),
+        jnp.where(is_seq, parent_b, jnp.int64(-2)),
+    ]
+    sorder = lexsort(segkey)
+    changed = jnp.zeros(n, bool)
+    for k in segkey:
+        ks = k[sorder]
+        changed = changed | jnp.concatenate([jnp.ones(1, bool), ks[1:] != ks[:-1]])
+    seg_sorted = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    seg = jnp.zeros(n, jnp.int32).at[sorder].set(seg_sorted)
+    seg = jnp.where(is_seq, seg, NULLI)
+
+    # origin rows; cross-segment / absent origins hang off the segment
+    # root (the GC'd-origin convention shared with map_winners)
+    okey = pack_id(origin_client, origin_clock)
+    origin_idx = searchsorted_ids(ikey, okey)
+    oseg = jnp.where(
+        origin_idx >= 0, seg[jnp.clip(origin_idx, 0, n - 1)], NULLI
+    )
+    parent_idx = jnp.where(
+        (origin_idx >= 0) & (oseg == seg), origin_idx, NULLI
+    )
+
+    rank, seq_len = tree_order_ranks(
+        seg,
+        parent_idx,
+        client.astype(jnp.int64),
+        clock.astype(jnp.int64),
+        is_seq,
+        num_segments=num_segments,
+    )
+    return order, seg, rank, seq_len
 
 
 # ---------------------------------------------------------------------------

@@ -36,6 +36,7 @@ from jax import shard_map
 
 from crdt_tpu.ops import statevec
 from crdt_tpu.ops.merge import converge_maps
+from crdt_tpu.ops.yata import converge_sequences
 
 REPLICA_AXIS = "replicas"
 
@@ -63,6 +64,11 @@ def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
       — the anti-entropy plan: entry (i, j) > 0 means i must send to j
     - ``winners``/``winner_visible`` [S] converged map winners over
       the whole union (replicated; indices into id-sorted union space)
+    - ``seq_order``/``seq_seg``/``seq_rank`` [R*N] converged sequence
+      document order over the union (replicated; id-sorted space,
+      ``seq_order`` maps back to flattened caller rows) and
+      ``seq_len`` [S] per-sequence lengths — the YATA half of the
+      device applyUpdate (maps AND sequences, VERDICT r1 weak #5)
     """
     axis = mesh.axis_names[0]
     nd = mesh.devices.size
@@ -74,7 +80,7 @@ def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
         shard_map,
         mesh=mesh,
         in_specs=col_specs + del_specs,
-        out_specs=(P(axis, None), P(), P(), P(), P()),
+        out_specs=(P(axis, None),) + (P(),) * 8,
         # the replicated outputs derive only from all_gather'd values,
         # but the vma checker cannot prove that through converge_maps's
         # while_loop (pointer doubling); the P() specs are correct
@@ -151,7 +157,31 @@ def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
             d_end,
             num_segments=num_segments,
         )
-        return sv_local, global_sv, deficit, winners, winner_visible
+        # ... and orders every sequence in the same union (the YATA
+        # half of applyUpdate; same id-sort, XLA CSEs the shared work)
+        seq_order, seq_seg, seq_rank, seq_len = converge_sequences(
+            u_client,
+            u_clock,
+            u_root,
+            u_pa,
+            u_pb,
+            u_key,
+            u_oc,
+            u_ok,
+            u_valid,
+            num_segments=num_segments,
+        )
+        return (
+            sv_local,
+            global_sv,
+            deficit,
+            winners,
+            winner_visible,
+            seq_order,
+            seq_seg,
+            seq_rank,
+            seq_len,
+        )
 
     return jax.jit(step)
 
@@ -162,28 +192,51 @@ def synth_columns(
     *,
     num_maps: int = 4,
     keys_per_map: int = 64,
+    num_lists: int = 0,
+    seq_fraction: float = 0.5,
     seed: int = 0,
 ):
-    """Synthetic replica-parallel LWW workload as padded columns.
+    """Synthetic replica-parallel workload as padded columns.
 
-    Each replica r (client id r+1) writes `ops_per_replica` map sets
-    over `num_maps` root maps × `keys_per_map` interned keys — the
-    1k-replica fan-in shape of the north star. Returns a dict of
-    [R, N] arrays plus empty delete ranges.
+    Each replica r (client id r+1) writes `ops_per_replica` ops: map
+    sets over `num_maps` root maps × `keys_per_map` interned keys, and
+    — when ``num_lists`` > 0 — concurrent appends to shared lists
+    (each item's origin is the replica's previous item in that list,
+    the shape Yjs produces when isolated replicas append locally and
+    then sync). The 1k-replica fan-in shape of the north star. Returns
+    a dict of [R, N] arrays plus empty delete ranges. List root ids
+    live above the map ids (num_maps..num_maps+num_lists-1).
     """
     rng = np.random.default_rng(seed)
     R, N = n_replicas, ops_per_replica
+    n_seq = int(N * seq_fraction) if num_lists else 0
+    n_map = N - n_seq
     cols = {
         "client": np.repeat(np.arange(1, R + 1, dtype=np.int32)[:, None], N, 1),
         "clock": np.repeat(np.arange(N, dtype=np.int64)[None, :], R, 0),
         "parent_is_root": np.ones((R, N), bool),
-        "parent_a": rng.integers(0, num_maps, (R, N)).astype(np.int64),
+        "parent_a": np.empty((R, N), np.int64),
         "parent_b": np.full((R, N), -1, np.int64),
-        "key_id": rng.integers(0, keys_per_map, (R, N)).astype(np.int32),
+        "key_id": np.full((R, N), -1, np.int32),
         "origin_client": np.full((R, N), -1, np.int32),
         "origin_clock": np.full((R, N), -1, np.int64),
         "valid": np.ones((R, N), bool),
     }
+    cols["parent_a"][:, :n_map] = rng.integers(0, num_maps, (R, n_map))
+    cols["key_id"][:, :n_map] = rng.integers(0, keys_per_map, (R, n_map))
+    if n_seq:
+        lists = rng.integers(0, num_lists, (R, n_seq))
+        for r in range(R):
+            last_clock: dict = {}
+            for j in range(n_seq):
+                lst = int(lists[r, j])
+                k = n_map + j
+                cols["parent_a"][r, k] = num_maps + lst
+                prev = last_clock.get(lst)
+                if prev is not None:
+                    cols["origin_client"][r, k] = r + 1
+                    cols["origin_clock"][r, k] = prev
+                last_clock[lst] = k  # this op's clock
     dels = (
         np.full(16, -1, np.int32),
         np.full(16, -1, np.int64),
