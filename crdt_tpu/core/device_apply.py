@@ -212,35 +212,11 @@ def rebuild_chains(engine) -> None:
             key1[i] = raw_client[i]
             key2[i] = clock[i]
 
-        # drop items whose origin is not a live member of the same
-        # sequence (GC fillers, foreign rows): the scalar engine splices
-        # them after a chain-less row so the head walk never emits them;
-        # the drop cascades to the orphaned subtree. One topological
-        # pass (children after parents) instead of fixpoint rescans —
-        # a row is kept iff its origin-ancestor path reaches a chain
-        # root without crossing a segment boundary.
-        children: Dict[int, List[int]] = {}
-        roots: List[int] = []
-        for i in (int(i) for i in seq_rows):
-            p = int(parent_arr[i])
-            if p < 0:
-                roots.append(i)
-            else:
-                children.setdefault(p, []).append(i)
-        kept_mask = np.zeros(n, bool)
-        stack = roots
-        while stack:
-            i = stack.pop()
-            kept_mask[i] = True
-            for c in children.get(i, ()):
-                if seg[c] == seg[i]:
-                    stack.append(c)
-        seq_list = []
-        for i in (int(i) for i in seq_rows):
-            if kept_mask[i]:
-                seq_list.append(i)
-            else:
-                seg[i] = -1
+        from crdt_tpu.ops.yata import drop_orphan_subtrees
+
+        seq_list = drop_orphan_subtrees(
+            (int(i) for i in seq_rows), seg, parent_arr
+        )
 
         # groups whose sibling order the client-asc key cannot express:
         # right-origin attachments and same-client duplicates run the

@@ -239,6 +239,38 @@ class Replica:
         self._propagate({"meta": "cleanup", "public_key": self.router.public_key})
         self.router.unsubscribe(self.topic)
 
+    def anti_entropy(self) -> Dict[str, int]:
+        """One targeted delta round driven by recorded peer SVs: for
+        each peer whose state vector shows a record deficit, unicast
+        exactly the records it lacks (the syncer's SV-diff,
+        crdt.js:288, generalized to every known peer instead of only
+        ready-probe requesters). Returns {peer: bytes_sent}.
+
+        Bytes scale with the DEFICIT, not the doc: a peer missing 3
+        ops gets a 3-op update (plus the delete-set tail every diff
+        carries, Yjs-style). Peers with no record deficit get nothing
+        — tombstone-only surplus still flows through the ready/sync
+        handshake, which sends unconditionally. Recorded SVs advance
+        optimistically (transports retry until acked; a lost message
+        is recovered by the next ready probe). The device-path
+        analogue is :mod:`crdt_tpu.parallel.delta`.
+        """
+        sent: Dict[str, int] = {}
+        if self.closed:
+            return sent
+        mine = self.doc.state_vector()
+        for pk, sv in list(self.peer_state_vectors.items()):
+            if sv.diff_dominates(mine):
+                continue  # no record deficit
+            update = self.doc.encode_state_as_update(sv)
+            self._to_peer(pk, {"update": update})
+            sent[pk] = len(update)
+            self.peer_state_vectors[pk] = sv.merge(mine)
+        if sent:
+            tracer = get_tracer()
+            tracer.count("replica.anti_entropy_bytes", sum(sent.values()))
+        return sent
+
     # ------------------------------------------------------------------
     # local update tail: persist + broadcast (crdt.js:442-446)
     # ------------------------------------------------------------------
@@ -246,6 +278,24 @@ class Replica:
         self._persist(update)
         if not self.closed:
             self._propagate({"update": update, **meta})
+            self._advance_topic_peer_svs()
+
+    def _advance_topic_peer_svs(self) -> None:
+        """Optimistically advance recorded SVs of peers CURRENTLY on
+        the topic — they just received our broadcast (transports retry
+        until acked). Keeps ``anti_entropy`` deficit-accurate without
+        extra probes; a peer that truly lost the message re-syncs via
+        its next ready probe. Peers not subscribed right now (left,
+        partitioned) are untouched and stay owed the delta."""
+        reached: List[str] = []
+        self.for_peers(reached.append)
+        if not reached:
+            return
+        mine = self.doc.state_vector()
+        for pk in reached:
+            sv = self.peer_state_vectors.get(pk)
+            if sv is not None:
+                self.peer_state_vectors[pk] = sv.merge(mine)
 
     def _persist(self, update: bytes) -> None:
         if self.persistence is None or self.persistence.closed:
@@ -303,7 +353,6 @@ class Replica:
             # state (e.g. ops replayed from its local log).
             requester = msg["public_key"]
             sv = v1.decode_state_vector(msg["state_vector"])
-            self.peer_state_vectors[requester] = sv
             diff = self.doc.encode_state_as_update(sv)
             self._to_peer(
                 requester,
@@ -312,6 +361,12 @@ class Replica:
                     "meta": "sync",
                     "state_vector": self.doc.encode_state_vector(),
                 },
+            )
+            # record the requester's SV ADVANCED by the diff just sent,
+            # or every later anti_entropy round would re-unicast the
+            # whole document to a peer that already converged
+            self.peer_state_vectors[requester] = sv.merge(
+                self.doc.state_vector()
             )
             return
         if "update" in msg:
@@ -336,6 +391,11 @@ class Replica:
                     their_sv = v1.decode_state_vector(msg["state_vector"])
                     back = self.doc.encode_state_as_update(their_sv)
                     self._to_peer(from_pk, {"update": back})
+                    # the syncer now holds everything we do (see the
+                    # ready-branch advance)
+                    self.peer_state_vectors[from_pk] = their_sv.merge(
+                        self.doc.state_vector()
+                    )
 
     # ------------------------------------------------------------------
     # convenience passthroughs to the document API

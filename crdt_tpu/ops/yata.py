@@ -210,8 +210,46 @@ def converge_sequences(
 
 
 # ---------------------------------------------------------------------------
-# host side: sibling ranks for groups containing attachments
+# host side: orphan drops + sibling ranks for attachment groups
 # ---------------------------------------------------------------------------
+
+
+def drop_orphan_subtrees(rows, seg, parent_idx) -> list:
+    """Keep only rows whose origin-ancestor path reaches a chain root
+    (parent < 0) without crossing a segment boundary. Orphans (items
+    whose origin is a GC filler or a foreign row) get ``seg = -1`` —
+    the engine splices them after a chain-less row, so its head walk
+    never emits them — and the drop cascades to their subtrees. One
+    topological pass (children after parents), O(rows).
+
+    ``rows`` is an iterable of row indices; ``seg``/``parent_idx`` are
+    indexable by row. Mutates ``seg`` in place; returns the kept rows
+    in input order.
+    """
+    rows = list(rows)  # iterated twice; accept one-shot iterables
+    children: Dict[int, list] = {}
+    roots: list = []
+    for i in rows:
+        p = int(parent_idx[i])
+        if p < 0:
+            roots.append(i)
+        else:
+            children.setdefault(p, []).append(i)
+    kept: set = set()
+    stack = roots
+    while stack:
+        i = stack.pop()
+        kept.add(i)
+        for c in children.get(i, ()):
+            if seg[c] == seg[i]:
+                stack.append(c)
+    out = []
+    for i in rows:
+        if i in kept:
+            out.append(i)
+        else:
+            seg[i] = -1
+    return out
 
 
 def _simulate_group(sibs: List[dict], member_ids: set) -> List[Tuple[int, int]]:
@@ -298,23 +336,7 @@ def order_sequences(records):
         key2[i] = r.clock
         seq_rows.append(i)
 
-    # Drop items whose in-batch origin is not a live member of the same
-    # sequence (a GC filler or a non-sequence row): the engine splices
-    # such items after a chain-less row, so its head walk never emits
-    # them (seq_order_table omits them). Dropping cascades to the
-    # orphaned subtree.
-    changed = True
-    while changed:
-        changed = False
-        kept = []
-        for i in seq_rows:
-            p = parent_idx[i]
-            if p >= 0 and seg[p] != seg[i]:
-                seg[i] = -1
-                changed = True
-            else:
-                kept.append(i)
-        seq_rows = kept
+    seq_rows = drop_orphan_subtrees(seq_rows, seg, parent_idx)
 
     # group members by origin-tree parent; detect attachment groups
     groups: Dict[Tuple[int, int], List[int]] = {}

@@ -1,0 +1,164 @@
+"""Targeted anti-entropy: bytes scale with the deficit, not the doc.
+
+Host path: Replica.anti_entropy unicasts SV-diffed updates to exactly
+the peers that lack records. Device path: the delta gossip step gathers
+only rows above the swarm floor, and the ring step ppermutes each
+successor exactly what it lacks (VERDICT r1 item #5).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from crdt_tpu.net import LoopbackNetwork, LoopbackRouter, ypear_crdt
+from crdt_tpu.parallel.delta import (
+    make_delta_gossip_step,
+    make_ring_delta_step,
+    synth_resident_columns,
+)
+from crdt_tpu.parallel.gossip import make_mesh
+
+
+# ---------------------------------------------------------------------------
+# host path
+# ---------------------------------------------------------------------------
+
+
+def _partition(net, router):
+    """Silently detach a router from its topics (delivery blackhole)."""
+    saved = {t: list(subs) for t, subs in net.topics.items()}
+    for t in net.topics:
+        net.topics[t] = [(r, h) for r, h in net.topics[t] if r is not router]
+    return saved
+
+
+class TestHostAntiEntropy:
+    def test_bytes_scale_with_deficit(self):
+        """The update sent to a lagging peer grows with the number of
+        missed ops, not with document size."""
+        net = LoopbackNetwork()
+        a = ypear_crdt(LoopbackRouter(net, "a"), topic="t", client_id=1)
+        b = ypear_crdt(LoopbackRouter(net, "b"), topic="t", client_id=2)
+        net.run()
+        for i in range(400):
+            a.set("m", f"k{i}", i)
+        net.run()
+        assert dict(b.c) == dict(a.c)
+
+        sizes = {}
+        for lag in (2, 20, 200):
+            saved = _partition(net, b.router)
+            for i in range(lag):
+                a.set("m", f"fresh{lag}-{i}", i)
+            net.topics.update(saved)  # heal the partition
+            # no manual SV refresh: a's record of b advanced with the
+            # live broadcasts and handshake diffs, and did NOT advance
+            # while b was partitioned — the deficit is exact
+            sent = a.anti_entropy()
+            net.run()
+            assert dict(b.c) == dict(a.c), f"lag={lag} did not converge"
+            sizes[lag] = sent["b"]
+        full = len(a.doc.encode_state_as_update())
+        assert sizes[2] < sizes[20] < sizes[200] < full
+        # a 2-op delta must be tiny next to the 600+-op document
+        assert sizes[2] * 10 < full
+
+    def test_no_deficit_sends_nothing(self):
+        net = LoopbackNetwork()
+        a = ypear_crdt(LoopbackRouter(net, "a"), topic="t", client_id=1)
+        b = ypear_crdt(LoopbackRouter(net, "b"), topic="t", client_id=2)
+        net.run()
+        a.set("m", "k", 1)
+        net.run()
+        assert a.anti_entropy() == {}
+
+    def test_targets_only_lagging_peers(self):
+        net = LoopbackNetwork()
+        a = ypear_crdt(LoopbackRouter(net, "a"), topic="t", client_id=1)
+        b = ypear_crdt(LoopbackRouter(net, "b"), topic="t", client_id=2)
+        c = ypear_crdt(LoopbackRouter(net, "c"), topic="t", client_id=3)
+        net.run()
+        saved = _partition(net, c.router)
+        a.set("m", "k", "v")
+        net.run()  # b gets it live; c is dark
+        net.topics.update(saved)
+        sent = a.anti_entropy()
+        net.run()
+        assert list(sent) == ["c"]  # only the lagging peer got bytes
+        assert dict(c.c) == dict(a.c)
+
+
+# ---------------------------------------------------------------------------
+# device path
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8
+    return make_mesh(8)
+
+
+def _cols_args(cols):
+    import jax.numpy as jnp
+
+    from crdt_tpu.parallel.delta import COL_NAMES
+
+    return [jnp.asarray(cols[k]) for k in COL_NAMES]
+
+
+class TestDeviceDelta:
+    def test_delta_gossip_ships_only_fresh_rows(self, mesh):
+        R, shared, fresh = 8, 96, 8
+        budget = 16  # << N = 104: gathered bytes scale with deficit
+        cols = synth_resident_columns(R, shared, fresh, seed=1)
+        step = make_delta_gossip_step(mesh, num_clients=R + 2, budget=budget)
+        out = step(*_cols_args(cols))
+        svs, deficit, n_needed = (np.asarray(x) for x in out[:3])
+        u = [np.asarray(x) for x in out[3:]]
+        u_client, u_clock, u_valid = u[0], u[1], u[8]
+
+        # every replica needed to ship exactly its fresh rows
+        np.testing.assert_array_equal(n_needed, np.full(R, fresh))
+        # the gathered union is R*budget wide — NOT R*(shared+fresh)
+        assert len(u_client) == R * budget
+        got = {
+            (int(c), int(k))
+            for c, k, v in zip(u_client, u_clock, u_valid)
+            if v
+        }
+        want = {(r + 2, k) for r in range(R) for k in range(fresh)}
+        assert got == want, "delta union must be exactly the fresh rows"
+        # deficit matrix: replicas owe each other exactly `fresh` clocks
+        assert deficit[0, 1] == fresh and deficit[5, 2] == fresh
+        assert deficit[3, 3] == 0
+
+    def test_delta_gossip_reports_overflow(self, mesh):
+        R, shared, fresh = 8, 32, 12
+        budget = 4  # too small: needed_count reveals it
+        cols = synth_resident_columns(R, shared, fresh, seed=2)
+        step = make_delta_gossip_step(mesh, num_clients=R + 2, budget=budget)
+        out = step(*_cols_args(cols))
+        n_needed = np.asarray(out[2])
+        assert (n_needed > budget).all()  # caller must loop / re-bucket
+        # shipped rows are still valid, just capped at budget
+        u_valid = np.asarray(out[3 + 8])
+        assert u_valid.sum() == R * budget
+
+    def test_ring_delta_reaches_successor(self, mesh):
+        R, shared, fresh = 8, 40, 6
+        cols = synth_resident_columns(R, shared, fresh, seed=3)
+        step = make_ring_delta_step(mesh, num_clients=R + 2, budget=8)
+        out = step(*_cols_args(cols))
+        sent = np.asarray(out[0])
+        recv_client = np.asarray(out[1])
+        recv_valid = np.asarray(out[9])
+        np.testing.assert_array_equal(sent, np.full(R, fresh))
+        for r in range(R):
+            pred = (r - 1) % R
+            got = {
+                int(c) for c, v in zip(recv_client[r], recv_valid[r]) if v
+            }
+            # predecessor's fresh rows are client pred+2
+            assert got == {pred + 2}, f"replica {r} got {got}"
+            assert recv_valid[r].sum() == fresh
