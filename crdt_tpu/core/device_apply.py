@@ -208,9 +208,11 @@ def rebuild_chains(engine) -> None:
             if origin_idx[i] >= 0:
                 parent_arr[i] = origin_idx[i]
             # raw client ids are safe here: sibling keys are plain
-            # int64 lexsort keys, never packed
+            # int64 lexsort keys, never packed. Clock is NEGATED:
+            # same-client same-origin duplicates order clock-DESC
+            # (the integrate break rule; see ops/yata.py)
             key1[i] = raw_client[i]
-            key2[i] = clock[i]
+            key2[i] = -clock[i]
 
         from crdt_tpu.ops.yata import drop_orphan_subtrees
 
@@ -218,9 +220,9 @@ def rebuild_chains(engine) -> None:
             (int(i) for i in seq_rows), seg, parent_arr
         )
 
-        # groups whose sibling order the client-asc key cannot express:
-        # right-origin attachments and same-client duplicates run the
-        # exact group-local scan on host (see ops/yata.py)
+        # groups whose sibling order the (client, ~clock) key cannot
+        # express — right-origin attachments only — run the exact
+        # group-local scan on host (see ops/yata.py)
         _rank_conflict_groups(
             engine, seq_list, seg, parent_arr, key1, key2,
             raw_client, clock, rcl, rck,
@@ -261,10 +263,11 @@ def rebuild_chains(engine) -> None:
 def _rank_conflict_groups(
     engine, seq_list, seg, parent_arr, key1, key2, client, clock, rcl, rck
 ) -> None:
-    """Replace (client, clock) sibling keys with exact scan ranks for
-    groups containing right-origin attachments or same-client
-    duplicates (the cases where client-asc order diverges from the Yjs
-    integrate scan)."""
+    """Replace (client, ~clock) sibling keys with exact scan ranks for
+    groups containing right-origin attachments — the only case where
+    the lexicographic key diverges from the Yjs integrate scan
+    (attachment-free groups, duplicates included, are exact on the
+    device key; see ops/yata.py)."""
     from crdt_tpu.ops.yata import _simulate_group
 
     groups: Dict[Tuple[int, int], List[int]] = {}
@@ -275,9 +278,8 @@ def _rank_conflict_groups(
         has_attachment = any(
             rcl[i] != NULL and (int(rcl[i]), int(rck[i])) in ids for i in rows
         )
-        has_dup_client = len({int(client[i]) for i in rows}) != len(rows)
-        if not (has_attachment or has_dup_client):
-            continue
+        if not has_attachment:
+            continue  # (client, ~clock) keys are exact (see ops/yata.py)
         sibs = [
             {
                 "id": (int(client[i]), int(clock[i])),

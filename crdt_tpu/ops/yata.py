@@ -11,26 +11,36 @@ Order semantics (must match ``Engine``'s faithful integrate scan):
 - What the scan does decide is the ORDER OF SIBLINGS within one origin
   group. For groups where no member's right origin is another member
   ("no attachments" — true for every append-only workload), the order
-  is simply ascending (client, clock). In the general case the order
-  follows the scan rule: a new sibling lands after the last
-  smaller-client sibling positioned before its *stop point* (its right
-  origin, or the first larger-client sibling with the same right
-  origin); larger-client siblings with different right origins are
-  scanned through transparently.
+  is ascending client id with DESCENDING clock within one client: a
+  later same-client same-origin sibling hits the scan's break rule and
+  is placed BEFORE its predecessor, and an induction over the scan
+  shows attachment-free placement otherwise always lands a new sibling
+  directly after the last smaller-client sibling, whatever the right
+  origins are. The device key (client, ~clock) is therefore EXACT for
+  every attachment-free group, duplicates included — the same
+  lexicographic rule the map winner kernel uses (ops/lww.py). In the
+  general (attachment) case the order follows the full scan rule: a
+  new sibling lands after the last smaller-client sibling positioned
+  before its *stop point* (its right origin, or the first
+  larger-client sibling with the same right origin); larger-client
+  siblings with different right origins are scanned through
+  transparently.
 
 The split of labor is therefore:
 
-  host   sibling ranks for the few groups that contain attachments
-         (exact group-local replay of the scan, O(g^2) worst case on
-         a group's siblings only);
-  device everything else, vectorized: group detection, client-asc
-         sibling ranks for attachment-free groups, and the full
-         tree-DFS ranking — one lexsort for sibling adjacency,
-         pointer doubling to climb last-child chains, successor
-         pointers, and Wyllie list ranking. O(n log n) work in
-         O(log n) gather rounds, independent of tree depth (the
-         reference's scalar integrate is O(n) sequential per chain,
-         crdt.js:294).
+  host   sibling ranks ONLY for groups containing right-origin
+         attachments (concurrent inserts anchored inside the same
+         sibling set — an exact group-local replay of the scan,
+         O(g^2) worst case on that group's g siblings only; g is the
+         number of concurrent same-position inserts, bounded by the
+         writers racing one position, not by doc size);
+  device everything else, vectorized: group detection,
+         (client, ~clock) sibling ranks, and the full tree-DFS
+         ranking — one lexsort for sibling adjacency, pointer
+         doubling to climb last-child chains, successor pointers, and
+         Wyllie list ranking. O(n log n) work in O(log n) gather
+         rounds, independent of tree depth (the reference's scalar
+         integrate is O(n) sequential per chain, crdt.js:294).
 """
 
 from __future__ import annotations
@@ -54,8 +64,8 @@ from crdt_tpu.ops.device import (
 def tree_order_ranks(
     seg,  # [N] int32 dense sequence id (-1 = not a sequence item)
     parent_idx,  # [N] int32 origin-tree parent (item index), NULLI = root
-    key1,  # [N] int64 primary sibling key (rank or client)
-    key2,  # [N] int64 secondary sibling key (0 or clock)
+    key1,  # [N] int64 primary sibling key (scan rank or client)
+    key2,  # [N] int64 secondary sibling key (0 or NEGATED clock)
     valid,  # [N] bool
     num_segments: int,
 ):
@@ -147,11 +157,11 @@ def converge_sequences(
 
     Returns ``(order, seg, rank, seq_len)``; all but ``order`` live in
     id-sorted space and ``order[i]`` maps sorted position i back to the
-    caller's row. Sibling order within an origin group is ascending
-    (client, clock) — exact for attachment-free unions (concurrent
-    appends, the gossip fan-in shape); right-origin attachment groups
-    and same-client duplicates are the host path's job
-    (:func:`order_sequences`, ``core.device_apply``).
+    caller's row. Sibling order within an origin group is the
+    (client asc, clock DESC) key — exact for every attachment-free
+    group, same-client duplicates included (see module docstring);
+    only right-origin attachment groups need the host scan, which is
+    :func:`order_sequences` / ``core.device_apply``'s job.
     """
     n = client.shape[0]
     ikey = jnp.where(valid, pack_id(client, clock), jnp.int64(2**62))
@@ -202,7 +212,7 @@ def converge_sequences(
         seg,
         parent_idx,
         client.astype(jnp.int64),
-        clock.astype(jnp.int64),
+        -clock.astype(jnp.int64),  # clock-DESC within a client
         is_seq,
         num_segments=num_segments,
     )
@@ -333,7 +343,7 @@ def order_sequences(records):
         if r.origin is not None and r.origin in row_of:
             parent_idx[i] = row_of[r.origin]
         key1[i] = r.client
-        key2[i] = r.clock
+        key2[i] = -r.clock  # clock-DESC within a client (break rule)
         seq_rows.append(i)
 
     seq_rows = drop_orphan_subtrees(seq_rows, seg, parent_idx)
@@ -347,13 +357,11 @@ def order_sequences(records):
         has_attachment = any(
             records[i].right in member_ids for i in rows if records[i].right
         )
-        # same-client duplicates need the exact scan too: Yjs places a
-        # later same-client same-origin sibling BEFORE its predecessor
-        # (the integrate break rule), so the client-asc/clock-asc device
-        # key would order them backwards
-        has_dup_client = len({records[i].client for i in rows}) != len(rows)
-        if not (has_attachment or has_dup_client):
-            continue  # client-asc keys already set
+        if not has_attachment:
+            # (client, ~clock) keys are exact here — including
+            # same-client duplicates, which the break rule places
+            # clock-descending (see module docstring)
+            continue
         sibs = [
             {
                 "id": records[i].id,

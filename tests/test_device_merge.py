@@ -229,3 +229,33 @@ class TestDeviceModePlumbing:
         scalar, device = run(False), run(True)
         for s, d in zip(scalar, device):
             _assert_same_state(s.doc, d.doc)
+
+    def test_duplicate_siblings_rebuild_on_device(self, monkeypatch):
+        """rebuild_chains' own (client, ~clock) key (separate code from
+        order_sequences) must order attachment-free same-client
+        duplicate groups without the host scan."""
+        import crdt_tpu.ops.yata as yata
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.records import ItemRecord
+
+        recs = [
+            ItemRecord(client=1, clock=0, parent_root="s", content="b0"),
+            ItemRecord(client=1, clock=1, parent_root="s", origin=(1, 0),
+                       content="b1"),
+        ]
+        for k in range(3):
+            recs.append(ItemRecord(client=2, clock=k, parent_root="s",
+                                   origin=(1, 0), content=f"dup{k}"))
+        blob = v1.encode_update(recs, None)
+
+        scalar = Crdt(999, device_merge=False)
+        scalar.apply_update(blob)
+
+        def boom(*a, **k):
+            raise AssertionError("host scan ran in rebuild_chains")
+
+        monkeypatch.setattr(yata, "_simulate_group", boom)
+        device = Crdt(999, device_merge=True)
+        device.apply_update(blob)
+        _assert_same_state(scalar, device)
+        assert device.c["s"] == ["b0", "b1", "dup2", "dup1", "dup0"]
