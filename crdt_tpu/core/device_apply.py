@@ -79,9 +79,7 @@ def _origin_rows(client, clock, ocl, ock) -> np.ndarray:
     return np.where(found, order[posc], -1).astype(np.int32)
 
 
-def _bucket(n: int, floor: int = 9) -> int:
-    """Power-of-two pad so jit compiles once per bucket."""
-    return 1 << max(floor, (max(n, 1) - 1).bit_length())
+from crdt_tpu.ops.device import bucket_pow2 as _bucket  # shared policy
 
 
 def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
@@ -90,11 +88,37 @@ def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
     return out
 
 
+def _rebuild_state(engine) -> dict:
+    """Persistent per-engine rebuild bookkeeping: an interned parent
+    spec id per store row, extended incrementally (O(new rows) per
+    rebuild). Spec ids let a rebuild select only the rows of AFFECTED
+    parents instead of restaging the whole document."""
+    st = getattr(engine, "_device_rebuild_state", None)
+    if st is None:
+        st = {
+            "row_spec": np.full(256, -1, np.int64),
+            "spec_table": {},
+            "specs": [],
+            "spec_rows": [],  # spec id -> [store rows], append-only
+            "len": 0,
+        }
+        engine._device_rebuild_state = st
+    return st
+
+
 def rebuild_chains(engine) -> None:
-    """Recompute every chain-derived structure from the store via the
-    device kernels: ``_map_tail``/``_map_kids`` + LWW loser tombstones
-    from ``converge_maps``; ``_seq_head``/``_next``/``_prev`` sequence
-    links from ``tree_order_ranks``."""
+    """Recompute chain-derived structures for every parent touched by
+    newly admitted rows: ``_map_tail``/``_map_kids`` + LWW loser
+    tombstones from ``converge_maps``; ``_seq_head``/``_next``/
+    ``_prev`` sequence links from ``tree_order_ranks``.
+
+    Incremental: only AFFECTED parents (those with new rows since the
+    last rebuild) are recomputed — chain state depends solely on which
+    rows exist under a parent, so untouched parents' chains stay valid
+    verbatim. Host work and kernel dispatch size scale with the
+    affected parents' rows, not the document (VERDICT r1 item #8; the
+    HBM-resident union for the firehose path is
+    :mod:`crdt_tpu.ops.resident`)."""
     import jax
     import jax.numpy as jnp
 
@@ -103,50 +127,97 @@ def rebuild_chains(engine) -> None:
 
     s = engine.store
     n = s.n
-    # chain state is derived; everything below rebuilds it from rows
-    engine._next.clear()
-    engine._prev.clear()
-    engine._seq_head.clear()
-    engine._seq_tail.clear()
-    engine._map_head.clear()
-    engine._map_tail.clear()
-    engine._map_kids.clear()
     if n == 0:
         return
+    st = _rebuild_state(engine)
 
-    raw_client = s.client[:n]
-    clock = s.clock[:n]
-    proot = s.parent_root[:n]
-    pcl = s.parent_client[:n]
-    pck = s.parent_clock[:n]
-    kid = s.key_id[:n].astype(np.int32)
-    kind = s.kind[:n]
-    raw_ocl = s.origin_client[:n]
-    ock = s.origin_clock[:n]
-    rcl = s.right_client[:n]
-    rck = s.right_clock[:n]
+    # -- extend per-row spec ids for new rows (O(new)) -----------------
+    row_spec = st["row_spec"]
+    if len(row_spec) < n:
+        grown = np.full(_bucket(n, floor=8), -1, np.int64)
+        grown[: len(row_spec)] = row_spec
+        st["row_spec"] = row_spec = grown
+    affected: set = set()
+    specs = st["specs"]
+    spec_table = st["spec_table"]
+    spec_rows = st["spec_rows"]
+    for r in range(st["len"], n):
+        if s.kind[r] == K_GC:
+            row_spec[r] = -1
+            continue
+        spec = engine._parent_spec_of_row(r)
+        sid = spec_table.get(spec)
+        if sid is None:
+            sid = len(specs)
+            spec_table[spec] = sid
+            specs.append(spec)
+            spec_rows.append([])
+        row_spec[r] = sid
+        spec_rows[sid].append(r)
+        affected.add(sid)
+    st["len"] = n
+    if not affected:
+        return  # only GC fillers admitted: no chain is touched
+
+    # -- select the affected parents' rows: O(their rows), not O(doc) --
+    sel = np.sort(
+        np.fromiter(
+            (r for sid in affected for r in spec_rows[sid]),
+            np.int64,
+        )
+    )
+    m = len(sel)
+
+    # -- clear derived state for affected parents only -----------------
+    for sid in affected:
+        spec = specs[sid]
+        engine._seq_head.pop(spec, None)
+        engine._seq_tail.pop(spec, None)
+        for k in engine._map_kids.pop(spec, {}):
+            engine._map_head.pop((spec, k), None)
+            engine._map_tail.pop((spec, k), None)
+    for r in sel.tolist():
+        engine._next.pop(r, None)
+        engine._prev.pop(r, None)
+
+    raw_client = s.client[sel]
+    clock = s.clock[sel]
+    proot = s.parent_root[sel]
+    pcl = s.parent_client[sel]
+    pck = s.parent_clock[sel]
+    kid = s.key_id[sel].astype(np.int32)
+    kind = s.kind[sel]
+    raw_ocl = s.origin_client[sel]
+    ock = s.origin_clock[sel]
+    rcl = s.right_client[sel]
+    rck = s.right_clock[sel]
 
     # Dense, order-preserving client remap: real client ids are random
     # 31-bit values (net/replica.py:_random_client_id), which overflow
     # the kernels' packed (client << 40 | clock) int64 ids — and every
     # YATA/LWW rule only ever COMPARES client ids, so a rank-dense
-    # relabeling leaves all outcomes unchanged. Origin clients always
-    # name admitted rows (dependency check), so the same table maps
-    # them; -1 stays -1.
+    # relabeling leaves all outcomes unchanged. An origin whose client
+    # is absent from the subset (a GC'd or foreign origin) maps to -1;
+    # same-client origins with out-of-subset clocks fail the packed-id
+    # search below instead.
     uniq_clients, client = np.unique(raw_client, return_inverse=True)
     client = client.astype(np.int32)
-    ocl = np.where(
-        raw_ocl >= 0,
-        np.searchsorted(uniq_clients, np.clip(raw_ocl, 0, None)),
-        -1,
-    ).astype(np.int32)
+    opos = np.searchsorted(uniq_clients, np.clip(raw_ocl, 0, None))
+    opos_c = np.clip(opos, 0, max(len(uniq_clients) - 1, 0))
+    o_found = (raw_ocl >= 0) & (uniq_clients[opos_c] == raw_ocl)
+    ocl = np.where(o_found, opos_c, -1).astype(np.int32)
 
     origin_idx = _origin_rows(client, clock, ocl, ock)
+    # an origin that names a row OUTSIDE the subset (GC filler, foreign
+    # parent) is an ORPHANING origin for sequences: the scalar engine
+    # splices such items after a chain-less row, invisible to the head
+    # walk. Distinguish it from "no origin at all" (a chain root).
+    orphan = (raw_ocl >= 0) & (origin_idx < 0)
     live = kind != K_GC
     is_map = live & (kid != NO_KEY)
     is_seq = live & (kid == NO_KEY)
 
-    pad = _bucket(n)
+    pad = _bucket(m)
 
     # ---- maps: winner (= chain tail) per (parent, key) segment --------
     if is_map.any():
@@ -160,7 +231,7 @@ def rebuild_chains(engine) -> None:
                 jnp.asarray(_pad(kid, pad, -1)),
                 jnp.asarray(_pad(ocl, pad, -1)),
                 jnp.asarray(_pad(ock.astype(np.int64), pad, -1)),
-                jnp.asarray(np.arange(pad) < n),
+                jnp.asarray(np.arange(pad) < m),
                 jnp.asarray(np.full(16, -1, np.int32)),
                 jnp.asarray(np.full(16, -1, np.int64)),
                 jnp.asarray(np.full(16, -1, np.int64)),
@@ -169,55 +240,58 @@ def rebuild_chains(engine) -> None:
         order_k = np.asarray(order_k)
         seg_sorted = np.asarray(seg_k)
         winners = np.asarray(winners)
-        # kernel outputs live in id-sorted space; map back to rows
+        # kernel outputs live in id-sorted SUBSET space; map back to
+        # subset positions, then to store rows via `sel`
         seg_row = np.full(pad, NULLI, np.int32)
         seg_row[order_k] = seg_sorted
         winner_of_seg: Dict[int, int] = {}
-        for sid in np.unique(seg_row[:n][is_map]):
+        for sid in np.unique(seg_row[:m][is_map]):
             w = winners[sid]
             if w != NULLI:
                 winner_of_seg[int(sid)] = int(order_k[w])
-        for i in np.flatnonzero(is_map):
-            i = int(i)
-            sid = int(seg_row[i])
+        for j in np.flatnonzero(is_map):
+            j = int(j)
+            sid = int(seg_row[j])
             w = winner_of_seg.get(sid)
-            spec = engine._parent_spec_of_row(i)
-            k = int(kid[i])
+            row = int(sel[j])
+            spec = specs[int(row_spec[row])]
+            k = int(kid[j])
             engine._map_kids.setdefault(spec, {})[k] = None
-            if w == i:
-                engine._map_tail[(spec, k)] = i
-            elif not s.deleted[i]:
+            if w == j:
+                engine._map_tail[(spec, k)] = row
+            elif not s.deleted[row]:
                 # LWW loser: the scalar integrate tombstones every
                 # non-tail map entry (crdt.js via yjs Item.integrate);
                 # enforcing the same invariant post-hoc yields the
                 # identical delete set
-                engine._delete_row(i)
+                engine._delete_row(row)
 
     # ---- sequences: document order per parent -------------------------
-    seq_rows = np.flatnonzero(is_seq)
+    # subset-local indices throughout; `sel` translates back to rows
+    seq_rows = np.flatnonzero(is_seq & ~orphan)
     if len(seq_rows):
-        spec_ids: Dict[Tuple, int] = {}
-        seg = np.full(n, -1, np.int32)
-        parent_arr = np.full(n, -1, np.int32)
-        key1 = np.zeros(n, np.int64)
-        key2 = np.zeros(n, np.int64)
-        for i in seq_rows:
-            i = int(i)
-            spec = engine._parent_spec_of_row(i)
-            seg[i] = spec_ids.setdefault(spec, len(spec_ids))
-            if origin_idx[i] >= 0:
-                parent_arr[i] = origin_idx[i]
+        local_seg_of: Dict[int, int] = {}  # global spec id -> dense
+        seg = np.full(m, -1, np.int32)
+        parent_arr = np.full(m, -1, np.int32)
+        key1 = np.zeros(m, np.int64)
+        key2 = np.zeros(m, np.int64)
+        for j in seq_rows:
+            j = int(j)
+            gsid = int(row_spec[sel[j]])
+            seg[j] = local_seg_of.setdefault(gsid, len(local_seg_of))
+            if origin_idx[j] >= 0:
+                parent_arr[j] = origin_idx[j]
             # raw client ids are safe here: sibling keys are plain
             # int64 lexsort keys, never packed. Clock is NEGATED:
             # same-client same-origin duplicates order clock-DESC
             # (the integrate break rule; see ops/yata.py)
-            key1[i] = raw_client[i]
-            key2[i] = -clock[i]
+            key1[j] = raw_client[j]
+            key2[j] = -clock[j]
 
         from crdt_tpu.ops.yata import drop_orphan_subtrees
 
         seq_list = drop_orphan_subtrees(
-            (int(i) for i in seq_rows), seg, parent_arr
+            (int(j) for j in seq_rows), seg, parent_arr
         )
 
         # groups whose sibling order the (client, ~clock) key cannot
@@ -228,27 +302,28 @@ def rebuild_chains(engine) -> None:
             raw_client, clock, rcl, rck,
         )
 
-        num_segments = _bucket(len(spec_ids), floor=3)
+        num_segments = _bucket(len(local_seg_of), floor=3)
         with jax.enable_x64(True):
             rank, _ = tree_order_ranks(
                 jnp.asarray(_pad(seg, pad, -1)),
                 jnp.asarray(_pad(parent_arr, pad, -1)),
                 jnp.asarray(_pad(key1, pad, 0)),
                 jnp.asarray(_pad(key2, pad, 0)),
-                jnp.asarray(np.arange(pad) < n),
+                jnp.asarray(np.arange(pad) < m),
                 num_segments=num_segments,
             )
-        rank = np.asarray(rank)[:n]
+        rank = np.asarray(rank)[:m]
 
         by_seg: Dict[int, List[Tuple[int, int]]] = {}
-        for i in seq_list:
-            by_seg.setdefault(int(seg[i]), []).append((int(rank[i]), i))
-        inv = {sid: spec for spec, sid in spec_ids.items()}
-        for sid, pairs in by_seg.items():
+        for j in seq_list:
+            by_seg.setdefault(int(seg[j]), []).append((int(rank[j]), j))
+        inv = {lsid: gsid for gsid, lsid in local_seg_of.items()}
+        for lsid, pairs in by_seg.items():
             pairs.sort()
-            spec = inv[sid]
+            spec = specs[inv[lsid]]
             prev = None
-            for _, row in pairs:
+            for _, j in pairs:
+                row = int(sel[j])
                 if prev is None:
                     engine._seq_head[spec] = row
                     engine._prev[row] = NULL

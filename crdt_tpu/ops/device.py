@@ -21,6 +21,12 @@ NULLI = -1
 _CLOCK_BITS = 40
 
 
+def bucket_pow2(n: int, floor: int = 9) -> int:
+    """Power-of-two size bucket (host helper): padding to buckets keeps
+    jit compiling once per bucket instead of once per exact shape."""
+    return 1 << max(floor, (max(n, 1) - 1).bit_length())
+
+
 def pack_id(client: jnp.ndarray, clock: jnp.ndarray) -> jnp.ndarray:
     """(client, clock) -> single sortable int64; null (-1,*) -> -1."""
     packed = (client.astype(jnp.int64) << _CLOCK_BITS) | clock.astype(jnp.int64)

@@ -1,0 +1,218 @@
+"""HBM-resident columnar union — converge without restaging the doc.
+
+The north star names this explicitly: incoming peer updates are
+buffered into columnar tensors, applied as one vectorized applyUpdate,
+and the ``crdt.c`` cache is rebuilt from HBM — NOT re-uploaded from the
+host every dispatch. :class:`ResidentColumns` is that buffer:
+
+- the op columns live in device memory across rounds (capacity grows
+  by power-of-two buckets, one recompile per bucket);
+- ``append`` ships ONLY the new delta over PCIe/ICI (padded to a
+  delta bucket) and splices it in-place with ``dynamic_update_slice``;
+- ``converge`` dispatches the LWW map kernel and the YATA sequence
+  kernel over the resident buffers and returns DEVICE arrays — nothing
+  crosses back to the host until the caller materializes.
+
+Client ids are interned to DENSE, ORDER-PRESERVING values on append:
+the kernels pack (client << 40 | clock) into int64, which random
+31-bit replica ids would alias (same rationale as the remap in
+``core.device_apply``), and YATA/LWW sibling rules compare client ids,
+so the mapping must be monotone in the raw id. Dense id = rank among
+all raw ids seen; a new id arriving BETWEEN existing ones shifts later
+ranks, triggering a one-off on-device relabel of the client columns
+(O(capacity), at most once per distinct client — and never when the
+client set is pre-registered via the ``clients=`` argument, which the
+fleet path can always do).
+
+Product-path counterpart: ``core.device_apply.rebuild_chains`` keeps
+per-parent incremental state on the host engine; this class is the
+firehose path (ReplicaFleet fan-in, trace replay, the benchmark).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.ops.device import bucket_pow2 as _bucket  # shared policy
+
+# (name, dtype) in kernel argument order
+COLUMNS = (
+    ("client", np.int32),
+    ("clock", np.int64),
+    ("parent_is_root", np.bool_),
+    ("parent_a", np.int64),
+    ("parent_b", np.int64),
+    ("key_id", np.int32),
+    ("origin_client", np.int32),
+    ("origin_clock", np.int64),
+    ("valid", np.bool_),
+)
+
+_FILL = {
+    "client": 0,
+    "clock": 0,
+    "parent_is_root": False,
+    "parent_a": -2,
+    "parent_b": -2,
+    "key_id": -1,
+    "origin_client": -1,
+    "origin_clock": -1,
+    "valid": False,
+}
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _splice(bufs, delta, n):
+    """In-place (donated) append of a padded delta at offset n."""
+    return tuple(
+        jax.lax.dynamic_update_slice(b, d, (n,)) for b, d in zip(bufs, delta)
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _relabel(bufs, perm):
+    """Rewrite the client columns through an old-dense -> new-dense
+    permutation (invalid rows hold 0, which perm covers; -1 origins
+    stay -1)."""
+    bufs = list(bufs)
+    bufs[0] = perm[bufs[0]].astype(bufs[0].dtype)
+    oc = bufs[6]
+    bufs[6] = jnp.where(
+        oc >= 0, perm[jnp.clip(oc, 0, perm.shape[0] - 1)], oc
+    ).astype(oc.dtype)
+    return tuple(bufs)
+
+
+class ResidentColumns:
+    """Growable device-resident op columns + in-place convergence."""
+
+    def __init__(
+        self,
+        capacity: int = 1 << 15,
+        clients: Optional[Sequence[int]] = None,
+    ):
+        cap = _bucket(capacity)
+        self.n = 0
+        self._seen: List[int] = []  # sorted raw client ids
+        self._dense: Dict[int, int] = {}  # raw -> rank among seen
+        if clients:
+            self._intern(np.asarray(sorted(set(int(c) for c in clients))))
+        with jax.enable_x64(True):
+            self._bufs: Tuple[jnp.ndarray, ...] = tuple(
+                jnp.full(cap, _FILL[name], dtype=dt) for name, dt in COLUMNS
+            )
+
+    @property
+    def capacity(self) -> int:
+        return int(self._bufs[0].shape[0])
+
+    # -- client interning ---------------------------------------------
+    def _intern(self, raw_ids: np.ndarray) -> Optional[np.ndarray]:
+        """Register raw ids. Returns an old-dense->new-dense permutation
+        when existing ranks shifted (caller must relabel the resident
+        columns), else None."""
+        new = sorted(set(int(c) for c in raw_ids) - self._dense.keys())
+        if not new:
+            return None
+        shifted = bool(self._seen) and new[0] < self._seen[-1]
+        old = dict(self._dense) if shifted else None
+        self._seen = sorted(self._seen + new)
+        self._seen_arr = np.asarray(self._seen)
+        self._dense = {raw: i for i, raw in enumerate(self._seen)}
+        if old and self.n:
+            perm = np.zeros(len(old), np.int32)
+            for raw, od in old.items():
+                perm[od] = self._dense[raw]
+            return perm
+        return None
+
+    def _map_clients(self, arr: np.ndarray, mask: np.ndarray) -> np.ndarray:
+        """Raw -> dense for the masked entries; others untouched."""
+        out = arr.astype(np.int32).copy()
+        if mask.any():
+            vals = arr[mask]
+            out[mask] = np.searchsorted(self._seen_arr, vals).astype(np.int32)
+        return out
+
+    # -- append / converge --------------------------------------------
+    def append(self, cols: Dict[str, np.ndarray]) -> None:
+        """Splice a host-side delta into the resident union. Only the
+        delta (padded to its power-of-two bucket) crosses to the
+        device; resident rows never re-upload."""
+        k = len(cols["client"])
+        if k == 0:
+            return
+        valid = np.asarray(cols["valid"][:k], bool)
+        raw_cl = np.asarray(cols["client"][:k])
+        raw_ocl = np.asarray(cols["origin_client"][:k])
+        perm = self._intern(
+            np.concatenate([raw_cl[valid], raw_ocl[raw_ocl >= 0]])
+        )
+        with jax.enable_x64(True):
+            if perm is not None:
+                self._bufs = _relabel(self._bufs, jnp.asarray(perm))
+            if self.n + k > self.capacity:
+                self._grow(self.n + k)
+            kpad = min(_bucket(k, floor=6), self.capacity)
+            if self.n + kpad > self.capacity:
+                self._grow(self.n + kpad)
+            delta = []
+            for name, dt in COLUMNS:
+                arr = np.full(kpad, _FILL[name], dtype=dt)
+                if name == "client":
+                    arr[:k] = np.where(
+                        valid, self._map_clients(raw_cl, valid), 0
+                    )
+                elif name == "origin_client":
+                    arr[:k] = self._map_clients(raw_ocl, raw_ocl >= 0)
+                else:
+                    arr[:k] = cols[name][:k]
+                delta.append(jnp.asarray(arr))
+            self._bufs = _splice(self._bufs, tuple(delta), jnp.int32(self.n))
+        self.n += k
+
+    def _grow(self, need: int) -> None:
+        new_cap = _bucket(need)
+        grown = []
+        for (name, dt), b in zip(COLUMNS, self._bufs):
+            nb = jnp.full(new_cap, _FILL[name], dtype=dt)
+            grown.append(jax.lax.dynamic_update_slice(nb, b, (0,)))
+        self._bufs = tuple(grown)
+
+    def dense_client(self, raw: int) -> Optional[int]:
+        """Dense id currently assigned to a raw client id."""
+        return self._dense.get(int(raw))
+
+    def converge(
+        self,
+        num_segments: Optional[int] = None,
+        d_client=None,
+        d_start=None,
+        d_end=None,
+    ):
+        """One full device applyUpdate over the resident union: map
+        winners (converge_maps) + sequence order (converge_sequences).
+        Returns the two kernels' raw outputs as DEVICE arrays.
+
+        Delete ranges, when given, must use DENSE client ids
+        (:meth:`dense_client`).
+        """
+        from crdt_tpu.ops.merge import converge_maps
+        from crdt_tpu.ops.yata import converge_sequences
+
+        segs = num_segments or self.capacity
+        with jax.enable_x64(True):
+            if d_client is None:
+                d_client = jnp.full(16, -1, jnp.int32)
+                d_start = jnp.full(16, -1, jnp.int64)
+                d_end = jnp.full(16, -1, jnp.int64)
+            maps_out = converge_maps(
+                *self._bufs, d_client, d_start, d_end, num_segments=segs
+            )
+            seq_out = converge_sequences(*self._bufs, num_segments=segs)
+        return maps_out, seq_out
