@@ -138,8 +138,10 @@ class TestIncrementalRebuild:
                     a.apply_update(u)
                 b_out.clear()
             assert dict(a.c) == dict(b.c)
-            outs[dev] = (dict(a.c), a.encode_state_as_update())
-        assert outs[False][0] == outs[True][0]
+            outs[dev] = (dict(a.c), a.encode_state_as_update(),
+                         b.encode_state_as_update())
+        # caches AND full encoded states (tombstones included) match
+        assert outs[False] == outs[True]
 
 
 class TestClientInterning:
