@@ -1,0 +1,263 @@
+"""Native codec vs the pure-Python reference codec.
+
+The Python codec is the semantic reference (pinned by the hand-derived
+wire fixtures); the C extension must agree with it field-for-field on
+decode and byte-for-byte on encode, across every content kind.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from crdt_tpu.codec import native, v1
+from crdt_tpu.codec.lib0 import UNDEFINED, Encoder
+from crdt_tpu.core.engine import Engine
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.ops.merge import resolve_parents
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native codec toolchain unavailable"
+)
+
+
+def assert_matches_python(blobs):
+    """C decode == Python decode(+resolve); C encode == original bytes."""
+    dec = native.decode_updates_columns(blobs)
+    c_records, c_ds = native.decoded_to_records(dec)
+
+    py_records = []
+    py_ds = DeleteSet()
+    for blob in blobs:
+        recs, d = v1.decode_update(blob)
+        py_records.extend(recs)
+        for c, k, length in d.iter_all():
+            py_ds.add(c, k, length)
+    py_records = resolve_parents(py_records)
+
+    assert len(c_records) == len(py_records)
+    for cr, pr in zip(c_records, py_records):
+        assert (cr.client, cr.clock) == (pr.client, pr.clock)
+        assert cr.parent_root == pr.parent_root, (cr, pr)
+        assert cr.parent_item == pr.parent_item
+        assert cr.key == pr.key
+        assert cr.origin == pr.origin
+        assert cr.right == pr.right
+        assert cr.kind == pr.kind
+        assert cr.content == pr.content or (
+            cr.content is UNDEFINED and pr.content is UNDEFINED
+        )
+        if cr.kind == 6:  # K_TYPE
+            assert cr.type_ref == pr.type_ref
+    assert c_ds == py_ds
+
+    # single-blob inputs: C re-encode reproduces the original bytes
+    if len(blobs) == 1:
+        assert native.encode_from_columns(dec) == blobs[0]
+    return dec
+
+
+def engine_blob(build):
+    e = Engine(1)
+    build(e)
+    return v1.encode_state_as_update(e)
+
+
+class TestDifferentialDecodeEncode:
+    def test_map_doc(self):
+        def build(e):
+            e.map_set("users", "alice", {"age": 30, "tags": ["x", 1.5]})
+            e.map_set("users", "bob", None)
+            e.map_set("users", "alice", "v2")
+            e.map_delete("users", "bob")
+
+        assert_matches_python([engine_blob(build)])
+
+    def test_seq_runs_and_deletes(self):
+        def build(e):
+            e.seq_insert("log", 0, list(range(40)))
+            e.seq_insert("log", 10, ["mid1", "mid2"])
+            e.seq_delete("log", 0, 5)
+
+        assert_matches_python([engine_blob(build)])
+
+    def test_nested_types(self):
+        def build(e):
+            from crdt_tpu.core.store import TYPE_ARRAY
+
+            e.map_set_type("m", "list", TYPE_ARRAY)
+            spec = e.map_entry_spec("m", "list")
+            e.seq_insert("", 0, [1, [2, 3], {"k": "v"}], parent=spec)
+
+        assert_matches_python([engine_blob(build)])
+
+    def test_multi_blob_union_with_ds_merge(self):
+        a, b = Engine(1), Engine(2)
+        a.map_set("m", "k", "a")
+        a.seq_insert("s", 0, ["x", "y"])
+        a.seq_delete("s", 0, 1)
+        b.map_set("m", "k", "b")
+        b.map_delete("m", "k")
+        blobs = [v1.encode_state_as_update(a), v1.encode_state_as_update(b)]
+        dec = assert_matches_python(blobs)
+        assert len(dec["client"]) == 4
+
+    def test_any_payload_coverage(self):
+        vals = [
+            UNDEFINED, None, True, False, 0, -1, 63, -64, 2**40,
+            -(2**40), 2**53 + 10, -(2**53) - 10, 1.5, 0.1, float(2**40),
+            "", "plain", "héllo \U0001F600", b"\x00\xff\x10",
+            {"a": 1, "b": [None, {"c": "d"}]}, [1, [2, [3]]],
+        ]
+        recs = [
+            ItemRecord(client=7, clock=i, parent_root="m", key=f"k{i}",
+                       content=v)
+            for i, v in enumerate(vals)
+        ]
+        blob = v1.encode_update(recs, None)
+        assert_matches_python([blob])
+
+    def test_string_runs_with_surrogates(self):
+        e = Encoder()
+        e.write_var_uint(1)
+        e.write_var_uint(1)
+        e.write_var_uint(9)
+        e.write_var_uint(0)
+        e.write_uint8(v1.REF_STRING)
+        e.write_var_uint(1)
+        e.write_var_string("t")
+        e.write_var_string("a\U0001F600bé")
+        e.write_var_uint(0)
+        assert_matches_python([e.to_bytes()])
+
+    def test_gc_skip_structs(self):
+        e = Encoder()
+        e.write_var_uint(1)
+        e.write_var_uint(3)
+        e.write_var_uint(5)
+        e.write_var_uint(0)
+        e.write_uint8(v1.REF_GC)
+        e.write_var_uint(3)
+        e.write_uint8(v1.REF_SKIP)
+        e.write_var_uint(4)
+        e.write_uint8(v1.REF_ANY | 0x20)
+        e.write_var_uint(1)
+        e.write_var_string("m")
+        e.write_var_string("k")
+        e.write_var_uint(1)
+        e.write_any("x")
+        e.write_var_uint(0)
+        assert_matches_python([e.to_bytes()])
+
+    def test_format_embed_doc_type(self):
+        e = Encoder()
+        e.write_var_uint(1)
+        e.write_var_uint(4)
+        e.write_var_uint(3)
+        e.write_var_uint(0)
+        # ContentType (YMap ref 1) under root
+        e.write_uint8(v1.REF_TYPE | 0x20)
+        e.write_var_uint(1)
+        e.write_var_string("root")
+        e.write_var_string("sub")
+        e.write_var_uint(1)
+        # ContentFormat chained
+        e.write_uint8(v1.REF_FORMAT | 0x80)
+        e.write_var_uint(3)
+        e.write_var_uint(0)
+        e.write_var_string("bold")
+        e.write_var_string("true")
+        # ContentEmbed chained
+        e.write_uint8(v1.REF_EMBED | 0x80)
+        e.write_var_uint(3)
+        e.write_var_uint(1)
+        e.write_var_string('{"img": "x.png"}')
+        # ContentDoc chained
+        e.write_uint8(v1.REF_DOC | 0x80)
+        e.write_var_uint(3)
+        e.write_var_uint(2)
+        e.write_var_string("guid-1")
+        e.write_any({"autoLoad": True})
+        e.write_var_uint(0)
+        assert_matches_python([e.to_bytes()])
+
+    def test_foreign_fixtures(self):
+        from tests.test_yjs_fixtures import (
+            FIX_ANY_EDGE,
+            FIX_MAP_SET,
+            FIX_NESTED,
+            FIX_TEXT_GC,
+        )
+
+        for blob in (FIX_MAP_SET, FIX_TEXT_GC, FIX_NESTED, FIX_ANY_EDGE):
+            assert_matches_python([blob])
+
+    def test_fuzz_engine_docs(self):
+        from tests.test_engine import _random_op
+
+        rng = random.Random(99)
+        for _ in range(5):
+            engines = [Engine(i + 1) for i in range(3)]
+            for _ in range(60):
+                _random_op(rng, rng.choice(engines), engines)
+            for e in engines:
+                for o in engines:
+                    if o is not e:
+                        v1.apply_update(e, v1.encode_state_as_update(o))
+            blob = v1.encode_state_as_update(engines[0])
+            assert_matches_python([blob])
+
+
+class TestMalformed:
+    def test_truncated(self):
+        with pytest.raises(ValueError):
+            native.decode_updates_columns([b"\x01"])
+
+    def test_trailing_bytes(self):
+        with pytest.raises(ValueError):
+            native.decode_updates_columns([b"\x00\x00\xff"])
+
+    def test_unknown_ref(self):
+        e = Encoder()
+        e.write_var_uint(1)
+        e.write_var_uint(1)
+        e.write_var_uint(1)
+        e.write_var_uint(0)
+        e.write_uint8(31)
+        with pytest.raises(ValueError):
+            native.decode_updates_columns([e.to_bytes()])
+
+    def test_empty_update(self):
+        dec = native.decode_updates_columns([b"\x00\x00"])
+        assert len(dec["client"]) == 0
+        assert native.encode_from_columns(dec) == b"\x00\x00"
+
+
+class TestKernelColumns:
+    def test_matches_records_to_columns(self):
+        from crdt_tpu.ops.merge import Interner, records_to_columns
+
+        def build(e):
+            e.map_set("m", "k1", 1)
+            e.map_set("m", "k2", 2)
+            e.seq_insert("l", 0, ["a", "b"])
+
+        blob = engine_blob(build)
+        dec = native.decode_updates_columns([blob])
+        cols = native.kernel_columns(dec)
+
+        recs = resolve_parents(v1.decode_update(blob)[0])
+        interner = Interner()
+        ref = records_to_columns(recs, interner, pad=len(recs))
+        # same interning order (first-appearance) -> identical columns
+        np.testing.assert_array_equal(cols["client"], ref["client"])
+        np.testing.assert_array_equal(cols["clock"], ref["clock"])
+        np.testing.assert_array_equal(
+            cols["parent_is_root"], ref["parent_is_root"]
+        )
+        np.testing.assert_array_equal(cols["parent_a"], ref["parent_a"])
+        np.testing.assert_array_equal(cols["key_id"], ref["key_id"])
+        np.testing.assert_array_equal(
+            cols["origin_client"], ref["origin_client"]
+        )
