@@ -315,7 +315,7 @@ def main():
     # permanently degrades later dispatches (demonstrated below), so the
     # clean kernel numbers and the N-scaling sweep run first.
     dec_w = decode_stage(blobs)
-    cols_w, _ = column_stage(dec_w)
+    cols_w, ds_w = column_stage(dec_w)
 
     sweep = {}
     for frac in (4, 2, 1):
@@ -422,8 +422,8 @@ def main():
     # degraded dispatches included.
     t = time.perf_counter()
     _, w_maps, w_seq = device_merge(cols_w)
-    device_gather(dec_w, column_stage(dec_w)[1], w_maps, w_seq)
-    del dec_w, cols_w, w_maps, w_seq
+    device_gather(dec_w, ds_w, w_maps, w_seq)
+    del dec_w, cols_w, ds_w, w_maps, w_seq
     log(f"warmup pass (compile + first D2H): {time.perf_counter() - t:.1f}s "
         "(untimed, one-time; jit cache persists across runs)")
 

@@ -546,13 +546,7 @@ class Crdt:
         """
         if not datas:
             return
-        all_records: List[Any] = []
-        all_ds = DeleteSet()
-        for data in datas:
-            records, ds = v1.decode_update(data)
-            all_records.extend(records)
-            for c, clk, length in ds.iter_all():
-                all_ds.add(c, clk, length)
+        all_records, all_ds = self._decode_batch(datas)
         if self.device_merge:
             from crdt_tpu.core.device_apply import apply_records_device
 
@@ -562,6 +556,32 @@ class Crdt:
         touched, touched_keys = self._touched_roots()
         self._refresh_cache(touched)  # + D3 backfill of new collections
         self._fire_observers(touched, touched_keys, origin)
+
+    @staticmethod
+    def _decode_batch(datas: Sequence[bytes]):
+        """Batch-decode updates, through the native C codec when the
+        toolchain allows (one C pass for the whole backlog — the
+        lib0/struct parsing that otherwise dominates log replays and
+        sync bursts), falling back to the pure-Python codec."""
+        try:
+            from crdt_tpu.codec import native
+
+            if native.available():
+                # ValueError (malformed update) propagates: same
+                # contract as the fallback below
+                return native.decoded_to_records(
+                    native.decode_updates_columns(datas)
+                )
+        except RuntimeError:
+            pass  # toolchain raced away mid-call: fall back
+        all_records: List[Any] = []
+        all_ds = DeleteSet()
+        for data in datas:
+            records, ds = v1.decode_update(data)
+            all_records.extend(records)
+            for c, clk, length in ds.iter_all():
+                all_ds.add(c, clk, length)
+        return all_records, all_ds
 
     # ------------------------------------------------------------------
     # observers (crdt.js:620-657)

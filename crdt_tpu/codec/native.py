@@ -30,7 +30,6 @@ import numpy as np
 
 from crdt_tpu.core.ids import DeleteSet
 from crdt_tpu.core.records import ItemRecord
-from crdt_tpu.core.store import K_GC
 
 _REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 _SRC = _REPO_ROOT / "native" / "codec" / "v1codec.cc"
@@ -108,7 +107,9 @@ def decode_updates_columns(blobs: Sequence[bytes]) -> Dict:
     interning tables ``roots``/``keys``, and ``ds`` — flat
     (client, clock, length) triples.
     """
-    return _load().decode_updates(list(blobs))
+    # bytes() normalization: the C pass takes exact bytes; callers may
+    # hand bytearray/memoryview (the Python fallback accepts them too)
+    return _load().decode_updates([bytes(b) for b in blobs])
 
 
 def ds_from_triples(triples: np.ndarray) -> DeleteSet:
@@ -120,15 +121,25 @@ def ds_from_triples(triples: np.ndarray) -> DeleteSet:
 
 
 def kernel_columns(dec: Dict) -> Dict[str, np.ndarray]:
-    """Kernel-facing columns (crdt_tpu.ops.merge layout) from a decode."""
+    """Kernel-facing columns (crdt_tpu.ops.merge layout) from a decode.
+
+    Matches ``records_to_columns`` exactly, including the -2 sentinel
+    for rows with NO parent at all (unresolvable origins) — the kernels
+    segment on parent_a/parent_b, so the sentinel must agree."""
     pr = dec["parent_root"]
+    pc, pk = dec["parent_client"], dec["parent_clock"]
     root = pr >= 0
+    item = (~root) & (pc >= 0)
     return {
         "client": dec["client"],
         "clock": dec["clock"],
         "parent_is_root": root,
-        "parent_a": np.where(root, pr.astype(np.int64), dec["parent_client"]),
-        "parent_b": np.where(root, np.int64(-1), dec["parent_clock"]),
+        "parent_a": np.where(
+            root, pr.astype(np.int64), np.where(item, pc, np.int64(-2))
+        ),
+        "parent_b": np.where(
+            root, np.int64(-1), np.where(item, pk, np.int64(-2))
+        ),
         "key_id": dec["key_id"],
         "origin_client": dec["origin_client"],
         "origin_clock": dec["origin_clock"],

@@ -261,3 +261,46 @@ class TestKernelColumns:
         np.testing.assert_array_equal(
             cols["origin_client"], ref["origin_client"]
         )
+
+    def test_bytearray_and_memoryview_inputs(self):
+        e = Engine(1)
+        e.map_set("m", "k", 1)
+        blob = v1.encode_state_as_update(e)
+        for wrap in (bytearray, memoryview):
+            dec = native.decode_updates_columns([wrap(blob)])
+            assert len(dec["client"]) == 1
+
+    def test_float_out_of_f32_range(self):
+        """1e300 is a legal f64 payload; both codecs must encode it
+        (the Python oracle's f32 probe used to OverflowError)."""
+        recs = [ItemRecord(client=1, clock=0, parent_root="m", key="k",
+                           content=[1e300, -1e300, 1.5])]
+        blob = v1.encode_update(recs, None)
+        assert_matches_python([blob])
+
+    def test_unresolvable_parent_keeps_merge_sentinels(self):
+        """Rows whose origin lies outside the batch have NO parent;
+        kernel_columns must emit the same -2 sentinels as
+        records_to_columns or segment keys diverge."""
+        from crdt_tpu.codec.lib0 import Encoder as E0
+        from crdt_tpu.ops.merge import Interner, records_to_columns
+
+        e = E0()
+        e.write_var_uint(1)
+        e.write_var_uint(1)
+        e.write_var_uint(9)
+        e.write_var_uint(5)
+        e.write_uint8(v1.REF_ANY | 0x80)  # origin present, outside batch
+        e.write_var_uint(3)               # origin (3, 7) — unknown
+        e.write_var_uint(7)
+        e.write_var_uint(1)
+        e.write_any("orphan")
+        e.write_var_uint(0)
+        blob = e.to_bytes()
+        dec = native.decode_updates_columns([blob])
+        cols = native.kernel_columns(dec)
+        recs = resolve_parents(v1.decode_update(blob)[0])
+        ref = records_to_columns(recs, Interner(), pad=len(recs))
+        np.testing.assert_array_equal(cols["parent_a"], ref["parent_a"])
+        np.testing.assert_array_equal(cols["parent_b"], ref["parent_b"])
+        assert cols["parent_a"][0] == -2 and cols["parent_b"][0] == -2
