@@ -130,11 +130,14 @@ class Encoder:
                 # lib0 bigint is a fixed 8-byte field; larger cannot be represented
                 raise TypeError(f"integer {v} out of lib0 bigint (int64) range")
         elif isinstance(v, float):
-            if math.isfinite(v) and abs(v) <= 3.4028235677973366e38:
-                # inside float32 range: use f32 when exact (out-of-range
-                # floats must not OverflowError out of the probe — they
-                # are legal f64 payloads)
-                f32 = struct.unpack(">f", struct.pack(">f", v))[0]
+            if math.isfinite(v):
+                # use f32 when exactly representable; values at/above
+                # the f32 rounding boundary are legal f64 payloads and
+                # must not OverflowError out of the probe
+                try:
+                    f32 = struct.unpack(">f", struct.pack(">f", v))[0]
+                except (OverflowError, struct.error):
+                    f32 = None
                 if f32 == v:
                     self.write_uint8(124)
                     self.write_float32(v)

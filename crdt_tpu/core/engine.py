@@ -308,38 +308,60 @@ class Engine:
         *,
         chain_integrate: bool,
     ) -> None:
-        """Shared admission loop for both merge paths: (client, clock)-
-        sorted causal retry with pending stash, then delete-set
-        application. ``chain_integrate=False`` is the device path's
-        admit-only mode (chains are rebuilt by kernels afterwards);
-        keeping one loop guarantees both modes share identical
-        admission/pending semantics."""
+        """Shared admission loop for both merge paths, O(n + deps):
+        records that cannot integrate yet are parked on their first
+        missing dependency (a clock gap parks on (client, clock-1);
+        a missing origin/right/parent parks on that id) and woken the
+        moment it lands — no quadratic re-scan passes over the batch
+        (the r1 engine retried the whole remainder per round).
+        ``chain_integrate=False`` is the device path's admit-only mode
+        (chains are rebuilt by kernels afterwards); one loop keeps both
+        modes' admission/pending semantics identical. Ends with the
+        delete-set application, like ``Y.applyUpdate``."""
+        from collections import deque
+
         self.begin_txn()
         if chain_integrate:
             step = self._try_integrate
         else:
             step = lambda rec: self._try_admit(rec)[0]  # noqa: E731
-        work = list(records)
-        work.sort(key=lambda r: (r.client, r.clock))
-        progress = True
-        while progress:
-            progress = False
-            still = []
-            for rec in work:
-                if step(rec):
-                    progress = True
+        queue = deque(
+            sorted(records + self.pending, key=lambda r: (r.client, r.clock))
+        )
+        self.pending = []
+        waiting: Dict[Tuple[int, int], List[ItemRecord]] = {}
+        while queue:
+            rec = queue.popleft()
+            if step(rec):
+                # anything parked on this id (contiguity waiters key on
+                # (client, clock); dep waiters key on the dep id) can go
+                woken = waiting.pop(rec.id, None)
+                if woken:
+                    queue.extend(woken)
+            else:
+                blocker = self._blocker_of(rec)
+                if blocker is None:
+                    # cannot happen for well-formed records (not-handled
+                    # implies a gap or a missing dep); park defensively
+                    self.pending.append(rec)
                 else:
-                    still.append(rec)
-            work = still
-            if progress and self.pending:
-                # retry previously stashed records too
-                work.extend(self.pending)
-                self.pending = []
-                work.sort(key=lambda r: (r.client, r.clock))
-        self.pending.extend(work)
+                    waiting.setdefault(blocker, []).append(rec)
+        for recs in waiting.values():
+            self.pending.extend(recs)
         if delete_set is not None:
             self._apply_delete_set(delete_set)
         self._retry_pending_deletes()
+
+    def _blocker_of(self, rec: ItemRecord) -> Optional[Tuple[int, int]]:
+        """The first id this record is waiting on: the previous clock
+        of its own client (contiguity), else a missing dependency."""
+        nc = self._next_clock.get(rec.client, 0)
+        if rec.clock > nc:
+            return (rec.client, rec.clock - 1)
+        for dep in rec.dep_ids():
+            if not self.store.has(*dep):
+                return dep
+        return None
 
     def begin_txn(self) -> None:
         self.last_txn_items = []

@@ -51,6 +51,35 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = REPLICA_AXIS) -> Mesh
     return Mesh(np.asarray(devs), (axis,))
 
 
+HOST_AXIS = "hosts"
+
+
+def make_mesh2d(n_hosts: int, devices_per_host: int) -> Mesh:
+    """2D (hosts, replicas) mesh — the multi-host topology. Collectives
+    over the inner axis ride ICI within each host's slice; collectives
+    over the outer axis cross DCN. On a single-process test rig the
+    same mesh shape runs on virtual devices; on a real multi-host pod
+    jax.devices() spans processes and the axis split maps onto the
+    physical fabric."""
+    devs = jax.devices()
+    need = n_hosts * devices_per_host
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    if jax.process_count() > 1 and devices_per_host != jax.local_device_count():
+        # the inner axis must stay inside one process, or every "ICI"
+        # collective silently crosses DCN and the two-tier rationale
+        # inverts
+        raise ValueError(
+            f"devices_per_host={devices_per_host} must equal "
+            f"local_device_count()={jax.local_device_count()} on a "
+            "multi-process pod"
+        )
+    return Mesh(
+        np.asarray(devs[:need]).reshape(n_hosts, devices_per_host),
+        (HOST_AXIS, REPLICA_AXIS),
+    )
+
+
 def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
     """Build the jitted full gossip+merge step for `mesh`.
 
@@ -182,6 +211,63 @@ def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
             seq_rank,
             seq_len,
         )
+
+    return jax.jit(step)
+
+
+def make_hierarchical_gossip_step(mesh: Mesh, num_segments: int,
+                                  num_clients: int):
+    """Two-tier gossip over a (hosts, replicas) mesh: fan-in happens as
+    an all-gather over the intra-host replica axis (ICI) followed by an
+    all-gather over the host axis (DCN) — the reference's full-mesh
+    swarm mapped onto a pod's physical fabric instead of one flat
+    collective. Outputs match :func:`make_gossip_step` on the same
+    flattened columns (differential-tested in tests/test_parallel.py).
+
+    Step inputs: [R, N] columns with R sharded over (hosts, replicas);
+    replicated delete ranges. Outputs as in :func:`make_gossip_step`.
+    """
+    host, rep = mesh.axis_names
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P((host, rep), None),) * 9 + (P(), P(), P()),
+        out_specs=(P((host, rep), None),) + (P(),) * 8,
+        check_vma=False,
+    )
+    def step(
+        client, clock, parent_is_root, parent_a, parent_b, key_id,
+        origin_client, origin_clock, valid, d_client, d_start, d_end,
+    ):
+        sv_local = jax.vmap(
+            lambda c, k, v: statevec.build(c, k, v, num_clients)
+        )(client, clock, valid)
+
+        def gather2(x):
+            # ICI first (cheap, wide), then DCN (few, slow links carry
+            # each host's already-combined slice exactly once)
+            x = jax.lax.all_gather(x, rep)
+            x = jax.lax.all_gather(x, host)
+            return x.reshape(-1, *x.shape[3:])
+
+        svs = gather2(sv_local)  # [R, num_clients]
+        global_sv = statevec.merge(svs)
+        deficit = statevec.missing(svs)
+
+        union = [
+            gather2(x).reshape(-1)
+            for x in (client, clock, parent_is_root, parent_a, parent_b,
+                      key_id, origin_client, origin_clock, valid)
+        ]
+        _, _, winners, winner_visible, _, _ = converge_maps(
+            *union, d_client, d_start, d_end, num_segments=num_segments
+        )
+        seq_order, seq_seg, seq_rank, seq_len = converge_sequences(
+            *union, num_segments=num_segments
+        )
+        return (sv_local, global_sv, deficit, winners, winner_visible,
+                seq_order, seq_seg, seq_rank, seq_len)
 
     return jax.jit(step)
 

@@ -269,3 +269,62 @@ def test_records_since_unknown_client_and_empty_sv():
     assert len(a.records_since(StateVector({99: 10}))) == 3
     # covered prefix excluded
     assert len(a.records_since(StateVector({1: 2}))) == 1
+
+
+def test_admission_is_linear_not_quadratic():
+    """Reverse-ordered delivery of a long dependency chain must park
+    each record once and wake it once — not re-scan the remainder per
+    round (r1's integrate loop was O(n^2) here)."""
+    from crdt_tpu.core.records import ItemRecord
+
+    n = 2000
+    recs = [
+        ItemRecord(client=1, clock=k, parent_root="s",
+                   origin=(1, k - 1) if k else None, content=k)
+        for k in range(n)
+    ]
+    recs_rev = list(reversed(recs))
+
+    calls = []
+    orig = Engine._try_admit
+
+    def counting(self, rec):
+        calls.append(rec.clock)
+        return orig(self, rec)
+
+    e = Engine(9)
+    Engine._try_admit = counting
+    try:
+        e.apply_records(recs_rev)
+    finally:
+        Engine._try_admit = orig
+    assert not e.pending
+    assert e.seq_json("s") == list(range(n))
+    # each record attempts once while blocked + once on wake: <= 2n
+    assert len(calls) <= 2 * n + 10, f"{len(calls)} attempts for {n} records"
+
+
+def test_admission_wakes_cross_client_chains():
+    """Dependencies across clients in adversarial order still converge
+    through the wake list, and true orphans stay pending."""
+    from crdt_tpu.core.records import ItemRecord
+
+    a = ItemRecord(client=1, clock=0, parent_root="s", content="a")
+    b = ItemRecord(client=2, clock=0, parent_root="s", origin=(1, 0),
+                   content="b")
+    c = ItemRecord(client=3, clock=0, parent_root="s", origin=(2, 0),
+                   content="c")
+    orphan = ItemRecord(client=4, clock=0, parent_root="s", origin=(9, 9),
+                        content="x")
+    e = Engine(8)
+    e.apply_records([orphan, c, b, a])
+    assert e.seq_json("s") == ["a", "b", "c"]
+    assert [r.client for r in e.pending] == [4]
+    # the missing dep arriving later frees the orphan
+    e.apply_records([ItemRecord(client=9, clock=0, parent_root="s",
+                                content="dep")
+                     ] + [ItemRecord(client=9, clock=k, parent_root="s",
+                                     origin=(9, k - 1), content=k)
+                          for k in range(1, 10)])
+    assert not e.pending
+    assert "x" in e.seq_json("s")

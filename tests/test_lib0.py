@@ -157,3 +157,15 @@ def test_truncated_buffers_raise():
                 Decoder(wire[:cut]).read_any()
             except Exception as ex:
                 raise ValueError(str(ex)) from ex
+
+
+def test_any_float_boundary_values():
+    """Floats at/above the f32 rounding boundary are legal f64 payloads
+    (the old f32 probe let struct's OverflowError escape)."""
+    from crdt_tpu.codec.lib0 import Decoder, Encoder
+
+    for v in (3.4028235677973366e38, -3.4028235677973366e38, 1e300,
+              3.4028234663852886e38):  # last = exact float32 max
+        e = Encoder()
+        e.write_any(v)
+        assert Decoder(e.to_bytes()).read_any() == v

@@ -146,3 +146,35 @@ def test_gossip_sequences_match_engine_oracle(mesh):
     assert len(dev_orders) == num_lists
     for lid, ids in dev_orders.items():
         assert oracle[("root", f"l{lid}")] == ids, f"list {lid} diverges"
+
+
+def test_hierarchical_2d_mesh_matches_flat_gossip():
+    """The (hosts, replicas) two-tier fan-in (ICI all-gather then DCN
+    all-gather) must produce exactly the flat 1D step's outputs on the
+    same columns — the multi-host mapping changes the fabric, not the
+    CRDT result."""
+    from crdt_tpu.parallel.gossip import (
+        make_hierarchical_gossip_step,
+        make_mesh2d,
+    )
+
+    R, N = 16, 24
+    cols, dels = synth_columns(R, N, num_maps=2, keys_per_map=8,
+                               num_lists=2, seed=21)
+    flat = run_step(make_mesh(8), cols, dels, 256, R + 2)
+
+    mesh2d = make_mesh2d(n_hosts=2, devices_per_host=4)
+    step2d = make_hierarchical_gossip_step(mesh2d, num_segments=256,
+                                           num_clients=R + 2)
+    args = [jnp.asarray(cols[k]) for k in (
+        "client", "clock", "parent_is_root", "parent_a", "parent_b",
+        "key_id", "origin_client", "origin_clock", "valid",
+    )] + [jnp.asarray(d) for d in dels]
+    hier = [np.asarray(x) for x in step2d(*args)]
+
+    for name, a, b in zip(
+        ("sv_local", "global_sv", "deficit", "winners", "winner_visible",
+         "seq_order", "seq_seg", "seq_rank", "seq_len"),
+        flat, hier,
+    ):
+        np.testing.assert_array_equal(a, b, err_msg=f"{name} diverges")
