@@ -209,7 +209,7 @@ class Crdt:
         new_records = eng.records_for_rows(eng.last_txn_items)
         txn_deletes = eng.last_txn_deletes
         touched, touched_keys = self._touched_roots()
-        self._refresh_cache(touched)
+        self._refresh_cache(touched, touched_keys)
         update = None
         emitting = propagate and self.on_update is not None and origin == "local"
         if (new_records or txn_deletes.ranges) and (emitting or want_update):
@@ -264,7 +264,11 @@ class Crdt:
             row = s.find(int(s.parent_client[row]), int(s.parent_clock[row]))
         return None, None
 
-    def _refresh_cache(self, roots: Sequence[str]) -> None:
+    def _refresh_cache(
+        self,
+        roots: Sequence[str],
+        touched_keys: Optional[Dict[str, set]] = None,
+    ) -> None:
         eng = self.engine
         for name in roots:
             if name == "ix":
@@ -276,7 +280,29 @@ class Crdt:
             if kind == "array":
                 self._c[name] = copy.deepcopy(eng.seq_json(name))
             elif kind == "map":
-                self._c[name] = copy.deepcopy(eng.map_json(name))
+                keys = (touched_keys or {}).get(name)
+                cur = self._c.get(name)
+                if keys is None or None in keys or not isinstance(cur, dict):
+                    # unknown per-key delta (or first materialization):
+                    # full rebuild
+                    self._c[name] = copy.deepcopy(eng.map_json(name))
+                    continue
+                # per-key incremental refresh: O(changed keys), not
+                # O(map) — r1 deep-copied whole collections per txn.
+                # Rebound (not mutated): stored observer events hold
+                # the previous snapshot dict. Like the reference's
+                # SHALLOW Object.freeze({...c}) (crdt.js:668-670),
+                # snapshots are isolated from CRDT-driven change, not
+                # from callers mutating nested values — cache values
+                # are read-only by contract (and unchanged keys were
+                # always shared across snapshots for untouched roots)
+                new = dict(cur)
+                for k in keys:
+                    if eng.map_has(name, k):
+                        new[k] = copy.deepcopy(eng.map_get(name, k))
+                    else:
+                        new.pop(k, None)
+                self._c[name] = new
         # D3 fix: collections created remotely get cache entries too.
         # New collections only appear when the txn touched the index
         # map or integrated items under a new root, so the O(known)
@@ -554,7 +580,7 @@ class Crdt:
         else:
             self.engine.apply_records(all_records, all_ds)  # own txn
         touched, touched_keys = self._touched_roots()
-        self._refresh_cache(touched)  # + D3 backfill of new collections
+        self._refresh_cache(touched, touched_keys)  # + D3 backfill
         self._fire_observers(touched, touched_keys, origin)
 
     @staticmethod

@@ -33,6 +33,7 @@ Semantics implemented (faithful to the YATA/Yjs behavior):
 from __future__ import annotations
 
 import copy
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from crdt_tpu.core.ids import DeleteSet, StateVector
@@ -318,8 +319,6 @@ class Engine:
         (chains are rebuilt by kernels afterwards); one loop keeps both
         modes' admission/pending semantics identical. Ends with the
         delete-set application, like ``Y.applyUpdate``."""
-        from collections import deque
-
         self.begin_txn()
         if chain_integrate:
             step = self._try_integrate
@@ -330,22 +329,31 @@ class Engine:
         )
         self.pending = []
         waiting: Dict[Tuple[int, int], List[ItemRecord]] = {}
-        while queue:
-            rec = queue.popleft()
-            if step(rec):
-                # anything parked on this id (contiguity waiters key on
-                # (client, clock); dep waiters key on the dep id) can go
-                woken = waiting.pop(rec.id, None)
-                if woken:
-                    queue.extend(woken)
-            else:
-                blocker = self._blocker_of(rec)
-                if blocker is None:
-                    # cannot happen for well-formed records (not-handled
-                    # implies a gap or a missing dep); park defensively
-                    self.pending.append(rec)
+        try:
+            while queue:
+                rec = queue.popleft()
+                if step(rec):
+                    # anything parked on this id (contiguity waiters key
+                    # on (client, clock); dep waiters on the dep id)
+                    woken = waiting.pop(rec.id, None)
+                    if woken:
+                        queue.extend(woken)
                 else:
-                    waiting.setdefault(blocker, []).append(rec)
+                    blocker = self._blocker_of(rec)
+                    if blocker is None:
+                        # cannot happen for well-formed records (not-
+                        # handled implies a gap or a missing dep)
+                        self.pending.append(rec)
+                    else:
+                        waiting.setdefault(blocker, []).append(rec)
+        except BaseException:
+            # a malformed record mid-batch must not wipe the stash:
+            # everything not yet integrated (queued, parked, and prior
+            # pending, which the queue absorbed) returns to pending
+            self.pending.extend(queue)
+            for recs in waiting.values():
+                self.pending.extend(recs)
+            raise
         for recs in waiting.values():
             self.pending.extend(recs)
         if delete_set is not None:
@@ -579,6 +587,16 @@ class Engine:
         if tail is None or self.store.deleted[tail]:
             return None
         return self._value_of_row(tail)
+
+    def map_has(self, name: str, key: str) -> bool:
+        """Whether the key has a VISIBLE entry — distinguishes a stored
+        None value from an absent/tombstoned key (map_get can't)."""
+        rid = self.store.root_id(name)
+        kid = self.store.key_id_of(key)
+        if rid is None or kid is None:
+            return False
+        tail = self._map_tail.get((("root", rid), kid))
+        return tail is not None and not bool(self.store.deleted[tail])
 
     def map_entry_spec(self, name: str, key: str) -> Optional[ParentSpec]:
         """Parent spec of the visible nested type under (name, key)."""

@@ -423,3 +423,47 @@ class TestTxnSafety:
         d.set("m", "list", "a", array_method="push")
         d.set("m", "list", "b", array_method="push")
         assert [e["value"] for e in seen] == [["a"], ["a", "b"]]
+
+
+def test_map_cache_refresh_is_per_key():
+    """A 1-key txn on a big map must not re-materialize the whole map
+    (r1 deep-copied every touched collection per txn)."""
+    from crdt_tpu.api.doc import Crdt
+    from crdt_tpu.core.engine import Engine
+
+    doc = Crdt(1)
+    for i in range(2000):
+        doc.set("big", f"k{i}", {"v": i})
+    calls = []
+    orig = Engine.map_json
+
+    def counting(self, name):
+        calls.append(name)
+        return orig(self, name)
+
+    Engine.map_json = counting
+    try:
+        doc.set("big", "k7", "updated")
+        doc.delete("big", "k9")
+    finally:
+        Engine.map_json = orig
+    # per-key refresh: the 2000-key map is never re-materialized
+    # ("ix" lookups via map_get are fine; map_json("big") is the smell)
+    assert "big" not in calls, calls
+    assert doc.c["big"]["k7"] == "updated"
+    assert "k9" not in doc.c["big"]
+    assert len(doc.c["big"]) == 1999
+
+
+def test_cache_snapshots_stay_immutable_across_per_key_refresh():
+    """Observer events hold the pre-txn snapshot; the per-key refresh
+    must rebind, not mutate."""
+    from crdt_tpu.api.doc import Crdt
+
+    events = []
+    doc = Crdt(1, observer_function=events.append)
+    doc.set("m", "a", 1)
+    snap_after_first = events[-1]["c"]
+    doc.set("m", "b", 2)
+    assert dict(snap_after_first["m"]) == {"a": 1}  # unchanged snapshot
+    assert dict(doc.c["m"]) == {"a": 1, "b": 2}

@@ -328,3 +328,27 @@ def test_admission_wakes_cross_client_chains():
                           for k in range(1, 10)])
     assert not e.pending
     assert "x" in e.seq_json("s")
+
+
+def test_malformed_record_mid_batch_preserves_pending():
+    """A record that raises during admission must not wipe previously
+    stashed pending records or the rest of the batch."""
+    from crdt_tpu.core.records import ItemRecord
+
+    e = Engine(5)
+    # stash: waits on (9, 0) which never arrived
+    e.apply_records([ItemRecord(client=9, clock=1, parent_root="s",
+                                origin=(9, 0), content="stashed")])
+    assert len(e.pending) == 1
+    # malformed: no parent, no origin (decoder could never produce it,
+    # but a buggy caller can) raises inside _try_admit
+    bad = ItemRecord(client=2, clock=0, content="bad")
+    good = ItemRecord(client=3, clock=1, parent_root="s", origin=(3, 0),
+                      content="also-waiting")
+    import pytest as _pytest
+
+    with _pytest.raises(AssertionError):
+        e.apply_records([bad, good])
+    ids = {r.id for r in e.pending}
+    assert (9, 1) in ids, "prior stash wiped"
+    assert (3, 1) in ids, "rest of batch wiped"
