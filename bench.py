@@ -107,71 +107,15 @@ def build_trace(R: int, K: int, seed: int = 0):
 # ---------------------------------------------------------------------------
 
 
-def decode_stage(blobs):
-    """Wire -> columnar union in one pass (native C codec when built,
-    pure Python otherwise) — decode, run splitting, interning, and
-    implicit-parent resolution together."""
-    from crdt_tpu.codec import native
+# The pipeline stages ARE the product's replay API: bench times
+# crdt_tpu.models.replay, not a private copy (see that module's doc).
+from crdt_tpu.models import replay as rp
 
-    dec = native.decode_updates_columns_any(blobs)
-    return dec
-
-
-def column_stage(dec):
-    """Kernel-facing columns + merged delete set from the union."""
-    from crdt_tpu.codec import native
-
-    cols = native.kernel_columns(dec)
-    ds = native.ds_from_triples(dec["ds"])
-    return cols, ds
-
-
-def materialize_stage(dec, ds, win_rows, win_visible, seq_orders):
-    """Winner rows + sequence orders -> the plain-JSON cache (crdt.c).
-    Tombstoned sequence items (delete-set members) are dropped, like
-    the engine's visible walk."""
-    roots, keys = dec["roots"], dec["keys"]
-    pr, kid = dec["parent_root"], dec["key_id"]
-    client, clock = dec["client"], dec["clock"]
-    contents = dec["contents"]
-    cache: dict = {}
-    for row, vis in zip(win_rows, win_visible):
-        if not vis:
-            continue
-        cache.setdefault(roots[pr[row]], {})[keys[kid[row]]] = contents[row]
-    for root, rows in seq_orders.items():
-        cache[root] = [
-            contents[r]
-            for r in rows
-            if not ds.contains(int(client[r]), int(clock[r]))
-        ]
-    return cache
-
-
-def compact_stage(dec, ds):
-    """Snapshot compaction: squash the replayed log into one blob
-    (native encoder when built)."""
-    from crdt_tpu.codec import native
-
-    return native.encode_from_columns_any(dec, ds)
-
-
-def visible_mask(dec, rows, ds):
-    """Tombstone visibility for winner rows (vectorized, shared by
-    both contenders so the comparison stays apples-to-apples)."""
-    if not rows:
-        return []
-    rows = np.asarray(rows)
-    pack = (dec["client"][rows] << 40) | dec["clock"][rows]
-    del_pack = np.asarray(
-        [
-            (c << 40) | k
-            for c, s, length in ds.iter_all()
-            for k in range(s, s + length)
-        ],
-        np.int64,
-    )
-    return list(~np.isin(pack, del_pack))
+decode_stage = rp.decode
+column_stage = rp.stage
+materialize_stage = rp.materialize
+compact_stage = rp.compact
+visible_mask = rp.visible_mask
 
 
 # ---------------------------------------------------------------------------
@@ -355,64 +299,9 @@ def main():
 
     # ================= DEVICE PATH (end to end) ========================
     def device_merge(cols):
-        rc = ResidentColumns(capacity=len(cols["client"]),
-                             clients=range(1, R + 1))
-        # one append: a log replay is one batched delta (incremental
-        # gossip rounds are exercised by tests/test_resident.py; on
-        # this tunnelled platform every dispatch in the post-D2H state
-        # costs ~0.15s, so the replay avoids gratuitous round-trips)
-        rc.append(cols)
-        # tight segment bound: distinct (map, key) pairs + sequence
-        # roots, bucketed (the default — buffer capacity — doubles the
-        # ranking kernel's working set for nothing)
-        n_segs = len(np.unique(
-            (cols["parent_a"] << 21)
-            | np.where(cols["key_id"] >= 0, cols["key_id"], 1 << 20)
-        ))
-        from crdt_tpu.ops.device import bucket_pow2
+        return rp.converge(cols, clients=range(1, R + 1))
 
-        maps_out, seq_out = rc.converge(
-            num_segments=bucket_pow2(n_segs)
-        )
-        jax.block_until_ready(maps_out)
-        jax.block_until_ready(seq_out)
-        return rc, maps_out, seq_out
-
-    # the winner/order outputs come back in ONE packed int32 transfer:
-    # per-array fetches pay the tunnel's first-transfer stall many
-    # times over (all indices < capacity, so int32 is lossless)
-    pack_fn = jax.jit(lambda a, b, c, d, e: jnp.concatenate([
-        a.astype(jnp.int32), b.astype(jnp.int32), c.astype(jnp.int32),
-        d.astype(jnp.int32), e.astype(jnp.int32),
-    ]))
-
-    def device_gather(dec, ds, maps_out, seq_out):
-        packed = pack_fn(maps_out[0], maps_out[2], seq_out[0],
-                         seq_out[1], seq_out[2])
-        h = np.asarray(packed)  # ONE transfer
-        cap = maps_out[0].shape[0]
-        nseg = maps_out[2].shape[0]
-        order = h[:cap]
-        winners = h[cap:cap + nseg]
-        sorder = h[cap + nseg:2 * cap + nseg]
-        sseg = h[2 * cap + nseg:3 * cap + nseg]
-        srank = h[3 * cap + nseg:]
-        win_rows = [int(order[w]) for w in winners if w >= 0]
-        win_vis = visible_mask(dec, win_rows, ds)
-        n = len(dec["client"])
-        seq_pairs: dict = {}
-        for p in np.flatnonzero(srank >= 0):
-            row = int(sorder[p])
-            if row < n:
-                seq_pairs.setdefault(int(sseg[p]), []).append(
-                    (int(srank[p]), row)
-                )
-        seq_orders = {}
-        for sid, pairs in seq_pairs.items():
-            pairs.sort()
-            rows = [r for _, r in pairs]
-            seq_orders[dec["roots"][dec["parent_root"][rows[0]]]] = rows
-        return win_rows, win_vis, seq_orders
+    device_gather = rp.gather
 
     # warmup pass: compiles every e2e shape bucket AND performs the
     # first device->host transfer (a one-time channel-setup cost on
@@ -450,11 +339,11 @@ def main():
     )
 
     def np_gather():
-        roots2, pr2 = dec2["roots"], dec2["parent_root"]
-        root_of_seg = {}
+        spec_of_seg = {}
         for i in np.flatnonzero(np_seg >= 0):
-            root_of_seg.setdefault(int(np_seg[i]), roots2[pr2[i]])
-        orders = seq_orders_from_ranks(np_seg, np_rank, root_of_seg)
+            spec_of_seg.setdefault(int(np_seg[i]),
+                                   rp._parent_spec(dec2, int(i)))
+        orders = seq_orders_from_ranks(np_seg, np_rank, spec_of_seg)
         vis = visible_mask(dec2, list(np_win), ds2)
         return orders, vis
 
@@ -507,13 +396,11 @@ def main():
                 (int(dec["client"][row]), int(dec["clock"][row])), vis)
         mismatch = sum(1 for kk, vv in wt.items() if got.get(kk) != vv)
         assert mismatch == 0, f"{mismatch}/{len(wt)} winners diverge"
-        want_orders = {
-            p[1]: ids for p, ids in eng.seq_order_table().items()
-        }
+        want_orders = eng.seq_order_table()  # keyed by parent spec
         got_orders = {
-            root: [(int(dec["client"][r]), int(dec["clock"][r]))
+            spec: [(int(dec["client"][r]), int(dec["clock"][r]))
                    for r in rows]
-            for root, rows in seq_orders.items()
+            for spec, rows in seq_orders.items()
         }
         assert got_orders == want_orders, "sequence order diverges"
         log(f"correctness vs oracle: {len(wt)} map keys, "

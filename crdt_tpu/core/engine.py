@@ -346,10 +346,16 @@ class Engine:
                         self.pending.append(rec)
                     else:
                         waiting.setdefault(blocker, []).append(rec)
-        except BaseException:
-            # a malformed record mid-batch must not wipe the stash:
-            # everything not yet integrated (queued, parked, and prior
-            # pending, which the queue absorbed) returns to pending
+        except BaseException as e:
+            # an exception mid-batch must not wipe the stash: the
+            # queue, parked waiters, and prior pending (absorbed into
+            # the queue) return to pending. The in-flight record is
+            # kept only for non-Exception interrupts (KeyboardInterrupt
+            # etc. — it was presumably valid); a record that RAISED a
+            # regular Exception is malformed and re-queueing it would
+            # poison every later batch.
+            if not isinstance(e, Exception):
+                self.pending.append(rec)
             self.pending.extend(queue)
             for recs in waiting.values():
                 self.pending.extend(recs)

@@ -1,3 +1,4 @@
 from crdt_tpu.models.fleet import FleetStep, ReplicaFleet
+from crdt_tpu.models.replay import ReplayResult, replay_trace
 
-__all__ = ["FleetStep", "ReplicaFleet"]
+__all__ = ["FleetStep", "ReplicaFleet", "ReplayResult", "replay_trace"]

@@ -1,0 +1,228 @@
+"""Trace replay — BASELINE config #5 as a product API.
+
+``replay_trace(blobs)`` ingests a batch of v1 update blobs (a captured
+swarm trace, a persistence log, a sync backlog) through the firehose
+path end to end:
+
+  1. decode: one native-codec pass -> columnar union + contents
+     (:mod:`crdt_tpu.codec.native`, Python fallback included);
+  2. converge: HBM-resident union, one LWW map dispatch + one YATA
+     sequence dispatch (:class:`crdt_tpu.ops.resident.ResidentColumns`);
+  3. gather: winner/order indices return in ONE packed int32 transfer;
+  4. materialize: the plain-JSON ``crdt.c`` cache, tombstones applied;
+  5. compact: one snapshot blob (the log squashed — what a fresh
+     replica needs instead of the whole history).
+
+This is the library form of what ``bench.py`` measures; the benchmark
+imports these stages so the timed pipeline IS the product pipeline.
+Differential-tested against the scalar document path in
+tests/test_models.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from crdt_tpu.codec import native
+from crdt_tpu.core.ids import DeleteSet
+
+
+class ReplayResult(NamedTuple):
+    cache: dict        # converged plain-JSON state (crdt.c)
+    snapshot: bytes    # compacted single-blob log
+    n_ops: int         # unit items replayed
+
+
+def decode(blobs: Sequence[bytes]) -> Dict:
+    """Wire -> columnar union (native C codec when built)."""
+    return native.decode_updates_columns_any(blobs)
+
+
+def stage(dec: Dict) -> Tuple[Dict[str, np.ndarray], DeleteSet]:
+    """Kernel-facing columns + merged delete set."""
+    return native.kernel_columns(dec), native.ds_from_triples(dec["ds"])
+
+
+def converge(cols: Dict[str, np.ndarray], *,
+             clients: Optional[Sequence[int]] = None):
+    """One resident-union convergence: returns (resident, maps_out,
+    seq_out) with outputs still on device."""
+    import jax
+
+    from crdt_tpu.ops.device import bucket_pow2
+    from crdt_tpu.ops.resident import ResidentColumns
+
+    n = len(cols["client"])
+    rc = ResidentColumns(
+        capacity=n,
+        clients=clients if clients is not None
+        else np.unique(cols["client"][cols["valid"]]),
+    )
+    rc.append(cols)
+    # tight segment bound: distinct (map parent, key) pairs + sequence
+    # roots (the capacity default doubles the ranking kernel's span)
+    n_segs = len(np.unique(
+        (cols["parent_a"] << 21)
+        | np.where(cols["key_id"] >= 0, cols["key_id"], 1 << 20)
+    ))
+    maps_out, seq_out = rc.converge(num_segments=bucket_pow2(n_segs))
+    jax.block_until_ready(maps_out)
+    jax.block_until_ready(seq_out)
+    return rc, maps_out, seq_out
+
+
+def _parent_spec(dec: Dict, row: int) -> Tuple:
+    """("root", name) or ("item", client, clock) of a row's parent."""
+    pr = dec["parent_root"][row]
+    if pr >= 0:
+        return ("root", dec["roots"][pr])
+    return (
+        "item",
+        int(dec["parent_client"][row]),
+        int(dec["parent_clock"][row]),
+    )
+
+
+def _make_pack_fn():
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(lambda a, b, c, d, e: jnp.concatenate([
+        a.astype(jnp.int32), b.astype(jnp.int32), c.astype(jnp.int32),
+        d.astype(jnp.int32), e.astype(jnp.int32),
+    ]))
+
+
+_pack_fn = None  # built lazily, module-level so jit caches across calls
+
+
+def gather(dec: Dict, ds: DeleteSet, maps_out, seq_out):
+    """Winner rows + visibility + per-sequence document orders (keyed
+    by parent spec — root name or item id), via one packed int32
+    device->host transfer."""
+    global _pack_fn
+    if _pack_fn is None:
+        _pack_fn = _make_pack_fn()
+    packed = _pack_fn(maps_out[0], maps_out[2], seq_out[0], seq_out[1],
+                      seq_out[2])
+    h = np.asarray(packed)  # ONE transfer
+    cap = maps_out[0].shape[0]
+    nseg = maps_out[2].shape[0]
+    order = h[:cap]
+    winners = h[cap:cap + nseg]
+    sorder = h[cap + nseg:2 * cap + nseg]
+    sseg = h[2 * cap + nseg:3 * cap + nseg]
+    srank = h[3 * cap + nseg:]
+
+    win_rows = [int(order[w]) for w in winners if w >= 0]
+    win_vis = visible_mask(dec, win_rows, ds)
+    n = len(dec["client"])
+    seq_pairs: dict = {}
+    for p in np.flatnonzero(srank >= 0):
+        row = int(sorder[p])
+        if row < n:
+            seq_pairs.setdefault(int(sseg[p]), []).append(
+                (int(srank[p]), row)
+            )
+    seq_orders = {}
+    for sid, pairs in seq_pairs.items():
+        pairs.sort()
+        rows = [r for _, r in pairs]
+        seq_orders[_parent_spec(dec, rows[0])] = rows
+    return win_rows, win_vis, seq_orders
+
+
+def visible_mask(dec: Dict, rows: List[int], ds: DeleteSet) -> List[bool]:
+    """Tombstone visibility for specific rows (vectorized)."""
+    if not rows:
+        return []
+    idx = np.asarray(rows)
+    pack = (dec["client"][idx] << 40) | dec["clock"][idx]
+    del_pack = np.asarray(
+        [
+            (c << 40) | k
+            for c, s, length in ds.iter_all()
+            for k in range(s, s + length)
+        ],
+        np.int64,
+    )
+    return list(~np.isin(pack, del_pack))
+
+
+def materialize(dec: Dict, ds: DeleteSet, win_rows, win_vis,
+                seq_orders) -> dict:
+    """Winner rows + sequence orders -> the plain-JSON cache, with
+    tombstoned sequence members dropped (the engine's visible walk).
+    Nested collections (a Y.Array/Y.Map stored under a map key or a
+    sequence slot) materialize recursively through their type items."""
+    from crdt_tpu.core.store import K_TYPE, TYPE_MAP
+
+    keys = dec["keys"]
+    kid = dec["key_id"]
+    client, clock = dec["client"], dec["clock"]
+    kind_col, tref = dec["kind"], dec["type_ref"]
+    contents = dec["contents"]
+
+    # visible map winners grouped by their parent spec
+    map_groups: Dict[Tuple, Dict[str, int]] = {}
+    for row, vis in zip(win_rows, win_vis):
+        if not vis:
+            continue
+        map_groups.setdefault(_parent_spec(dec, row), {})[
+            keys[kid[row]]
+        ] = row
+
+    def value_of(row: int, depth: int):
+        if kind_col[row] == K_TYPE:
+            spec = ("item", int(client[row]), int(clock[row]))
+            is_map = tref[row] == TYPE_MAP
+            return collection(spec, is_map, depth + 1)
+        return contents[row]
+
+    def collection(spec: Tuple, is_map: bool, depth: int):
+        if depth > 64:
+            return None  # malformed cyclic nesting: cut, don't recurse
+        if is_map:
+            return {
+                k: value_of(r, depth)
+                for k, r in map_groups.get(spec, {}).items()
+            }
+        return [
+            value_of(r, depth)
+            for r in seq_orders.get(spec, ())
+            if not ds.contains(int(client[r]), int(clock[r]))
+        ]
+
+    cache: dict = {}
+    for spec in map_groups:
+        # the reserved collection-kind index stays internal, exactly
+        # as the document API's `c` hides it
+        if spec[0] == "root" and spec[1] != "ix":
+            cache[spec[1]] = collection(spec, True, 0)
+    for spec in seq_orders:
+        if spec[0] == "root" and spec[1] not in cache:
+            cache[spec[1]] = collection(spec, False, 0)
+    return cache
+
+
+def compact(dec: Dict, ds: DeleteSet) -> bytes:
+    """Snapshot compaction: the whole replayed union as one blob."""
+    return native.encode_from_columns_any(dec, ds)
+
+
+def replay_trace(
+    blobs: Sequence[bytes],
+    *,
+    clients: Optional[Sequence[int]] = None,
+) -> ReplayResult:
+    """One-shot: blobs in, converged cache + compacted snapshot out."""
+    dec = decode(blobs)
+    cols, ds = stage(dec)
+    _, maps_out, seq_out = converge(cols, clients=clients)
+    win_rows, win_vis, seq_orders = gather(dec, ds, maps_out, seq_out)
+    cache = materialize(dec, ds, win_rows, win_vis, seq_orders)
+    return ReplayResult(
+        cache=cache, snapshot=compact(dec, ds), n_ops=len(dec["client"])
+    )

@@ -100,7 +100,7 @@ class ResidentColumns:
         self.n = 0
         self._seen: List[int] = []  # sorted raw client ids
         self._dense: Dict[int, int] = {}  # raw -> rank among seen
-        if clients:
+        if clients is not None and len(clients) > 0:
             self._intern(np.asarray(sorted(set(int(c) for c in clients))))
         with jax.enable_x64(True):
             self._bufs: Tuple[jnp.ndarray, ...] = tuple(
