@@ -342,7 +342,7 @@ def main():
         spec_of_seg = {}
         for i in np.flatnonzero(np_seg >= 0):
             spec_of_seg.setdefault(int(np_seg[i]),
-                                   rp._parent_spec(dec2, int(i)))
+                                   rp.parent_spec(dec2, int(i)))
         orders = seq_orders_from_ranks(np_seg, np_rank, spec_of_seg)
         vis = visible_mask(dec2, list(np_win), ds2)
         return orders, vis

@@ -88,6 +88,9 @@ def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
     return out
 
 
+from crdt_tpu.ops.device import fetch_packed_i32 as _fetch3  # shared
+
+
 def _rebuild_state(engine) -> dict:
     """Persistent per-engine rebuild bookkeeping: an interned parent
     spec id per store row, extended incrementally (O(new rows) per
@@ -237,9 +240,7 @@ def rebuild_chains(engine) -> None:
                 jnp.asarray(np.full(16, -1, np.int64)),
                 num_segments=pad,
             )
-        order_k = np.asarray(order_k)
-        seg_sorted = np.asarray(seg_k)
-        winners = np.asarray(winners)
+        order_k, seg_sorted, winners = _fetch3(order_k, seg_k, winners)
         # kernel outputs live in id-sorted SUBSET space; map back to
         # subset positions, then to store rows via `sel`
         seg_row = np.full(pad, NULLI, np.int32)

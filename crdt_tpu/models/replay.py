@@ -73,7 +73,7 @@ def converge(cols: Dict[str, np.ndarray], *,
     return rc, maps_out, seq_out
 
 
-def _parent_spec(dec: Dict, row: int) -> Tuple:
+def parent_spec(dec: Dict, row: int) -> Tuple:
     """("root", name) or ("item", client, clock) of a row's parent."""
     pr = dec["parent_root"][row]
     if pr >= 0:
@@ -85,36 +85,15 @@ def _parent_spec(dec: Dict, row: int) -> Tuple:
     )
 
 
-def _make_pack_fn():
-    import jax
-    import jax.numpy as jnp
-
-    return jax.jit(lambda a, b, c, d, e: jnp.concatenate([
-        a.astype(jnp.int32), b.astype(jnp.int32), c.astype(jnp.int32),
-        d.astype(jnp.int32), e.astype(jnp.int32),
-    ]))
-
-
-_pack_fn = None  # built lazily, module-level so jit caches across calls
-
-
 def gather(dec: Dict, ds: DeleteSet, maps_out, seq_out):
     """Winner rows + visibility + per-sequence document orders (keyed
     by parent spec — root name or item id), via one packed int32
     device->host transfer."""
-    global _pack_fn
-    if _pack_fn is None:
-        _pack_fn = _make_pack_fn()
-    packed = _pack_fn(maps_out[0], maps_out[2], seq_out[0], seq_out[1],
-                      seq_out[2])
-    h = np.asarray(packed)  # ONE transfer
-    cap = maps_out[0].shape[0]
-    nseg = maps_out[2].shape[0]
-    order = h[:cap]
-    winners = h[cap:cap + nseg]
-    sorder = h[cap + nseg:2 * cap + nseg]
-    sseg = h[2 * cap + nseg:3 * cap + nseg]
-    srank = h[3 * cap + nseg:]
+    from crdt_tpu.ops.device import fetch_packed_i32
+
+    order, winners, sorder, sseg, srank = fetch_packed_i32(
+        maps_out[0], maps_out[2], seq_out[0], seq_out[1], seq_out[2]
+    )
 
     win_rows = [int(order[w]) for w in winners if w >= 0]
     win_vis = visible_mask(dec, win_rows, ds)
@@ -130,7 +109,7 @@ def gather(dec: Dict, ds: DeleteSet, maps_out, seq_out):
     for sid, pairs in seq_pairs.items():
         pairs.sort()
         rows = [r for _, r in pairs]
-        seq_orders[_parent_spec(dec, rows[0])] = rows
+        seq_orders[parent_spec(dec, rows[0])] = rows
     return win_rows, win_vis, seq_orders
 
 
@@ -170,7 +149,7 @@ def materialize(dec: Dict, ds: DeleteSet, win_rows, win_vis,
     for row, vis in zip(win_rows, win_vis):
         if not vis:
             continue
-        map_groups.setdefault(_parent_spec(dec, row), {})[
+        map_groups.setdefault(parent_spec(dec, row), {})[
             keys[kid[row]]
         ] = row
 
@@ -204,6 +183,12 @@ def materialize(dec: Dict, ds: DeleteSet, win_rows, win_vis,
     for spec in seq_orders:
         if spec[0] == "root" and spec[1] not in cache:
             cache[spec[1]] = collection(spec, False, 0)
+    # roots registered in the ix index but with no visible content
+    # (e.g. a map whose every key was tombstoned) still materialize —
+    # empty — exactly like the document cache
+    for name, row in map_groups.get(("root", "ix"), {}).items():
+        if name not in cache and name != "ix":
+            cache[name] = [] if contents[row] == "array" else {}
     return cache
 
 

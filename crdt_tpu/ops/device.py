@@ -27,6 +27,33 @@ def bucket_pow2(n: int, floor: int = 9) -> int:
     return 1 << max(floor, (max(n, 1) - 1).bit_length())
 
 
+_pack_fns: dict = {}  # arity -> jitted concat (host helper cache)
+
+
+def fetch_packed_i32(*arrays):
+    """Fetch several device index arrays in ONE packed int32 transfer.
+
+    Per-array `np.asarray` fetches pay the transfer stall per call on
+    tunnelled platforms; all kernel index/segment outputs fit int32
+    (values < the pad bucket, NULLI = -1). Returns host arrays in
+    input order."""
+    import numpy as np
+
+    fn = _pack_fns.get(len(arrays))
+    if fn is None:
+        fn = jax.jit(
+            lambda *xs: jnp.concatenate([x.astype(jnp.int32) for x in xs])
+        )
+        _pack_fns[len(arrays)] = fn
+    h = np.asarray(fn(*arrays))
+    out, off = [], 0
+    for a in arrays:
+        n = a.shape[0]
+        out.append(h[off:off + n])
+        off += n
+    return out
+
+
 def pack_id(client: jnp.ndarray, clock: jnp.ndarray) -> jnp.ndarray:
     """(client, clock) -> single sortable int64; null (-1,*) -> -1."""
     packed = (client.astype(jnp.int64) << _CLOCK_BITS) | clock.astype(jnp.int64)

@@ -145,3 +145,18 @@ class TestTraceReplay:
         d.set("solo", "k", [1, 2])
         res = replay_trace(out)
         assert res.cache == {"solo": {"k": [1, 2]}}
+
+    def test_fully_tombstoned_root_still_materializes_empty(self):
+        from crdt_tpu.api.doc import Crdt
+        from crdt_tpu.models.replay import replay_trace
+
+        out = []
+        d = Crdt(3, on_update=lambda u, m: out.append(u))
+        d.set("gone", "k", 1)
+        d.delete("gone", "k")
+        d.set("other", "x", 2)
+        res = replay_trace(out)
+        oracle = Crdt(99)
+        oracle.apply_updates(out)
+        assert res.cache == dict(oracle.c)
+        assert res.cache["gone"] == {}
