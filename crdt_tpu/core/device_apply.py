@@ -37,7 +37,7 @@ import numpy as np
 from crdt_tpu.core.ids import DeleteSet
 from crdt_tpu.core.records import ItemRecord
 from crdt_tpu.core.store import K_GC, NO_KEY, NULL
-from crdt_tpu.ops.device import _CLOCK_BITS, NULLI
+from crdt_tpu.ops.device import _CLOCK_BITS, NULLI, fetch_packed_i32
 
 
 def apply_records_device(engine, records: List[ItemRecord],
@@ -88,7 +88,6 @@ def _pad(arr: np.ndarray, size: int, fill) -> np.ndarray:
     return out
 
 
-from crdt_tpu.ops.device import fetch_packed_i32 as _fetch3  # shared
 
 
 def _rebuild_state(engine) -> dict:
@@ -240,7 +239,9 @@ def rebuild_chains(engine) -> None:
                 jnp.asarray(np.full(16, -1, np.int64)),
                 num_segments=pad,
             )
-        order_k, seg_sorted, winners = _fetch3(order_k, seg_k, winners)
+        order_k, seg_sorted, winners = fetch_packed_i32(
+            order_k, seg_k, winners
+        )
         # kernel outputs live in id-sorted SUBSET space; map back to
         # subset positions, then to store rows via `sel`
         seg_row = np.full(pad, NULLI, np.int32)

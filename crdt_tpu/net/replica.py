@@ -114,6 +114,7 @@ class Replica:
         full_state_updates: bool = False,
         compact_every: Optional[int] = None,
         device_merge: Optional[bool] = None,
+        batch_incoming: Optional[bool] = None,
     ):
         if not getattr(router, "is_ypear_router", False):
             raise TypeError("router is not a ypear router")  # crdt.js:172
@@ -134,6 +135,15 @@ class Replica:
             full_state_updates=full_state_updates,
             device_merge=device_merge,
         )
+        # receive-side batching: updates arriving within one router
+        # poll round are buffered and applied as ONE merge transaction
+        # (one kernel dispatch in device mode) — the north-star gate at
+        # the sync handler. Defaults on in device mode; scalar mode
+        # keeps per-message application unless asked.
+        if batch_incoming is None:
+            batch_incoming = self.doc.device_merge
+        self.batch_incoming = batch_incoming
+        self._inbox: List[tuple] = []  # (update bytes, meta dict)
 
         # load from the update log (crdt.js:193-217): the whole log
         # replays as ONE batched merge (one observer flush; in device
@@ -167,6 +177,9 @@ class Replica:
                     "set_peer_state_vector": self.set_peer_state_vector,
                     "peer_close": self.peer_close,
                     "self_close": self.self_close,
+                    # routers call this after each poll/delivery round
+                    # so buffered inbound updates land as one merge
+                    "flush": self.flush_incoming,
                     # async-transport hook (e.g. the UDP router): a
                     # peer subscribing to our topic AFTER construction
                     # triggers a directed anti-entropy probe even when
@@ -233,6 +246,7 @@ class Replica:
         """Close persistence and announce cleanup (crdt.js:272-275)."""
         if self.closed:
             return
+        self.flush_incoming()  # buffered updates land before the log closes
         self.closed = True
         if self.persistence is not None:
             self.persistence.close()
@@ -258,6 +272,7 @@ class Replica:
         sent: Dict[str, int] = {}
         if self.closed:
             return sent
+        self.flush_incoming()  # deficits computed on current state
         mine = self.doc.state_vector()
         for pk, sv in list(self.peer_state_vectors.items()):
             if sv.diff_dominates(mine):
@@ -343,6 +358,9 @@ class Replica:
             self.peer_close(msg.get("public_key", from_pk))
             return
         if meta == "ready":
+            # answer with everything we hold: buffered updates must
+            # land first or the diff would silently omit them
+            self.flush_incoming()
             # act as syncer (crdt.js:286-291). Unlike the reference,
             # unsynced replicas answer too: two unsynced peers exchange
             # what they have and both converge (the reference's
@@ -370,25 +388,60 @@ class Replica:
             )
             return
         if "update" in msg:
-            update = msg["update"]
-            tracer = get_tracer()
+            if self.batch_incoming:
+                self._inbox.append((msg["update"], dict(msg), from_pk))
+                return
+            self._apply_incoming([(msg["update"], dict(msg), from_pk)])
+
+    def flush_incoming(self) -> int:
+        """Apply all buffered inbound updates as ONE merge transaction.
+        Returns the number of updates applied. No-op when empty; safe
+        to call from any router at any time."""
+        if not self._inbox:
+            return 0
+        items, self._inbox = self._inbox, []
+        self._apply_incoming(items)
+        return len(items)
+
+    def _apply_incoming(self, items) -> None:
+        tracer = get_tracer()
+        updates = [u for u, _, _ in items]
+        try:
             with tracer.span("replica.apply_update"):
-                self.doc.apply_update(
-                    update, origin="sync" if meta == "sync" else "remote"
-                )
+                # two origin-preserving sub-batches: observers filter
+                # on origin, so a handshake reply sharing a round with
+                # ordinary broadcasts must not relabel them "sync"
+                remote = [u for u, m, _ in items if m.get("meta") != "sync"]
+                syncs = [u for u, m, _ in items if m.get("meta") == "sync"]
+                if remote:
+                    self.doc.apply_updates(remote, origin="remote")
+                if syncs:
+                    self.doc.apply_updates(syncs, origin="sync")
+        except ValueError:
+            # a malformed blob poisons its whole batch decode; isolate
+            # it so other peers' valid updates still land (application
+            # is idempotent, so re-applying survivors is safe)
+            if len(items) == 1:
+                tracer.count("replica.malformed_updates")
+                return
+            for item in items:
+                self._apply_incoming([item])
+            return
+        for u in updates:
             tracer.count("replica.updates_applied")
-            tracer.count("replica.bytes_received", len(update))
-            self._persist(update)
-            if meta == "sync":
+            tracer.count("replica.bytes_received", len(u))
+            self._persist(u)
+        for _, m, from_pk in items:
+            if m.get("meta") == "sync":
                 self._set_synced(True)  # crdt.js:306
-                if "state_vector" in msg:
+                if "state_vector" in m:
                     # second leg of the handshake: ship the syncer
                     # whatever we hold beyond its state vector. Sent
                     # unconditionally — an SV-dominance check would
                     # strand tombstone-only surplus, since delete sets
                     # live outside state vectors (diffs always carry
                     # the full delete set, like Yjs)
-                    their_sv = v1.decode_state_vector(msg["state_vector"])
+                    their_sv = v1.decode_state_vector(m["state_vector"])
                     back = self.doc.encode_state_as_update(their_sv)
                     self._to_peer(from_pk, {"update": back})
                     # the syncer now holds everything we do (see the

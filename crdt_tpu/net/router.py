@@ -93,6 +93,15 @@ class LoopbackNetwork:
                 for _ in range(copies):
                     handler(msg, frm)
                     self.delivered += 1
+            # end of delivery round: replicas buffering inbound updates
+            # (batch_incoming) merge the round's worth in one txn
+            for topic, subs in list(self.topics.items()):
+                for r, _ in subs:
+                    flush = r.options.get("cache", {}).get(topic, {}).get(
+                        "flush"
+                    )
+                    if flush is not None:
+                        flush()
         if self.queue:
             raise RuntimeError(f"network did not quiesce in {max_rounds} rounds")
         return self.delivered - n0

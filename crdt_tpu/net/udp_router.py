@@ -263,6 +263,12 @@ class UdpRouter:
             elif kind == _ENVELOPE and len(body) > 32:
                 if self._on_envelope(body, (src_ip, src_port)):
                     handled += 1
+        # end of poll round: replicas buffering inbound updates
+        # (batch_incoming) merge this round's worth in one txn
+        for contract in list(self.options["cache"].values()):
+            flush = contract.get("flush")
+            if flush is not None:
+                flush()
         return handled
 
     def _on_hello(self, body: bytes, addr: Tuple[str, int]) -> None:
