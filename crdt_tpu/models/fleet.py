@@ -32,6 +32,7 @@ import numpy as np
 from crdt_tpu.parallel.gossip import (
     REPLICA_AXIS,
     make_gossip_step,
+    make_hierarchical_gossip_step,
     make_mesh,
     synth_columns,
 )
@@ -87,13 +88,26 @@ class ReplicaFleet:
         self.num_clients = num_clients or n_replicas + 2
         total = n_replicas * ops_per_replica
         self.num_segments = num_segments or (1 << max(9, (total - 1).bit_length()))
-        self._step = make_gossip_step(
+        # a 2D (hosts, replicas) mesh runs the two-tier fan-in (ICI
+        # within a host, DCN across — make_mesh2d); 1D runs flat gossip
+        build = (
+            make_hierarchical_gossip_step
+            if len(self.mesh.axis_names) == 2
+            else make_gossip_step
+        )
+        self._step = build(
             self.mesh, num_segments=self.num_segments, num_clients=self.num_clients
         )
+        self._delta_step = None  # built on first delta_round
+        self._delta_budget = None
 
     @property
     def axis(self) -> str:
-        return self.mesh.axis_names[0] if self.mesh.axis_names else REPLICA_AXIS
+        """The REPLICA axis name — the one fleet-shaped [R, N] arrays
+        shard over (on a 2D (hosts, replicas) mesh that is the inner
+        axis, not the host axis)."""
+        names = self.mesh.axis_names
+        return names[-1] if names else REPLICA_AXIS
 
     def synth(
         self,
@@ -146,3 +160,35 @@ class ReplicaFleet:
                 "fleet.ops_converged", int(np.asarray(cols["valid"]).sum())
             )
         return FleetStep(*(np.asarray(x) for x in out))
+
+    def delta_round(
+        self,
+        cols: Dict[str, np.ndarray],
+        *,
+        budget: int,
+    ):
+        """One TARGETED anti-entropy round over the fleet's mesh: ship
+        only rows above the swarm floor, capped at ``budget`` per
+        replica (see crdt_tpu.parallel.delta — ICI bytes scale with
+        the deficit, not the resident columns). Requires a 1D mesh.
+
+        Returns ``(svs, deficit, needed_count, delta_cols)`` where
+        ``delta_cols`` is the gathered delta union as a column dict.
+        """
+        import jax.numpy as jnp
+
+        from crdt_tpu.parallel.delta import COL_NAMES, make_delta_gossip_step
+
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError("delta rounds run on a 1D replica mesh")
+        if self._delta_step is None or self._delta_budget != budget:
+            self._delta_step = make_delta_gossip_step(
+                self.mesh, num_clients=self.num_clients, budget=budget
+            )
+            self._delta_budget = budget
+        out = self._delta_step(*(jnp.asarray(cols[k]) for k in COL_NAMES))
+        svs, deficit, needed = (np.asarray(x) for x in out[:3])
+        delta_cols = {
+            name: np.asarray(col) for name, col in zip(COL_NAMES, out[3:])
+        }
+        return svs, deficit, needed, delta_cols

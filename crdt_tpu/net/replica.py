@@ -219,6 +219,7 @@ class Replica:
         we lack. The two-way handshake then reconciles both sides."""
         if self.closed:
             return
+        self.flush_incoming()  # advertise the SV incl. buffered updates
         msg = {
             "meta": "ready",
             "public_key": self.router.public_key,
@@ -234,6 +235,7 @@ class Replica:
         self.router.options["cache"].setdefault(self.topic, {})["synced"] = value
 
     def _update_own_sv(self) -> bytes:
+        self.flush_incoming()  # the advertised SV covers buffered updates
         return self.doc.encode_state_vector()
 
     def set_peer_state_vector(self, public_key: str, sv_bytes: bytes) -> None:
