@@ -292,14 +292,16 @@ def rebuild_chains(engine) -> None:
 
         from crdt_tpu.ops.yata import drop_orphan_subtrees
 
+        seg_all = seg.copy()  # pre-drop assignment (hard fallback)
         seq_list = drop_orphan_subtrees(
             (int(j) for j in seq_rows), seg, parent_arr
         )
 
         # groups whose sibling order the (client, ~clock) key cannot
-        # express — right-origin attachments only — run the exact
-        # group-local scan on host (see ops/yata.py)
-        _rank_conflict_groups(
+        # express: right-origin attachments run the exact group-local
+        # scan; segments with rights the sibling model cannot express
+        # at all (hostile shapes) fall back to a scalar integrate
+        hard_local = _rank_conflict_groups(
             engine, seq_list, seg, parent_arr, key1, key2,
             raw_client, clock, rcl, rck,
         )
@@ -318,14 +320,14 @@ def rebuild_chains(engine) -> None:
 
         by_seg: Dict[int, List[Tuple[int, int]]] = {}
         for j in seq_list:
+            if int(seg[j]) in hard_local:
+                continue  # linked by the scalar fallback below
             by_seg.setdefault(int(seg[j]), []).append((int(rank[j]), j))
         inv = {lsid: gsid for gsid, lsid in local_seg_of.items()}
-        for lsid, pairs in by_seg.items():
-            pairs.sort()
-            spec = specs[inv[lsid]]
+
+        def link(spec, rows_in_order):
             prev = None
-            for _, j in pairs:
-                row = int(sel[j])
+            for row in rows_in_order:
                 if prev is None:
                     engine._seq_head[spec] = row
                     engine._prev[row] = NULL
@@ -333,25 +335,83 @@ def rebuild_chains(engine) -> None:
                     engine._next[prev] = row
                     engine._prev[row] = prev
                 prev = row
-            engine._next[prev] = NULL
-            engine._seq_tail[spec] = prev
+            if prev is not None:
+                engine._next[prev] = NULL
+                engine._seq_tail[spec] = prev
+
+        for lsid, pairs in by_seg.items():
+            pairs.sort()
+            link(specs[inv[lsid]], [int(sel[j]) for _, j in pairs])
+
+        if hard_local:
+            from crdt_tpu.ops.yata import order_hard_segment
+
+            for lsid in hard_local:
+                recs = [
+                    engine.record_of_row(int(sel[j]))
+                    for j in np.flatnonzero(seg_all == lsid)
+                ]
+                ordered = order_hard_segment(
+                    recs, ref_exists=lambda ref: engine.store.has(*ref)
+                )
+                link(
+                    specs[inv[lsid]],
+                    [engine.store.find(c, k) for c, k in ordered],
+                )
 
 
 def _rank_conflict_groups(
     engine, seq_list, seg, parent_arr, key1, key2, client, clock, rcl, rck
-) -> None:
+) -> set:
     """Replace (client, ~clock) sibling keys with exact scan ranks for
     groups containing right-origin attachments — the only case where
     the lexicographic key diverges from the Yjs integrate scan
     (attachment-free groups, duplicates included, are exact on the
-    device key; see ops/yata.py)."""
+    device key; see ops/yata.py). Returns the set of local segment ids
+    whose rights the sibling model cannot express at all (dangling /
+    cross-parent / inside-a-member's-subtree — hostile shapes): those
+    sequences need the caller's scalar-integrate fallback."""
     from crdt_tpu.ops.yata import _simulate_group
 
     groups: Dict[Tuple[int, int], List[int]] = {}
     for i in seq_list:
         groups.setdefault((int(seg[i]), int(parent_arr[i])), []).append(i)
-    for rows in groups.values():
+    hard: set = set()
+    row_of = None  # (client, clock) -> local idx, built on demand
+    for (gseg, _), rows in groups.items():
+        if gseg in hard:
+            continue
         ids = {(int(client[i]), int(clock[i])) for i in rows}
+        out_rights = [
+            i for i in rows
+            if rcl[i] != NULL and (int(rcl[i]), int(rck[i])) not in ids
+        ]
+        if out_rights:
+            from crdt_tpu.ops.yata import right_walk_is_hard
+
+            if row_of is None:
+                row_of = {
+                    (int(client[j]), int(clock[j])): j
+                    for j in range(len(client))
+                    if seg[j] >= 0
+                }
+            for i in out_rights:
+                if right_walk_is_hard(
+                    (int(rcl[i]), int(rck[i])),
+                    ids,
+                    row_of.get,
+                    lambda cur: int(seg[cur]),
+                    gseg,
+                    lambda cur: (int(client[cur]), int(clock[cur])),
+                    lambda cur: (
+                        int(parent_arr[cur]) if parent_arr[cur] >= 0 else None
+                    ),
+                    len(client),
+                ):
+                    hard.add(gseg)
+                    break
+        if gseg in hard:
+            continue
         has_attachment = any(
             rcl[i] != NULL and (int(rcl[i]), int(rck[i])) in ids for i in rows
         )
@@ -369,7 +429,8 @@ def _rank_conflict_groups(
             for i in rows
         ]
         ordered = _simulate_group(sibs, ids)
-        row_of = {(int(client[i]), int(clock[i])): i for i in rows}
+        member_row = {(int(client[i]), int(clock[i])): i for i in rows}
         for pos, sid in enumerate(ordered):
-            key1[row_of[sid]] = pos
-            key2[row_of[sid]] = 0
+            key1[member_row[sid]] = pos
+            key2[member_row[sid]] = 0
+    return hard

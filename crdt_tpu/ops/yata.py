@@ -303,6 +303,117 @@ def _simulate_group(sibs: List[dict], member_ids: set) -> List[Tuple[int, int]]:
     return [s["id"] for s in placed]
 
 
+def order_hard_segment(seg_records, ref_exists=None) -> List[Tuple[int, int]]:
+    """Exact chain order for one sequence via a throwaway scalar
+    integrate — the fallback for segments whose right origins the
+    sibling-rank model cannot express (rights pointing INTO a member's
+    subtree, dangling rights, cross-parent rights: shapes honest Yjs
+    peers never produce, but hostile updates can).
+
+    The slice is made integrable WITHOUT changing its chain outcome:
+    per-client clocks renumber to a contiguous run (the real document
+    may interleave other collections' clocks, which must not pend the
+    slice), and references to ids outside the slice are rewritten —
+    ones that EXIST elsewhere (``ref_exists``; default: treat as
+    existing) get a synthetic donor item in a foreign chain (dep
+    satisfied, never encountered by this chain's scan, equality
+    classes of right origins preserved), while truly dangling ones map
+    to absent ids so the member pends, exactly like the engine."""
+    from crdt_tpu.core.engine import Engine
+    from crdt_tpu.core.records import ItemRecord
+
+    by_client: Dict[int, List[Tuple[int, int]]] = {}
+    for r in sorted(seg_records, key=lambda x: (x.client, x.clock)):
+        by_client.setdefault(r.client, []).append(r.id)
+    remap = {
+        rid: (rid[0], i)
+        for ids_ in by_client.values()
+        for i, rid in enumerate(ids_)
+    }
+    SENT = 1 << 45  # outside any real client-id namespace
+    ext: Dict[Tuple[int, int], Tuple[int, int]] = {}
+    donors: List[ItemRecord] = []
+
+    def map_ref(ref):
+        if ref is None:
+            return None
+        if ref in remap:
+            return remap[ref]
+        if ref not in ext:
+            sid = (SENT + len(ext), 0)
+            ext[ref] = sid
+            if ref_exists is None or ref_exists(ref):
+                donors.append(ItemRecord(
+                    client=sid[0], clock=0, parent_root="__other__",
+                    content=None,
+                ))
+            # else: absent id — the referencing member pends
+        return ext[ref]
+
+    rewritten = [
+        ItemRecord(
+            client=r.client, clock=remap[r.id][1], parent_root="__hard__",
+            origin=map_ref(r.origin), right=map_ref(r.right), kind=r.kind,
+            type_ref=r.type_ref,
+        )
+        for r in seg_records
+    ]
+    eng = Engine(10**9)
+    eng.apply_records(donors + rewritten)
+    inv = {v: k for k, v in remap.items()}
+    return [
+        inv[i]
+        for i in eng.seq_order_table().get(("root", "__hard__"), [])
+        if i in inv
+    ]
+
+
+def right_walk_is_hard(
+    right, member_ids, lookup, seg_of, gseg, id_of, origin_of, max_steps
+) -> bool:
+    """Shared hard-shape walk for one out-of-group right origin: True
+    when it is dangling in the caller's universe, in another segment,
+    or a DESCENDANT of a group member (the integrate scan would stop
+    inside that member's subtree, splitting it — inexpressible by
+    sibling ranks). ``max_steps`` must bound the UNIVERSE size, not
+    the group size: subtree depth is unrelated to sibling count."""
+    cur = lookup(right)
+    if cur is None:
+        return True  # dangling right: the engine pends the member
+    if seg_of(cur) != gseg:
+        return True  # cross-parent right: malformed
+    steps = 0
+    while cur is not None and steps <= max_steps:
+        steps += 1
+        if id_of(cur) in member_ids:
+            return True  # right sits inside a member's subtree
+        cur = origin_of(cur)
+    return False
+
+
+def _group_is_hard(rows, member_ids, row_of, records, seg, gseg) -> bool:
+    for i in rows:
+        right = records[i].right
+        if right is None or right in member_ids:
+            continue  # no right, or a plain in-group anchor
+        if right_walk_is_hard(
+            right,
+            member_ids,
+            row_of.get,
+            lambda cur: seg[cur],
+            gseg,
+            lambda cur: records[cur].id,
+            lambda cur: (
+                row_of.get(records[cur].origin)
+                if records[cur].origin is not None
+                else None
+            ),
+            len(records),
+        ):
+            return True
+    return False
+
+
 def order_sequences(records):
     """Order a record union's sequences through the device kernel.
 
@@ -346,14 +457,23 @@ def order_sequences(records):
         key2[i] = -r.clock  # clock-DESC within a client (break rule)
         seq_rows.append(i)
 
+    seg_all = seg.copy()  # pre-drop assignment (hard fallback needs it)
     seq_rows = drop_orphan_subtrees(seq_rows, seg, parent_idx)
 
     # group members by origin-tree parent; detect attachment groups
+    # and HARD segments (rights the sibling-rank model cannot express
+    # — those sequences fall back to an exact scalar integrate)
     groups: Dict[Tuple[int, int], List[int]] = {}
     for i in seq_rows:
         groups.setdefault((seg[i], parent_idx[i]), []).append(i)
+    hard_segs: set = set()
     for (gseg, gparent), rows in groups.items():
+        if gseg in hard_segs:
+            continue
         member_ids = {records[i].id for i in rows}
+        if _group_is_hard(rows, member_ids, row_of, records, seg, gseg):
+            hard_segs.add(gseg)
+            continue
         has_attachment = any(
             records[i].right in member_ids for i in rows if records[i].right
         )
@@ -393,10 +513,17 @@ def order_sequences(records):
     rank = np.asarray(rank[:n])
     by_spec: Dict[int, List[Tuple[int, Tuple[int, int]]]] = {}
     for i in seq_rows:
+        if int(seg[i]) in hard_segs:
+            continue  # ordered by the scalar fallback below
         by_spec.setdefault(int(seg[i]), []).append((int(rank[i]), records[i].id))
     inv = {v: k for k, v in seq_specs.items()}
     out = {spec: [] for spec in seq_specs}
     for sid, pairs in by_spec.items():
         pairs.sort()
         out[inv[sid]] = [pid for _, pid in pairs]
+    for sid in hard_segs:
+        out[inv[sid]] = order_hard_segment(
+            [records[i] for i in range(n) if seg_all[i] == sid],
+            ref_exists=lambda ref: ref in row_of,
+        )
     return out

@@ -259,3 +259,97 @@ class TestDeviceModePlumbing:
         device.apply_update(blob)
         _assert_same_state(scalar, device)
         assert device.c["s"] == ["b0", "b1", "dup2", "dup1", "dup0"]
+
+    def test_hostile_rights_stay_identical_across_modes(self):
+        """Crafted updates with rights pointing inside a sibling's
+        subtree (or dangling) pass admission but defeat the sibling
+        rank model; the hard-segment scalar fallback keeps device mode
+        byte-identical to scalar mode."""
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.records import ItemRecord
+
+        recs = [
+            ItemRecord(client=1, clock=0, parent_root="s", content="a"),
+            ItemRecord(client=1, clock=1, parent_root="s", origin=(1, 0),
+                       content="b"),
+            ItemRecord(client=1, clock=2, parent_root="s", origin=(1, 1),
+                       content="c"),
+        ]
+        # right = grandchild of the sibling (1,1): splits its subtree
+        hostile = ItemRecord(client=4, clock=0, parent_root="s",
+                             origin=(1, 0), right=(1, 2), content="H")
+        blob = v1.encode_update(recs + [hostile], None)
+        scalar = Crdt(999, device_merge=False)
+        device = Crdt(999, device_merge=True)
+        scalar.apply_update(blob)
+        device.apply_update(blob)
+        _assert_same_state(scalar, device)
+        assert scalar.c["s"] == ["a", "b", "H", "c"]
+
+    def test_hostile_right_with_interleaved_clocks(self):
+        """The hard-segment fallback must not re-apply admission gates:
+        a client whose sequence clocks interleave with map clocks (gaps
+        WITHIN the slice) keeps every live item."""
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.records import ItemRecord
+
+        recs = [
+            ItemRecord(client=1, clock=0, parent_root="s", content="a"),
+            ItemRecord(client=1, clock=1, parent_root="m", key="k",
+                       content="map-gap"),
+            ItemRecord(client=1, clock=2, parent_root="s", origin=(1, 0),
+                       content="b"),
+            ItemRecord(client=1, clock=3, parent_root="s", origin=(1, 2),
+                       content="c"),
+            ItemRecord(client=4, clock=0, parent_root="s", origin=(1, 0),
+                       right=(1, 3), content="H"),
+        ]
+        blob = v1.encode_update(recs, None)
+        scalar = Crdt(999, device_merge=False)
+        device = Crdt(999, device_merge=True)
+        scalar.apply_update(blob)
+        device.apply_update(blob)
+        _assert_same_state(scalar, device)
+        assert scalar.c["s"] == ["a", "b", "H", "c"]
+
+    def test_hostile_right_deep_in_subtree(self):
+        """Subtree depth exceeds group size: the hard-shape walk must
+        bound by universe size, not sibling count."""
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.records import ItemRecord
+
+        recs = [ItemRecord(client=1, clock=0, parent_root="s", content="root")]
+        # a1: client 2, child of root; then an 8-deep chain under a1
+        recs.append(ItemRecord(client=2, clock=0, parent_root="s",
+                               origin=(1, 0), content="a1"))
+        for k in range(1, 9):
+            recs.append(ItemRecord(client=2, clock=k, parent_root="s",
+                                   origin=(2, k - 1), content=f"d{k}"))
+        hostile = ItemRecord(client=5, clock=0, parent_root="s",
+                             origin=(1, 0), right=(2, 8), content="H")
+        blob = v1.encode_update(recs + [hostile], None)
+        scalar = Crdt(999, device_merge=False)
+        device = Crdt(999, device_merge=True)
+        scalar.apply_update(blob)
+        device.apply_update(blob)
+        _assert_same_state(scalar, device)
+
+    def test_cross_parent_right_integrates_in_both_modes(self):
+        """A right origin living in ANOTHER collection exists in the
+        store, so the member must integrate (scan-to-end), not pend."""
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.records import ItemRecord
+
+        recs = [
+            ItemRecord(client=1, clock=0, parent_root="other", content="x"),
+            ItemRecord(client=1, clock=1, parent_root="s", content="a"),
+            ItemRecord(client=3, clock=0, parent_root="s", origin=(1, 1),
+                       right=(1, 0), content="weird"),
+        ]
+        blob = v1.encode_update(recs, None)
+        scalar = Crdt(999, device_merge=False)
+        device = Crdt(999, device_merge=True)
+        scalar.apply_update(blob)
+        device.apply_update(blob)
+        _assert_same_state(scalar, device)
+        assert "weird" in scalar.c["s"]

@@ -245,3 +245,41 @@ def test_fuzz_duplicate_heavy_no_host_scan(monkeypatch):
         oracle = Engine(10**6)
         oracle.apply_records(recs)
         assert got == oracle.seq_order_table(), f"trial {trial} diverged"
+
+
+def test_fuzz_mixed_rights_duplicates_and_attachments():
+    """Adversarial sibling soup: same-origin duplicates across and
+    within clients, right origins pointing OUTSIDE the group (ignored
+    by the attachment check), and true in-group anchors — kernel wrapper
+    must match the oracle on all of it."""
+    from crdt_tpu.core.records import ItemRecord
+
+    rng = random.Random(31)
+    for trial in range(8):
+        recs = [ItemRecord(client=1, clock=0, parent_root="s", content=0)]
+        for k in range(1, 5):
+            recs.append(ItemRecord(client=1, clock=k, parent_root="s",
+                                   origin=(1, k - 1), content=k))
+        ids = [(1, k) for k in range(5)]
+        for client in (2, 3, 4):
+            for k in range(rng.randint(2, 8)):
+                origin = ids[rng.randrange(len(ids))]
+                # rights: absent, an existing id (possible in-group
+                # anchor), or a dangling id never integrated
+                roll = rng.random()
+                if roll < 0.4:
+                    right = None
+                elif roll < 0.8:
+                    right = ids[rng.randrange(len(ids))]
+                else:
+                    right = (99, rng.randrange(50))
+                rec = ItemRecord(client=client, clock=k, parent_root="s",
+                                 origin=origin, right=right,
+                                 content=(client, k))
+                recs.append(rec)
+                ids.append(rec.id)
+        got = order_sequences(recs)
+        oracle = Engine(10**6)
+        oracle.apply_records(recs)
+        want = oracle.seq_order_table()
+        assert got == want, f"trial {trial} diverged"
