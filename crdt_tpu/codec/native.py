@@ -238,6 +238,40 @@ def decode_updates_columns_any(blobs: Sequence[bytes]) -> Dict:
     return _decode_py(blobs)
 
 
+_COLUMN_KEYS = (
+    "client", "clock", "parent_root", "parent_client", "parent_clock",
+    "key_id", "origin_client", "origin_clock", "right_client",
+    "right_clock", "kind", "type_ref",
+)
+
+
+def dedup_columns(dec: Dict) -> Dict:
+    """Drop duplicate-id rows (first occurrence wins), returning a
+    canonical union. Redelivered blobs — at-least-once transports,
+    overlapping log segments — produce duplicate ids that the kernels
+    dedup on-device but that would corrupt a host re-ENCODE (both
+    encoders' run/skip bookkeeping assumes unique, forward-moving
+    clocks per client)."""
+    n = len(dec["client"])
+    if n == 0:
+        return dec
+    pack = (dec["client"].astype(np.int64) << 40) | dec["clock"]
+    order = np.argsort(pack, kind="stable")
+    sp = pack[order]
+    first = np.zeros(n, bool)
+    first[order[np.r_[True, sp[1:] != sp[:-1]]]] = True
+    if first.all():
+        return dec
+    idx = np.flatnonzero(first)  # original order preserved
+    out = {k: dec[k][idx] for k in _COLUMN_KEYS}
+    contents = dec["contents"]
+    out["contents"] = [contents[i] for i in idx]
+    out["ds"] = dec["ds"]
+    out["roots"] = dec["roots"]
+    out["keys"] = dec["keys"]
+    return out
+
+
 # ---------------------------------------------------------------------------
 # encode
 # ---------------------------------------------------------------------------

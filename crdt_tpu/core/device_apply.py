@@ -251,13 +251,24 @@ def rebuild_chains(engine) -> None:
             w = winners[sid]
             if w != NULLI:
                 winner_of_seg[int(sid)] = int(order_k[w])
+        # crafted rights on map rows (honest map sets never carry
+        # them) shift chain tails in ways the argmax kernel cannot
+        # express; those chains take the exact scalar tail instead
+        hard_chains: Dict[Tuple, List[int]] = {}
+        for j in np.flatnonzero(is_map & (rcl != NULL)):
+            j = int(j)
+            hard_chains[(int(row_spec[sel[j]]), int(kid[j]))] = []
         for j in np.flatnonzero(is_map):
             j = int(j)
+            row = int(sel[j])
+            gsid = int(row_spec[row])
+            k = int(kid[j])
+            if (gsid, k) in hard_chains:
+                hard_chains[(gsid, k)].append(j)
+                continue
             sid = int(seg_row[j])
             w = winner_of_seg.get(sid)
-            row = int(sel[j])
-            spec = specs[int(row_spec[row])]
-            k = int(kid[j])
+            spec = specs[gsid]
             engine._map_kids.setdefault(spec, {})[k] = None
             if w == j:
                 engine._map_tail[(spec, k)] = row
@@ -267,6 +278,27 @@ def rebuild_chains(engine) -> None:
                 # enforcing the same invariant post-hoc yields the
                 # identical delete set
                 engine._delete_row(row)
+        if hard_chains:
+            from crdt_tpu.ops.yata import order_hard_segment
+
+            for (gsid, k), js in hard_chains.items():
+                spec = specs[gsid]
+                engine._map_kids.setdefault(spec, {})[k] = None
+                # order_hard_segment rebuilds records without keys;
+                # chain order depends only on origins/rights
+                recs = [engine.record_of_row(int(sel[j])) for j in js]
+                ordered = order_hard_segment(
+                    recs, ref_exists=lambda ref: engine.store.has(*ref)
+                )
+                tail = (
+                    engine.store.find(*ordered[-1]) if ordered else None
+                )
+                if tail is not None:
+                    engine._map_tail[(spec, k)] = tail
+                for j in js:
+                    row = int(sel[j])
+                    if row != tail and not s.deleted[row]:
+                        engine._delete_row(row)
 
     # ---- sequences: document order per parent -------------------------
     # subset-local indices throughout; `sel` translates back to rows

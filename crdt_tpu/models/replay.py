@@ -36,8 +36,9 @@ class ReplayResult(NamedTuple):
 
 
 def decode(blobs: Sequence[bytes]) -> Dict:
-    """Wire -> columnar union (native C codec when built)."""
-    return native.decode_updates_columns_any(blobs)
+    """Wire -> canonical columnar union (native C codec when built;
+    duplicate ids from redelivered blobs are dropped, first wins)."""
+    return native.dedup_columns(native.decode_updates_columns_any(blobs))
 
 
 def stage(dec: Dict) -> Tuple[Dict[str, np.ndarray], DeleteSet]:
@@ -88,7 +89,13 @@ def parent_spec(dec: Dict, row: int) -> Tuple:
 def gather(dec: Dict, ds: DeleteSet, maps_out, seq_out):
     """Winner rows + visibility + per-sequence document orders (keyed
     by parent spec — root name or item id), via one packed int32
-    device->host transfer."""
+    device->host transfer.
+
+    The device kernels' sibling/argmax models are exact for unions
+    without right origins (append-only gossip, map sets — the firehose
+    shape). Rows carrying rights — honest prepends/mid-inserts, or
+    crafted updates — re-order on the host through the exact machinery
+    so the result always matches the scalar document."""
     from crdt_tpu.ops.device import fetch_packed_i32
 
     order, winners, sorder, sseg, srank = fetch_packed_i32(
@@ -96,21 +103,104 @@ def gather(dec: Dict, ds: DeleteSet, maps_out, seq_out):
     )
 
     win_rows = [int(order[w]) for w in winners if w >= 0]
-    win_vis = visible_mask(dec, win_rows, ds)
     n = len(dec["client"])
-    seq_pairs: dict = {}
-    for p in np.flatnonzero(srank >= 0):
-        row = int(sorder[p])
-        if row < n:
-            seq_pairs.setdefault(int(sseg[p]), []).append(
-                (int(srank[p]), row)
-            )
-    seq_orders = {}
-    for sid, pairs in seq_pairs.items():
-        pairs.sort()
-        rows = [r for _, r in pairs]
-        seq_orders[parent_spec(dec, rows[0])] = rows
+    rc_col, kid_col = dec["right_client"], dec["key_id"]
+    if bool(((rc_col >= 0) & (kid_col < 0)).any()):
+        # right-bearing sequences: skip the device-order assembly
+        # entirely and use the exact host machinery
+        seq_orders = _host_seq_orders(dec)
+    else:
+        seq_pairs: dict = {}
+        for p in np.flatnonzero(srank >= 0):
+            row = int(sorder[p])
+            if row < n:
+                seq_pairs.setdefault(int(sseg[p]), []).append(
+                    (int(srank[p]), row)
+                )
+        seq_orders = {}
+        for sid, pairs in seq_pairs.items():
+            pairs.sort()
+            rows = [r for _, r in pairs]
+            seq_orders[parent_spec(dec, rows[0])] = rows
+    win_rows = _fix_map_chains_with_rights(dec, win_rows)
+    win_vis = visible_mask(dec, win_rows, ds)
     return win_rows, win_vis, seq_orders
+
+
+def _host_seq_orders(dec: Dict):
+    """Exact sequence orders via the host machinery (handles right
+    origins, attachment groups, and hostile shapes)."""
+    from crdt_tpu.ops.yata import order_sequences
+
+    records, _ = native.decoded_to_records(dec)
+    id_row = {
+        (int(dec["client"][i]), int(dec["clock"][i])): i
+        for i in range(len(dec["client"]))
+    }
+    return {
+        spec: [id_row[i] for i in ids]
+        for spec, ids in order_sequences(records).items()
+    }
+
+
+def _fix_map_chains_with_rights(dec: Dict, win_rows):
+    """Crafted rights on MAP rows shift chain tails in ways the argmax
+    kernel cannot express; recompute exactly those chains' tails via
+    the scalar chain order."""
+    from crdt_tpu.core.records import ItemRecord
+    from crdt_tpu.ops.yata import order_hard_segment
+
+    rc_col, kid_col = dec["right_client"], dec["key_id"]
+    bad = np.flatnonzero((rc_col >= 0) & (kid_col >= 0))
+    if not len(bad):
+        return win_rows
+    affected = {(parent_spec(dec, int(r)), int(kid_col[r])) for r in bad}
+    chains: Dict[Tuple, List[int]] = {}
+    for i in range(len(kid_col)):
+        if kid_col[i] >= 0:
+            key = (parent_spec(dec, i), int(kid_col[i]))
+            if key in affected:
+                chains.setdefault(key, []).append(i)
+    id_row = {
+        (int(dec["client"][i]), int(dec["clock"][i])): i
+        for rows in chains.values()
+        for i in rows
+    }
+    union_ids = {
+        (int(dec["client"][i]), int(dec["clock"][i]))
+        for i in range(len(kid_col))
+    }
+    patched = dict.fromkeys(affected)
+    for key, rows in chains.items():
+        recs = [
+            ItemRecord(
+                client=int(dec["client"][i]), clock=int(dec["clock"][i]),
+                origin=(
+                    (int(dec["origin_client"][i]),
+                     int(dec["origin_clock"][i]))
+                    if dec["origin_client"][i] >= 0 else None
+                ),
+                right=(
+                    (int(dec["right_client"][i]),
+                     int(dec["right_clock"][i]))
+                    if dec["right_client"][i] >= 0 else None
+                ),
+                parent_root="x",  # chain order ignores parent identity
+            )
+            for i in rows
+        ]
+        ordered = order_hard_segment(
+            recs, ref_exists=lambda ref: ref in union_ids
+        )
+        patched[key] = id_row[ordered[-1]] if ordered else None
+    out = []
+    for row in win_rows:
+        key = (parent_spec(dec, row), int(kid_col[row]))
+        if key in affected:
+            continue  # replaced by the exact tail below
+        out.append(row)
+    out.extend(r for r in patched.values() if r is not None)
+    return out
 
 
 def visible_mask(dec: Dict, rows: List[int], ds: DeleteSet) -> List[bool]:

@@ -322,6 +322,14 @@ def order_hard_segment(seg_records, ref_exists=None) -> List[Tuple[int, int]]:
     from crdt_tpu.core.engine import Engine
     from crdt_tpu.core.records import ItemRecord
 
+    # dedup by id: redelivered blobs reach some callers unmerged, and a
+    # duplicate would double-count in the clock renumbering (leaving a
+    # gap that pends the whole client)
+    uniq: Dict[Tuple[int, int], object] = {}
+    for r in seg_records:
+        uniq.setdefault(r.id, r)
+    seg_records = list(uniq.values())
+
     by_client: Dict[int, List[Tuple[int, int]]] = {}
     for r in sorted(seg_records, key=lambda x: (x.client, x.clock)):
         by_client.setdefault(r.client, []).append(r.id)

@@ -353,3 +353,28 @@ class TestDeviceModePlumbing:
         device.apply_update(blob)
         _assert_same_state(scalar, device)
         assert "weird" in scalar.c["s"]
+
+    def test_hostile_rights_on_map_rows(self):
+        """Crafted rights on MAP entries shift the chain tail; both
+        modes must agree on the winner (the kernel path falls back to
+        the exact scalar tail for those chains)."""
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.records import ItemRecord
+
+        recs = [
+            ItemRecord(client=1, clock=0, parent_root="m", key="k",
+                       content="A"),
+            # hostile: right = A makes the scan stop at the head, so B
+            # lands BEFORE A and is tombstoned despite the larger client
+            ItemRecord(client=2, clock=0, parent_root="m", key="k",
+                       right=(1, 0), content="B"),
+            ItemRecord(client=1, clock=1, parent_root="m", key="other",
+                       content="clean"),
+        ]
+        blob = v1.encode_update(recs, None)
+        scalar = Crdt(999, device_merge=False)
+        device = Crdt(999, device_merge=True)
+        scalar.apply_update(blob)
+        device.apply_update(blob)
+        _assert_same_state(scalar, device)
+        assert scalar.c["m"]["k"] == "A"
