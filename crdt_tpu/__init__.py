@@ -27,7 +27,7 @@ from crdt_tpu.core.ids import ID, StateVector, DeleteSet  # noqa: F401
 
 def __getattr__(name):
     # lazy subpackage access without importing jax at package import
-    if name in ("ReplicaFleet", "FleetStep"):
+    if name in ("ReplicaFleet", "FleetStep", "ReplayResult", "replay_trace"):
         from crdt_tpu import models
 
         return getattr(models, name)

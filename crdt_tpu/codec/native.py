@@ -147,9 +147,12 @@ def kernel_columns(dec: Dict) -> Dict[str, np.ndarray]:
     }
 
 
-def decoded_to_records(dec: Dict) -> Tuple[List[ItemRecord], DeleteSet]:
+def decoded_to_records(
+    dec: Dict, rows: Optional[Sequence[int]] = None
+) -> Tuple[List[ItemRecord], DeleteSet]:
     """Reconstruct symbolic records (parent-resolved) — the bridge to
-    the scalar engine and the differential tests."""
+    the scalar engine and the differential tests. ``rows`` restricts
+    the output to a row subset (full delete set either way)."""
     roots, keys = dec["roots"], dec["keys"]
     out: List[ItemRecord] = []
     n = len(dec["client"])
@@ -162,7 +165,8 @@ def decoded_to_records(dec: Dict) -> Tuple[List[ItemRecord], DeleteSet]:
     rc, rk = dec["right_client"], dec["right_clock"]
     kind, tref = dec["kind"], dec["type_ref"]
     contents = dec["contents"]
-    for i in range(n):
+    for i in (range(n) if rows is None else rows):
+        i = int(i)
         out.append(ItemRecord(
             client=int(client[i]),
             clock=int(clock[i]),
@@ -255,11 +259,14 @@ def dedup_columns(dec: Dict) -> Dict:
     n = len(dec["client"])
     if n == 0:
         return dec
-    pack = (dec["client"].astype(np.int64) << 40) | dec["clock"]
-    order = np.argsort(pack, kind="stable")
-    sp = pack[order]
+    # lexsort, NOT a packed (client << 40 | clock) key: real client ids
+    # are 31-bit and would alias modulo 2^24 in the shifted int64,
+    # silently merging distinct clients' rows
+    order = np.lexsort((dec["clock"], dec["client"]))
+    sc = dec["client"][order]
+    sk = dec["clock"][order]
     first = np.zeros(n, bool)
-    first[order[np.r_[True, sp[1:] != sp[:-1]]]] = True
+    first[order[np.r_[True, (sc[1:] != sc[:-1]) | (sk[1:] != sk[:-1])]]] = True
     if first.all():
         return dec
     idx = np.flatnonzero(first)  # original order preserved

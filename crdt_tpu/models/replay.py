@@ -104,42 +104,73 @@ def gather(dec: Dict, ds: DeleteSet, maps_out, seq_out):
 
     win_rows = [int(order[w]) for w in winners if w >= 0]
     n = len(dec["client"])
+    seq_pairs: dict = {}
+    for p in np.flatnonzero(srank >= 0):
+        row = int(sorder[p])
+        if row < n:
+            seq_pairs.setdefault(int(sseg[p]), []).append(
+                (int(srank[p]), row)
+            )
+    seq_orders = {}
+    for sid, pairs in seq_pairs.items():
+        pairs.sort()
+        rows = [r for _, r in pairs]
+        seq_orders[parent_spec(dec, rows[0])] = rows
+
     rc_col, kid_col = dec["right_client"], dec["key_id"]
-    if bool(((rc_col >= 0) & (kid_col < 0)).any()):
-        # right-bearing sequences: skip the device-order assembly
-        # entirely and use the exact host machinery
-        seq_orders = _host_seq_orders(dec)
-    else:
-        seq_pairs: dict = {}
-        for p in np.flatnonzero(srank >= 0):
-            row = int(sorder[p])
-            if row < n:
-                seq_pairs.setdefault(int(sseg[p]), []).append(
-                    (int(srank[p]), row)
-                )
-        seq_orders = {}
-        for sid, pairs in seq_pairs.items():
-            pairs.sort()
-            rows = [r for _, r in pairs]
-            seq_orders[parent_spec(dec, rows[0])] = rows
+    right_seq_rows = np.flatnonzero((rc_col >= 0) & (kid_col < 0))
+    if len(right_seq_rows):
+        # right-bearing sequences: replace exactly the AFFECTED
+        # parents' device orders with the exact host machinery;
+        # untouched (append-only) sequences keep the kernel result
+        affected = {parent_spec(dec, int(r)) for r in right_seq_rows}
+        seq_orders.update(_host_seq_orders(dec, affected))
     win_rows = _fix_map_chains_with_rights(dec, win_rows)
     win_vis = visible_mask(dec, win_rows, ds)
     return win_rows, win_vis, seq_orders
 
 
-def _host_seq_orders(dec: Dict):
-    """Exact sequence orders via the host machinery (handles right
-    origins, attachment groups, and hostile shapes)."""
+def _host_seq_orders(dec: Dict, specs_needed: set):
+    """Exact sequence orders for the given parent specs via the host
+    machinery (right origins, attachment groups, hostile shapes).
+
+    The subset keeps full-union semantics: every id referenced from the
+    subset but living OUTSIDE it (GC fillers, foreign parents' rows)
+    joins as a GC stub — the ordering machinery then drops/hardens
+    those references exactly as it would with the whole union in hand,
+    while truly dangling references stay absent (members pend)."""
+    from crdt_tpu.core.records import ItemRecord
+    from crdt_tpu.core.store import K_GC
     from crdt_tpu.ops.yata import order_sequences
 
-    records, _ = native.decoded_to_records(dec)
+    kid_col, kind_col = dec["key_id"], dec["kind"]
+    n = len(kid_col)
+    rows = [
+        i for i in range(n)
+        if kid_col[i] < 0 and kind_col[i] != K_GC
+        and parent_spec(dec, i) in specs_needed
+    ]
+    records, _ = native.decoded_to_records(dec, rows)
+    sub_ids = {r.id for r in records}
+    union_ids = {
+        (int(dec["client"][i]), int(dec["clock"][i])) for i in range(n)
+    }
+    stubs = {
+        ref
+        for r in records
+        for ref in (r.origin, r.right)
+        if ref is not None and ref not in sub_ids and ref in union_ids
+    }
+    records += [
+        ItemRecord(client=c, clock=k, kind=K_GC) for c, k in stubs
+    ]
     id_row = {
-        (int(dec["client"][i]), int(dec["clock"][i])): i
-        for i in range(len(dec["client"]))
+        (int(dec["client"][i]), int(dec["clock"][i])): i for i in range(n)
     }
     return {
         spec: [id_row[i] for i in ids]
         for spec, ids in order_sequences(records).items()
+        if spec in specs_needed
     }
 
 
@@ -204,19 +235,32 @@ def _fix_map_chains_with_rights(dec: Dict, win_rows):
 
 
 def visible_mask(dec: Dict, rows: List[int], ds: DeleteSet) -> List[bool]:
-    """Tombstone visibility for specific rows (vectorized)."""
+    """Tombstone visibility for specific rows (vectorized). Clients
+    remap densely before packing — raw 31-bit ids overflow a packed
+    (client << 40 | clock) int64."""
     if not rows:
         return []
     idx = np.asarray(rows)
-    pack = (dec["client"][idx] << 40) | dec["clock"][idx]
-    del_pack = np.asarray(
+    del_c = np.asarray(
+        [c for c, s, length in ds.iter_all() for _ in range(length)],
+        np.int64,
+    )
+    del_k = np.asarray(
         [
-            (c << 40) | k
-            for c, s, length in ds.iter_all()
-            for k in range(s, s + length)
+            s + j
+            for _, s, length in ds.iter_all()
+            for j in range(length)
         ],
         np.int64,
     )
+    if not len(del_c):
+        return [True] * len(rows)
+    row_c = dec["client"][idx].astype(np.int64)
+    uniq = np.unique(np.concatenate([row_c, del_c]))
+    pack = (np.searchsorted(uniq, row_c).astype(np.int64) << 40) | dec[
+        "clock"
+    ][idx]
+    del_pack = (np.searchsorted(uniq, del_c).astype(np.int64) << 40) | del_k
     return list(~np.isin(pack, del_pack))
 
 

@@ -274,3 +274,40 @@ class TestFleetIntegration:
         fresh = Crdt(8)
         fresh.apply_update(res.snapshot)
         assert dict(fresh.c) == res.cache
+
+    def test_dedup_does_not_alias_large_client_ids(self):
+        """Distinct 31-bit clients differing by a multiple of 2^24 must
+        both survive dedup (the old packed key aliased them)."""
+        from crdt_tpu.api.doc import Crdt
+        from crdt_tpu.models.replay import replay_trace
+
+        c1, c2 = 1 << 24, 2 << 24
+        out = []
+        a = Crdt(c1, on_update=lambda u, m: out.append(u))
+        b = Crdt(c2, on_update=lambda u, m: out.append(u))
+        a.set("m", "ka", "A")
+        b.set("m", "kb", "B")
+        res = replay_trace(out + out)  # with redelivery
+        assert res.cache["m"] == {"ka": "A", "kb": "B"}
+
+    def test_mixed_append_and_prepend_parents_stay_selective(self):
+        """Only right-bearing parents re-order on host; a pure-append
+        list in the same trace keeps its (correct) kernel order."""
+        from crdt_tpu.api.doc import Crdt
+        from crdt_tpu.models.replay import replay_trace
+
+        out = []
+        a = Crdt(1, on_update=lambda u, m: out.append(u))
+        b = Crdt(2, on_update=lambda u, m: out.append(u))
+        for i in range(10):
+            a.push("appendy", [i])
+        a.push("edity", ["base"])
+        for u in list(out):
+            b.apply_update(u)
+        b.unshift("edity", "pre")
+        res = replay_trace(out)
+        oracle = Crdt(9)
+        oracle.apply_updates(out)
+        assert res.cache == dict(oracle.c)
+        assert res.cache["edity"] == ["pre", "base"]
+        assert res.cache["appendy"] == list(range(10))
