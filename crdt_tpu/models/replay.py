@@ -61,14 +61,16 @@ def converge(cols: Dict[str, np.ndarray], *,
         clients=clients if clients is not None
         else np.unique(cols["client"][cols["valid"]]),
     )
-    rc.append(cols)
     # tight segment bound: distinct (map parent, key) pairs + sequence
     # roots (the capacity default doubles the ranking kernel's span)
     n_segs = len(np.unique(
         (cols["parent_a"] << 21)
         | np.where(cols["key_id"] >= 0, cols["key_id"], 1 << 20)
     ))
-    maps_out, seq_out = rc.converge(num_segments=bucket_pow2(n_segs))
+    # fused: splice + both kernels = ONE dispatch
+    maps_out, seq_out = rc.append_converge(
+        cols, num_segments=bucket_pow2(n_segs)
+    )
     jax.block_until_ready(maps_out)
     jax.block_until_ready(seq_out)
     return rc, maps_out, seq_out
@@ -152,21 +154,18 @@ def _host_seq_orders(dec: Dict, specs_needed: set):
     ]
     records, _ = native.decoded_to_records(dec, rows)
     sub_ids = {r.id for r in records}
-    union_ids = {
-        (int(dec["client"][i]), int(dec["clock"][i])) for i in range(n)
+    id_row = {
+        (int(dec["client"][i]), int(dec["clock"][i])): i for i in range(n)
     }
     stubs = {
         ref
         for r in records
         for ref in (r.origin, r.right)
-        if ref is not None and ref not in sub_ids and ref in union_ids
+        if ref is not None and ref not in sub_ids and ref in id_row
     }
     records += [
         ItemRecord(client=c, clock=k, kind=K_GC) for c, k in stubs
     ]
-    id_row = {
-        (int(dec["client"][i]), int(dec["clock"][i])): i for i in range(n)
-    }
     return {
         spec: [id_row[i] for i in ids]
         for spec, ids in order_sequences(records).items()

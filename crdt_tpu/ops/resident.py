@@ -90,6 +90,22 @@ def _splice(bufs, delta, n):
     )
 
 
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("num_segments",))
+def _splice_and_converge(bufs, delta, n, d_client, d_start, d_end,
+                         num_segments):
+    """Append + full convergence as ONE program: the splice, the LWW
+    map kernel, and the YATA sequence kernel trace together, so a
+    single-delta replay pays one dispatch instead of two (each costs
+    ~0.35s in the tunnelled platform's degraded state)."""
+    bufs = tuple(
+        jax.lax.dynamic_update_slice(b, d, (n,)) for b, d in zip(bufs, delta)
+    )
+    maps_out, seq_out = _converge_all(
+        bufs, d_client, d_start, d_end, num_segments=num_segments
+    )
+    return bufs, maps_out, seq_out
+
+
 @partial(jax.jit, donate_argnums=(0,))
 def _relabel(bufs, perm):
     """Rewrite the client columns through an old-dense -> new-dense
@@ -156,6 +172,37 @@ class ResidentColumns:
         return out
 
     # -- append / converge --------------------------------------------
+    def _prepare_delta(self, cols: Dict[str, np.ndarray], k: int):
+        """Shared append preamble: client interning (+ on-device
+        relabel when ranks shifted), capacity growth, and the padded
+        delta arrays. Caller splices inside the same x64 scope."""
+        valid = np.asarray(cols["valid"][:k], bool)
+        raw_cl = np.asarray(cols["client"][:k])
+        raw_ocl = np.asarray(cols["origin_client"][:k])
+        perm = self._intern(
+            np.concatenate([raw_cl[valid], raw_ocl[raw_ocl >= 0]])
+        )
+        if perm is not None:
+            self._bufs = _relabel(self._bufs, jnp.asarray(perm))
+        if self.n + k > self.capacity:
+            self._grow(self.n + k)
+        kpad = min(_bucket(k, floor=6), self.capacity)
+        if self.n + kpad > self.capacity:
+            self._grow(self.n + kpad)
+        delta = []
+        for name, dt in COLUMNS:
+            arr = np.full(kpad, _FILL[name], dtype=dt)
+            if name == "client":
+                arr[:k] = np.where(
+                    valid, self._map_clients(raw_cl, valid), 0
+                )
+            elif name == "origin_client":
+                arr[:k] = self._map_clients(raw_ocl, raw_ocl >= 0)
+            else:
+                arr[:k] = cols[name][:k]
+            delta.append(jnp.asarray(arr))
+        return tuple(delta)
+
     def append(self, cols: Dict[str, np.ndarray]) -> None:
         """Splice a host-side delta into the resident union. Only the
         delta (padded to its power-of-two bucket) crosses to the
@@ -163,34 +210,44 @@ class ResidentColumns:
         k = len(cols["client"])
         if k == 0:
             return
-        valid = np.asarray(cols["valid"][:k], bool)
-        raw_cl = np.asarray(cols["client"][:k])
-        raw_ocl = np.asarray(cols["origin_client"][:k])
-        perm = self._intern(
-            np.concatenate([raw_cl[valid], raw_ocl[raw_ocl >= 0]])
-        )
         with jax.enable_x64(True):
-            if perm is not None:
-                self._bufs = _relabel(self._bufs, jnp.asarray(perm))
-            if self.n + k > self.capacity:
-                self._grow(self.n + k)
-            kpad = min(_bucket(k, floor=6), self.capacity)
-            if self.n + kpad > self.capacity:
-                self._grow(self.n + kpad)
-            delta = []
-            for name, dt in COLUMNS:
-                arr = np.full(kpad, _FILL[name], dtype=dt)
-                if name == "client":
-                    arr[:k] = np.where(
-                        valid, self._map_clients(raw_cl, valid), 0
-                    )
-                elif name == "origin_client":
-                    arr[:k] = self._map_clients(raw_ocl, raw_ocl >= 0)
-                else:
-                    arr[:k] = cols[name][:k]
-                delta.append(jnp.asarray(arr))
-            self._bufs = _splice(self._bufs, tuple(delta), jnp.int32(self.n))
+            delta = self._prepare_delta(cols, k)
+            self._bufs = _splice(self._bufs, delta, jnp.int32(self.n))
         self.n += k
+
+    def append_converge(
+        self,
+        cols: Dict[str, np.ndarray],
+        num_segments: Optional[int] = None,
+        d_client=None,
+        d_start=None,
+        d_end=None,
+    ):
+        """Fused append + convergence: the splice and both kernels run
+        as ONE dispatch — the single-delta replay path. Equivalent to
+        ``append(cols)`` then ``converge(...)``."""
+        k = len(cols["client"])
+        if k == 0:
+            return self.converge(
+                num_segments=num_segments, d_client=d_client,
+                d_start=d_start, d_end=d_end,
+            )
+        with jax.enable_x64(True):
+            delta = self._prepare_delta(cols, k)
+            # default segments AFTER _prepare_delta: it may grow the
+            # capacity, and a pre-growth default would alias segment
+            # ids (diverging from append() + converge())
+            segs = num_segments or self.capacity
+            if d_client is None:
+                d_client = jnp.full(16, -1, jnp.int32)
+                d_start = jnp.full(16, -1, jnp.int64)
+                d_end = jnp.full(16, -1, jnp.int64)
+            self._bufs, maps_out, seq_out = _splice_and_converge(
+                self._bufs, delta, jnp.int32(self.n),
+                d_client, d_start, d_end, num_segments=segs,
+            )
+        self.n += k
+        return maps_out, seq_out
 
     def _grow(self, need: int) -> None:
         new_cap = _bucket(need)

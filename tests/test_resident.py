@@ -180,3 +180,48 @@ class TestClientInterning:
         maps_out, _ = rc.converge(num_segments=512)
         winners = np.asarray(maps_out[2])
         assert (winners >= 0).sum() == 1
+
+
+class TestFusedAppendConverge:
+    def test_matches_append_then_converge(self):
+        import numpy as np
+
+        cols = _map_cols(1, range(12), [0] * 12, list(range(6)) * 2)
+        a = ResidentColumns(capacity=512)
+        a.append(cols)
+        sep = a.converge(num_segments=512)
+        b = ResidentColumns(capacity=512)
+        fused = b.append_converge(cols, num_segments=512)
+        for x, y in zip(sep, fused):
+            for ax, ay in zip(x, y):
+                np.testing.assert_array_equal(np.asarray(ax), np.asarray(ay))
+        assert a.n == b.n == 12
+
+    def test_empty_delta_falls_back_to_converge(self):
+        rc = ResidentColumns(capacity=512)
+        rc.append(_map_cols(1, range(4), [0] * 4, range(4)))
+        out = rc.append_converge(
+            {k: v[:0] for k, v in _map_cols(1, [], [], []).items()},
+            num_segments=512,
+        )
+        import numpy as np
+
+        winners = np.asarray(out[0][2])
+        assert (winners >= 0).sum() == 4
+
+    def test_growing_fused_append_keeps_segment_default(self):
+        """A fused append that grows capacity must size its default
+        segment count from the POST-growth capacity."""
+        import numpy as np
+
+        rc = ResidentColumns(capacity=512)
+        rc.append(_map_cols(1, range(512), [0] * 512, range(512)))
+        grow = _map_cols(2, range(600), [1] * 600, range(600))
+        fused = rc.append_converge(grow)  # default num_segments
+        ref = ResidentColumns(capacity=512)
+        ref.append(_map_cols(1, range(512), [0] * 512, range(512)))
+        ref.append(grow)
+        sep = ref.converge()
+        for x, y in zip(sep, fused):
+            for ax, ay in zip(x, y):
+                np.testing.assert_array_equal(np.asarray(ax), np.asarray(ay))
