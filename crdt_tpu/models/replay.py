@@ -48,8 +48,22 @@ def stage(dec: Dict) -> Tuple[Dict[str, np.ndarray], DeleteSet]:
 
 def converge(cols: Dict[str, np.ndarray], *,
              clients: Optional[Sequence[int]] = None):
-    """One resident-union convergence: returns (resident, maps_out,
-    seq_out) with outputs still on device."""
+    """One union convergence. Returns an opaque handle for
+    :func:`gather`.
+
+    Fast path: the packed single-dispatch pipeline
+    (:mod:`crdt_tpu.ops.packed` — one upload, one fused kernel, one
+    fetch). Falls back to the general resident path when the batch
+    exceeds the packed key bounds (>=2^25 parents, >=2^21 map keys)."""
+    from crdt_tpu.ops import packed
+
+    plan = packed.stage(cols)
+    if plan is not None:
+        return ("packed", packed.converge(plan))
+    return ("resident", _converge_resident(cols, clients))
+
+
+def _converge_resident(cols, clients):
     import jax
 
     from crdt_tpu.ops.device import bucket_pow2
@@ -88,22 +102,55 @@ def parent_spec(dec: Dict, row: int) -> Tuple:
     )
 
 
-def gather(dec: Dict, ds: DeleteSet, maps_out, seq_out):
+def gather(dec: Dict, ds: DeleteSet, handle):
     """Winner rows + visibility + per-sequence document orders (keyed
-    by parent spec — root name or item id), via one packed int32
-    device->host transfer.
+    by parent spec — root name or item id) from a :func:`converge`
+    handle.
 
     The device kernels' sibling/argmax models are exact for unions
     without right origins (append-only gossip, map sets — the firehose
     shape). Rows carrying rights — honest prepends/mid-inserts, or
     crafted updates — re-order on the host through the exact machinery
     so the result always matches the scalar document."""
+    if handle[0] == "packed":
+        win_rows, seq_orders = _assemble_packed(dec, handle[1])
+    else:
+        win_rows, seq_orders = _assemble_resident(dec, handle[1])
+
+    rc_col, kid_col = dec["right_client"], dec["key_id"]
+    right_seq_rows = np.flatnonzero((rc_col >= 0) & (kid_col < 0))
+    if len(right_seq_rows):
+        # right-bearing sequences: replace exactly the AFFECTED
+        # parents' device orders with the exact host machinery;
+        # untouched (append-only) sequences keep the kernel result
+        affected = {parent_spec(dec, int(r)) for r in right_seq_rows}
+        seq_orders.update(_host_seq_orders(dec, affected))
+    win_rows = _fix_map_chains_with_rights(dec, win_rows)
+    win_vis = visible_mask(dec, win_rows, ds)
+    return win_rows, win_vis, seq_orders
+
+
+def _assemble_packed(dec: Dict, res):
+    """Vectorized host assembly of the packed kernel's one fetch."""
+    win_rows = res.win_rows[res.win_rows >= 0].tolist()
+    m = res.stream_row >= 0
+    rows, segs = res.stream_row[m], res.stream_seg[m]
+    seq_orders: dict = {}
+    if len(rows):
+        cuts = np.r_[0, np.flatnonzero(segs[1:] != segs[:-1]) + 1, len(segs)]
+        for a, b in zip(cuts[:-1], cuts[1:]):
+            chunk = rows[a:b].tolist()
+            seq_orders[parent_spec(dec, chunk[0])] = chunk
+    return win_rows, seq_orders
+
+
+def _assemble_resident(dec: Dict, out):
+    rc, maps_out, seq_out = out
     from crdt_tpu.ops.device import fetch_packed_i32
 
     order, winners, sorder, sseg, srank = fetch_packed_i32(
         maps_out[0], maps_out[2], seq_out[0], seq_out[1], seq_out[2]
     )
-
     win_rows = [int(order[w]) for w in winners if w >= 0]
     n = len(dec["client"])
     seq_pairs: dict = {}
@@ -118,18 +165,7 @@ def gather(dec: Dict, ds: DeleteSet, maps_out, seq_out):
         pairs.sort()
         rows = [r for _, r in pairs]
         seq_orders[parent_spec(dec, rows[0])] = rows
-
-    rc_col, kid_col = dec["right_client"], dec["key_id"]
-    right_seq_rows = np.flatnonzero((rc_col >= 0) & (kid_col < 0))
-    if len(right_seq_rows):
-        # right-bearing sequences: replace exactly the AFFECTED
-        # parents' device orders with the exact host machinery;
-        # untouched (append-only) sequences keep the kernel result
-        affected = {parent_spec(dec, int(r)) for r in right_seq_rows}
-        seq_orders.update(_host_seq_orders(dec, affected))
-    win_rows = _fix_map_chains_with_rights(dec, win_rows)
-    win_vis = visible_mask(dec, win_rows, ds)
-    return win_rows, win_vis, seq_orders
+    return win_rows, seq_orders
 
 
 def _host_seq_orders(dec: Dict, specs_needed: set):
@@ -277,6 +313,15 @@ def materialize(dec: Dict, ds: DeleteSet, win_rows, win_vis,
     kind_col, tref = dec["kind"], dec["type_ref"]
     contents = dec["contents"]
 
+    # vectorized tombstone test for every sequence row at once (the
+    # per-row ds.contains walk was ~half of materialize at 100k ops)
+    all_seq_rows = sorted(
+        {int(r) for rows in seq_orders.values() for r in rows}
+    )
+    seq_vis = dict(
+        zip(all_seq_rows, visible_mask(dec, all_seq_rows, ds))
+    )
+
     # visible map winners grouped by their parent spec
     map_groups: Dict[Tuple, Dict[str, int]] = {}
     for row, vis in zip(win_rows, win_vis):
@@ -304,7 +349,7 @@ def materialize(dec: Dict, ds: DeleteSet, win_rows, win_vis,
         return [
             value_of(r, depth)
             for r in seq_orders.get(spec, ())
-            if not ds.contains(int(client[r]), int(clock[r]))
+            if seq_vis[int(r)]
         ]
 
     cache: dict = {}
@@ -338,8 +383,8 @@ def replay_trace(
     """One-shot: blobs in, converged cache + compacted snapshot out."""
     dec = decode(blobs)
     cols, ds = stage(dec)
-    _, maps_out, seq_out = converge(cols, clients=clients)
-    win_rows, win_vis, seq_orders = gather(dec, ds, maps_out, seq_out)
+    handle = converge(cols, clients=clients)
+    win_rows, win_vis, seq_orders = gather(dec, ds, handle)
     cache = materialize(dec, ds, win_rows, win_vis, seq_orders)
     return ReplayResult(
         cache=cache, snapshot=compact(dec, ds), n_ops=len(dec["client"])

@@ -53,7 +53,7 @@ def apply_mask(
     rkey = rkey[order]
     rend = pack_id(d_client[order], d_end[order])
     ikey = pack_id(client, clock)
-    pos = jnp.searchsorted(rkey, ikey, side="right") - 1
+    pos = jnp.searchsorted(rkey, ikey, side="right", method="sort") - 1
     pos_c = jnp.clip(pos, 0, rkey.shape[0] - 1)
     inside = (pos >= 0) & (ikey >= rkey[pos_c]) & (ikey < rend[pos_c])
     # same-client guard (packed compare already implies it, but be

@@ -92,11 +92,44 @@ def dense_ranks_sorted(sorted_key: jnp.ndarray) -> jnp.ndarray:
 
 
 def searchsorted_ids(sorted_ids: jnp.ndarray, query: jnp.ndarray) -> jnp.ndarray:
-    """Index of each query id in sorted_ids, or NULLI if absent."""
-    pos = jnp.searchsorted(sorted_ids, query)
+    """Index of each query id in sorted_ids, or NULLI if absent.
+
+    method='sort' everywhere in this package: the default binary-search
+    lowering is a log(N)-step loop of full-width gathers, an order of
+    magnitude slower on TPU than one extra radix sort pass (measured
+    ~34ms vs ~2.4ms at N=128k on v5e)."""
+    pos = jnp.searchsorted(sorted_ids, query, method="sort")
     pos_c = jnp.clip(pos, 0, sorted_ids.shape[0] - 1)
     found = (sorted_ids.shape[0] > 0) & (sorted_ids[pos_c] == query) & (query >= 0)
     return jnp.where(found, pos_c, NULLI).astype(jnp.int32)
+
+
+def scatter_perm(perm: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """out[perm[i]] = vals[i] for a PERMUTATION perm — as a gather.
+
+    XLA TPU lowers a real scatter to a serialized update loop (~13ms at
+    N=128k on v5e); inverting the permutation with one more argsort and
+    gathering is ~50x cheaper. Only valid when perm is a permutation of
+    0..N-1 (e.g. any argsort output)."""
+    return vals[jnp.argsort(perm, stable=True)]
+
+
+def run_edge_lookup(slots_sorted: jnp.ndarray, size: int, *, side: str):
+    """For each dense slot j in [0, size): the index into `slots_sorted`
+    of the FIRST (side='left') or LAST (side='right') element equal to
+    j, or NULLI when j is absent. `slots_sorted` must be ascending
+    (route invalid rows to a value >= size before sorting).
+
+    This is the scatter-free way to build dense per-slot tables (first
+    child per parent, last child per node, max per segment): sort rows
+    by slot once, then one searchsorted picks each run's edge."""
+    iota = jnp.arange(size, dtype=slots_sorted.dtype)
+    pos = jnp.searchsorted(slots_sorted, iota, side=side, method="sort")
+    if side == "right":
+        pos = pos - 1
+    pos_c = jnp.clip(pos, 0, slots_sorted.shape[0] - 1)
+    found = slots_sorted[pos_c] == iota
+    return jnp.where(found, pos_c, NULLI).astype(jnp.int32), found
 
 
 def pointer_double(f: jnp.ndarray) -> jnp.ndarray:

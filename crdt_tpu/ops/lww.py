@@ -14,12 +14,13 @@ reached from the virtual root by repeatedly stepping to the
 
 Kernel shape (all vectorized, no data-dependent Python control flow):
 
-1. scatter-max: for every item, pack (client, ~clock) and scatter-max
-   into its parent slot -> last-child key per node.
-2. scatter the index of each node's last child (key match).
-3. pointer doubling over the last-child function -> rightmost
+1. sort items by (parent slot, packed (client, ~clock)) — each
+   parent's run-tail in this order is its last child; one
+   searchsorted over the run boundaries builds the dense last-child
+   table (scatter-free: XLA TPU scatters serialize, sorts don't).
+2. pointer doubling over the last-child function -> rightmost
    descendant (= chain tail) of every node in O(log depth) rounds.
-4. gather per-segment winner from each segment's virtual root.
+3. gather per-segment winner from each segment's virtual root.
 
 This is the "segmented argmax over Lamport clocks" of the north star
 (BASELINE.json), done exactly: a plain per-key argmax over (clock,
@@ -32,7 +33,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from crdt_tpu.ops.device import _CLOCK_BITS, NULLI, pointer_double
+from crdt_tpu.ops.device import (
+    _CLOCK_BITS,
+    NULLI,
+    lexsort,
+    pointer_double,
+    run_edge_lookup,
+)
 
 
 def map_winners(
@@ -53,32 +60,25 @@ def map_winners(
     n = client.shape[0]
     m = n + num_segments  # item nodes + one virtual root per segment
     is_map = valid & (seg >= 0)
-    idx_n = jnp.arange(n, dtype=jnp.int32)
 
     # child -> parent edges; roots hang off their segment's virtual root
     origin_ok = (origin_idx >= 0) & is_map
     origin_seg = jnp.where(origin_ok, seg[jnp.clip(origin_idx, 0, n - 1)], NULLI)
     same_seg = origin_ok & (origin_seg == seg)
     parent = jnp.where(same_seg, origin_idx, n + seg)
-    parent = jnp.where(is_map, parent, 0)  # dummy slot for non-map rows
+    parent = jnp.where(is_map, parent, m)  # overflow slot for non-map rows
 
-    # scatter-max of (client, inverted clock) -> last-child key per node
+    # last child per node = max child by (client, inverted clock) —
+    # computed scatter-free: sort children by (parent, key), then each
+    # parent's run-tail IS its last child (see run_edge_lookup)
     inv_clock = ((1 << _CLOCK_BITS) - 1) - clock.astype(jnp.int64)
-    pack = jnp.where(
-        is_map,
-        (client.astype(jnp.int64) << _CLOCK_BITS) | inv_clock,
-        jnp.int64(-1),
-    )
-    best = jnp.full(m, -1, dtype=jnp.int64).at[parent].max(pack, mode="drop")
-
-    # index of each node's last child: ids are unique after dedup, so
-    # exactly one child matches its parent's best key
-    is_last_child = is_map & (best[parent] == pack)
-    child_idx = (
-        jnp.full(m, NULLI, jnp.int32)
-        .at[jnp.where(is_last_child, parent, 0)]
-        .max(jnp.where(is_last_child, idx_n, NULLI), mode="drop")
-    )
+    pack = (client.astype(jnp.int64) << _CLOCK_BITS) | inv_clock
+    corder = lexsort([parent, pack])
+    p_sorted = parent[corder]
+    last_pos, _ = run_edge_lookup(p_sorted, m, side="right")
+    child_idx = jnp.where(
+        last_pos >= 0, corder[jnp.clip(last_pos, 0, n - 1)], NULLI
+    ).astype(jnp.int32)
 
     # last-child function with self-loops at leaves
     f = jnp.where(child_idx >= 0, child_idx, jnp.arange(m, dtype=jnp.int32))

@@ -34,6 +34,7 @@ from crdt_tpu.ops.device import (
     dense_ranks_sorted,
     lexsort,
     pack_id,
+    scatter_perm,
     searchsorted_ids,
 )
 from crdt_tpu.ops.lww import map_winners
@@ -101,7 +102,7 @@ def converge_maps(
         ks = k[sorder]
         changed = changed | jnp.concatenate([jnp.ones(1, bool), ks[1:] != ks[:-1]])
     seg_sorted = jnp.cumsum(changed.astype(jnp.int32)) - 1
-    seg = jnp.zeros(n, jnp.int32).at[sorder].set(seg_sorted)
+    seg = scatter_perm(sorder, seg_sorted)
     seg = jnp.where(is_map, seg, NULLI)
 
     # -- 4. per-segment winners ----------------------------------------

@@ -1,0 +1,252 @@
+"""Packed one-dispatch trace-replay convergence.
+
+The firehose replay (BASELINE config #5; ``crdt_tpu.models.replay``)
+is a COLD start: decode a trace, converge once, materialize. On a
+tunnelled single-chip platform every host<->device interaction pays a
+fixed round-trip (measured ~25ms) and bulk transfer runs ~60MB/s, so
+the general :class:`~crdt_tpu.ops.resident.ResidentColumns` path —
+9 buffer allocations + 9 column uploads + dispatch — spends most of
+its wall clock on transport, not merging. This module collapses the
+whole cold replay to exactly three device interactions:
+
+  1. ONE host->device transfer: all op columns packed into a single
+     int32 (or int64 when clocks are wide) matrix;
+  2. ONE dispatch: unpack -> shared id-sort/dedup/origin resolution ->
+     map winners (:func:`crdt_tpu.ops.lww.map_winners`) + sequence DFS
+     ranks (:func:`crdt_tpu.ops.yata.tree_order_ranks`) — the same
+     exact kernel cores as the general path — plus document-order
+     assembly, all fused;
+  3. ONE device->host transfer: a single packed int32 result (winner
+     rows + per-sequence document-order streams).
+
+Segment ids for maps and sequences come from ONE argsort of a single
+composite key (is_map | parent_ref | key_id) — parent specs are
+interned to dense ids on the host, which already walks the columns
+once to build them. Clients are interned to dense ORDER-PRESERVING
+ranks (the sibling rules compare client ids, so the map must be
+monotone — same rationale as ``ResidentColumns``).
+
+Reference hot loop being replaced: crdt.js:294 (``Y.applyUpdate`` per
+update); here the whole union is one applyUpdate, as the north star
+prescribes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crdt_tpu.ops.device import (
+    NULLI,
+    bucket_pow2,
+    pack_id,
+    scatter_perm,
+    searchsorted_ids,
+)
+
+# host-side packing limits for the composite segment key:
+# (is_map:1 | pref:25 bits | kid:21 bits) must fit non-negative int64
+_PREF_BITS = 25
+_KID_BITS = 21
+
+
+class PackedPlan(NamedTuple):
+    """Host-side staging result: one matrix + static metadata."""
+
+    mat: np.ndarray           # [7, kpad] i32 (narrow) or i64 (wide)
+    n: int                    # real rows (rest is padding)
+    num_segments: int         # pow2 bucket over distinct segments
+    seq_bucket: int           # pow2 bucket over sequence-row count
+    clients: np.ndarray       # sorted raw client ids (dense rank = index)
+
+
+def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
+    """Pack kernel columns into the single-transfer matrix.
+
+    Returns None when the batch exceeds the packed path's key bounds
+    (callers fall back to the general kernels): >=2^25 distinct
+    parents or >=2^21 distinct map keys.
+    """
+    client = np.asarray(cols["client"], np.int64)
+    clock = np.asarray(cols["clock"], np.int64)
+    pir = np.asarray(cols["parent_is_root"], bool)
+    pa = np.asarray(cols["parent_a"], np.int64)
+    pb = np.asarray(cols["parent_b"], np.int64)
+    kid = np.asarray(cols["key_id"], np.int64)
+    oc = np.asarray(cols["origin_client"], np.int64)
+    ock = np.asarray(cols["origin_clock"], np.int64)
+    valid = np.asarray(cols["valid"], bool)
+    n = len(client)
+    if n == 0 or not valid.any():
+        return None
+
+    # dense order-preserving client ranks (origins share the table)
+    uniq = np.unique(np.concatenate([client[valid], oc[oc >= 0]]))
+    client_d = np.searchsorted(uniq, np.clip(client, uniq[0], None))
+    client_d = np.where(valid, client_d, 0)
+    oc_d = np.where(oc >= 0, np.searchsorted(uniq, np.clip(oc, uniq[0], None)), -1)
+
+    # dense parent refs: exact two-key unique via lexsort runs
+    porder = np.lexsort((pb, pa, pir))
+    pir_s, pa_s, pb_s = pir[porder], pa[porder], pb[porder]
+    new_run = np.r_[
+        True,
+        (pir_s[1:] != pir_s[:-1])
+        | (pa_s[1:] != pa_s[:-1])
+        | (pb_s[1:] != pb_s[:-1]),
+    ]
+    ref_sorted = np.cumsum(new_run) - 1
+    pref = np.empty(n, np.int64)
+    pref[porder] = ref_sorted
+    n_parents = int(ref_sorted[-1]) + 1
+
+    kid_max = int(kid.max())
+    if n_parents >= (1 << _PREF_BITS) or kid_max >= (1 << _KID_BITS):
+        return None
+
+    # distinct segments: map rows by (pref, kid), seq rows by pref
+    segkey = (pref << _KID_BITS) | np.where(kid >= 0, kid, 0)
+    segkey = np.where(kid >= 0, segkey | (1 << 62), segkey)
+    n_segs = len(np.unique(segkey[valid]))
+    n_seq = int((valid & (kid < 0)).sum())
+
+    narrow = clock.max() < (1 << 31) and ock.max() < (1 << 31)
+    dt = np.int32 if narrow else np.int64
+    kpad = bucket_pow2(n, floor=6)
+    mat = np.zeros((7, kpad), dt)
+    mat[0, :n] = client_d
+    mat[1, :n] = clock
+    mat[2, :n] = pref
+    mat[3, :n] = kid
+    mat[4, :n] = oc_d
+    mat[5, :n] = ock
+    mat[6, :n] = valid
+    mat[3, n:] = -1  # padding rows: invalid, non-map, null origins
+    mat[4, n:] = -1
+    mat[5, n:] = -1
+    return PackedPlan(
+        mat=mat,
+        n=n,
+        num_segments=bucket_pow2(n_segs),
+        seq_bucket=min(kpad, bucket_pow2(max(n_seq, 1), floor=6)),
+        clients=uniq,
+    )
+
+
+@partial(jax.jit, static_argnames=("num_segments", "seq_bucket"))
+def _converge_packed(mat, num_segments: int, seq_bucket: int):
+    """The single fused dispatch. Returns one packed int32 array:
+
+      [ win_rows[S] | stream_seg[B] | stream_row[B] ]
+
+    - win_rows: original row index of each map segment's winner (-1
+      for non-map / empty segments);
+    - stream_seg/stream_row: sequence rows in document order, grouped
+      by segment id (B = seq_bucket; -1 padding at the tail).
+    """
+    from crdt_tpu.ops.lww import map_winners
+    from crdt_tpu.ops.yata import tree_order_ranks
+
+    client = mat[0].astype(jnp.int32)
+    clock = mat[1].astype(jnp.int64)
+    pref = mat[2].astype(jnp.int64)
+    kid = mat[3].astype(jnp.int32)
+    oc = mat[4].astype(jnp.int32)
+    ock = mat[5].astype(jnp.int64)
+    valid = mat[6] != 0
+    n = client.shape[0]
+
+    # shared id-sort + dedup + origin resolution (one for both kernels)
+    ikey = jnp.where(valid, pack_id(client, clock), jnp.int64(2**62))
+    order = jnp.argsort(ikey, stable=True)
+    ikey = ikey[order]
+    client = client[order]
+    clock = clock[order]
+    pref = pref[order]
+    kid = kid[order]
+    oc = oc[order]
+    ock = ock[order]
+    valid = valid[order]
+    dup = jnp.concatenate([jnp.zeros(1, bool), ikey[1:] == ikey[:-1]])
+    uniq_valid = valid & ~dup
+    okey = pack_id(oc, ock)
+    origin_idx = searchsorted_ids(ikey, okey)
+
+    is_map = uniq_valid & (kid >= 0)
+    is_seq = uniq_valid & (kid < 0)
+
+    # one composite segment key covers maps AND sequences
+    segkey = (pref << _KID_BITS) | jnp.where(is_map, kid, 0)
+    segkey = jnp.where(is_map, segkey | (jnp.int64(1) << 62), segkey)
+    segkey = jnp.where(uniq_valid, segkey, jnp.int64(2**63 - 1))
+    sorder = jnp.argsort(segkey, stable=True)
+    sk = segkey[sorder]
+    changed = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
+    seg_sorted = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    seg = scatter_perm(sorder, seg_sorted)
+    seg_map = jnp.where(is_map, seg, NULLI)
+    seg_seq = jnp.where(is_seq, seg, NULLI)
+
+    winners = map_winners(
+        seg_map, client, clock, origin_idx, is_map, num_segments
+    )
+    win_rows = jnp.where(
+        winners >= 0, order[jnp.clip(winners, 0, n - 1)], NULLI
+    ).astype(jnp.int32)
+
+    oseg = jnp.where(origin_idx >= 0, seg[jnp.clip(origin_idx, 0, n - 1)], NULLI)
+    parent_idx = jnp.where(
+        (origin_idx >= 0) & (oseg == seg_seq), origin_idx, NULLI
+    )
+    rank, _ = tree_order_ranks(
+        seg_seq,
+        parent_idx,
+        client.astype(jnp.int64),
+        -clock.astype(jnp.int64),
+        is_seq,
+        num_segments=num_segments,
+    )
+
+    # document-order stream: sequence rows sorted by (segment, rank),
+    # truncated to the static seq bucket (staging sizes it to cover
+    # the true sequence-row count)
+    skey2 = jnp.where(
+        is_seq & (rank >= 0),
+        (seg_seq.astype(jnp.int64) << 32) | rank.astype(jnp.int64),
+        jnp.int64(2**62),
+    )
+    dorder = jnp.argsort(skey2, stable=True)[:seq_bucket]
+    d_ok = (is_seq & (rank >= 0))[dorder]
+    stream_seg = jnp.where(d_ok, seg_seq[dorder], NULLI).astype(jnp.int32)
+    stream_row = jnp.where(d_ok, order[dorder], NULLI).astype(jnp.int32)
+
+    return jnp.concatenate([win_rows, stream_seg, stream_row])
+
+
+class PackedResult(NamedTuple):
+    win_rows: np.ndarray     # [S] original row of each map winner (-1 none)
+    stream_seg: np.ndarray   # [B] doc-order segment ids (-1 padding)
+    stream_row: np.ndarray   # [B] doc-order original rows (-1 padding)
+
+
+def converge(plan: PackedPlan) -> PackedResult:
+    """Stage -> single dispatch -> single fetch."""
+    with jax.enable_x64(True):
+        dev_mat = jnp.asarray(plan.mat)                      # 1 transfer
+        out = _converge_packed(
+            dev_mat,
+            num_segments=plan.num_segments,
+            seq_bucket=plan.seq_bucket,
+        )                                                    # 1 dispatch
+        h = np.asarray(out)                                  # 1 fetch
+    s = plan.num_segments
+    b = plan.seq_bucket
+    return PackedResult(
+        win_rows=h[:s],
+        stream_seg=h[s:s + b],
+        stream_row=h[s + b:s + 2 * b],
+    )

@@ -19,16 +19,23 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from crdt_tpu.ops.device import lexsort, run_edge_lookup
+
 
 def build(
     client: jnp.ndarray, clock: jnp.ndarray, valid: jnp.ndarray, num_clients: int
 ) -> jnp.ndarray:
     """Next-clock per client. Assumes per-client clocks are contiguous
     (integration enforces this; see ItemStore.state_vector for the
-    host-side gap-honest variant)."""
+    host-side gap-honest variant). Scatter-free: sort by (client,
+    next-clock) and read each client's run-tail (TPU scatters
+    serialize; sorts don't)."""
     nxt = jnp.where(valid, clock + 1, 0)
-    cl = jnp.where(valid, client, 0)
-    return jnp.zeros(num_clients, clock.dtype).at[cl].max(nxt, mode="drop")
+    cl = jnp.where(valid, client, num_clients).astype(jnp.int32)
+    order = lexsort([cl, nxt])
+    last_pos, found = run_edge_lookup(cl[order], num_clients, side="right")
+    vals = nxt[order][jnp.clip(last_pos, 0, cl.shape[0] - 1)]
+    return jnp.where(found, vals, 0).astype(nxt.dtype)
 
 
 def diff_mask(

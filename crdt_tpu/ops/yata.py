@@ -56,6 +56,8 @@ from crdt_tpu.ops.device import (
     lexsort,
     pack_id,
     pointer_double,
+    run_edge_lookup,
+    scatter_perm,
     searchsorted_ids,
 )
 
@@ -81,20 +83,20 @@ def tree_order_ranks(
     )
     parent = jnp.where(is_seq, parent, m)  # invalid rows -> overflow bucket
 
-    # sibling adjacency: sort by (parent, key1, key2)
+    # sibling adjacency: sort by (parent, key1, key2). Rows routed to
+    # the overflow slot m are exactly the non-sequence rows, so every
+    # run with parent < m is a clean sibling group.
     order = lexsort([parent, key1, key2])
     p_s = parent[order]
     same_group = jnp.concatenate([p_s[1:] == p_s[:-1], jnp.zeros(1, bool)])
     nxt_sorted = jnp.where(same_group, jnp.roll(order, -1), NULLI).astype(jnp.int32)
-    next_sib = jnp.full(n, NULLI, jnp.int32).at[order].set(nxt_sorted)
+    next_sib = scatter_perm(order, nxt_sorted)  # scatter-free inverse
 
-    group_first = jnp.concatenate([jnp.ones(1, bool), p_s[1:] != p_s[:-1]])
-    first_mask = group_first & is_seq[order]
-    first_child = (
-        jnp.full(m + 1, NULLI, jnp.int32)
-        .at[jnp.where(first_mask, p_s, m)]
-        .set(jnp.where(first_mask, order, NULLI).astype(jnp.int32), mode="drop")
-    )[:m]
+    # dense first-child table via one searchsorted over the run starts
+    first_pos, _ = run_edge_lookup(p_s, m, side="left")
+    first_child = jnp.where(
+        first_pos >= 0, order[jnp.clip(first_pos, 0, n - 1)], NULLI
+    ).astype(jnp.int32)
 
     # climb past last-child chains: g(x) = parent if no next sibling
     pad_next = jnp.pad(next_sib, (0, num_segments), constant_values=NULLI)
@@ -117,17 +119,26 @@ def tree_order_ranks(
     succ = jnp.where(has_fc, jnp.clip(first_child, 0, m - 1), succ_no_fc)
     succ = jnp.where(pad_isseq | (idx_m >= n), succ, idx_m).astype(jnp.int32)
 
-    # Wyllie list ranking: dist to end of sequence
+    # Wyllie list ranking: dist to end of sequence. Early exit at the
+    # fixpoint (ptr all self-loops) — real documents are far shallower
+    # than the log2(m) worst case, and each extra round is two full
+    # gathers.
     dist = jnp.where(succ != idx_m, 1, 0).astype(jnp.int32)
     iters = max(1, (max(m, 2) - 1).bit_length() + 1)
 
-    def body(_, state):
-        ptr, d = state
+    def body(state):
+        ptr, d, it, _ = state
         d = d + d[ptr]
-        ptr = ptr[ptr]
-        return ptr, d
+        ptr2 = ptr[ptr]
+        return ptr2, d, it + 1, jnp.any(ptr2 != ptr)
 
-    _, dist_to_end = jax.lax.fori_loop(0, iters, body, (succ, dist))
+    def cond(state):
+        _, _, it, changed = state
+        return changed & (it < iters)
+
+    _, dist_to_end, _, _ = jax.lax.while_loop(
+        cond, body, (succ, dist, jnp.int32(0), jnp.any(succ[succ] != succ))
+    )
 
     root_dist = dist_to_end[n + jnp.maximum(seg, 0)]
     rank = jnp.where(is_seq, root_dist - dist_to_end[:n] - 1, NULLI).astype(
@@ -194,7 +205,7 @@ def converge_sequences(
         ks = k[sorder]
         changed = changed | jnp.concatenate([jnp.ones(1, bool), ks[1:] != ks[:-1]])
     seg_sorted = jnp.cumsum(changed.astype(jnp.int32)) - 1
-    seg = jnp.zeros(n, jnp.int32).at[sorder].set(seg_sorted)
+    seg = scatter_perm(sorder, seg_sorted)
     seg = jnp.where(is_seq, seg, NULLI)
 
     # origin rows; cross-segment / absent origins hang off the segment
