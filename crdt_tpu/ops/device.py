@@ -132,6 +132,62 @@ def run_edge_lookup(slots_sorted: jnp.ndarray, size: int, *, side: str):
     return jnp.where(found, pos_c, NULLI).astype(jnp.int32), found
 
 
+def dfs_ranks(
+    parent: jnp.ndarray,      # [B] int32 tree parent (root children point
+                              #     at B+seg; non-items at B+num_roots)
+    next_sib: jnp.ndarray,    # [B] int32 next sibling, NULLI at group end
+    first_child: jnp.ndarray, # [B+num_roots] int32 first child per node
+    is_item: jnp.ndarray,     # [B] bool real tree members
+    num_roots: int,
+) -> jnp.ndarray:
+    """Distance-to-end of the DFS traversal for every node (items and
+    the virtual roots appended after them) via successor pointer
+    doubling (Wyllie list ranking with fixpoint early exit).
+
+    The DFS successor of a node is its first child if any, else the
+    next sibling of the nearest ancestor (itself included) that has
+    one — the "climb past last-child chains" step, itself a pointer
+    doubling. Shared by :func:`crdt_tpu.ops.yata.tree_order_ranks`
+    (full-width) and the packed replay kernel (compact-width).
+    """
+    B = parent.shape[0]
+    m = B + num_roots
+    idx_m = jnp.arange(m, dtype=jnp.int32)
+    pad_next = jnp.pad(next_sib, (0, num_roots), constant_values=NULLI)
+    pad_parent = jnp.pad(parent, (0, num_roots), constant_values=0).astype(
+        jnp.int32
+    )
+    pad_item = jnp.pad(is_item, (0, num_roots))
+
+    is_last_child = (idx_m < B) & (pad_next == NULLI) & pad_item
+    g = jnp.where(is_last_child, pad_parent, idx_m)
+    climb_t = pointer_double(g)
+
+    y_next = pad_next[jnp.clip(climb_t, 0, m - 1)]
+    succ = jnp.where((climb_t >= B) | (y_next < 0), idx_m, y_next)
+    succ = jnp.where(
+        first_child >= 0, jnp.clip(first_child, 0, m - 1), succ
+    )
+    succ = jnp.where(pad_item | (idx_m >= B), succ, idx_m).astype(jnp.int32)
+
+    dist = jnp.where(succ != idx_m, 1, 0).astype(jnp.int32)
+    iters = max(1, (max(m, 2) - 1).bit_length() + 1)
+
+    def body(state):
+        ptr, d, it, _ = state
+        d = d + d[ptr]
+        ptr2 = ptr[ptr]
+        return ptr2, d, it + 1, jnp.any(ptr2 != ptr)
+
+    def cond(state):
+        return state[3] & (state[2] < iters)
+
+    _, dist_to_end, _, _ = jax.lax.while_loop(
+        cond, body, (succ, dist, jnp.int32(0), jnp.any(succ[succ] != succ))
+    )
+    return dist_to_end
+
+
 def pointer_double(f: jnp.ndarray) -> jnp.ndarray:
     """Iterate f <- f∘f to a fixpoint. `f` maps node->node with
     self-loops at terminals; returns the terminal reached from each

@@ -43,7 +43,11 @@ import numpy as np
 from crdt_tpu.ops.device import (
     NULLI,
     bucket_pow2,
+    dense_ranks_sorted,
+    dfs_ranks,
+    lexsort,
     pack_id,
+    run_edge_lookup,
     scatter_perm,
     searchsorted_ids,
 )
@@ -149,7 +153,6 @@ def _converge_packed(mat, num_segments: int, seq_bucket: int):
       by segment id (B = seq_bucket; -1 padding at the tail).
     """
     from crdt_tpu.ops.lww import map_winners
-    from crdt_tpu.ops.yata import tree_order_ranks
 
     client = mat[0].astype(jnp.int32)
     clock = mat[1].astype(jnp.int64)
@@ -184,9 +187,7 @@ def _converge_packed(mat, num_segments: int, seq_bucket: int):
     segkey = jnp.where(is_map, segkey | (jnp.int64(1) << 62), segkey)
     segkey = jnp.where(uniq_valid, segkey, jnp.int64(2**63 - 1))
     sorder = jnp.argsort(segkey, stable=True)
-    sk = segkey[sorder]
-    changed = jnp.concatenate([jnp.ones(1, bool), sk[1:] != sk[:-1]])
-    seg_sorted = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    seg_sorted = dense_ranks_sorted(segkey[sorder])
     seg = scatter_perm(sorder, seg_sorted)
     seg_map = jnp.where(is_map, seg, NULLI)
     seg_seq = jnp.where(is_seq, seg, NULLI)
@@ -198,31 +199,82 @@ def _converge_packed(mat, num_segments: int, seq_bucket: int):
         winners >= 0, order[jnp.clip(winners, 0, n - 1)], NULLI
     ).astype(jnp.int32)
 
-    oseg = jnp.where(origin_idx >= 0, seg[jnp.clip(origin_idx, 0, n - 1)], NULLI)
-    parent_idx = jnp.where(
-        (origin_idx >= 0) & (oseg == seg_seq), origin_idx, NULLI
-    )
-    rank, _ = tree_order_ranks(
-        seg_seq,
-        parent_idx,
-        client.astype(jnp.int64),
-        -clock.astype(jnp.int64),
-        is_seq,
-        num_segments=num_segments,
-    )
+    # ---- sequence ranking in COMPACT space ---------------------------
+    # Sequence segkeys sort below map segkeys (bit 62) and invalid rows
+    # (max), so sorder's prefix holds exactly the sequence rows and the
+    # static seq bucket B >= n_seq covers them. All sibling/climb/rank
+    # machinery runs at size B (+S roots) instead of the full padded n.
+    B = seq_bucket
+    mB = B + num_segments
+    sub = sorder[:B]
+    c_ok = is_seq[sub]
+    c_seg = jnp.where(c_ok, seg[sub], NULLI)
+    # full-space row -> sorder position (compact index for seq rows)
+    inv_sorder = jnp.argsort(sorder, stable=True).astype(jnp.int32)
+    o = origin_idx[sub]
+    o_ok = c_ok & (o >= 0)
+    o_seg = jnp.where(o_ok, seg[jnp.clip(o, 0, n - 1)], NULLI)
+    same_seg = o_ok & (o_seg == c_seg)
+    c_parent = jnp.where(
+        same_seg, inv_sorder[jnp.clip(o, 0, n - 1)], NULLI
+    ).astype(jnp.int32)
 
-    # document-order stream: sequence rows sorted by (segment, rank),
-    # truncated to the static seq bucket (staging sizes it to cover
-    # the true sequence-row count)
+    parent = jnp.where(
+        c_ok & (c_parent >= 0), c_parent, B + jnp.maximum(c_seg, 0)
+    )
+    parent = jnp.where(c_ok, parent, mB).astype(jnp.int32)
+
+    # sibling order by (parent, client asc, clock DESC). Within one
+    # client, clock order == id-sorted position order, so the global
+    # row index (already an id-rank here) stands in for the clock —
+    # making the whole key fit one int64 when the static widths allow.
+    c_client = client[sub]
+    pos_desc = (n - 1) - sub  # descending position == descending clock
+    pbits = int(mB).bit_length()
+    qbits = int(max(n - 1, 1)).bit_length()
+    if pbits + 22 + qbits <= 63:
+        sibkey = (
+            (parent.astype(jnp.int64) << (22 + qbits))
+            | (c_client.astype(jnp.int64) << qbits)
+            | pos_desc.astype(jnp.int64)
+        )
+        sord2 = jnp.argsort(sibkey, stable=True)
+    else:
+        sord2 = lexsort([
+            parent.astype(jnp.int64),
+            (c_client.astype(jnp.int64) << qbits)
+            | pos_desc.astype(jnp.int64),
+        ])
+    p_s = parent[sord2]
+    same_group = jnp.concatenate([p_s[1:] == p_s[:-1], jnp.zeros(1, bool)])
+    nxt_sorted = jnp.where(
+        same_group, jnp.roll(sord2, -1), NULLI
+    ).astype(jnp.int32)
+    next_sib = scatter_perm(sord2, nxt_sorted)
+    first_pos, _ = run_edge_lookup(p_s, mB, side="left")
+    first_child = jnp.where(
+        first_pos >= 0, sord2[jnp.clip(first_pos, 0, B - 1)], NULLI
+    ).astype(jnp.int32)
+
+    # climb + DFS-successor + Wyllie ranking via the shared helper, at
+    # compact size (B items + S virtual roots instead of n + S)
+    dist_to_end = dfs_ranks(parent, next_sib, first_child, c_ok,
+                            num_segments)
+    root_dist = dist_to_end[B + jnp.maximum(c_seg, 0)]
+    c_rank = jnp.where(c_ok, root_dist - dist_to_end[:B] - 1, NULLI)
+
+    # document-order stream: compact rows sorted by (segment, rank)
     skey2 = jnp.where(
-        is_seq & (rank >= 0),
-        (seg_seq.astype(jnp.int64) << 32) | rank.astype(jnp.int64),
+        c_ok & (c_rank >= 0),
+        (c_seg.astype(jnp.int64) << qbits) | c_rank.astype(jnp.int64),
         jnp.int64(2**62),
     )
-    dorder = jnp.argsort(skey2, stable=True)[:seq_bucket]
-    d_ok = (is_seq & (rank >= 0))[dorder]
-    stream_seg = jnp.where(d_ok, seg_seq[dorder], NULLI).astype(jnp.int32)
-    stream_row = jnp.where(d_ok, order[dorder], NULLI).astype(jnp.int32)
+    dorder = jnp.argsort(skey2, stable=True)
+    d_ok = (c_ok & (c_rank >= 0))[dorder]
+    stream_seg = jnp.where(d_ok, c_seg[dorder], NULLI).astype(jnp.int32)
+    stream_row = jnp.where(
+        d_ok, order[sub[dorder]], NULLI
+    ).astype(jnp.int32)
 
     return jnp.concatenate([win_rows, stream_seg, stream_row])
 

@@ -53,9 +53,9 @@ import jax.numpy as jnp
 
 from crdt_tpu.ops.device import (
     NULLI,
+    dfs_ranks,
     lexsort,
     pack_id,
-    pointer_double,
     run_edge_lookup,
     scatter_perm,
     searchsorted_ids,
@@ -76,7 +76,6 @@ def tree_order_ranks(
     n = seg.shape[0]
     m = n + num_segments
     is_seq = valid & (seg >= 0)
-    idx_m = jnp.arange(m, dtype=jnp.int32)
 
     parent = jnp.where(
         is_seq & (parent_idx >= 0), parent_idx, n + jnp.maximum(seg, 0)
@@ -98,47 +97,10 @@ def tree_order_ranks(
         first_pos >= 0, order[jnp.clip(first_pos, 0, n - 1)], NULLI
     ).astype(jnp.int32)
 
-    # climb past last-child chains: g(x) = parent if no next sibling
-    pad_next = jnp.pad(next_sib, (0, num_segments), constant_values=NULLI)
-    pad_parent = jnp.pad(parent, (0, num_segments), constant_values=0).astype(
-        jnp.int32
-    )
-    pad_isseq = jnp.pad(is_seq, (0, num_segments))
-    is_last_child = (idx_m < n) & (pad_next == NULLI) & pad_isseq
-    g = jnp.where(is_last_child, pad_parent, idx_m)
-    climb_t = pointer_double(g)
-
-    # successor: first child, else next sibling of climb terminal
-    has_fc = first_child >= 0
-    y = climb_t
-    y_isroot = y >= n
-    y_next = pad_next[jnp.clip(y, 0, m - 1)]
-    succ_no_fc = jnp.where(
-        y_isroot | (y_next < 0), idx_m, y_next
-    )
-    succ = jnp.where(has_fc, jnp.clip(first_child, 0, m - 1), succ_no_fc)
-    succ = jnp.where(pad_isseq | (idx_m >= n), succ, idx_m).astype(jnp.int32)
-
-    # Wyllie list ranking: dist to end of sequence. Early exit at the
-    # fixpoint (ptr all self-loops) — real documents are far shallower
-    # than the log2(m) worst case, and each extra round is two full
-    # gathers.
-    dist = jnp.where(succ != idx_m, 1, 0).astype(jnp.int32)
-    iters = max(1, (max(m, 2) - 1).bit_length() + 1)
-
-    def body(state):
-        ptr, d, it, _ = state
-        d = d + d[ptr]
-        ptr2 = ptr[ptr]
-        return ptr2, d, it + 1, jnp.any(ptr2 != ptr)
-
-    def cond(state):
-        _, _, it, changed = state
-        return changed & (it < iters)
-
-    _, dist_to_end, _, _ = jax.lax.while_loop(
-        cond, body, (succ, dist, jnp.int32(0), jnp.any(succ[succ] != succ))
-    )
+    # DFS successor assembly + Wyllie ranking (shared helper; fixpoint
+    # early exit keeps rounds at the real document depth)
+    dist_to_end = dfs_ranks(parent, next_sib, first_child, is_seq,
+                            num_segments)
 
     root_dist = dist_to_end[n + jnp.maximum(seg, 0)]
     rank = jnp.where(is_seq, root_dist - dist_to_end[:n] - 1, NULLI).astype(

@@ -1,0 +1,148 @@
+"""Packed one-dispatch replay path (crdt_tpu.ops.packed).
+
+The end-to-end exactness of this path is covered by the replay
+differentials (tests/test_models.py, tests/test_grand_differential.py,
+which route through it); these tests pin the staging contract and the
+branches those suites do not reach: wide clocks, bound fallbacks, and
+the stream layout.
+"""
+
+import numpy as np
+import pytest
+
+from crdt_tpu.ops import packed
+
+
+def _cols(n, *, clock_base=0, clients=None, seq=False):
+    clients = clients if clients is not None else np.arange(1, n + 1)
+    kid = np.full(n, -1 if seq else 0, np.int64)
+    origin_c = np.full(n, -1, np.int64)
+    origin_k = np.full(n, -1, np.int64)
+    return {
+        "client": np.asarray(clients, np.int64),
+        "clock": np.arange(n, dtype=np.int64) + clock_base,
+        "parent_is_root": np.ones(n, bool),
+        "parent_a": np.zeros(n, np.int64),
+        "parent_b": np.full(n, -1, np.int64),
+        "key_id": kid,
+        "origin_client": origin_c,
+        "origin_clock": origin_k,
+        "valid": np.ones(n, bool),
+    }
+
+
+class TestStage:
+    def test_narrow_matrix(self):
+        plan = packed.stage(_cols(8))
+        assert plan is not None
+        assert plan.mat.dtype == np.int32
+        assert plan.mat.shape[0] == 7
+        assert plan.n == 8
+
+    def test_wide_clock_selects_int64(self):
+        plan = packed.stage(_cols(8, clock_base=1 << 33))
+        assert plan is not None and plan.mat.dtype == np.int64
+
+    def test_empty_returns_none(self):
+        cols = _cols(4)
+        cols["valid"][:] = False
+        assert packed.stage(cols) is None
+        assert packed.stage(_cols(0)) is None
+
+    def test_key_bound_fallback(self):
+        cols = _cols(4)
+        cols["key_id"][:] = 1 << packed._KID_BITS
+        assert packed.stage(cols) is None
+
+    def test_seq_bucket_covers_seq_rows(self):
+        cols = _cols(200, clients=np.ones(200), seq=True)
+        plan = packed.stage(cols)
+        assert plan.seq_bucket >= 200
+
+    def test_client_interning_order_preserving(self):
+        cols = _cols(3, clients=np.array([900, 5, 37]))
+        plan = packed.stage(cols)
+        assert list(plan.clients) == [5, 37, 900]
+        assert list(plan.mat[0, :3]) == [2, 0, 1]
+
+
+class TestConverge:
+    def test_map_winners_and_stream(self):
+        # 3 clients set the same key; client 3 wins (no chains)
+        cols = _cols(3, clients=np.array([1, 2, 3]))
+        cols["clock"][:] = 0
+        plan = packed.stage(cols)
+        res = packed.converge(plan)
+        wins = res.win_rows[res.win_rows >= 0]
+        assert list(wins) == [2]
+        assert not (res.stream_row >= 0).any()
+
+    def test_sequence_stream_document_order(self):
+        # one client appends a 5-chain: stream = rows in append order
+        n = 5
+        cols = _cols(n, clients=np.ones(n), seq=True)
+        cols["origin_client"] = np.asarray([-1, 1, 1, 1, 1], np.int64)
+        cols["origin_clock"] = np.asarray([-1, 0, 1, 2, 3], np.int64)
+        plan = packed.stage(cols)
+        res = packed.converge(plan)
+        rows = res.stream_row[res.stream_row >= 0]
+        assert list(rows) == [0, 1, 2, 3, 4]
+        segs = res.stream_seg[res.stream_seg >= 0]
+        assert len(set(segs.tolist())) == 1
+
+    def test_duplicate_ids_dedup(self):
+        # same (client, clock) delivered twice: one winner, first row kept
+        cols = _cols(2, clients=np.array([7, 7]))
+        cols["clock"][:] = 0
+        plan = packed.stage(cols)
+        res = packed.converge(plan)
+        wins = res.win_rows[res.win_rows >= 0]
+        assert len(wins) == 1
+
+    def test_resident_fallback_matches_packed(self, monkeypatch):
+        """The general resident path (taken when stage() refuses a
+        batch) must produce the same replay result as the packed
+        path — forced here by stubbing stage to refuse."""
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+        from crdt_tpu.models import replay_trace
+
+        rng = np.random.default_rng(5)
+        blobs = []
+        for client in (1, 2, 3):
+            recs, ds = [], DeleteSet()
+            prev = None
+            for k in range(30):
+                if k % 3 == 0:
+                    recs.append(ItemRecord(
+                        client=client, clock=k, parent_root="m",
+                        key=f"k{int(rng.integers(0, 4))}", content=k))
+                else:
+                    recs.append(ItemRecord(
+                        client=client, clock=k, parent_root="L",
+                        origin=(client, prev) if prev is not None else None,
+                        content=k))
+                    prev = k
+            ds.add(client, 1)
+            blobs.append(v1.encode_update(recs, ds))
+
+        want = replay_trace(blobs)  # packed path
+        monkeypatch.setattr(packed, "stage", lambda cols: None)
+        got = replay_trace(blobs)   # resident fallback
+        assert got.cache == want.cache
+        assert got.snapshot == want.snapshot
+
+    def test_wide_path_matches_narrow(self):
+        n = 40
+        rng = np.random.default_rng(0)
+        base = _cols(n, clients=rng.integers(1, 6, n), seq=True)
+        base["origin_client"][:] = -1
+        base["origin_clock"][:] = -1
+        narrow = packed.converge(packed.stage(base))
+        wide_cols = {k: v.copy() for k, v in base.items()}
+        wide_cols["clock"] = wide_cols["clock"] + (1 << 33)
+        wide = packed.converge(packed.stage(wide_cols))
+        n_rows = narrow.stream_row[narrow.stream_row >= 0]
+        w_rows = wide.stream_row[wide.stream_row >= 0]
+        assert list(n_rows) == list(w_rows)
