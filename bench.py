@@ -33,8 +33,10 @@ snapshot compaction" — measured honestly:
 Prints ONE JSON line with the headline and all supporting numbers.
 
 Env knobs: BENCH_REPLICAS (1000), BENCH_OPS (per replica, 100),
-BENCH_ITERS (3), BENCH_SKIP_ORACLE=1, BENCH_SCALE=k (also run a
-k-times-larger workload end to end on both paths).
+BENCH_ITERS (3), BENCH_SKIP_ORACLE=1, BENCH_SCALE (default 16: also
+run a 16x-larger workload end to end on both paths; 0 skips),
+BENCH_CONFLICT (default 1: also run the shared-anchor conflict
+workload, oracle-checked; 0 skips).
 """
 
 from __future__ import annotations
@@ -102,6 +104,64 @@ def build_trace(R: int, K: int, seed: int = 0):
         for k in rng.choice(K, size=max(1, K // 20), replace=False):
             ds.add(client, int(k))
         blobs.append(v1.encode_update(recs, ds))
+    return blobs
+
+
+def build_conflict_trace(R: int, K: int, seed: int = 2):
+    """The YATA hard case the append-only trace never triggers: every
+    replica keeps attaching to a handful of SHARED origin items, so
+    sibling groups grow R wide and the conflict scan (client-ordered
+    sibling resolution) does real work on every insert. Right origins
+    are absent, as in real concurrent appends, so both contenders stay
+    exact. 70% sequence ops (vs 40% in the main trace)."""
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.ids import DeleteSet
+    from crdt_tpu.core.records import ItemRecord
+
+    rng = np.random.default_rng(seed)
+    num_lists = 4
+    n_map = (K * 3) // 10
+    # shared attachment points (client 1's first seq ops), clamped so
+    # small K never references anchors client 1 does not emit
+    hot = min(16, K - n_map)
+    hot -= hot % num_lists  # equal anchors per list (0 = no anchors)
+    blobs = []
+    for r in range(R):
+        client = r + 1
+        recs = []
+        last_set: dict = {}
+        for k in range(n_map):
+            key = int(rng.integers(0, 64))
+            prev_set = last_set.get(key)
+            recs.append(ItemRecord(
+                client=client, clock=k, parent_root="m0",
+                key=f"k{key}", content=k,
+                # chained like real Yjs map sets
+                origin=(client, prev_set) if prev_set is not None else None,
+            ))
+            last_set[key] = k
+        hot_per_list = hot // num_lists
+        prev: dict = {}
+        for k in range(n_map, K):
+            if client == 1 and k < n_map + hot:
+                # the hot anchors: client 1 heads each list round-robin
+                lst = (k - n_map) % num_lists
+                origin = None
+            else:
+                lst = int(rng.integers(0, num_lists))
+                if hot_per_list and rng.random() < 0.5:
+                    # pile onto a shared anchor OF THIS LIST -> R-wide
+                    # same-origin sibling group
+                    j = lst + num_lists * int(rng.integers(0, hot_per_list))
+                    origin = (1, n_map + j)
+                else:
+                    origin = (client, prev[lst]) if lst in prev else None
+            recs.append(ItemRecord(
+                client=client, clock=k, parent_root=f"l{lst}",
+                origin=origin, content=k,
+            ))
+            prev[lst] = k
+        blobs.append(v1.encode_update(recs, DeleteSet()))
     return blobs
 
 
@@ -496,6 +556,48 @@ def main():
         log(f"correctness vs oracle: {len(wt)} map keys, "
             f"{len(want_orders)} sequences, 0 divergent")
 
+    # ---- conflict-heavy YATA run (BENCH_CONFLICT=0 to skip) ----------
+    # The hard case the append-only trace never triggers (VERDICT r1):
+    # R-wide same-origin sibling groups on shared anchors. Exactness is
+    # asserted against the scalar oracle at this size.
+    conflict_result = None
+    if os.environ.get("BENCH_CONFLICT", "1") != "0":
+        R_c = min(R, 200)
+        blobs_c = build_conflict_trace(R_c, K)
+        run_device(blobs_c, {})  # warm shapes
+        t0 = time.perf_counter()
+        cache_c, *_ = run_device(blobs_c, {})
+        t_dev_c = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        cache_cn, _ = run_numpy(blobs_c, {})
+        t_np_c = time.perf_counter() - t0
+        assert cache_c == cache_cn, "conflict run: contenders diverge"
+        from crdt_tpu.codec import v1 as _v1c
+        from crdt_tpu.core.engine import Engine as _Eng
+        from crdt_tpu.core.ids import DeleteSet as _DSc
+
+        t0 = time.perf_counter()
+        eng_c = _Eng(0)
+        rc_all, dsc = [], _DSc()
+        for blob in blobs_c:
+            rr, dd = _v1c.decode_update(blob)
+            rc_all.extend(rr)
+            for c, k, ln in dd.iter_all():
+                dsc.add(c, k, ln)
+        eng_c.apply_records(rc_all, dsc)
+        t_oracle_c = time.perf_counter() - t0
+        assert cache_c == eng_c.to_json(), "conflict run diverges from oracle"
+        conflict_result = {
+            "ops": R_c * K,
+            "device_s": round(t_dev_c, 3),
+            "numpy_s": round(t_np_c, 3),
+            "vs_baseline": round(t_np_c / t_dev_c, 2),
+            "vs_python_oracle": round(t_oracle_c / t_dev_c, 1),
+        }
+        log(f"conflict e2e ({R_c * K} ops, shared-anchor siblings): "
+            f"device {t_dev_c:.3f}s vs numpy {t_np_c:.3f}s vs oracle "
+            f"{t_oracle_c:.2f}s; exact vs oracle")
+
     # ---- larger-scale crossover run (BENCH_SCALE=0 to skip) ----------
     scale_result = None
     scale = int(os.environ.get("BENCH_SCALE", 16))
@@ -545,6 +647,8 @@ def main():
             "through the tunnel."
         ),
     }
+    if conflict_result:
+        out["conflict_run"] = conflict_result
     if scale_result:
         out["scale_run"] = scale_result
     print(json.dumps(out))

@@ -13,9 +13,9 @@ whole cold replay to exactly three device interactions:
      int32 (or int64 when clocks are wide) matrix;
   2. ONE dispatch: unpack -> shared id-sort/dedup/origin resolution ->
      map winners (:func:`crdt_tpu.ops.lww.map_winners`) + sequence DFS
-     ranks (:func:`crdt_tpu.ops.yata.tree_order_ranks`) — the same
-     exact kernel cores as the general path — plus document-order
-     assembly, all fused;
+     ranks over a compact sequence-rows-only prefix (the shared
+     :func:`crdt_tpu.ops.device.dfs_ranks` machinery the general YATA
+     kernel also uses) — plus document-order assembly, all fused;
   3. ONE device->host transfer: a single packed int32 result (winner
      rows + per-sequence document-order streams).
 
