@@ -36,7 +36,9 @@ Env knobs: BENCH_REPLICAS (1000), BENCH_OPS (per replica, 100),
 BENCH_ITERS (3), BENCH_SKIP_ORACLE=1, BENCH_SCALE (default 16: also
 run a 16x-larger workload end to end on both paths; 0 skips),
 BENCH_CONFLICT (default 1: also run the shared-anchor conflict
-workload, oracle-checked; 0 skips).
+workload, oracle-checked; 0 skips), BENCH_TEXT (default 1: also run
+the right-bearing collaborative-text workload, oracle-checked; 0
+skips).
 """
 
 from __future__ import annotations
@@ -161,6 +163,41 @@ def build_conflict_trace(R: int, K: int, seed: int = 2):
                 origin=origin, content=k,
             ))
             prev[lst] = k
+        blobs.append(v1.encode_update(recs, DeleteSet()))
+    return blobs
+
+
+def build_text_trace(R: int, K: int, seed: int = 3):
+    """Collaborative-text shape: every replica types its own runs into
+    one shared document; 20% of ops are mid-inserts carrying BOTH
+    origins (left = predecessor, right = the character that followed
+    at insert time) — the workload whose right origins route ordering
+    through the exact host machinery instead of the pure device
+    sibling model. The numpy baseline does not model rights, so this
+    run is referenced against the scalar oracle only."""
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.ids import DeleteSet
+    from crdt_tpu.core.records import ItemRecord
+
+    rng = np.random.default_rng(seed)
+    blobs = []
+    for r in range(R):
+        client = r + 1
+        recs = []
+        chain: list = []  # own chars in own document order
+        for k in range(K):
+            if chain and rng.random() < 0.2:
+                j = int(rng.integers(0, len(chain)))
+                recs.append(ItemRecord(
+                    client=client, clock=k, parent_root="text",
+                    origin=chain[j - 1] if j > 0 else None,
+                    right=chain[j], content=k))
+                chain.insert(j, (client, k))
+            else:
+                recs.append(ItemRecord(
+                    client=client, clock=k, parent_root="text",
+                    origin=chain[-1] if chain else None, content=k))
+                chain.append((client, k))
         blobs.append(v1.encode_update(recs, DeleteSet()))
     return blobs
 
@@ -295,6 +332,26 @@ def numpy_gather(dec, ds, np_win, np_seg, np_rank):
 
 
 # ---------------------------------------------------------------------------
+
+
+def run_oracle(blobs, *, with_deletes=True):
+    """Decode a trace and replay it through the scalar-semantics
+    engine (BASELINE.md's named baseline). Returns (engine, seconds)."""
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.engine import Engine
+    from crdt_tpu.core.ids import DeleteSet
+
+    t0 = time.perf_counter()
+    eng = Engine(0)
+    recs, ds = [], DeleteSet()
+    for blob in blobs:
+        rr, dd = v1.decode_update(blob)
+        recs.extend(rr)
+        if with_deletes:
+            for c, k, length in dd.iter_all():
+                ds.add(c, k, length)
+    eng.apply_records(recs, ds)
+    return eng, time.perf_counter() - t0
 
 
 def force_sync_mode():
@@ -513,22 +570,10 @@ def main():
     assert snap_dev == snap_np
 
     # ---- python oracle (BASELINE.md's named baseline) ----------------
+    skip_oracle = os.environ.get("BENCH_SKIP_ORACLE", "0") == "1"
     oracle_x = None
-    if os.environ.get("BENCH_SKIP_ORACLE", "0") != "1":
-        from crdt_tpu.codec import v1 as _v1
-        from crdt_tpu.core.engine import Engine
-        from crdt_tpu.core.ids import DeleteSet as _DS
-
-        t0 = time.perf_counter()
-        eng = Engine(0)
-        recs3, ds3 = [], _DS()
-        for blob in blobs:
-            rr, dd = _v1.decode_update(blob)
-            recs3.extend(rr)
-            for c, k, length in dd.iter_all():
-                ds3.add(c, k, length)
-        eng.apply_records(recs3, ds3)
-        t_oracle = time.perf_counter() - t0
+    if not skip_oracle:
+        eng, t_oracle = run_oracle(blobs)
         oracle_x = round(t_oracle / t_dev, 1)
         log(f"python oracle e2e: {t_oracle:.2f}s "
             f"({total / t_oracle:,.0f} ops/s) -> device is {oracle_x}x")
@@ -572,31 +617,54 @@ def main():
         cache_cn, _ = run_numpy(blobs_c, {})
         t_np_c = time.perf_counter() - t0
         assert cache_c == cache_cn, "conflict run: contenders diverge"
-        from crdt_tpu.codec import v1 as _v1c
-        from crdt_tpu.core.engine import Engine as _Eng
-        from crdt_tpu.core.ids import DeleteSet as _DSc
-
-        t0 = time.perf_counter()
-        eng_c = _Eng(0)
-        rc_all, dsc = [], _DSc()
-        for blob in blobs_c:
-            rr, dd = _v1c.decode_update(blob)
-            rc_all.extend(rr)
-            for c, k, ln in dd.iter_all():
-                dsc.add(c, k, ln)
-        eng_c.apply_records(rc_all, dsc)
-        t_oracle_c = time.perf_counter() - t0
-        assert cache_c == eng_c.to_json(), "conflict run diverges from oracle"
         conflict_result = {
             "ops": R_c * K,
             "device_s": round(t_dev_c, 3),
             "numpy_s": round(t_np_c, 3),
             "vs_baseline": round(t_np_c / t_dev_c, 2),
-            "vs_python_oracle": round(t_oracle_c / t_dev_c, 1),
+            "vs_python_oracle": None,
         }
+        oracle_note = "oracle skipped"
+        if not skip_oracle:
+            eng_c, t_oracle_c = run_oracle(blobs_c)
+            assert cache_c == eng_c.to_json(), \
+                "conflict run diverges from oracle"
+            conflict_result["vs_python_oracle"] = round(
+                t_oracle_c / t_dev_c, 1
+            )
+            oracle_note = f"oracle {t_oracle_c:.2f}s; exact"
         log(f"conflict e2e ({R_c * K} ops, shared-anchor siblings): "
-            f"device {t_dev_c:.3f}s vs numpy {t_np_c:.3f}s vs oracle "
-            f"{t_oracle_c:.2f}s; exact vs oracle")
+            f"device {t_dev_c:.3f}s vs numpy {t_np_c:.3f}s; {oracle_note}")
+
+    # ---- right-bearing text run (BENCH_TEXT=0 to skip) ---------------
+    # Mid-inserts carry right origins, which the device sibling model
+    # cannot express; ordering for affected parents runs through the
+    # exact host machinery. Referenced against the oracle (the numpy
+    # contender does not model rights).
+    text_result = None
+    if os.environ.get("BENCH_TEXT", "1") != "0":
+        R_t = min(R, 200)
+        blobs_t = build_text_trace(R_t, K)
+        from crdt_tpu.models import replay_trace as _replay
+
+        _replay(blobs_t)  # warm shapes
+        t0 = time.perf_counter()
+        res_t = _replay(blobs_t)
+        t_dev_t = time.perf_counter() - t0
+        text_result = {
+            "ops": R_t * K,
+            "device_s": round(t_dev_t, 3),
+            "vs_python_oracle": None,
+        }
+        oracle_note = "oracle skipped"
+        if not skip_oracle:
+            eng_t, t_oracle_t = run_oracle(blobs_t)
+            assert res_t.cache == eng_t.to_json(), \
+                "text run diverges from oracle"
+            text_result["vs_python_oracle"] = round(t_oracle_t / t_dev_t, 1)
+            oracle_note = f"oracle {t_oracle_t:.2f}s; exact"
+        log(f"text e2e ({R_t * K} ops, 20% right-bearing mid-inserts): "
+            f"{t_dev_t:.3f}s; {oracle_note}")
 
     # ---- larger-scale crossover run (BENCH_SCALE=0 to skip) ----------
     scale_result = None
@@ -649,6 +717,8 @@ def main():
     }
     if conflict_result:
         out["conflict_run"] = conflict_result
+    if text_result:
+        out["text_run"] = text_result
     if scale_result:
         out["scale_run"] = scale_result
     print(json.dumps(out))
