@@ -38,7 +38,8 @@ run a 16x-larger workload end to end on both paths; 0 skips),
 BENCH_CONFLICT (default 1: also run the shared-anchor conflict
 workload, oracle-checked; 0 skips), BENCH_TEXT (default 1: also run
 the right-bearing collaborative-text workload, oracle-checked; 0
-skips).
+skips), BENCH_ROUNDS (default 1: steady-state incremental rounds on
+the scale doc; 0 skips; requires the scale run).
 """
 
 from __future__ import annotations
@@ -61,10 +62,15 @@ def log(*a):
 # ---------------------------------------------------------------------------
 
 
-def build_trace(R: int, K: int, seed: int = 0):
-    """Per-replica v1 update blobs: 60% map sets over 8 maps, 40%
-    concurrent list appends over 8 lists (own-chain origins), 5% of
-    each replica's ops tombstoned in its final blob's delete set."""
+def build_trace(R: int, K: int, seed: int = 0, client_base: int = 0,
+                map_frac: float = 0.6):
+    """Per-replica v1 update blobs: ``map_frac`` map sets over 8 maps,
+    the rest concurrent list appends over 8 lists (own-chain origins),
+    5% of each replica's ops tombstoned in its final blob's delete
+    set. ``client_base`` offsets the client ids (steady-state rounds
+    need fresh writers whose ids do not collide with the base doc's);
+    ``map_frac=1.0`` makes delta rounds touch only per-key map
+    segments instead of whole lists."""
     from crdt_tpu.codec import v1
     from crdt_tpu.core.ids import DeleteSet
     from crdt_tpu.core.records import ItemRecord
@@ -72,10 +78,10 @@ def build_trace(R: int, K: int, seed: int = 0):
     rng = np.random.default_rng(seed)
     num_maps, num_lists = 8, 8
     keys_per_map = max(64, (R * K) // 64)
-    n_map = (K * 6) // 10
+    n_map = int(K * map_frac)
     blobs = []
     for r in range(R):
-        client = r + 1
+        client = client_base + r + 1
         recs = []
         maps = rng.integers(0, num_maps, n_map)
         keys = rng.integers(0, keys_per_map, n_map)
@@ -689,6 +695,83 @@ def main():
         }
         log(f"scale e2e: device {t_dev_l:.2f}s vs numpy {t_np_l:.2f}s "
             f"-> {scale_result['vs_baseline']}x")
+
+        # ---- steady-state rounds on the big doc (BENCH_ROUNDS=0 off)
+        # The product's long-lived shape: a replica holding the doc in
+        # HBM consumes small update batches forever. IncrementalReplay
+        # re-converges only the touched segments per round; the cold
+        # path re-stages the whole union. Per-round cost must stay
+        # FLAT in doc size — that is the resident-state claim.
+        if os.environ.get("BENCH_ROUNDS", "1") != "0":
+            from crdt_tpu.models.incremental import IncrementalReplay
+            from crdt_tpu.ops.device import bucket_pow2 as _b2
+
+            n_rounds, R_d, K_d = 4, 20, 50  # 1k-op deltas
+            # map-write deltas: each round touches a few hundred
+            # per-key segments, not whole lists — the segment-rich
+            # shape where touched state is a sliver of the doc
+            deltas = [
+                build_trace(R_d, K_d, seed=500 + i,
+                            client_base=R * scale + 1000 + i * R_d,
+                            map_frac=1.0)
+                for i in range(n_rounds)
+            ]
+            inc = IncrementalReplay(
+                capacity=_b2(R * scale * K + 2 * n_rounds * R_d * K_d)
+            )
+            t0 = time.perf_counter()
+            inc.apply(blobs_l)
+            t_ingest = time.perf_counter() - t0
+            inc_times = []
+            for d in deltas:
+                t0 = time.perf_counter()
+                inc.apply(d)
+                inc_times.append(time.perf_counter() - t0)
+            # references: ONE cold full replay of doc+deltas, and the
+            # scalar engine applying just a delta to the loaded doc
+            all_blobs = list(blobs_l)
+            for d in deltas:
+                all_blobs += d
+            t0 = time.perf_counter()
+            from crdt_tpu.models import replay_trace as _rt
+
+            res_full = _rt(all_blobs)
+            t_cold_round = time.perf_counter() - t0
+            assert inc.cache == res_full.cache, \
+                "incremental diverges from cold replay"
+            # scalar-incremental reference: apply one delta to the
+            # ALREADY-LOADED main-run engine (engine application is
+            # O(delta); loading the scale doc into it would cost
+            # minutes and measure nothing new)
+            oracle_round = None
+            if not skip_oracle:
+                rr_d = []
+                from crdt_tpu.codec import v1 as _v1r
+
+                for blob in deltas[-1]:
+                    rr, _dd = _v1r.decode_update(blob)
+                    rr_d.extend(rr)
+                t0 = time.perf_counter()
+                eng.apply_records(rr_d)
+                oracle_round = time.perf_counter() - t0
+            med = sorted(inc_times)[len(inc_times) // 2]
+            rounds_result = {
+                "doc_ops": R * scale * K,
+                "delta_ops": R_d * K_d,
+                "incremental_round_s": round(med, 3),
+                "cold_replay_round_s": round(t_cold_round, 2),
+                "vs_cold_replay": round(t_cold_round / med, 1),
+                "scalar_incremental_round_s": (
+                    round(oracle_round, 3) if oracle_round else None
+                ),
+                "ingest_s": round(t_ingest, 2),
+            }
+            scale_result["rounds"] = rounds_result
+            log(f"steady-state rounds ({R_d * K_d}-op deltas on the "
+                f"{R * scale * K}-op doc): incremental {med:.3f}s/round "
+                f"vs cold replay {t_cold_round:.2f}s/round"
+                + (f" vs scalar incremental {oracle_round:.3f}s"
+                   if oracle_round else ""))
 
     out = {
         "metric": "e2e_trace_replay_lww_yata",

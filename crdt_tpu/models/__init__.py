@@ -1,4 +1,11 @@
 from crdt_tpu.models.fleet import FleetStep, ReplicaFleet
+from crdt_tpu.models.incremental import IncrementalReplay
 from crdt_tpu.models.replay import ReplayResult, replay_trace
 
-__all__ = ["FleetStep", "ReplicaFleet", "ReplayResult", "replay_trace"]
+__all__ = [
+    "FleetStep",
+    "IncrementalReplay",
+    "ReplayResult",
+    "ReplicaFleet",
+    "replay_trace",
+]

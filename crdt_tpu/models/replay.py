@@ -269,10 +269,29 @@ def _fix_map_chains_with_rights(dec: Dict, win_rows):
     return out
 
 
+def rows_visible(
+    row_client: np.ndarray,
+    row_clock: np.ndarray,
+    del_c: np.ndarray,
+    del_k: np.ndarray,
+) -> np.ndarray:
+    """Vectorized tombstone test against EXPANDED delete ids. Clients
+    remap densely before packing — raw 31-bit ids would overflow a
+    packed (client << 40 | clock) int64. Shared by the cold replay's
+    visible_mask and the incremental replay's cached-tombstone path."""
+    if not len(del_c):
+        return np.ones(len(row_client), bool)
+    row_client = row_client.astype(np.int64)
+    uniq = np.unique(np.concatenate([row_client, del_c]))
+    pack = (
+        np.searchsorted(uniq, row_client).astype(np.int64) << 40
+    ) | row_clock
+    del_pack = (np.searchsorted(uniq, del_c).astype(np.int64) << 40) | del_k
+    return ~np.isin(pack, del_pack)
+
+
 def visible_mask(dec: Dict, rows: List[int], ds: DeleteSet) -> List[bool]:
-    """Tombstone visibility for specific rows (vectorized). Clients
-    remap densely before packing — raw 31-bit ids overflow a packed
-    (client << 40 | clock) int64."""
+    """Tombstone visibility for specific rows (vectorized)."""
     if not rows:
         return []
     idx = np.asarray(rows)
@@ -288,15 +307,9 @@ def visible_mask(dec: Dict, rows: List[int], ds: DeleteSet) -> List[bool]:
         ],
         np.int64,
     )
-    if not len(del_c):
-        return [True] * len(rows)
-    row_c = dec["client"][idx].astype(np.int64)
-    uniq = np.unique(np.concatenate([row_c, del_c]))
-    pack = (np.searchsorted(uniq, row_c).astype(np.int64) << 40) | dec[
-        "clock"
-    ][idx]
-    del_pack = (np.searchsorted(uniq, del_c).astype(np.int64) << 40) | del_k
-    return list(~np.isin(pack, del_pack))
+    return list(rows_visible(
+        dec["client"][idx], dec["clock"][idx], del_c, del_k
+    ))
 
 
 def materialize(dec: Dict, ds: DeleteSet, win_rows, win_vis,

@@ -113,9 +113,7 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
         return None
 
     # distinct segments: map rows by (pref, kid), seq rows by pref
-    segkey = (pref << _KID_BITS) | np.where(kid >= 0, kid, 0)
-    segkey = np.where(kid >= 0, segkey | (1 << 62), segkey)
-    n_segs = len(np.unique(segkey[valid]))
+    n_segs = len(np.unique(segkey_of(pref, kid)[valid]))
     n_seq = int((valid & (kid < 0)).sum())
 
     narrow = clock.max() < (1 << 31) and ock.max() < (1 << 31)
@@ -152,8 +150,6 @@ def _converge_packed(mat, num_segments: int, seq_bucket: int):
     - stream_seg/stream_row: sequence rows in document order, grouped
       by segment id (B = seq_bucket; -1 padding at the tail).
     """
-    from crdt_tpu.ops.lww import map_winners
-
     client = mat[0].astype(jnp.int32)
     clock = mat[1].astype(jnp.int64)
     pref = mat[2].astype(jnp.int64)
@@ -161,6 +157,19 @@ def _converge_packed(mat, num_segments: int, seq_bucket: int):
     oc = mat[4].astype(jnp.int32)
     ock = mat[5].astype(jnp.int64)
     valid = mat[6] != 0
+    return _converge_core(
+        client, clock, pref, kid, oc, ock, valid,
+        num_segments=num_segments, seq_bucket=seq_bucket,
+    )
+
+
+def _converge_core(client, clock, pref, kid, oc, ock, valid, *,
+                   num_segments: int, seq_bucket: int):
+    """Traced body shared by the cold single-dispatch replay and the
+    incremental touched-segment path (``crdt_tpu.models.incremental``).
+    Row indices in the output refer to the CALLER's row space."""
+    from crdt_tpu.ops.lww import map_winners
+
     n = client.shape[0]
 
     # shared id-sort + dedup + origin resolution (one for both kernels)
@@ -182,10 +191,14 @@ def _converge_packed(mat, num_segments: int, seq_bucket: int):
     is_map = uniq_valid & (kid >= 0)
     is_seq = uniq_valid & (kid < 0)
 
-    # one composite segment key covers maps AND sequences
-    segkey = (pref << _KID_BITS) | jnp.where(is_map, kid, 0)
-    segkey = jnp.where(is_map, segkey | (jnp.int64(1) << 62), segkey)
-    segkey = jnp.where(uniq_valid, segkey, jnp.int64(2**63 - 1))
+    # one composite segment key covers maps AND sequences (dup rows of
+    # a map item are ~uniq_valid, so the unmasked kid flag is moot for
+    # them — the invalid-row sentinel overrides either way)
+    segkey = jnp.where(
+        uniq_valid,
+        segkey_of(pref, kid.astype(jnp.int64)),
+        jnp.int64(2**63 - 1),
+    )
     sorder = jnp.argsort(segkey, stable=True)
     seg_sorted = dense_ranks_sorted(segkey[sorder])
     seg = scatter_perm(sorder, seg_sorted)
@@ -277,6 +290,91 @@ def _converge_packed(mat, num_segments: int, seq_bucket: int):
     ).astype(jnp.int32)
 
     return jnp.concatenate([win_rows, stream_seg, stream_row])
+
+
+def segkey_of(pref, kid):
+    """The composite segment key, shared by staging, the fused kernel,
+    and the incremental host bookkeeping. Works on numpy or jnp
+    (dtype-explicit: the map-flag bit 62 must not fall into a narrow
+    weak-typed promotion)."""
+    is_map = (kid >= 0).astype(np.int64)
+    base = (pref << _KID_BITS) | (is_map * kid)
+    return base | (is_map << np.int64(62))
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0,),
+    static_argnames=("num_segments", "sel_bucket", "seq_bucket"),
+)
+def _splice_select_converge(mat, delta, n_off, touched_sorted,
+                            num_segments: int, sel_bucket: int,
+                            seq_bucket: int):
+    """Incremental warm dispatch: splice a packed delta into the
+    resident matrix (donated), select the rows of the TOUCHED segments
+    (touched_sorted: ascending segkeys, padded with int64 max), and
+    re-converge only that compact subset. Returns
+
+      (resident_mat, out[S + 2B] int32, sel_rows[sel_bucket] int32)
+
+    where out's row indices are LOCAL to sel_rows; callers map back
+    with sel_rows (resident row ids, -1 padding)."""
+    mat = jax.lax.dynamic_update_slice(
+        mat, delta.astype(mat.dtype), (jnp.int32(0), n_off.astype(jnp.int32))
+    )
+    client = mat[0].astype(jnp.int32)
+    clock = mat[1].astype(jnp.int64)
+    pref = mat[2].astype(jnp.int64)
+    kid = mat[3].astype(jnp.int32)
+    oc = mat[4].astype(jnp.int32)
+    ock = mat[5].astype(jnp.int64)
+    valid = mat[6] != 0
+
+    segkey = segkey_of(pref, kid.astype(jnp.int64))
+    pos = jnp.searchsorted(touched_sorted, segkey, method="sort")
+    pos_c = jnp.clip(pos, 0, touched_sorted.shape[0] - 1)
+    sel = valid & (touched_sorted[pos_c] == segkey)
+    skey = jnp.where(sel, segkey, jnp.int64(2**63 - 1))
+    order2 = jnp.argsort(skey, stable=True)
+    sel_rows = order2[:sel_bucket].astype(jnp.int32)
+    sub_valid = sel[sel_rows]
+    out = _converge_core(
+        client[sel_rows], clock[sel_rows], pref[sel_rows], kid[sel_rows],
+        oc[sel_rows], ock[sel_rows], sub_valid,
+        num_segments=num_segments, seq_bucket=seq_bucket,
+    )
+    return mat, out, jnp.where(sub_valid, sel_rows, NULLI)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _splice_mat(mat, delta, n_off):
+    """Delta splice without convergence (delete-only / host-only
+    rounds still need the rows resident for later dispatches)."""
+    return jax.lax.dynamic_update_slice(
+        mat, delta.astype(mat.dtype), (jnp.int32(0), n_off.astype(jnp.int32))
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("new_cap",))
+def _grow_mat(mat, new_cap: int):
+    """Capacity growth for the resident matrix, on device."""
+    big = jnp.zeros((7, new_cap), mat.dtype)
+    big = big.at[3:6, :].set(-1)  # key_id / origin columns: null
+    return jax.lax.dynamic_update_slice(big, mat, (0, 0))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _relabel_mat(mat, perm):
+    """Rewrite dense client ids through an old->new permutation after
+    a mid-table client insertion (order-preserving interning)."""
+    cl = mat[0]
+    oc = mat[4]
+    mat = mat.at[0, :].set(perm[jnp.clip(cl, 0, perm.shape[0] - 1)]
+                           .astype(mat.dtype))
+    new_oc = jnp.where(
+        oc >= 0, perm[jnp.clip(oc, 0, perm.shape[0] - 1)], oc
+    )
+    return mat.at[4, :].set(new_oc.astype(mat.dtype))
 
 
 class PackedResult(NamedTuple):

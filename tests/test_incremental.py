@@ -1,0 +1,271 @@
+"""Incremental device replay vs the cold replay and the engine.
+
+After EVERY round the incremental cache must equal the cold
+``replay_trace`` of all blobs so far (which is itself differential-
+tested against the scalar engine), across map overwrites, concurrent
+appends, shared-anchor conflicts, right-bearing mid-inserts,
+tombstones, redelivery, and nested collections.
+"""
+
+import numpy as np
+import pytest
+
+from crdt_tpu.codec import v1
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.models import replay_trace
+from crdt_tpu.models.incremental import IncrementalReplay
+
+
+def _blob(recs, ds=None):
+    return v1.encode_update(recs, ds or DeleteSet())
+
+
+class TestIncrementalRounds:
+    def test_map_rounds(self):
+        inc = IncrementalReplay()
+        blobs = []
+        for rnd in range(4):
+            recs = [
+                ItemRecord(client=c, clock=rnd * 4 + j, parent_root="m",
+                           key=f"k{j % 3}", content=(c, rnd, j))
+                for c in (1, 2) for j in range(4)
+            ]
+            blobs.append(_blob(recs))
+            cache = inc.apply(blobs[-1])
+            assert cache == replay_trace(blobs).cache, f"round {rnd}"
+
+    def test_sequence_append_rounds(self):
+        inc = IncrementalReplay()
+        blobs, prev = [], {}
+        for rnd in range(4):
+            recs = []
+            for c in (1, 2, 3):
+                for j in range(5):
+                    k = rnd * 5 + j
+                    recs.append(ItemRecord(
+                        client=c, clock=k, parent_root="lst",
+                        origin=(c, prev[c]) if c in prev else None,
+                        content=(c, k)))
+                    prev[c] = k
+            blobs.append(_blob(recs))
+            cache = inc.apply(blobs[-1])
+            assert cache == replay_trace(blobs).cache, f"round {rnd}"
+
+    def test_mixed_with_deletes_and_redelivery(self):
+        rng = np.random.default_rng(3)
+        inc = IncrementalReplay()
+        blobs, clk, prev = [], {}, {}
+        for rnd in range(6):
+            recs, ds = [], DeleteSet()
+            for c in (1, 2, 3, 4):
+                for _ in range(6):
+                    k = clk[c] = clk.get(c, -1) + 1
+                    if rng.random() < 0.5:
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root="m",
+                            key=f"x{rng.integers(0, 5)}", content=k))
+                    else:
+                        key = (c, rng.integers(0, 2))
+                        lst = f"l{key[1]}"
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root=lst,
+                            origin=(c, prev[key]) if key in prev else None,
+                            content=k))
+                        prev[key] = k
+            if rnd >= 2:
+                ds.add(1, int(rng.integers(0, clk[1])))
+            blobs.append(_blob(recs, ds))
+            inc.apply(blobs[-1])
+            if rnd >= 1:  # redeliver an old blob: must be a no-op
+                inc.apply(blobs[int(rng.integers(0, len(blobs)))])
+            assert inc.cache == replay_trace(blobs).cache, f"round {rnd}"
+
+    def test_shared_anchor_conflict_rounds(self):
+        inc = IncrementalReplay()
+        blobs = []
+        # round 1: client 1 heads the list with anchors
+        anchors = [ItemRecord(client=1, clock=j, parent_root="L",
+                              content=("a", j)) for j in range(3)]
+        blobs.append(_blob(anchors))
+        inc.apply(blobs[-1])
+        # later rounds: everyone piles onto the anchors
+        for rnd, c in enumerate((2, 3, 4)):
+            recs = [ItemRecord(client=c, clock=j, parent_root="L",
+                               origin=(1, j % 3), content=(c, j))
+                    for j in range(4)]
+            blobs.append(_blob(recs))
+            cache = inc.apply(blobs[-1])
+            assert cache == replay_trace(blobs).cache, f"round {rnd}"
+
+    def test_right_bearing_rounds(self):
+        inc = IncrementalReplay()
+        blobs = []
+        chain = [ItemRecord(client=1, clock=j, parent_root="t",
+                            origin=(1, j - 1) if j else None, content=j)
+                 for j in range(5)]
+        blobs.append(_blob(chain))
+        inc.apply(blobs[-1])
+        for rnd, c in enumerate((2, 3)):
+            # concurrent mid-inserts with right origins
+            recs = [ItemRecord(client=c, clock=0, parent_root="t",
+                               origin=(1, 1), right=(1, 2), content=(c, 0)),
+                    ItemRecord(client=c, clock=1, parent_root="t",
+                               origin=(c, 0), right=(1, 2), content=(c, 1))]
+            blobs.append(_blob(recs))
+            cache = inc.apply(blobs[-1])
+            assert cache == replay_trace(blobs).cache, f"round {rnd}"
+
+    def test_nested_collections(self):
+        from crdt_tpu.core.store import K_TYPE, TYPE_ARRAY
+
+        inc = IncrementalReplay()
+        blobs = []
+        # round 1: a nested array under a map key
+        recs = [
+            ItemRecord(client=1, clock=0, parent_root="root", key="list",
+                       kind=K_TYPE, type_ref=TYPE_ARRAY),
+            ItemRecord(client=1, clock=1, parent_item=(1, 0), content="a"),
+        ]
+        blobs.append(_blob(recs))
+        inc.apply(blobs[-1])
+        assert inc.cache == replay_trace(blobs).cache
+        # round 2: another client extends the nested array
+        recs = [ItemRecord(client=2, clock=0, parent_item=(1, 0),
+                           origin=(1, 1), content="b")]
+        blobs.append(_blob(recs))
+        cache = inc.apply(blobs[-1])
+        assert cache == replay_trace(blobs).cache
+        assert cache["root"]["list"] == ["a", "b"]
+
+    def test_child_arrives_before_parent_type(self):
+        """A nested collection's rows delivered BEFORE the type item
+        that parents them must surface once the parent arrives."""
+        from crdt_tpu.core.store import K_TYPE, TYPE_MAP
+
+        inc = IncrementalReplay()
+        blobs = [
+            # batch 1: an entry of a nested map whose parent type is
+            # still unknown
+            _blob([ItemRecord(client=2, clock=0, parent_item=(1, 0),
+                              key="a", content=5)]),
+            # batch 2: the parent type item under root "r"
+            _blob([ItemRecord(client=1, clock=0, parent_root="r",
+                              key="sub", kind=K_TYPE, type_ref=TYPE_MAP)]),
+        ]
+        inc.apply(blobs[0])
+        cache = inc.apply(blobs[1])
+        assert cache == replay_trace(blobs).cache
+        assert cache["r"]["sub"] == {"a": 5}
+
+    def test_growth_across_capacity(self):
+        inc = IncrementalReplay(capacity=64)
+        blobs, prev = [], {}
+        for rnd in range(4):
+            recs = []
+            for c in (1, 2):
+                for j in range(40):
+                    k = rnd * 40 + j
+                    recs.append(ItemRecord(
+                        client=c, clock=k, parent_root="big",
+                        origin=(c, prev[c]) if c in prev else None,
+                        content=k))
+                    prev[c] = k
+            blobs.append(_blob(recs))
+            cache = inc.apply(blobs[-1])
+            assert cache == replay_trace(blobs).cache, f"round {rnd}"
+
+    def test_late_small_client_relabel(self):
+        inc = IncrementalReplay()
+        blobs = []
+        recs = [ItemRecord(client=50, clock=0, parent_root="m", key="k",
+                           content="big")]
+        blobs.append(_blob(recs))
+        inc.apply(blobs[-1])
+        # a smaller client id arrives later: dense ranks shift and the
+        # resident matrix must relabel
+        recs = [ItemRecord(client=7, clock=0, parent_root="m", key="k",
+                           content="small")]
+        blobs.append(_blob(recs))
+        cache = inc.apply(blobs[-1])
+        assert cache == replay_trace(blobs).cache
+        assert cache["m"]["k"] == "big"  # client 50 still wins
+
+    def test_hostile_parent_cycle_terminates(self):
+        """Two type items naming each other as parent must not hang
+        apply() (the cold replay drops them as unrootable too)."""
+        from crdt_tpu.core.store import K_TYPE, TYPE_MAP
+
+        inc = IncrementalReplay()
+        blob = _blob([
+            ItemRecord(client=1, clock=0, parent_item=(2, 0), key="a",
+                       kind=K_TYPE, type_ref=TYPE_MAP),
+            ItemRecord(client=2, clock=0, parent_item=(1, 0), key="b",
+                       kind=K_TYPE, type_ref=TYPE_MAP),
+        ])
+        cache = inc.apply(blob)
+        assert cache == replay_trace([blob]).cache
+
+    def test_redelivered_deletes_do_not_grow(self):
+        inc = IncrementalReplay()
+        ds = DeleteSet()
+        for k in range(10):
+            ds.add(1, k)
+        recs = [ItemRecord(client=1, clock=k, parent_root="m", key=f"k{k}",
+                           content=k) for k in range(12)]
+        blob = _blob(recs, ds)
+        inc.apply(blob)
+        size = len(inc._del_c)
+        assert size == 10
+        for _ in range(3):
+            inc.apply(blob)  # redelivery must not re-append
+        assert len(inc._del_c) == size
+        assert inc.cache == replay_trace([blob]).cache
+
+    def test_bulk_delete_range(self):
+        inc = IncrementalReplay()
+        recs = [ItemRecord(client=1, clock=k, parent_root="m",
+                           key=f"k{k % 7}", content=k) for k in range(50)]
+        b1 = _blob(recs)
+        inc.apply(b1)
+        ds = DeleteSet()
+        ds.add(1, 0, 45)  # one compacted range -> vectorized scan path
+        b2 = _blob([], ds)
+        cache = inc.apply(b2)
+        assert cache == replay_trace([b1, b2]).cache
+
+    def test_random_grand_rounds(self):
+        rng = np.random.default_rng(11)
+        inc = IncrementalReplay()
+        blobs, clk = [], {}
+        own: dict = {}
+        for rnd in range(8):
+            recs, ds = [], DeleteSet()
+            for c in (1, 2, 3):
+                for _ in range(8):
+                    k = clk[c] = clk.get(c, -1) + 1
+                    p = rng.random()
+                    if p < 0.35:
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root="m",
+                            key=f"q{rng.integers(0, 6)}", content=k))
+                    elif p < 0.85 or not own.get(c):
+                        chain = own.setdefault(c, [])
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root="s",
+                            origin=chain[-1] if chain else None,
+                            content=k))
+                        chain.append((c, k))
+                    else:
+                        chain = own[c]
+                        j = int(rng.integers(0, len(chain)))
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root="s",
+                            origin=chain[j - 1] if j else None,
+                            right=chain[j], content=k))
+                        chain.insert(j, (c, k))
+            if rnd >= 3 and rng.random() < 0.6:
+                ds.add(int(rng.integers(1, 4)), int(rng.integers(0, 10)))
+            blobs.append(_blob(recs, ds))
+            inc.apply(blobs[-1])
+            assert inc.cache == replay_trace(blobs).cache, f"round {rnd}"
