@@ -16,11 +16,18 @@ batch:
      orders) and rebuilds just the affected root collections of the
      plain-JSON cache.
 
-Admission is vectorized: dedup, stable interning, and the
-implicit-parent resolution of wire runs (origin-else-right chains,
-``crdt_tpu.ops.merge.resolve_parents`` semantics) run as numpy passes
-— resolution itself is host-side pointer doubling, O(log chain) array
-rounds instead of a per-row walk.
+Admission is vectorized AND engine-faithful: dedup, stable interning,
+and the implicit-parent resolution of wire runs (origin-else-right
+chains, ``crdt_tpu.ops.merge.resolve_parents`` semantics) run as numpy
+passes — resolution itself is host-side pointer doubling, O(log chain)
+array rounds instead of a per-row walk. Out-of-order delivery follows
+the engine's rule (``Engine._blocker_of``): a row integrates only when
+its per-client clock run is contiguous and its origin/right/item-
+parent have arrived; blocked rows stash in ``_pending`` and retry on
+every apply, so intermediate states match ``Engine.apply_records``
+under the same arrival order. (Hostile dependency CYCLES — impossible
+under causal delivery — admit as a group, matching the cold replay's
+convention rather than pending forever.)
 
 Segments whose rows carry right origins re-order through the exact
 host machinery (:func:`crdt_tpu.ops.yata.order_sequences`) — same
@@ -93,6 +100,9 @@ class IncrementalReplay:
         self._key_names: List[str] = []
         self._prefs: Dict[Tuple, int] = {}
         self._pref_spec: List[Tuple] = []  # pref -> parent spec
+        self._pref_item_c: List[int] = []  # pref -> item-parent id
+        self._pref_item_k: List[int] = []  # (-1, -1 for root specs)
+        self._next_clock: Dict[int, int] = {}
         self._clients: List[int] = []      # sorted raw ids
         self._dense: Dict[int, int] = {}
         self._id_row: Dict[Tuple[int, int], int] = {}
@@ -105,6 +115,10 @@ class IncrementalReplay:
         self._root_segs: Dict[str, set] = {}      # root name -> segkeys
         self._spec_root: Dict[Tuple, str] = {}
         self._rootless: set = set()               # segkeys awaiting a root
+        # engine-faithful admission: rows whose per-client clock run
+        # has a gap, or whose origin/right has not arrived, stash here
+        # (columns + content keyed by id) and retry on every apply
+        self._pending: Dict[Tuple[int, int], Tuple] = {}
         # expanded tombstone ids, appended per batch (visibility tests
         # must not re-expand the whole accumulated DeleteSet per round)
         self._del_c = np.empty(0, np.int64)
@@ -146,6 +160,12 @@ class IncrementalReplay:
                 raise OverflowError("parent-ref space exhausted")
             self._prefs[spec] = ref
             self._pref_spec.append(spec)
+            if spec[0] == "item":
+                self._pref_item_c.append(spec[1])
+                self._pref_item_k.append(spec[2])
+            else:
+                self._pref_item_c.append(-1)
+                self._pref_item_k.append(-1)
         return ref
 
     def _spec_of_row(self, row: int) -> Optional[Tuple]:
@@ -246,8 +266,11 @@ class IncrementalReplay:
 
     # -- admission (vectorized) ---------------------------------------
     def _admit(self, dec) -> np.ndarray:
-        """Stable-intern a decoded batch and append new rows. Returns
-        the new host row indices (np array, possibly empty)."""
+        """Stable-intern a decoded batch, gate it through the engine's
+        admission rule (per-client clock contiguity + origin/right/
+        parent presence; failures stash in ``_pending`` and retry every
+        apply), and append the admitted rows. Returns the new host row
+        indices (np array, possibly empty)."""
         from crdt_tpu.core.store import K_GC
 
         n = len(dec["client"])
@@ -262,7 +285,7 @@ class IncrementalReplay:
         )
         idx = np.flatnonzero(fresh)
         k = len(idx)
-        if k == 0:
+        if k == 0 and not self._pending:
             return idx
 
         pr = dec["parent_root"][idx].astype(np.int64)
@@ -308,6 +331,40 @@ class IncrementalReplay:
             )
             pref[m_item] = refs[inv]
 
+        # merge the pending stash (retry with this batch), dropping
+        # stashed ids redelivered in this very batch
+        contents = [dec["contents"][i] for i in idx.tolist()]
+        tref = dec["type_ref"][idx].astype(np.int64)
+        if self._pending:
+            fresh_ids = set(zip(cl.tolist(), ck.tolist()))
+            pend = [
+                (pid, row) for pid, row in self._pending.items()
+                if pid not in fresh_ids
+            ]
+            if pend:
+                parr = np.asarray([row[:9] for _, row in pend], np.int64)
+                cl = np.concatenate([cl, parr[:, 0]])
+                ck = np.concatenate([ck, parr[:, 1]])
+                pref = np.concatenate([pref, parr[:, 2]])
+                kid = np.concatenate([kid, parr[:, 3]])
+                oc = np.concatenate([oc, parr[:, 4]])
+                ock = np.concatenate([ock, parr[:, 5]])
+                rc = np.concatenate([rc, parr[:, 6]])
+                rk = np.concatenate([rk, parr[:, 7]])
+                kind = np.concatenate([kind, parr[:, 8]])
+                tref = np.concatenate(
+                    [tref, np.asarray([row[9] for _, row in pend])]
+                )
+                contents.extend(row[10] for _, row in pend)
+            self._pending = {}
+        k = len(cl)
+        if k == 0:
+            return np.empty(0, np.int64)
+
+        # (client, clock) -> batch index, shared by the implicit-parent
+        # resolution and the admission gate's dependency lookups
+        btups = {t: j for j, t in enumerate(zip(cl.tolist(), ck.tolist()))}
+
         # implicit parents/keys: pointer doubling over the
         # origin-else-right graph (in-batch hops; refs that hit the
         # resident union terminate with its pref/kid immediately)
@@ -316,10 +373,6 @@ class IncrementalReplay:
             ref_c = np.where(oc >= 0, oc, rc)
             ref_k = np.where(oc >= 0, ock, rk)
             has_ref = ref_c >= 0
-            # in-batch index of the ref, else resident terminal
-            btups = {t: j for j, t in enumerate(
-                zip(cl.tolist(), ck.tolist())
-            )}
             ptr = np.arange(k)
             term_pref = pref.copy()
             term_kid = kid.copy()
@@ -346,18 +399,103 @@ class IncrementalReplay:
             pref = np.where(need, term_pref, pref)
             kid = np.where(need & (kid < 0), term_kid, kid)
 
+        # ---- admission gate: the ENGINE's rule ----------------------
+        # a row integrates only when its clock is the next for its
+        # client (contiguity) and its origin/right/item-parent are all
+        # present (resident, or admitted in this same pass). Failures
+        # stash in _pending and retry on every later apply.
+        sort_ord = np.lexsort((ck, cl))
+        cl_s, ck_s = cl[sort_ord], ck[sort_ord]
+        run_starts = np.flatnonzero(np.r_[True, cl_s[1:] != cl_s[:-1]])
+        run_ends = np.r_[run_starts[1:], k]
+        nxt0 = np.asarray([
+            self._next_clock.get(int(cl_s[s]), 0) for s in run_starts
+        ])
+
+        if self._pref_item_c:
+            pic = np.asarray(self._pref_item_c, np.int64)
+            pik = np.asarray(self._pref_item_k, np.int64)
+            dep_pc = np.where(pref >= 0, pic[np.clip(pref, 0, None)], -1)
+            dep_pk = np.where(pref >= 0, pik[np.clip(pref, 0, None)], -1)
+        else:
+            dep_pc = np.full(k, -1, np.int64)
+            dep_pk = np.full(k, -1, np.int64)
+
+        def dep_state(c_arr, k_arr):
+            """(in_resident, in_batch_index) per row; -1 = no dep."""
+            res = np.zeros(k, bool)
+            bidx2 = np.full(k, -1, np.int64)
+            for j in np.flatnonzero(c_arr >= 0):
+                t = (int(c_arr[j]), int(k_arr[j]))
+                if t in self._id_row:
+                    res[j] = True
+                else:
+                    bidx2[j] = btups.get(t, -1)
+            return res, bidx2
+
+        deps = [
+            dep_state(oc, ock),
+            dep_state(rc, rk),
+            dep_state(dep_pc, dep_pk),
+        ]
+        dep_c = [oc, rc, dep_pc]
+
+        admit = np.ones(k, bool)
+        while True:
+            adm_s = admit[sort_ord]
+            ok_s = np.zeros(k, bool)
+            for r, (s, e) in enumerate(zip(run_starts, run_ends)):
+                ok_s[s:e] = np.logical_and.accumulate(
+                    adm_s[s:e]
+                    & (ck_s[s:e] - nxt0[r] == np.arange(e - s))
+                )
+            new_admit = np.zeros(k, bool)
+            new_admit[sort_ord] = ok_s
+            for (res, bidx2), c_arr in zip(deps, dep_c):
+                has = c_arr >= 0
+                in_batch_ok = (bidx2 >= 0) & new_admit[
+                    np.clip(bidx2, 0, None)
+                ]
+                new_admit &= ~has | res | in_batch_ok
+            if (new_admit == admit).all():
+                break
+            admit = new_admit
+
+        # stash the blocked rows
+        blocked = np.flatnonzero(~admit)
+        for j in blocked.tolist():
+            self._pending[(int(cl[j]), int(ck[j]))] = (
+                int(cl[j]), int(ck[j]), int(pref[j]), int(kid[j]),
+                int(oc[j]), int(ock[j]), int(rc[j]), int(rk[j]),
+                int(kind[j]), int(tref[j]), contents[j],
+            )
+        if not admit.any():
+            return np.empty(0, np.int64)
+        # bump per-client next clocks past the admitted runs
+        adm_s = admit[sort_ord]
+        for r, (s, e) in enumerate(zip(run_starts, run_ends)):
+            cnt = int(adm_s[s:e].sum())
+            if cnt:
+                self._next_clock[int(cl_s[s])] = int(nxt0[r]) + cnt
+
+        a = np.flatnonzero(admit)
+        cl, ck, pref, kid = cl[a], ck[a], pref[a], kid[a]
+        oc, ock, rc, rk = oc[a], ock[a], rc[a], rk[a]
+        kind, tref = kind[a], tref[a]
+        contents = [contents[j] for j in a.tolist()]
+        k = len(a)
+
         rows = np.arange(self.cols.n, self.cols.n + k)
         self._id_row.update(zip(
-            (tups[i] for i in idx.tolist()), rows.tolist()
+            zip(cl.tolist(), ck.tolist()), rows.tolist()
         ))
         self.cols.append(
             {
                 "client": cl, "clock": ck, "kid": kid, "pref": pref,
                 "oc": oc, "ock": ock, "right_client": rc,
-                "right_clock": rk, "kind": kind,
-                "type_ref": dec["type_ref"][idx].astype(np.int64),
+                "right_clock": rk, "kind": kind, "type_ref": tref,
             },
-            [dec["contents"][i] for i in idx.tolist()],
+            contents,
         )
 
         # segment bookkeeping, grouped per distinct segkey
@@ -636,16 +774,16 @@ class IncrementalReplay:
                 self.cache[root] = built
 
         c = self.cols
+        maybe_empty: set = set()
         for root, sk in patches:
             key = self._key_names[self._seg_kid[sk]]
-            tgt = self.cache[root]
+            tgt = self.cache.setdefault(root, {})
             row = self._win.get(sk)
             if row is None or self.ds.contains(
                 int(c.col("client")[row]), int(c.col("clock")[row])
             ):
                 tgt.pop(key, None)
-                if not tgt:
-                    self.cache.pop(root, None)  # same rule as above
+                maybe_empty.add(root)  # pop AFTER all patches applied
                 continue
             from crdt_tpu.core.store import K_TYPE, TYPE_MAP
 
@@ -658,6 +796,9 @@ class IncrementalReplay:
                 )
             else:
                 tgt[key] = c.contents[row]
+        for root in maybe_empty:
+            if self.cache.get(root) == {}:
+                self.cache.pop(root, None)  # same rule as above
         # ix-registered collections with no visible content still
         # materialize (empty), exactly like the cold materialize
         for sk in self._root_segs.get("ix", ()):

@@ -234,6 +234,89 @@ class TestIncrementalRounds:
         cache = inc.apply(b2)
         assert cache == replay_trace([b1, b2]).cache
 
+    def test_out_of_order_delivery_pends_like_engine(self):
+        """Batches arriving out of causal order: rows whose clock run
+        has a gap (or whose origin is missing) must stay invisible
+        until the gap fills — matching Engine.apply_records applied in
+        the same arrival order, round by round."""
+        from crdt_tpu.core.engine import Engine
+
+        inc = IncrementalReplay()
+        eng = Engine(0)
+        # client 1 writes a 9-op chain + 3 map sets, split into three
+        # blobs delivered newest-first
+        recs = []
+        prev = None
+        for kk in range(9):
+            recs.append(ItemRecord(client=1, clock=kk, parent_root="s",
+                                   origin=prev, content=kk))
+            prev = (1, kk)
+        for j, kk in enumerate(range(9, 12)):
+            recs.append(ItemRecord(client=1, clock=kk, parent_root="m",
+                                   key=f"k{j}", content=kk))
+        chunks = [recs[8:], recs[4:8], recs[:4]]  # reversed delivery
+        for i, chunk in enumerate(chunks):
+            blob = _blob(chunk)
+            inc.apply(blob)
+            rr, _ = v1.decode_update(blob)
+            eng.apply_records(rr)
+            assert inc.cache == eng.to_json(), f"chunk {i}"
+        assert inc.cache["s"] == list(range(9))
+        assert len(inc._pending) == 0
+
+    def test_cross_client_dependency_ordering(self):
+        """Client 2's insert referencing client 1's item arrives first;
+        it must pend until client 1's chain shows up."""
+        from crdt_tpu.core.engine import Engine
+
+        inc = IncrementalReplay()
+        eng = Engine(0)
+        b2 = _blob([ItemRecord(client=2, clock=0, parent_root="s",
+                               origin=(1, 1), content="late")])
+        b1 = _blob([
+            ItemRecord(client=1, clock=0, parent_root="s", content="a"),
+            ItemRecord(client=1, clock=1, parent_root="s", origin=(1, 0),
+                       content="b"),
+        ])
+        for i, blob in enumerate((b2, b1)):
+            inc.apply(blob)
+            rr, _ = v1.decode_update(blob)
+            eng.apply_records(rr)
+            assert inc.cache == eng.to_json(), f"blob {i}"
+        assert inc.cache["s"] == ["a", "b", "late"]
+
+    def test_random_shuffled_delivery(self):
+        from crdt_tpu.core.engine import Engine
+
+        rng = np.random.default_rng(23)
+        blobs, clk, chains = [], {}, {}
+        for rnd in range(10):
+            recs = []
+            for c in (1, 2, 3):
+                for _ in range(5):
+                    k = clk[c] = clk.get(c, -1) + 1
+                    if rng.random() < 0.4:
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root="m",
+                            key=f"q{rng.integers(0, 5)}", content=k))
+                    else:
+                        prev = chains.get(c)
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root="s",
+                            origin=prev, content=k))
+                        chains[c] = (c, k)
+            blobs.append(_blob(recs))
+        order = rng.permutation(len(blobs))
+        inc = IncrementalReplay()
+        eng = Engine(0)
+        for i in order:
+            inc.apply(blobs[i])
+            rr, _ = v1.decode_update(blobs[i])
+            eng.apply_records(rr)
+            assert inc.cache == eng.to_json(), f"after blob {i}"
+        assert inc.cache == replay_trace(blobs).cache
+        assert len(inc._pending) == 0
+
     def test_random_grand_rounds(self):
         rng = np.random.default_rng(11)
         inc = IncrementalReplay()
