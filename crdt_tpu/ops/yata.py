@@ -202,37 +202,42 @@ def drop_orphan_subtrees(rows, seg, parent_idx) -> list:
     (parent < 0) without crossing a segment boundary. Orphans (items
     whose origin is a GC filler or a foreign row) get ``seg = -1`` —
     the engine splices them after a chain-less row, so its head walk
-    never emits them — and the drop cascades to their subtrees. One
-    topological pass (children after parents), O(rows).
+    never emits them — and the drop cascades to their subtrees.
+    Vectorized reachability: numpy pointer doubling over the parent
+    function, O(rows log depth) array work instead of a python BFS.
 
     ``rows`` is an iterable of row indices; ``seg``/``parent_idx`` are
     indexable by row. Mutates ``seg`` in place; returns the kept rows
     in input order.
     """
-    rows = list(rows)  # iterated twice; accept one-shot iterables
-    children: Dict[int, list] = {}
-    roots: list = []
-    for i in rows:
-        p = int(parent_idx[i])
-        if p < 0:
-            roots.append(i)
-        else:
-            children.setdefault(p, []).append(i)
-    kept: set = set()
-    stack = roots
-    while stack:
-        i = stack.pop()
-        kept.add(i)
-        for c in children.get(i, ()):
-            if seg[c] == seg[i]:
-                stack.append(c)
-    out = []
-    for i in rows:
-        if i in kept:
-            out.append(i)
-        else:
-            seg[i] = -1
-    return out
+    import numpy as np
+
+    rows = np.asarray(list(rows), dtype=np.int64)
+    n = len(rows)
+    if n == 0:
+        return []
+    seg_np = np.asarray(seg)
+    par_np = np.asarray(parent_idx)
+    # local index of each row's parent (rows outside the set, or
+    # out-of-range parent references, -> -1)
+    m = int(seg_np.shape[0])
+    pos = np.full(m, -1, np.int64)
+    pos[rows] = np.arange(n)
+    p = par_np[rows]
+    in_range = (p >= 0) & (p < m)
+    pc = np.clip(p, 0, m - 1)
+    p_local = np.where(in_range, pos[pc], -1)
+    same_seg = in_range & (p_local >= 0) & (seg_np[pc] == seg_np[rows])
+    ok = p < 0  # chain roots are reachable; dead ends (cross-seg /
+    # foreign parents) self-loop with ok=False and stay False
+    idx = np.arange(n)
+    ptr = np.where(same_seg, p_local, idx)
+    for _ in range(max(1, (max(n, 2) - 1).bit_length() + 1)):
+        ok = ok | ok[ptr]
+        ptr = ptr[ptr]
+    for i in rows[~ok]:
+        seg[int(i)] = -1
+    return rows[ok].tolist()
 
 
 def _simulate_group(sibs: List[dict], member_ids: set) -> List[Tuple[int, int]]:
