@@ -490,7 +490,38 @@ def run_numpy(blobs, phases):
     return cache, snap
 
 
+def _ensure_live_backend():
+    """The axon tunnel, when down, HANGS backend init (the
+    sitecustomize hook dials it even under JAX_PLATFORMS=cpu). Probe
+    device init in a subprocess with a timeout; on failure re-exec
+    this benchmark on the CPU backend so the run still produces an
+    honest JSON line (its `platform` field records what actually ran).
+    """
+    import subprocess
+
+    if os.environ.get("BENCH_BACKEND_CHECKED"):
+        return
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=240,
+        )
+        if probe.returncode == 0:
+            os.environ["BENCH_BACKEND_CHECKED"] = "1"
+            return
+        reason = probe.stderr.decode(errors="replace")[-300:]
+    except subprocess.TimeoutExpired:
+        reason = "backend init hung (tunnel down?)"
+    log(f"TPU backend probe failed; re-running on CPU: {reason}")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # skips axon registration
+    env.update({"JAX_PLATFORMS": "cpu", "BENCH_BACKEND_CHECKED": "1"})
+    os.execve(sys.executable, [sys.executable, __file__], env)
+
+
 def main():
+    _ensure_live_backend()
     import jax
 
     jax.config.update("jax_enable_x64", True)
