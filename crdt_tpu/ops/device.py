@@ -27,6 +27,22 @@ def bucket_pow2(n: int, floor: int = 9) -> int:
     return 1 << max(floor, (max(n, 1) - 1).bit_length())
 
 
+def bucket_grid(n: int, floor: int = 9) -> int:
+    """Quarter-pow2 size bucket: smallest of {1, 1.25, 1.5, 1.75}*2^k
+    >= n. Pow2 padding wastes up to 100% of every downstream sort and
+    doubling round; the quarter grid caps waste at 25% for 2x the
+    shape-bucket count. Used by the cold packed replay, where the
+    doubling loops' width is the dispatch's dominant axis; resident
+    buffers keep plain pow2 (their capacity growth amortizes)."""
+    n = max(n, 1 << floor)
+    k = (n - 1).bit_length() - 1  # candidate exponent: 2^k < n <= 2^(k+1)
+    for num in (5, 6, 7, 8):
+        cand = num << max(k - 2, 0)
+        if cand >= n:
+            return cand
+    return 1 << (k + 1)
+
+
 _pack_fns: dict = {}  # arity -> jitted concat (host helper cache)
 
 
@@ -139,16 +155,26 @@ def dfs_ranks(
     first_child: jnp.ndarray, # [B+num_roots] int32 first child per node
     is_item: jnp.ndarray,     # [B] bool real tree members
     num_roots: int,
+    rank_rounds: int | None = None,
 ) -> jnp.ndarray:
     """Distance-to-end of the DFS traversal for every node (items and
     the virtual roots appended after them) via successor pointer
-    doubling (Wyllie list ranking with fixpoint early exit).
+    doubling (Wyllie list ranking).
 
     The DFS successor of a node is its first child if any, else the
     next sibling of the nearest ancestor (itself included) that has
     one — the "climb past last-child chains" step, itself a pointer
     doubling. Shared by :func:`crdt_tpu.ops.yata.tree_order_ranks`
     (full-width) and the packed replay kernel (compact-width).
+
+    ``rank_rounds`` (static), when the caller can bound the longest
+    per-segment DFS path on the host (e.g. max segment population from
+    one ``np.unique`` at staging), fixes both doubling loops to that
+    many rounds: the fixpoint reduce per round disappears and the
+    whole ranking runs exactly ceil(log2(path)) gathers. ``None``
+    keeps the data-driven while-loop with fixpoint early exit (the
+    incremental path, where the bound changes every round and a static
+    would recompile).
     """
     B = parent.shape[0]
     m = B + num_roots
@@ -161,7 +187,7 @@ def dfs_ranks(
 
     is_last_child = (idx_m < B) & (pad_next == NULLI) & pad_item
     g = jnp.where(is_last_child, pad_parent, idx_m)
-    climb_t = pointer_double(g)
+    climb_t = pointer_double(g, max_iters=rank_rounds)
 
     y_next = pad_next[jnp.clip(climb_t, 0, m - 1)]
     succ = jnp.where((climb_t >= B) | (y_next < 0), idx_m, y_next)
@@ -170,25 +196,62 @@ def dfs_ranks(
     )
     succ = jnp.where(pad_item | (idx_m >= B), succ, idx_m).astype(jnp.int32)
 
-    dist = jnp.where(succ != idx_m, 1, 0).astype(jnp.int32)
-    iters = max(1, (max(m, 2) - 1).bit_length() + 1)
-
-    def body(state):
-        ptr, d, it, _ = state
-        d = d + d[ptr]
-        ptr2 = ptr[ptr]
-        return ptr2, d, it + 1, jnp.any(ptr2 != ptr)
-
-    def cond(state):
-        return state[3] & (state[2] < iters)
-
-    _, dist_to_end, _, _ = jax.lax.while_loop(
-        cond, body, (succ, dist, jnp.int32(0), jnp.any(succ[succ] != succ))
-    )
-    return dist_to_end
+    return wyllie_dist(succ, rounds=rank_rounds)
 
 
-def pointer_double(f: jnp.ndarray) -> jnp.ndarray:
+# low 32 bits of the packed (pointer, distance) word hold the distance.
+# A plain Python int: a module-level jnp scalar would be constructed at
+# import time, when jax_enable_x64 may be off, and truncate to int32.
+_W_DIST = (1 << 32) - 1
+
+
+def wyllie_dist(succ: jnp.ndarray, rounds: int | None = None) -> jnp.ndarray:
+    """Distance-to-terminal along ``succ`` for every node (terminals
+    are self-loops), by pointer doubling with the (pointer, distance)
+    pair packed into ONE int64 per node: each round costs a single
+    random gather instead of two, and on a gather-latency-bound TPU
+    the ranking loop is exactly where the fused replay dispatch spends
+    its time (see tools/profile_kernel.py).
+
+    ``rounds`` (static) runs a fixed ``fori_loop`` with no per-round
+    fixpoint reduce; callers must guarantee 2**rounds >= the longest
+    path. ``None`` falls back to the early-exit while-loop bounded by
+    ceil(log2(m)) + 1 (any malformed cycle terminates there and keeps
+    an in-cycle value, same convention as :func:`pointer_double`)."""
+    m = succ.shape[0]
+    idx = jnp.arange(m, dtype=jnp.int32)
+    dist0 = (succ != idx).astype(jnp.int64)
+    comb = (succ.astype(jnp.int64) << 32) | dist0
+    max_iters = max(1, (max(m, 2) - 1).bit_length() + 1)
+
+    def step(c):
+        ptr = (c >> 32).astype(jnp.int32)
+        c2 = c[ptr]
+        newd = (c & _W_DIST) + (c2 & _W_DIST)
+        return (c2 & ~_W_DIST) | newd, ptr, (c2 >> 32).astype(jnp.int32)
+
+    if rounds is not None:
+        def fbody(_, c):
+            return step(c)[0]
+
+        comb = jax.lax.fori_loop(0, min(rounds, max_iters), fbody, comb)
+    else:
+        def body(state):
+            c, it, _ = state
+            nc, ptr, nptr = step(c)
+            return nc, it + 1, jnp.any(nptr != ptr)
+
+        def cond(state):
+            return state[2] & (state[1] < max_iters)
+
+        p0 = (comb >> 32).astype(jnp.int32)
+        comb, _, _ = jax.lax.while_loop(
+            cond, body, (comb, jnp.int32(0), jnp.any(p0[p0] != p0))
+        )
+    return (comb & _W_DIST).astype(jnp.int32)
+
+
+def pointer_double(f: jnp.ndarray, max_iters: int | None = None) -> jnp.ndarray:
     """Iterate f <- f∘f to a fixpoint. `f` maps node->node with
     self-loops at terminals; returns the terminal reached from each
     node in O(log depth) gather rounds.
@@ -198,9 +261,14 @@ def pointer_double(f: jnp.ndarray) -> jnp.ndarray:
     a cycle (e.g. a hostile update with cyclic origins) terminates
     instead of spinning the device forever — cycle members simply keep
     an in-cycle value, which downstream visibility checks treat like
-    any other non-root result."""
+    any other non-root result.
+
+    ``max_iters`` (static) tightens the bound when the caller knows the
+    chain depth (the early-exit reduce still runs; the cap only clips
+    the worst case)."""
     n = f.shape[0]
-    max_iters = max(1, (max(n, 2) - 1).bit_length() + 1)
+    cap = max(1, (max(n, 2) - 1).bit_length() + 1)
+    max_iters = cap if max_iters is None else max(1, min(max_iters, cap))
 
     def body(state):
         g, it, _ = state

@@ -45,10 +45,13 @@ from crdt_tpu.ops.device import (
 def map_winners(
     seg: jnp.ndarray,  # [N] int32 dense segment id per item (-1 = not a map item)
     client: jnp.ndarray,  # [N] int32
-    clock: jnp.ndarray,  # [N] int64
+    clock: jnp.ndarray,  # [N] int64 (may be None when rows_id_ranked fits)
     origin_idx: jnp.ndarray,  # [N] int32 index of origin item, NULLI if none
     valid: jnp.ndarray,  # [N] bool
     num_segments: int,  # static
+    rows_id_ranked: bool = False,  # static
+    chain_rounds: int | None = None,  # static
+    client_bits: int = 22,  # static
 ):
     """Winner item index per segment (NULLI for empty segments).
 
@@ -56,6 +59,18 @@ def map_winners(
     guarantees this for map chains); cross-segment or missing origins
     are treated as segment roots, matching host integration of items
     whose origins were garbage-collected.
+
+    ``rows_id_ranked`` (static): every caller in this package invokes
+    the kernel AFTER the shared id sort, where row position is already
+    the (client, clock) rank — so within one client, DESCENDING clock
+    is exactly DESCENDING row index and the three-part sibling key
+    (parent, client, clock desc) collapses into one int64, replacing
+    the two-pass lexsort with a single argsort (when the static widths
+    fit; the lexsort remains as the wide fallback — callers staging
+    dense client ranks can tighten ``client_bits`` so the collapsed
+    key still fits at million-row widths, and may then pass
+    ``clock=None``). ``chain_rounds`` (static) caps the tail pointer
+    doubling when the caller bounded the deepest key chain at staging.
     """
     n = client.shape[0]
     m = n + num_segments  # item nodes + one virtual root per segment
@@ -71,9 +86,25 @@ def map_winners(
     # last child per node = max child by (client, inverted clock) —
     # computed scatter-free: sort children by (parent, key), then each
     # parent's run-tail IS its last child (see run_edge_lookup)
-    inv_clock = ((1 << _CLOCK_BITS) - 1) - clock.astype(jnp.int64)
-    pack = (client.astype(jnp.int64) << _CLOCK_BITS) | inv_clock
-    corder = lexsort([parent, pack])
+    pbits = int(m).bit_length()
+    qbits = int(max(n - 1, 1)).bit_length()
+    if rows_id_ranked and pbits + client_bits + qbits <= 63:
+        idx_desc = (n - 1) - jnp.arange(n, dtype=jnp.int64)
+        key = (
+            (parent.astype(jnp.int64) << (client_bits + qbits))
+            | (client.astype(jnp.int64) << qbits)
+            | idx_desc
+        )
+        corder = jnp.argsort(key, stable=True)
+    else:
+        if clock is None:
+            raise ValueError(
+                "map_winners needs clock when the collapsed id-ranked "
+                "key does not fit (stage() must pre-check the widths)"
+            )
+        inv_clock = ((1 << _CLOCK_BITS) - 1) - clock.astype(jnp.int64)
+        pack = (client.astype(jnp.int64) << _CLOCK_BITS) | inv_clock
+        corder = lexsort([parent, pack])
     p_sorted = parent[corder]
     last_pos, _ = run_edge_lookup(p_sorted, m, side="right")
     child_idx = jnp.where(
@@ -83,7 +114,7 @@ def map_winners(
     # last-child function with self-loops at leaves
     f = jnp.where(child_idx >= 0, child_idx, jnp.arange(m, dtype=jnp.int32))
 
-    tail = pointer_double(f)
+    tail = pointer_double(f, max_iters=chain_rounds)
 
     root_tail = tail[n:]
     winners = jnp.where(

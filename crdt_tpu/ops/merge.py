@@ -106,7 +106,13 @@ def converge_maps(
     seg = jnp.where(is_map, seg, NULLI)
 
     # -- 4. per-segment winners ----------------------------------------
-    winners = map_winners(seg, client, clock, origin_idx, is_map, num_segments)
+    # rows are id-sorted here (step 1), so the collapsed sibling key
+    # applies. Raw client ids flow through this path, so client_bits
+    # must be pack_id's true client width (23); when the collapsed key
+    # does not fit an int64 at this width, map_winners falls back to
+    # the lexsort internally.
+    winners = map_winners(seg, client, clock, origin_idx, is_map, num_segments,
+                          rows_id_ranked=True, client_bits=23)
 
     # -- 5. tombstones --------------------------------------------------
     del_mask = ds_ops.apply_mask(client, clock, uniq_valid, d_client, d_start, d_end)

@@ -42,6 +42,7 @@ import numpy as np
 
 from crdt_tpu.ops.device import (
     NULLI,
+    bucket_grid,
     bucket_pow2,
     dense_ranks_sorted,
     dfs_ranks,
@@ -51,6 +52,7 @@ from crdt_tpu.ops.device import (
     scatter_perm,
     searchsorted_ids,
 )
+from crdt_tpu.ops.lww import map_winners
 
 # host-side packing limits for the composite segment key:
 # (is_map:1 | pref:25 bits | kid:21 bits) must fit non-negative int64
@@ -58,22 +60,56 @@ _PREF_BITS = 25
 _KID_BITS = 21
 
 
-class PackedPlan(NamedTuple):
-    """Host-side staging result: one matrix + static metadata."""
+from crdt_tpu.ops.device import _CLOCK_BITS  # pack_id's clock width
 
-    mat: np.ndarray           # [7, kpad] i32 (narrow) or i64 (wide)
+_SEQ_FLAG = 1 << 30          # bit in the seg column marking sequence rows
+
+
+class PackedPlan(NamedTuple):
+    """Host-side staging result: one matrix + static metadata.
+
+    Staging does the layout work a tuned columnar store would do
+    anyway — id radix sort, dedup, origin resolution, dense segment
+    numbering — and ships its OUTPUT: the device dispatch starts at
+    the combinatorial core (sibling sort, tree tables, pointer-doubled
+    ranking) instead of re-deriving layout with device-width sorts.
+    Measured on v5e (tools/profile_kernel.py), the id sort + origin
+    searchsorted + segment sort cost ~14ms of the fused dispatch at
+    100k rows; as numpy radix passes at staging they cost ~6ms of host
+    time and drop the matrix from 7 to 5 rows (one int32 transfer).
+    """
+
+    mat: np.ndarray           # [5, kpad] int32, rows in id-sorted order:
+                              #   0: dense client rank
+                              #   1: dense segment id | _SEQ_FLAG (-1 dead)
+                              #   2: origin row (map rows; -1 root)
+                              #   3: compact block - seq row ids (-1 pad)
+                              #   4: compact block - compact parent (-1 root)
     n: int                    # real rows (rest is padding)
-    num_segments: int         # pow2 bucket over distinct segments
-    seq_bucket: int           # pow2 bucket over sequence-row count
+    num_segments: int         # size bucket over distinct segments
+    seq_bucket: int           # size bucket over sequence-row count
+    order: np.ndarray         # id-sort permutation: mat row i = caller
+                              # row order[i] (maps device output back)
     clients: np.ndarray       # sorted raw client ids (dense rank = index)
+    client_bits: int          # dense client rank width (static)
+    rank_rounds: int          # doubling rounds bound (seq DFS)
+    map_rounds: int           # doubling rounds bound (map chains)
+
+
+def _even_up(x: int) -> int:
+    """Round a doubling-rounds bound up to even: halves the static
+    variants the jit cache sees at a cost of at most one extra round."""
+    return x + (x & 1)
 
 
 def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     """Pack kernel columns into the single-transfer matrix.
 
-    Returns None when the batch exceeds the packed path's key bounds
+    Returns None when the batch exceeds the packed path's bounds
     (callers fall back to the general kernels): >=2^25 distinct
-    parents or >=2^21 distinct map keys.
+    parents, >=2^21 distinct map keys, clocks >= 2^40 (the shared
+    ``pack_id`` bound), >=2^30 segments, or composite sibling keys
+    that do not fit an int64 at this row count.
     """
     client = np.asarray(cols["client"], np.int64)
     clock = np.asarray(cols["clock"], np.int64)
@@ -86,6 +122,10 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     valid = np.asarray(cols["valid"], bool)
     n = len(client)
     if n == 0 or not valid.any():
+        return None
+    if int(clock.max()) >= (1 << _CLOCK_BITS):
+        return None
+    if ock.size and int(ock.max()) >= (1 << _CLOCK_BITS):
         return None
 
     # dense order-preserving client ranks (origins share the table)
@@ -112,64 +152,232 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     if n_parents >= (1 << _PREF_BITS) or kid_max >= (1 << _KID_BITS):
         return None
 
-    # distinct segments: map rows by (pref, kid), seq rows by pref
-    n_segs = len(np.unique(segkey_of(pref, kid)[valid]))
-    n_seq = int((valid & (kid < 0)).sum())
+    # id sort + dedup (dense client ranks are monotone in the raw ids,
+    # so the dense-packed id sorts identically to the raw-packed one)
+    ikey = np.where(
+        valid, (client_d << _CLOCK_BITS) | clock, np.int64(2**62)
+    )
+    order = np.argsort(ikey, kind="stable").astype(np.int32)
+    ikey_s = ikey[order]
+    kid_s = kid[order]
+    pref_s = pref[order]
+    oc_s = oc_d[order]
+    ock_s = ock[order]
+    valid_s = valid[order]
+    client_s = client_d[order]
+    dup = np.r_[False, ikey_s[1:] == ikey_s[:-1]]
+    uniq_valid = valid_s & ~dup
 
-    narrow = clock.max() < (1 << 31) and ock.max() < (1 << 31)
-    dt = np.int32 if narrow else np.int64
-    kpad = bucket_pow2(n, floor=6)
-    mat = np.zeros((7, kpad), dt)
-    mat[0, :n] = client_d
-    mat[1, :n] = clock
-    mat[2, :n] = pref
-    mat[3, :n] = kid
-    mat[4, :n] = oc_d
-    mat[5, :n] = ock
-    mat[6, :n] = valid
-    mat[3, n:] = -1  # padding rows: invalid, non-map, null origins
-    mat[4, n:] = -1
-    mat[5, n:] = -1
+    # dense segments over live rows; map segkeys carry bit 62, so
+    # np.unique numbers every sequence segment below every map segment
+    sk = segkey_of(pref_s, kid_s)
+    uniq_sk, seg_inv, seg_counts = np.unique(
+        sk[uniq_valid], return_inverse=True, return_counts=True
+    )
+    n_segs = len(uniq_sk)
+    if n_segs >= _SEQ_FLAG:
+        return None
+    seg = np.full(n, -1, np.int64)
+    seg[uniq_valid] = seg_inv
+    map_seg = uniq_sk >= (1 << 62)
+    # per-segment populations bound the device doubling rounds: a DFS
+    # path cannot exceed its segment's row count + 1 (virtual root),
+    # a map key chain cannot be deeper than its segment's row count
+    max_map = int(seg_counts[map_seg].max()) if map_seg.any() else 1
+    max_seq = int(seg_counts[~map_seg].max()) if (~map_seg).any() else 1
+
+    # origin rows by binary search over the sorted ids (leftmost match
+    # is the kept representative of any duplicate run)
+    okey = np.where(
+        oc_s >= 0, (oc_s << _CLOCK_BITS) | ock_s, np.int64(-1)
+    )
+    pos = np.searchsorted(ikey_s, okey)
+    posc = np.clip(pos, 0, n - 1)
+    origin_row = np.where(
+        (okey >= 0) & (ikey_s[posc] == okey), posc, -1
+    )
+    is_map_row = uniq_valid & (kid_s >= 0)
+    origin_map = np.where(is_map_row, origin_row, -1)
+
+    # compact sequence block: seq rows ascending (= id rank ascending),
+    # same-segment origins resolved to compact positions
+    seq_rows = np.flatnonzero(uniq_valid & (kid_s < 0))
+    n_seq = len(seq_rows)
+    if n_seq:
+        o_rows = origin_row[seq_rows]
+        o_seg = seg[np.clip(o_rows, 0, n - 1)]
+        same_seg = (o_rows >= 0) & (o_seg == seg[seq_rows])
+        cpos = np.searchsorted(seq_rows, np.clip(o_rows, 0, None))
+        cposc = np.clip(cpos, 0, n_seq - 1)
+        c_parent = np.where(
+            same_seg & (seq_rows[cposc] == o_rows), cposc, -1
+        )
+    else:
+        c_parent = np.empty(0, np.int64)
+
+    # size buckets + static key widths
+    cbits = _even_up(max(8, len(uniq).bit_length()))
+    kpad = bucket_grid(n, floor=6)
+    qbits = (kpad - 1).bit_length()
+    B = min(kpad, bucket_grid(max(n_seq, 1), floor=6))
+    Sb = bucket_grid(max(n_segs, 1), floor=6)
+    if max(kpad, B) + Sb >= (1 << 31) - 1:
+        return None
+    pbits = int(max(kpad, B) + Sb + 1).bit_length()
+    if pbits + cbits + qbits > 63:
+        return None
+
+    mat = np.full((5, kpad), -1, np.int32)
+    mat[0, :] = 0
+    mat[0, :n] = client_s
+    mat[1, :n] = np.where(
+        seg >= 0,
+        seg | np.where(kid_s < 0, _SEQ_FLAG, 0),
+        -1,
+    )
+    mat[2, :n] = origin_map
+    mat[3, :n_seq] = seq_rows
+    mat[4, :n_seq] = c_parent
     return PackedPlan(
         mat=mat,
         n=n,
-        num_segments=bucket_pow2(n_segs),
-        seq_bucket=min(kpad, bucket_pow2(max(n_seq, 1), floor=6)),
+        num_segments=Sb,
+        seq_bucket=B,
+        order=order,
         clients=uniq,
+        client_bits=cbits,
+        rank_rounds=_even_up((max_seq + 2).bit_length() + 1),
+        map_rounds=_even_up((max_map + 2).bit_length() + 1),
     )
 
 
-@partial(jax.jit, static_argnames=("num_segments", "seq_bucket"))
-def _converge_packed(mat, num_segments: int, seq_bucket: int):
-    """The single fused dispatch. Returns one packed int32 array:
+@partial(
+    jax.jit,
+    static_argnames=("num_segments", "seq_bucket", "rank_rounds",
+                     "map_rounds", "client_bits"),
+)
+def _converge_packed(mat, num_segments: int, seq_bucket: int,
+                     rank_rounds: int, map_rounds: int,
+                     client_bits: int):
+    """The single fused dispatch over the STAGED matrix (rows already
+    id-sorted, deduped, origin-resolved, segment-numbered — see
+    :class:`PackedPlan`). Returns one packed int32 array:
 
       [ win_rows[S] | stream_seg[B] | stream_row[B] ]
 
-    - win_rows: original row index of each map segment's winner (-1
-      for non-map / empty segments);
+    - win_rows: id-sorted row index of each map segment's winner (-1
+      for non-map / empty segments; the host maps back through
+      ``plan.order``);
     - stream_seg/stream_row: sequence rows in document order, grouped
       by segment id (B = seq_bucket; -1 padding at the tail).
     """
-    client = mat[0].astype(jnp.int32)
-    clock = mat[1].astype(jnp.int64)
-    pref = mat[2].astype(jnp.int64)
-    kid = mat[3].astype(jnp.int32)
-    oc = mat[4].astype(jnp.int32)
-    ock = mat[5].astype(jnp.int64)
-    valid = mat[6] != 0
-    return _converge_core(
-        client, clock, pref, kid, oc, ock, valid,
-        num_segments=num_segments, seq_bucket=seq_bucket,
+    n = mat.shape[1]
+    client = mat[0]
+    segf = mat[1]
+    live = segf >= 0
+    seg = jnp.where(live, segf & (_SEQ_FLAG - 1), NULLI)
+    is_map = live & ((segf & _SEQ_FLAG) == 0)
+    seg_map = jnp.where(is_map, seg, NULLI)
+
+    winners = map_winners(
+        seg_map, client, None, mat[2], is_map, num_segments,
+        rows_id_ranked=True, chain_rounds=map_rounds,
+        client_bits=client_bits,
     )
+    win_rows = winners.astype(jnp.int32)
+
+    B = seq_bucket
+    sub = mat[3, :B]
+    c_ok = sub >= 0
+    subc = jnp.clip(sub, 0, n - 1)
+    c_seg = jnp.where(c_ok, seg[subc], NULLI)
+    cp = mat[4, :B]
+    parent = jnp.where(c_ok & (cp >= 0), cp, B + jnp.maximum(c_seg, 0))
+    parent = jnp.where(c_ok, parent, B + num_segments).astype(jnp.int32)
+    c_client = client[subc]
+    pos_desc = jnp.where(c_ok, (n - 1) - sub, 0)
+    stream_seg, stream_row = _rank_compact(
+        parent, c_client, pos_desc, c_seg, c_ok, sub,
+        num_segments=num_segments, rank_rounds=rank_rounds,
+        client_bits=client_bits,
+        qbits=int(max(n - 1, 1)).bit_length(),
+    )
+    return jnp.concatenate([win_rows, stream_seg, stream_row])
+
+
+
+
+def _rank_compact(parent, c_client, pos_desc, c_seg, c_ok, row_of, *,
+                  num_segments: int, rank_rounds: Optional[int],
+                  client_bits: int, qbits: int):
+    """Sibling sort + tree tables + climb + Wyllie ranking + document
+    order over the COMPACT sequence space (B rows + S virtual roots).
+    ``row_of[i]`` is the caller-space row of compact row i, used only
+    to label the output stream. Shared by the cold staged dispatch and
+    the general/incremental :func:`_converge_core`.
+
+    Sibling order is (parent, client asc, clock DESC); ``pos_desc``
+    must be descending in clock within one (parent, client) group —
+    all callers derive it from id-sorted row positions.
+    """
+    B = parent.shape[0]
+    mB = B + num_segments
+    pbits = int(mB).bit_length()
+    if pbits + client_bits + qbits <= 63:
+        sibkey = (
+            (parent.astype(jnp.int64) << (client_bits + qbits))
+            | (c_client.astype(jnp.int64) << qbits)
+            | pos_desc.astype(jnp.int64)
+        )
+        sord2 = jnp.argsort(sibkey, stable=True)
+    else:
+        sord2 = lexsort([
+            parent.astype(jnp.int64),
+            (c_client.astype(jnp.int64) << qbits)
+            | pos_desc.astype(jnp.int64),
+        ])
+    p_s = parent[sord2]
+    same_group = jnp.concatenate([p_s[1:] == p_s[:-1], jnp.zeros(1, bool)])
+    nxt_sorted = jnp.where(
+        same_group, jnp.roll(sord2, -1), NULLI
+    ).astype(jnp.int32)
+    next_sib = scatter_perm(sord2, nxt_sorted)
+    first_pos, _ = run_edge_lookup(p_s, mB, side="left")
+    first_child = jnp.where(
+        first_pos >= 0, sord2[jnp.clip(first_pos, 0, B - 1)], NULLI
+    ).astype(jnp.int32)
+
+    dist_to_end = dfs_ranks(parent, next_sib, first_child, c_ok,
+                            num_segments, rank_rounds=rank_rounds)
+    root_dist = dist_to_end[B + jnp.maximum(c_seg, 0)]
+    c_rank = jnp.where(c_ok, root_dist - dist_to_end[:B] - 1, NULLI)
+
+    skey2 = jnp.where(
+        c_ok & (c_rank >= 0),
+        (c_seg.astype(jnp.int64) << qbits) | c_rank.astype(jnp.int64),
+        jnp.int64(2**62),
+    )
+    dorder = jnp.argsort(skey2, stable=True)
+    d_ok = (c_ok & (c_rank >= 0))[dorder]
+    stream_seg = jnp.where(d_ok, c_seg[dorder], NULLI).astype(jnp.int32)
+    stream_row = jnp.where(
+        d_ok, row_of[dorder], NULLI
+    ).astype(jnp.int32)
+    return stream_seg, stream_row
 
 
 def _converge_core(client, clock, pref, kid, oc, ock, valid, *,
-                   num_segments: int, seq_bucket: int):
-    """Traced body shared by the cold single-dispatch replay and the
-    incremental touched-segment path (``crdt_tpu.models.incremental``).
-    Row indices in the output refer to the CALLER's row space."""
-    from crdt_tpu.ops.lww import map_winners
-
+                   num_segments: int, seq_bucket: int,
+                   rank_rounds: Optional[int] = None,
+                   map_rounds: Optional[int] = None):
+    """Traced body of the GENERAL packed convergence: does its own id
+    sort, dedup, origin resolution, and segment numbering on device.
+    The cold replay no longer routes here (its staging precomputes the
+    layout — see :func:`_converge_packed`); this remains the engine of
+    the incremental touched-segment path
+    (``crdt_tpu.models.incremental``), where rows live resident in HBM
+    and host precomputation is not available. Row indices in the
+    output refer to the CALLER's row space."""
     n = client.shape[0]
 
     # shared id-sort + dedup + origin resolution (one for both kernels)
@@ -206,7 +414,8 @@ def _converge_core(client, clock, pref, kid, oc, ock, valid, *,
     seg_seq = jnp.where(is_seq, seg, NULLI)
 
     winners = map_winners(
-        seg_map, client, clock, origin_idx, is_map, num_segments
+        seg_map, client, clock, origin_idx, is_map, num_segments,
+        rows_id_ranked=True, chain_rounds=map_rounds, client_bits=23,
     )
     win_rows = jnp.where(
         winners >= 0, order[jnp.clip(winners, 0, n - 1)], NULLI
@@ -243,52 +452,11 @@ def _converge_core(client, clock, pref, kid, oc, ock, valid, *,
     # making the whole key fit one int64 when the static widths allow.
     c_client = client[sub]
     pos_desc = (n - 1) - sub  # descending position == descending clock
-    pbits = int(mB).bit_length()
-    qbits = int(max(n - 1, 1)).bit_length()
-    if pbits + 22 + qbits <= 63:
-        sibkey = (
-            (parent.astype(jnp.int64) << (22 + qbits))
-            | (c_client.astype(jnp.int64) << qbits)
-            | pos_desc.astype(jnp.int64)
-        )
-        sord2 = jnp.argsort(sibkey, stable=True)
-    else:
-        sord2 = lexsort([
-            parent.astype(jnp.int64),
-            (c_client.astype(jnp.int64) << qbits)
-            | pos_desc.astype(jnp.int64),
-        ])
-    p_s = parent[sord2]
-    same_group = jnp.concatenate([p_s[1:] == p_s[:-1], jnp.zeros(1, bool)])
-    nxt_sorted = jnp.where(
-        same_group, jnp.roll(sord2, -1), NULLI
-    ).astype(jnp.int32)
-    next_sib = scatter_perm(sord2, nxt_sorted)
-    first_pos, _ = run_edge_lookup(p_s, mB, side="left")
-    first_child = jnp.where(
-        first_pos >= 0, sord2[jnp.clip(first_pos, 0, B - 1)], NULLI
-    ).astype(jnp.int32)
-
-    # climb + DFS-successor + Wyllie ranking via the shared helper, at
-    # compact size (B items + S virtual roots instead of n + S)
-    dist_to_end = dfs_ranks(parent, next_sib, first_child, c_ok,
-                            num_segments)
-    root_dist = dist_to_end[B + jnp.maximum(c_seg, 0)]
-    c_rank = jnp.where(c_ok, root_dist - dist_to_end[:B] - 1, NULLI)
-
-    # document-order stream: compact rows sorted by (segment, rank)
-    skey2 = jnp.where(
-        c_ok & (c_rank >= 0),
-        (c_seg.astype(jnp.int64) << qbits) | c_rank.astype(jnp.int64),
-        jnp.int64(2**62),
+    stream_seg, stream_row = _rank_compact(
+        parent, c_client, pos_desc, c_seg, c_ok, order[sub],
+        num_segments=num_segments, rank_rounds=rank_rounds,
+        client_bits=23, qbits=int(max(n - 1, 1)).bit_length(),
     )
-    dorder = jnp.argsort(skey2, stable=True)
-    d_ok = (c_ok & (c_rank >= 0))[dorder]
-    stream_seg = jnp.where(d_ok, c_seg[dorder], NULLI).astype(jnp.int32)
-    stream_row = jnp.where(
-        d_ok, order[sub[dorder]], NULLI
-    ).astype(jnp.int32)
-
     return jnp.concatenate([win_rows, stream_seg, stream_row])
 
 
@@ -384,19 +552,28 @@ class PackedResult(NamedTuple):
 
 
 def converge(plan: PackedPlan) -> PackedResult:
-    """Stage -> single dispatch -> single fetch."""
+    """Stage -> single dispatch -> single fetch. Device outputs are in
+    id-sorted row space; the plan's sort permutation maps them back to
+    the caller's rows (one numpy gather, off the device clock)."""
     with jax.enable_x64(True):
         dev_mat = jnp.asarray(plan.mat)                      # 1 transfer
         out = _converge_packed(
             dev_mat,
             num_segments=plan.num_segments,
             seq_bucket=plan.seq_bucket,
+            rank_rounds=plan.rank_rounds,
+            map_rounds=plan.map_rounds,
+            client_bits=plan.client_bits,
         )                                                    # 1 dispatch
         h = np.asarray(out)                                  # 1 fetch
     s = plan.num_segments
     b = plan.seq_bucket
+    order = plan.order
+    win = h[:s]
+    srow = h[s + b:s + 2 * b]
+    last = max(len(order) - 1, 0)
     return PackedResult(
-        win_rows=h[:s],
+        win_rows=np.where(win >= 0, order[np.clip(win, 0, last)], NULLI),
         stream_seg=h[s:s + b],
-        stream_row=h[s + b:s + 2 * b],
+        stream_row=np.where(srow >= 0, order[np.clip(srow, 0, last)], NULLI),
     )

@@ -352,3 +352,14 @@ def test_diff_mask_unknown_client():
         jnp.array([3, 1, 0, 7], jnp.int64),
     )
     assert bool(m[0])
+
+
+def test_merge_wide_client_ids():
+    # clients near pack_id's 23-bit bound must not corrupt the
+    # collapsed id-ranked sibling key (regression: a 22-bit field
+    # overflowed into the parent bits and dropped the winner)
+    big = (1 << 22) + 1
+    a, b = Engine(5), Engine(big)
+    a.map_set("m", "k", "small")
+    b.map_set("m", "k", "big")
+    check_against_oracle([a, b])

@@ -32,16 +32,20 @@ def _cols(n, *, clock_base=0, clients=None, seq=False):
 
 
 class TestStage:
-    def test_narrow_matrix(self):
+    def test_staged_matrix(self):
         plan = packed.stage(_cols(8))
         assert plan is not None
         assert plan.mat.dtype == np.int32
-        assert plan.mat.shape[0] == 7
+        assert plan.mat.shape[0] == 5
         assert plan.n == 8
 
-    def test_wide_clock_selects_int64(self):
+    def test_wide_clock_stays_packed(self):
+        # clocks below the shared pack_id bound stay on the packed path
         plan = packed.stage(_cols(8, clock_base=1 << 33))
-        assert plan is not None and plan.mat.dtype == np.int64
+        assert plan is not None and plan.mat.dtype == np.int32
+
+    def test_clock_beyond_pack_bound_falls_back(self):
+        assert packed.stage(_cols(8, clock_base=1 << 40)) is None
 
     def test_empty_returns_none(self):
         cols = _cols(4)
@@ -63,7 +67,10 @@ class TestStage:
         cols = _cols(3, clients=np.array([900, 5, 37]))
         plan = packed.stage(cols)
         assert list(plan.clients) == [5, 37, 900]
-        assert list(plan.mat[0, :3]) == [2, 0, 1]
+        # rows ship id-sorted: dense client ranks ascend, and the sort
+        # permutation maps each staged row back to its caller row
+        assert list(plan.mat[0, :3]) == [0, 1, 2]
+        assert list(plan.order[:3]) == [1, 2, 0]
 
 
 class TestConverge:

@@ -1,0 +1,235 @@
+"""Ablation profiler for the fused packed-replay dispatch.
+
+Times the full `_converge_packed` program against variants with pieces
+stubbed out, on the live backend in forced-sync mode, to locate where
+the dispatch milliseconds actually go (sorts vs list-ranking loops vs
+tunnel fixed cost). Throwaway diagnostics — not part of the product.
+
+Usage: python tools/profile_kernel.py [n_ops]
+"""
+from __future__ import annotations
+
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/tmp/crdt_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+from crdt_tpu.ops import packed as pk
+from crdt_tpu.ops.device import (
+    NULLI, dense_ranks_sorted, dfs_ranks, lexsort, pack_id,
+    run_edge_lookup, scatter_perm, searchsorted_ids, pointer_double,
+)
+from crdt_tpu.ops.lww import map_winners
+
+
+def _core_ablated(client, clock, pref, kid, oc, ock, valid, *,
+                  num_segments: int, seq_bucket: int,
+                  do_idsort=True, do_origin=True, do_segsort=True,
+                  do_map=True, do_sib=True, do_rank=True, do_dorder=True):
+    n = client.shape[0]
+    ikey = jnp.where(valid, pack_id(client, clock), jnp.int64(2**62))
+    if do_idsort:
+        order = jnp.argsort(ikey, stable=True)
+    else:
+        order = jnp.arange(n, dtype=jnp.int32)
+    ikey = ikey[order]
+    client = client[order]
+    clock = clock[order]
+    pref = pref[order]
+    kid = kid[order]
+    oc = oc[order]
+    ock = ock[order]
+    valid = valid[order]
+    dup = jnp.concatenate([jnp.zeros(1, bool), ikey[1:] == ikey[:-1]])
+    uniq_valid = valid & ~dup
+    okey = pack_id(oc, ock)
+    if do_origin:
+        origin_idx = searchsorted_ids(ikey, okey)
+    else:
+        origin_idx = jnp.where(okey >= 0, 0, NULLI).astype(jnp.int32)
+
+    is_map = uniq_valid & (kid >= 0)
+    is_seq = uniq_valid & (kid < 0)
+
+    segkey = jnp.where(
+        uniq_valid,
+        pk.segkey_of(pref, kid.astype(jnp.int64)),
+        jnp.int64(2**63 - 1),
+    )
+    if do_segsort:
+        sorder = jnp.argsort(segkey, stable=True)
+        seg_sorted = dense_ranks_sorted(segkey[sorder])
+        seg = scatter_perm(sorder, seg_sorted)
+    else:
+        sorder = jnp.arange(n, dtype=jnp.int32)
+        seg = jnp.where(uniq_valid, 0, NULLI).astype(jnp.int32)
+    seg_map = jnp.where(is_map, seg, NULLI)
+
+    if do_map:
+        winners = map_winners(
+            seg_map, client, clock, origin_idx, is_map, num_segments
+        )
+    else:
+        winners = jnp.zeros(num_segments, jnp.int32) - 1
+    win_rows = jnp.where(
+        winners >= 0, order[jnp.clip(winners, 0, n - 1)], NULLI
+    ).astype(jnp.int32)
+
+    B = seq_bucket
+    mB = B + num_segments
+    sub = sorder[:B]
+    c_ok = is_seq[sub]
+    c_seg = jnp.where(c_ok, seg[sub], NULLI)
+    inv_sorder = jnp.argsort(sorder, stable=True).astype(jnp.int32)
+    o = origin_idx[sub]
+    o_ok = c_ok & (o >= 0)
+    o_seg = jnp.where(o_ok, seg[jnp.clip(o, 0, n - 1)], NULLI)
+    same_seg = o_ok & (o_seg == c_seg)
+    c_parent = jnp.where(
+        same_seg, inv_sorder[jnp.clip(o, 0, n - 1)], NULLI
+    ).astype(jnp.int32)
+
+    parent = jnp.where(
+        c_ok & (c_parent >= 0), c_parent, B + jnp.maximum(c_seg, 0)
+    )
+    parent = jnp.where(c_ok, parent, mB).astype(jnp.int32)
+
+    c_client = client[sub]
+    pos_desc = (n - 1) - sub
+    pbits = int(mB).bit_length()
+    qbits = int(max(n - 1, 1)).bit_length()
+    if do_sib:
+        if pbits + 22 + qbits <= 63:
+            sibkey = (
+                (parent.astype(jnp.int64) << (22 + qbits))
+                | (c_client.astype(jnp.int64) << qbits)
+                | pos_desc.astype(jnp.int64)
+            )
+            sord2 = jnp.argsort(sibkey, stable=True)
+        else:
+            sord2 = lexsort([
+                parent.astype(jnp.int64),
+                (c_client.astype(jnp.int64) << qbits)
+                | pos_desc.astype(jnp.int64),
+            ])
+        p_s = parent[sord2]
+        same_group = jnp.concatenate([p_s[1:] == p_s[:-1], jnp.zeros(1, bool)])
+        nxt_sorted = jnp.where(
+            same_group, jnp.roll(sord2, -1), NULLI
+        ).astype(jnp.int32)
+        next_sib = scatter_perm(sord2, nxt_sorted)
+        first_pos, _ = run_edge_lookup(p_s, mB, side="left")
+        first_child = jnp.where(
+            first_pos >= 0, sord2[jnp.clip(first_pos, 0, B - 1)], NULLI
+        ).astype(jnp.int32)
+    else:
+        next_sib = jnp.zeros(B, jnp.int32) - 1
+        first_child = jnp.zeros(mB, jnp.int32) - 1
+
+    if do_rank:
+        dist_to_end = dfs_ranks(parent, next_sib, first_child, c_ok,
+                                num_segments)
+        root_dist = dist_to_end[B + jnp.maximum(c_seg, 0)]
+        c_rank = jnp.where(c_ok, root_dist - dist_to_end[:B] - 1, NULLI)
+    else:
+        c_rank = jnp.where(c_ok, 0, NULLI)
+
+    qb2 = qbits
+    skey2 = jnp.where(
+        c_ok & (c_rank >= 0),
+        (c_seg.astype(jnp.int64) << qb2) | c_rank.astype(jnp.int64),
+        jnp.int64(2**62),
+    )
+    if do_dorder:
+        dorder = jnp.argsort(skey2, stable=True)
+    else:
+        dorder = jnp.arange(B, dtype=jnp.int32)
+    d_ok = (c_ok & (c_rank >= 0))[dorder]
+    stream_seg = jnp.where(d_ok, c_seg[dorder], NULLI).astype(jnp.int32)
+    stream_row = jnp.where(
+        d_ok, order[sub[dorder]], NULLI
+    ).astype(jnp.int32)
+
+    return jnp.concatenate([win_rows, stream_seg, stream_row])
+
+
+def make_variant(**flags):
+    @partial(jax.jit, static_argnames=("num_segments", "seq_bucket"))
+    def fn(mat, num_segments: int, seq_bucket: int):
+        client = mat[0].astype(jnp.int32)
+        clock = mat[1].astype(jnp.int64)
+        pref = mat[2].astype(jnp.int64)
+        kid = mat[3].astype(jnp.int32)
+        oc = mat[4].astype(jnp.int32)
+        ock = mat[5].astype(jnp.int64)
+        valid = mat[6] != 0
+        return _core_ablated(
+            client, clock, pref, kid, oc, ock, valid,
+            num_segments=num_segments, seq_bucket=seq_bucket, **flags)
+    return fn
+
+
+def main():
+    n_ops = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    import bench
+
+    R = max(1, n_ops // 100)
+    t0 = time.perf_counter()
+    blobs = bench.build_trace(R, 100)
+    dec = bench.decode_stage(blobs)
+    cols, _ = bench.column_stage(dec)
+    plan = pk.stage(cols)
+    print(f"staged {len(cols['client'])} rows in {time.perf_counter()-t0:.1f}s "
+          f"(segs={plan.num_segments} seqB={plan.seq_bucket} "
+          f"kpad={plan.mat.shape[1]} dtype={plan.mat.dtype})", flush=True)
+
+    # force sync mode (lazy-exec trap)
+    np.asarray(jnp.arange(8) + 1)
+
+    with jax.enable_x64(True):
+        dev = jnp.asarray(plan.mat)
+        jax.block_until_ready(dev)
+        kw = dict(num_segments=plan.num_segments, seq_bucket=plan.seq_bucket)
+
+        null = jax.jit(lambda m: m[0, :1] + 1)
+
+        variants = [
+            ("null-dispatch", null, {}),
+            ("FULL", make_variant(), kw),
+            ("no idsort", make_variant(do_idsort=False), kw),
+            ("no origin-ss", make_variant(do_origin=False), kw),
+            ("no segsort", make_variant(do_segsort=False), kw),
+            ("no map_winners", make_variant(do_map=False), kw),
+            ("no sib-sort", make_variant(do_sib=False), kw),
+            ("no dfs_ranks", make_variant(do_rank=False), kw),
+            ("no dorder", make_variant(do_dorder=False), kw),
+            ("layout only (no map/rank)",
+             make_variant(do_map=False, do_rank=False), kw),
+        ]
+        for name, fn, kwargs in variants:
+            tc = time.perf_counter()
+            jax.block_until_ready(fn(dev, **kwargs))
+            compile_s = time.perf_counter() - tc
+            times = []
+            for _ in range(7):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(dev, **kwargs))
+                times.append(time.perf_counter() - t0)
+            ms = sorted(t * 1e3 for t in times)
+            print(f"{name:28s} min={ms[0]:7.1f}ms med={ms[3]:7.1f}ms "
+                  f"max={ms[-1]:7.1f}ms (compile {compile_s:.0f}s)",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()
