@@ -47,7 +47,6 @@ from __future__ import annotations
 import json
 import os
 import sys
-import threading
 import time
 
 import numpy as np
@@ -444,31 +443,21 @@ def run_device(blobs, phases):
         phases[name] = round(time.perf_counter() - t, 4)
         return out
 
-    # snapshot compaction only needs the decode: overlap it with the
-    # device leg (the device leg is tunnel-I/O-bound; the host CPU is
-    # idle while it waits — the numpy contender gets no such overlap
-    # benefit because its merge IS host CPU work)
+    # snapshot compaction runs SERIALLY in both contenders: an earlier
+    # revision overlapped it on a background thread for the device leg
+    # only, which mixed a pipeline-structure advantage into the merge
+    # comparison (advisor finding, round 2)
     dec = timed("decode", decode_stage, blobs)
     cols, ds = timed("columns", column_stage, dec)
-    snap_box = {}
-
-    def compact_bg():
-        t0 = time.perf_counter()
-        snap_box["snap"] = compact_stage(dec, ds)
-        snap_box["t"] = round(time.perf_counter() - t0, 4)
-
-    th = threading.Thread(target=compact_bg)
     plan = timed("pack", packed.stage, cols)
-    th.start()
     res = timed("converge", packed.converge, plan)
     win_rows, win_vis, seq_orders = timed(
         "gather", rp.gather, dec, ds, ("packed", res)
     )
     cache = timed("materialize", materialize_stage,
                   dec, ds, win_rows, win_vis, seq_orders)
-    th.join()
-    phases["compact_overlapped"] = snap_box["t"]
-    return cache, snap_box["snap"], dec, ds, win_rows, win_vis, seq_orders
+    snap = timed("compact", compact_stage, dec, ds)
+    return cache, snap, dec, ds, win_rows, win_vis, seq_orders
 
 
 def run_numpy(blobs, phases):
@@ -551,30 +540,51 @@ def main():
     log(f"device warmup (compile): {time.perf_counter() - t0:.1f}s (untimed)")
 
     # ---- kernel-only N-scaling sweep (forced-sync, honest) -----------
+    # Methodology: per-dispatch time is the best of three 8-deep
+    # back-to-back batches (one block at the end of each batch). The
+    # tunnel pipelines queued dispatches, so batching amortizes its
+    # per-dispatch LATENCY jitter (25-115ms, session weather) while
+    # still charging the real per-dispatch THROUGHPUT cost; a null
+    # dispatch measured with the identical methodology pins that
+    # residual floor, and `net` = sweep - floor is the device compute.
     from crdt_tpu.ops import packed as _pk
+    import jax.numpy as jnp
+
+    def _b2b_ms(fn, reps=8, outer=3):
+        jax.block_until_ready(fn())  # warm / compile
+        best = float("inf")
+        for _ in range(outer):
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn()
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / reps)
+        return best * 1e3
 
     dec_w = decode_stage(blobs)
     cols_w, _ = column_stage(dec_w)
     sweep = {}
+    null_floor_ms = None
     for frac in (4, 2, 1):
         nsub = len(cols_w["client"]) // frac
         plan = _pk.stage({k: v[:nsub] for k, v in cols_w.items()})
-        import jax.numpy as jnp
-
         with jax.enable_x64(True):
             dev = jnp.asarray(plan.mat)
             jax.block_until_ready(dev)
             args = dict(num_segments=plan.num_segments,
-                        seq_bucket=plan.seq_bucket)
-            jax.block_until_ready(_pk._converge_packed(dev, **args))
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = _pk._converge_packed(dev, **args)
-            jax.block_until_ready(out)
-            sweep[nsub] = (time.perf_counter() - t0) / iters
+                        seq_bucket=plan.seq_bucket,
+                        rank_rounds=plan.rank_rounds,
+                        map_rounds=plan.map_rounds,
+                        client_bits=plan.client_bits)
+            sweep[nsub] = _b2b_ms(
+                lambda: _pk._converge_packed(dev, **args)) / 1e3
+            if frac == 1:
+                null = jax.jit(lambda m: m[0, :1] + 1)
+                null_floor_ms = _b2b_ms(lambda: null(dev))
     ns = sorted(sweep)
-    log("fused-kernel dispatch sweep (sync mode): " + ", ".join(
-        f"{n}: {sweep[n]*1e3:.1f}ms" for n in ns))
+    log("fused-kernel dispatch sweep (8-deep b2b, sync mode): " + ", ".join(
+        f"{n}: {sweep[n]*1e3:.1f}ms" for n in ns)
+        + f"; null-dispatch floor {null_floor_ms:.1f}ms")
     kernel_ops_s = round(ns[-1] / sweep[ns[-1]])
 
     # ---- timed end-to-end runs ---------------------------------------
@@ -836,6 +846,11 @@ def main():
         "vs_python_oracle": oracle_x,
         "kernel_dispatch_ops_per_s": kernel_ops_s,
         "kernel_sweep_ms": {str(n): round(sweep[n] * 1e3, 1) for n in ns},
+        "kernel_sweep_net_ms": {
+            str(n): round(max(sweep[n] * 1e3 - null_floor_ms, 0.0), 1)
+            for n in ns
+        },
+        "dispatch_floor_ms": round(null_floor_ms, 1),
         "phases_device_s": best_phases_dev,
         "phases_numpy_s": best_phases_np,
         "platform": platform,

@@ -1,3 +1,4 @@
 from crdt_tpu.api.doc import Crdt, ReservedNameError, WrongKindError
+from crdt_tpu.api.resident_doc import ResidentCrdt
 
-__all__ = ["Crdt", "ReservedNameError", "WrongKindError"]
+__all__ = ["Crdt", "ResidentCrdt", "ReservedNameError", "WrongKindError"]

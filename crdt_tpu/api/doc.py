@@ -69,7 +69,97 @@ class _Observer:
         self.func = func
 
 
-class Crdt:
+class DocOpsMixin:
+    """Backend-independent op plumbing shared by the engine-backed
+    :class:`Crdt` and the resident-backed
+    :class:`crdt_tpu.api.resident_doc.ResidentCrdt`: the reserved-name
+    guard, the observer registry, the txn-exception choreography, and
+    the batch queue. Subclasses supply ``_begin_txn()`` and
+    ``_finish_txn(origin, meta=None, propagate=True,
+    want_update=False)`` plus ``_batched`` / ``_observers`` lists."""
+
+    def _check_name(self, name: str) -> None:
+        if not isinstance(name, str) or not name:
+            raise ValueError("collection name must be a non-empty string")
+        if name in RESERVED_NAMES:
+            raise ReservedNameError(
+                f"'{name}' is reserved (crdt.js:320,365)"
+            )
+
+    # ---- op plumbing (the per-op tail, crdt.js:440-447) --------------
+    def _run_op(self, batch: bool, operation: Callable[[], Any]) -> Any:
+        if batch:
+            self._batched.append(operation)
+            return None
+        self._begin_txn()
+        try:
+            result = operation()
+        except BaseException:
+            # a throwing op still commits what it integrated (Yjs txn
+            # semantics): the records exist with allocated clocks, so
+            # not broadcasting them would wedge every peer on a
+            # per-client clock gap forever — but the op's own error
+            # must win over any broadcast-tail error
+            try:
+                self._finish_txn(origin="local")
+            except Exception:
+                pass
+            raise
+        self._finish_txn(origin="local")
+        return result
+
+    # ---- batch queue (crdt.js:325-355) -------------------------------
+    def exec_batch(self, propagate: bool = True) -> Optional[bytes]:
+        """Drain queued ops in one transaction → one update (one
+        broadcast). Empty queue returns None (D4: the reference hangs).
+
+        ``propagate=False`` mirrors ``throughDatabase``
+        (crdt.js:350-353): the update is returned without invoking
+        ``on_update``.
+        """
+        if not self._batched:
+            return None
+        ops, self._batched = self._batched, []
+        self._begin_txn()
+        try:
+            for op in ops:
+                op()
+        except BaseException:
+            # partial batches commit what ran before the throw (see
+            # _run_op: unbroadcast records would wedge peers)
+            try:
+                self._finish_txn(
+                    "local",
+                    meta={"meta": "batch"},
+                    propagate=propagate,
+                    want_update=True,
+                )
+            except Exception:
+                pass
+            raise
+        return self._finish_txn(
+            "local",
+            meta={"meta": "batch"},
+            propagate=propagate,
+            want_update=True,
+        )
+
+    @property
+    def pending_batch_size(self) -> int:
+        return len(self._batched)
+
+    # ---- observers (crdt.js:620-657) ---------------------------------
+    def observe(self, name: str, func: Callable, key: Optional[str] = None):
+        self._observers.append(_Observer(name, key, func))
+        return func
+
+    def unobserve(self, func: Callable) -> bool:
+        before = len(self._observers)
+        self._observers = [o for o in self._observers if o.func is not func]
+        return len(self._observers) < before
+
+
+class Crdt(DocOpsMixin):
     """One replica's document + API.
 
     Transport and persistence attach through two hooks:
@@ -153,14 +243,6 @@ class Crdt:
     # ------------------------------------------------------------------
     # guards
     # ------------------------------------------------------------------
-    def _check_name(self, name: str) -> None:
-        if not isinstance(name, str) or not name:
-            raise ValueError("collection name must be a non-empty string")
-        if name in RESERVED_NAMES:
-            raise ReservedNameError(
-                f"'{name}' is reserved (crdt.js:320,365)"
-            )
-
     def _kind_of(self, name: str) -> Optional[str]:
         kind = self.engine.map_get("ix", name)
         if kind is not None:
@@ -173,28 +255,11 @@ class Crdt:
             raise WrongKindError(f"'{name}' is a {kind}, not a {want}")
 
     # ------------------------------------------------------------------
-    # op plumbing (the per-op tail, crdt.js:440-447)
+    # op plumbing (the per-op tail, crdt.js:440-447; _run_op and the
+    # batch queue live in DocOpsMixin)
     # ------------------------------------------------------------------
-    def _run_op(self, batch: bool, operation: Callable[[], Any]) -> Any:
-        if batch:
-            self._batched.append(operation)
-            return None
+    def _begin_txn(self) -> None:
         self.engine.begin_txn()
-        try:
-            result = operation()
-        except BaseException:
-            # a throwing op still commits what it integrated (Yjs txn
-            # semantics): the records exist with allocated clocks, so
-            # not broadcasting them would wedge every peer on a
-            # per-client clock gap forever — but the op's own error
-            # must win over any broadcast-tail error
-            try:
-                self._finish_txn(origin="local")
-            except Exception:
-                pass
-            raise
-        self._finish_txn(origin="local")
-        return result
 
     def _finish_txn(
         self,
@@ -512,47 +577,6 @@ class Crdt:
         )
 
     # ------------------------------------------------------------------
-    # batch queue (crdt.js:325-355)
-    # ------------------------------------------------------------------
-    def exec_batch(self, propagate: bool = True) -> Optional[bytes]:
-        """Drain queued ops in one transaction → one update (one
-        broadcast). Empty queue returns None (D4: the reference hangs).
-
-        ``propagate=False`` mirrors ``throughDatabase`` (crdt.js:350-353):
-        the update is returned without invoking ``on_update``.
-        """
-        if not self._batched:
-            return None
-        ops, self._batched = self._batched, []
-        self.engine.begin_txn()
-        try:
-            for op in ops:
-                op()
-        except BaseException:
-            # partial batches commit what ran before the throw (see
-            # _run_op: unbroadcast records would wedge peers)
-            try:
-                self._finish_txn(
-                    "local",
-                    meta={"meta": "batch"},
-                    propagate=propagate,
-                    want_update=True,
-                )
-            except Exception:
-                pass
-            raise
-        return self._finish_txn(
-            "local",
-            meta={"meta": "batch"},
-            propagate=propagate,
-            want_update=True,
-        )
-
-    @property
-    def pending_batch_size(self) -> int:
-        return len(self._batched)
-
-    # ------------------------------------------------------------------
     # remote updates (crdt.js:292-311)
     # ------------------------------------------------------------------
     def apply_update(self, data: bytes, origin: str = "remote") -> None:
@@ -609,14 +633,3 @@ class Crdt:
                 all_ds.add(c, clk, length)
         return all_records, all_ds
 
-    # ------------------------------------------------------------------
-    # observers (crdt.js:620-657)
-    # ------------------------------------------------------------------
-    def observe(self, name: str, func: Callable, key: Optional[str] = None):
-        self._observers.append(_Observer(name, key, func))
-        return func
-
-    def unobserve(self, func: Callable) -> bool:
-        before = len(self._observers)
-        self._observers = [o for o in self._observers if o.func is not func]
-        return len(self._observers) < before

@@ -35,6 +35,17 @@ split as the cold path's gather. Delete sets only change visibility,
 never winners or order, so delete-only batches rebuild caches without
 any device work.
 
+Caveat (advisor finding, round 2): the right-origin marking is STICKY
+per segment. Once a segment has seen one right-bearing row, every
+later touch re-runs the exact host ordering over that ENTIRE segment,
+so for a long-lived collaborative-TEXT sequence the per-round cost
+grows with the document, not the delta — "cost scales with the delta"
+holds for map segments and append-shaped sequences. Splicing
+right-bearing deltas incrementally into the cached order is possible
+(the YATA insertion point is deterministic given the cached
+neighborhood) but is not implemented; the honest bench number for
+this shape is the text run, not the steady-state round.
+
 Differential-tested against the cold replay and the scalar engine in
 tests/test_incremental.py.
 """
@@ -85,16 +96,37 @@ class _Cols:
 
 
 class IncrementalReplay:
-    """A long-lived replica state fed by v1 update blobs."""
+    """A long-lived replica state fed by v1 update blobs.
 
-    def __init__(self, capacity: int = 1 << 14):
+    ``device_min_rows`` is the host/device crossover: when the rows of
+    a round's touched segments total fewer than this, convergence runs
+    through the exact host machinery against the resident columns
+    (the delta still splices into the device matrix, keeping HBM state
+    current for later large rounds). Measured through the tunnelled
+    single chip a device round costs ~0.1-0.3s of fixed interaction
+    latency regardless of size, so small deltas — a collaborator's
+    keystrokes, a replica's own ops — are host-won; firehose rounds
+    and cold gaps go to the device. BENCH_r0N.json's ``rounds`` table
+    publishes the measured crossover."""
+
+    def __init__(self, capacity: int = 1 << 14,
+                 device_min_rows: Optional[int] = None):
         import jax
         import jax.numpy as jnp
 
         self._jax, self._jnp = jax, jnp
+        if device_min_rows is None:
+            import os
+
+            device_min_rows = int(
+                os.environ.get("CRDT_TPU_DEVICE_MIN", 4096)
+            )
+        self.device_min_rows = device_min_rows
         self.cols = _Cols()
         self.ds = DeleteSet()
         self.cache: dict = {}
+        self.last_touched_roots: List[str] = []
+        self.last_touched_keys: Dict[str, set] = {}
         # stable interners
         self._keys: Dict[str, int] = {}
         self._key_names: List[str] = []
@@ -576,6 +608,15 @@ class IncrementalReplay:
             sk for sk in touched
             if sk in self._seg_rows and self._seg_rights.get(sk)
         ]
+        # host/device crossover: small rounds are exact on host against
+        # the resident columns (the fixed per-dispatch cost dominates
+        # below the threshold; see the class docstring), and the delta
+        # still splices below so HBM stays current
+        if dev_segs and sum(
+            len(self._seg_rows[sk]) for sk in dev_segs
+        ) < self.device_min_rows:
+            host_segs.extend(dev_segs)
+            dev_segs = []
 
         # stage the delta (rows in this batch) as a packed matrix
         k = len(new_rows)
@@ -728,12 +769,145 @@ class IncrementalReplay:
             content=c.contents[row],
         )
 
+    # -- sync protocol surface ----------------------------------------
+    # The live replica (crdt_tpu.api.resident_doc / net.replica in
+    # merge_mode="resident") answers ready probes, anti-entropy
+    # deficits, and compaction FROM THIS RESIDENT STATE — the scalar
+    # engine is never materialized. Semantics mirror Engine exactly:
+    # the state vector is the contiguous admitted watermark, diffs
+    # carry rows above the requester's watermark plus the full delete
+    # set, and _pending rows are excluded (they are not integrated
+    # state; the protocol re-supplies them). Match: crdt.js:288,294.
+
+    def state_vector(self):
+        from crdt_tpu.core.ids import StateVector
+
+        return StateVector(dict(self._next_clock))
+
+    def records_since(self, sv=None) -> List:
+        """Records with clock >= sv[client] (full state when None),
+        O(deficit) via the id-row index — admitted runs are contiguous
+        per client by the admission rule."""
+        if sv is None:
+            return [self._record_of(r) for r in range(self.cols.n)]
+        out = []
+        for client, nxt in self._next_clock.items():
+            wm = sv.get(int(client))
+            for ck in range(wm, nxt):
+                row = self._id_row.get((int(client), ck))
+                if row is not None:
+                    out.append(self._record_of(row))
+        return out
+
+    def to_decoded_columns(self) -> Dict:
+        """The full resident union in the decode column schema
+        (client-grouped, clock-ascending — the wire's run order), the
+        seam for the native ``encode_from_columns`` snapshot path:
+        compaction of a resident doc never walks a scalar engine.
+        Match: crdt.js:79-98 (what compaction replaces)."""
+        c = self.cols
+        n = c.n
+        order = np.lexsort((c.col("clock"), c.col("client")))
+        roots: List[str] = []
+        root_idx: Dict[str, int] = {}
+        pr = np.full(n, -1, np.int64)
+        pc = np.full(n, -1, np.int64)
+        pk_ = np.full(n, -1, np.int64)
+        pref_col = c.col("pref")
+        # pref -> (root index | item id) tables, then one gather
+        n_pref = len(self._pref_spec)
+        t_root = np.full(n_pref + 1, -1, np.int64)
+        t_pc = np.full(n_pref + 1, -1, np.int64)
+        t_pk = np.full(n_pref + 1, -1, np.int64)
+        for ref, spec in enumerate(self._pref_spec):
+            if spec[0] == "root":
+                ix = root_idx.get(spec[1])
+                if ix is None:
+                    ix = root_idx[spec[1]] = len(roots)
+                    roots.append(spec[1])
+                t_root[ref] = ix
+            else:
+                t_pc[ref] = spec[1]
+                t_pk[ref] = spec[2]
+        has = pref_col >= 0
+        pr[has] = t_root[pref_col[has]]
+        pc[has] = t_pc[pref_col[has]]
+        pk_[has] = t_pk[pref_col[has]]
+        trips = []
+        for cl, st, ln in self.ds.iter_all():
+            trips.extend((int(cl), int(st), int(ln)))
+        return {
+            "client": c.col("client")[order],
+            "clock": c.col("clock")[order],
+            "parent_root": pr[order].astype(np.int32),
+            "parent_client": pc[order],
+            "parent_clock": pk_[order],
+            "key_id": c.col("kid")[order].astype(np.int32),
+            "origin_client": c.col("oc")[order],
+            "origin_clock": c.col("ock")[order],
+            "right_client": c.col("right_client")[order],
+            "right_clock": c.col("right_clock")[order],
+            "kind": c.col("kind")[order].astype(np.int32),
+            "type_ref": c.col("type_ref")[order].astype(np.int32),
+            "contents": [c.contents[int(r)] for r in order],
+            "roots": roots,
+            "keys": list(self._key_names),
+            "ds": np.asarray(trips, np.int64),
+        }
+
+    def encode_state_as_update(self, sv=None) -> bytes:
+        """Diff (or full-state when ``sv`` is None) v1 blob from the
+        resident columns. Deficit-sized diffs go through the record
+        path (O(deficit)); full state goes through the native
+        column encoder in one C pass when the toolchain allows."""
+        from crdt_tpu.codec import v1
+
+        if sv is None:
+            return native.encode_from_columns_any(
+                self.to_decoded_columns(), self.ds
+            )
+        return v1.encode_update(self.records_since(sv), self.ds)
+
+    def _top_key_of_seg(self, sk: int) -> Optional[str]:
+        """Top-level map key holding this segment's subtree (None for
+        direct sequence members of a root array) — the per-key
+        observer rollup the engine-backed doc computes via
+        ``Crdt._classify_row``."""
+        spec = self._seg_spec(sk)
+        seen = set()
+        kid = self._seg_kid.get(sk, -1)
+        while spec is not None and spec not in seen:
+            seen.add(spec)
+            if spec[0] == "root":
+                return self._key_names[kid] if kid >= 0 else None
+            row = self._id_row.get((spec[1], spec[2]))
+            if row is None:
+                return None
+            kid = int(self.cols.col("kid")[row])
+            spec = self._spec_of_row(row)
+        return None
+
     # -- cache --------------------------------------------------------
     def _rebuild_cache(self, touched: set) -> None:
         # root-level map keys patch IN PLACE (a delta touching a few
         # hundred keys of a 25k-key map must not pay a full-collection
         # python rebuild); sequences, nested collections, and roots
         # not yet materialized rebuild whole
+        t_roots: set = set()
+        t_keys: Dict[str, set] = {}
+        for sk in touched:
+            if sk not in self._seg_rows:
+                continue
+            root = self._root_of(self._seg_spec(sk))
+            if root is None:
+                continue
+            t_roots.add(root)
+            key = self._top_key_of_seg(sk)
+            if key is not None:
+                t_keys.setdefault(root, set()).add(key)
+        self.last_touched_roots = sorted(t_roots)
+        self.last_touched_keys = t_keys
+
         full_roots: set = set()
         patches: List[Tuple[str, int]] = []
         for sk in touched:

@@ -54,7 +54,17 @@ def converge(cols: Dict[str, np.ndarray], *,
     Fast path: the packed single-dispatch pipeline
     (:mod:`crdt_tpu.ops.packed` — one upload, one fused kernel, one
     fetch). Falls back to the general resident path when the batch
-    exceeds the packed key bounds (>=2^25 parents, >=2^21 map keys)."""
+    exceeds the packed key bounds (>=2^25 parents, >=2^21 map keys,
+    clocks >= 2^40).
+
+    ``clients`` only affects the RESIDENT fallback (it seeds that
+    path's client table). The packed plan interns its own
+    order-preserving table, which is equivalent for convergence: the
+    sibling rules compare clients only through a monotone mapping, so
+    any order-preserving table yields the identical document. Callers
+    that need a fleet-shared table to be the one actually used (e.g.
+    to reuse a resident store across batches) should route through
+    :class:`crdt_tpu.ops.resident.ResidentColumns` directly."""
     from crdt_tpu.ops import packed
 
     plan = packed.stage(cols)

@@ -115,6 +115,8 @@ class Replica:
         compact_every: Optional[int] = None,
         device_merge: Optional[bool] = None,
         batch_incoming: Optional[bool] = None,
+        merge_mode: Optional[str] = None,
+        device_min_rows: Optional[int] = None,
     ):
         if not getattr(router, "is_ypear_router", False):
             raise TypeError("router is not a ypear router")  # crdt.js:172
@@ -127,14 +129,46 @@ class Replica:
         self.closed = False
         self.peer_state_vectors: Dict[str, StateVector] = {}
 
+        # merge_mode selects the document backend:
+        #   "scalar"   — Engine-backed, host integrate loop
+        #   "device"   — Engine-backed, TPU-kernel merges (device_merge)
+        #   "resident" — no engine at all: HBM-resident columns serve
+        #                merges, local ops, AND the sync protocol
+        #                (crdt_tpu.api.resident_doc; the north star's
+        #                "cache rebuilt from HBM")
+        merge_mode_explicit = merge_mode is not None
+        if merge_mode is None:
+            merge_mode = "device" if device_merge else "scalar"
+        if merge_mode not in ("scalar", "device", "resident"):
+            raise ValueError(f"unknown merge_mode {merge_mode!r}")
+        self.merge_mode = merge_mode
+
         cid = client_id if client_id is not None else _random_client_id()
-        self.doc = Crdt(
-            cid,
-            observer_function=observer_function,
-            on_update=self._on_local_update,
-            full_state_updates=full_state_updates,
-            device_merge=device_merge,
-        )
+        if merge_mode == "resident":
+            from crdt_tpu.api.resident_doc import ResidentCrdt
+
+            self.doc = ResidentCrdt(
+                cid,
+                observer_function=observer_function,
+                on_update=self._on_local_update,
+                full_state_updates=full_state_updates,
+                device_min_rows=device_min_rows,
+            )
+        else:
+            self.doc = Crdt(
+                cid,
+                observer_function=observer_function,
+                on_update=self._on_local_update,
+                full_state_updates=full_state_updates,
+                # an explicit merge_mode overrides the env-var default
+                # (merge_mode="device" must enable device merges even
+                # with CRDT_TPU_DEVICE unset, and "scalar" must disable
+                # them even with it set)
+                device_merge=(
+                    merge_mode == "device" if merge_mode_explicit
+                    else device_merge
+                ),
+            )
         # receive-side batching: updates arriving within one router
         # poll round are buffered and applied as ONE merge transaction
         # (one kernel dispatch in device mode) — the north-star gate at

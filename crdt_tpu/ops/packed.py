@@ -123,13 +123,19 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     n = len(client)
     if n == 0 or not valid.any():
         return None
-    if int(clock.max()) >= (1 << _CLOCK_BITS):
+    # bound checks consider only admitted rows: garbage in invalid /
+    # padding rows must not force a spurious fallback (advisor
+    # finding, round 2)
+    if int(clock[valid].max()) >= (1 << _CLOCK_BITS):
         return None
-    if ock.size and int(ock.max()) >= (1 << _CLOCK_BITS):
+    live_origin = valid & (oc >= 0)
+    if live_origin.any() and int(ock[live_origin].max()) >= (1 << _CLOCK_BITS):
         return None
 
-    # dense order-preserving client ranks (origins share the table)
-    uniq = np.unique(np.concatenate([client[valid], oc[oc >= 0]]))
+    # dense order-preserving client ranks (origins share the table;
+    # only admitted rows contribute — garbage in invalid rows must not
+    # widen client_bits toward a spurious key-width fallback)
+    uniq = np.unique(np.concatenate([client[valid], oc[live_origin]]))
     client_d = np.searchsorted(uniq, np.clip(client, uniq[0], None))
     client_d = np.where(valid, client_d, 0)
     oc_d = np.where(oc >= 0, np.searchsorted(uniq, np.clip(oc, uniq[0], None)), -1)
@@ -146,10 +152,10 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     ref_sorted = np.cumsum(new_run) - 1
     pref = np.empty(n, np.int64)
     pref[porder] = ref_sorted
-    n_parents = int(ref_sorted[-1]) + 1
 
-    kid_max = int(kid.max())
-    if n_parents >= (1 << _PREF_BITS) or kid_max >= (1 << _KID_BITS):
+    kid_max = int(kid[valid].max())
+    if (int(pref[valid].max()) >= (1 << _PREF_BITS)
+            or kid_max >= (1 << _KID_BITS)):
         return None
 
     # id sort + dedup (dense client ranks are monotone in the raw ids,

@@ -17,11 +17,17 @@ from crdt_tpu.net import (
 )
 
 
-@pytest.fixture(params=[False, True], ids=["scalar", "device"])
-def device_mode(request):
-    """Acceptance configs run in BOTH merge modes: the scalar integrate
-    loop and the TPU kernel path (CRDT_TPU_DEVICE semantics) must
-    converge to identical state (VERDICT r1 item #1)."""
+@pytest.fixture(
+    params=["scalar", "device", "resident"],
+    ids=["scalar", "device", "resident"],
+)
+def merge_mode(request):
+    """Acceptance configs run in ALL THREE merge modes: the scalar
+    integrate loop, the TPU kernel path over the engine
+    (CRDT_TPU_DEVICE semantics, VERDICT r1 item #1), and the
+    HBM-resident document that serves merges, local ops, and the sync
+    protocol without an engine (VERDICT r2 item #2). All must converge
+    to identical state."""
     return request.param
 
 
@@ -137,9 +143,9 @@ class TestSyncHandshake:
 
 
 class TestAcceptanceConfigs:
-    def test_config1_two_replica_map_set_del(self, device_mode):
+    def test_config1_two_replica_map_set_del(self, merge_mode):
         # config #1: 2-replica Y.Map, set/del, no persistence
-        net, (a, b) = make_swarm(2, device_merge=device_mode)
+        net, (a, b) = make_swarm(2, merge_mode=merge_mode)
         for i in range(100):
             a.set("users", f"a{i}", i)
             b.set("users", f"b{i}", i)
@@ -152,9 +158,9 @@ class TestAcceptanceConfigs:
         assert len(state["users"]) == 100
         assert state["users"]["a1"] == 1 and "a0" not in state["users"]
 
-    def test_config2_four_replica_array_ops(self, device_mode):
+    def test_config2_four_replica_array_ops(self, merge_mode):
         # config #2: concurrent push/insert/cut, 4 replicas
-        net, reps = make_swarm(4, device_merge=device_mode)
+        net, reps = make_swarm(4, merge_mode=merge_mode)
         for i, r in enumerate(reps):
             r.push("log", [f"p{i}-{j}" for j in range(5)])
         net.run()
@@ -167,7 +173,7 @@ class TestAcceptanceConfigs:
         state = assert_converged(reps)
         assert len(state["log"]) == 4 * 5 + 4 - 4
 
-    def test_config3_sixteen_replica_batch_with_persistence(self, device_mode):
+    def test_config3_sixteen_replica_batch_with_persistence(self, merge_mode):
         # config #3: execBatch mixed Map+Array, 16 replicas, store on
         net = LoopbackNetwork()
         stores = [MemoryPersistence() for _ in range(16)]
@@ -178,7 +184,7 @@ class TestAcceptanceConfigs:
                     LoopbackRouter(net, f"pk{i}"),
                     topic="t",
                     persistence=stores[i],
-                    device_merge=device_mode,
+                    merge_mode=merge_mode,
                 )
             )
         net.run()
@@ -205,9 +211,9 @@ class TestAcceptanceConfigs:
         # different topic: nothing stored under t2 -> no replay crash
         assert stores[3].get_meta("t")["count"] > 0
 
-    def test_config4_nested_array_in_map_64_replicas(self, device_mode):
+    def test_config4_nested_array_in_map_64_replicas(self, merge_mode):
         # config #4: nested Array-in-Map, 64 replicas, interleaved edits
-        net, reps = make_swarm(64, device_merge=device_mode)
+        net, reps = make_swarm(64, merge_mode=merge_mode)
         reps[0].set("doc0", "items", "seed", array_method="push")
         net.run()
         for i, r in enumerate(reps):
@@ -221,13 +227,13 @@ class TestAcceptanceConfigs:
 
 
 class TestAdversarialDelivery:
-    def test_reorder_and_duplicate(self, device_mode):
+    def test_reorder_and_duplicate(self, merge_mode):
         net = LoopbackNetwork(seed=7, reorder=True, duplicate=0.5)
         reps = []
         for i in range(6):
             reps.append(
                 ypear_crdt(LoopbackRouter(net, f"pk{i}"), topic="t",
-                           device_merge=device_mode)
+                           merge_mode=merge_mode)
             )
         net.run()
         for i, r in enumerate(reps):
@@ -239,7 +245,7 @@ class TestAdversarialDelivery:
         state = assert_converged(reps)
         assert len(state["log"]) == 6 + 3
 
-    def test_reorder_seeds_all_converge(self, device_mode):
+    def test_reorder_seeds_all_converge(self, merge_mode):
         finals = []
         for seed in range(5):
             net = LoopbackNetwork(seed=seed, reorder=True)
@@ -248,7 +254,7 @@ class TestAdversarialDelivery:
             reps = [
                 ypear_crdt(
                     LoopbackRouter(net, f"pk{i}"), topic="t", client_id=i + 1,
-                    device_merge=device_mode,
+                    merge_mode=merge_mode,
                 )
                 for i in range(4)
             ]
@@ -421,11 +427,11 @@ class TestBatchIncoming:
         r2 = ypear_crdt(LoopbackRouter(net, "y"), topic="t")
         assert not r2.batch_incoming
 
-    def test_batched_device_swarm_converges(self, device_mode):
+    def test_batched_device_swarm_converges(self, merge_mode):
         net = LoopbackNetwork(seed=5, reorder=True, duplicate=0.2)
         reps = [
             ypear_crdt(LoopbackRouter(net, f"pk{i}"), topic="t",
-                       client_id=i + 1, device_merge=device_mode,
+                       client_id=i + 1, merge_mode=merge_mode,
                        batch_incoming=True)
             for i in range(6)
         ]
@@ -490,3 +496,98 @@ class TestBatchIncoming:
         assert set(by_origin) == {"remote", "sync"}, set(by_origin)
         assert "r" in by_origin["remote"]["touched"]
         assert "s" in by_origin["sync"]["touched"]
+
+
+class TestResidentMode:
+    """merge_mode="resident" specifics: the document lives in the
+    HBM-resident replay (no scalar engine); the sync protocol, the
+    persistence log, and compaction are all answered from resident
+    state (VERDICT r2 items #2/#6)."""
+
+    def test_no_engine_store_materialized(self):
+        net, (a, b) = make_swarm(2, merge_mode="resident")
+        a.set("m", "k", 1)
+        net.run()
+        assert_converged([a, b])
+        from crdt_tpu.api.resident_doc import _ResidentEngineShim
+
+        assert isinstance(a.doc.engine, _ResidentEngineShim)
+
+    def test_persistence_replay_and_rejoin(self):
+        net = LoopbackNetwork()
+        store = MemoryPersistence()
+        a = ypear_crdt(LoopbackRouter(net, "a"), topic="t",
+                       merge_mode="resident")
+        b = ypear_crdt(LoopbackRouter(net, "b"), topic="t",
+                       merge_mode="resident", persistence=store)
+        net.run()
+        a.set("m", "k", 1)
+        b.push("l", "mine")
+        net.run()
+        b.self_close()
+        a.set("m", "k2", 2)  # while b is down
+        net.run()
+        b2 = ypear_crdt(LoopbackRouter(net, "b2"), topic="t",
+                        merge_mode="resident", persistence=store)
+        # restored from its own log (resident replay of the update log)
+        assert b2.m == {"k": 1} and b2.l == ["mine"]
+        net.run()  # anti-entropy catches it up
+        assert_converged([a, b2])
+        assert b2.m == {"k": 1, "k2": 2}
+
+    def test_compaction_from_resident_columns(self):
+        net = LoopbackNetwork()
+        store = MemoryPersistence()
+        a = ypear_crdt(LoopbackRouter(net, "a"), topic="t",
+                       merge_mode="resident", persistence=store,
+                       compact_every=10)
+        for i in range(25):
+            a.set("m", f"k{i}", i)
+        a.push("l", ["x", "y"])
+        meta = store.get_meta("t")
+        assert meta["count"] < 10  # squashed from resident columns
+        # the snapshot replays into a fresh ENGINE-backed replica
+        # identically (cross-backend snapshot fidelity)
+        fresh = ypear_crdt(LoopbackRouter(net, "f"), topic="t",
+                           merge_mode="scalar", persistence=store)
+        assert dict(fresh.c) == dict(a.c)
+
+    def test_device_forced_protocol_round(self):
+        """device_min_rows=0 pushes every protocol merge through the
+        device splice+converge dispatch — the full resident device
+        path exercised by the live sync protocol, not just the model
+        differentials."""
+        net, reps = make_swarm(3, merge_mode="resident",
+                               device_min_rows=0)
+        for i, r in enumerate(reps):
+            r.set("m", f"k{i}", i)
+            r.push("l", f"v{i}")
+        net.run()
+        state = assert_converged(reps)
+        assert len(state["m"]) == 3 and len(state["l"]) == 3
+
+    def test_resident_observers_fire(self):
+        events = []
+        net, (a, b) = make_swarm(2, merge_mode="resident")
+        b.doc.observe("m", events.append, key="k1")
+        a.set("m", "k1", "v1")
+        a.set("m", "other", "x")
+        net.run()
+        assert any(e.get("key") == "k1" and e.get("value") == "v1"
+                   for e in events)
+        # the per-key observer did not fire for the unrelated key
+        assert all(e.get("key") == "k1" for e in events)
+
+    def test_anti_entropy_deficit_from_resident(self):
+        net, (a, b) = make_swarm(2, merge_mode="resident")
+        for i in range(5):
+            a.set("m", f"k{i}", i)
+        net.run()
+        # forget b's progress, then anti-entropy re-sends the deficit
+        from crdt_tpu.core.ids import StateVector
+
+        a.peer_state_vectors["pk1"] = StateVector({})
+        sent = a.anti_entropy()
+        assert sent.get("pk1", 0) > 0
+        net.run()
+        assert_converged([a, b])
