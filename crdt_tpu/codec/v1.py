@@ -483,7 +483,22 @@ def decode_update(data: bytes) -> Tuple[List[ItemRecord], DeleteSet]:
 
 def encode_state_as_update(engine, sv: Optional[StateVector] = None) -> bytes:
     """``Y.encodeStateAsUpdate(doc[, sv])`` (crdt.js:56,288,347): items
-    above the target state vector plus the full delete set."""
+    above the target state vector plus the full delete set.
+
+    Full-state encodes (``sv`` None or empty — compaction snapshots,
+    and the syncer's answer to a FRESH requester, whose decoded state
+    vector is empty) go through the native column encoder in one C
+    pass over the store's SoA columns; byte-identity with the Python
+    record path is pinned by tests/test_native_codec.py. Real diffs
+    stay on the O(deficit) record path."""
+    if sv is None or not sv.clocks:
+        from crdt_tpu.codec import native
+
+        if native.available():
+            ds = engine.delete_set()
+            return native.encode_from_columns(
+                engine.to_decoded_columns(ds), ds
+            )
     return encode_update(engine.records_since(sv), engine.delete_set())
 
 

@@ -705,6 +705,44 @@ class Engine:
         out.sort(key=lambda r: (r.client, r.clock))
         return out
 
+    def to_decoded_columns(self, ds: Optional[DeleteSet] = None) -> dict:
+        """The whole store in the decode column schema (client-grouped,
+        clock-ascending — the wire's run order): the seam for the
+        native ``encode_from_columns`` snapshot path. The store is
+        already SoA numpy, so a full-state encode is one lexsort + one
+        C pass instead of an O(doc) ``record_of_row`` walk — the same
+        unification the resident replay has
+        (``IncrementalReplay.to_decoded_columns``). ``ds`` lets the
+        caller reuse an already-computed delete set (building one is
+        an O(store) scan). Match: north star 'snapshot rebuild through
+        the same kernel'; /root/reference/crdt.js:79-98."""
+        import numpy as np
+
+        from crdt_tpu.codec.native import ds_to_triples
+
+        s = self.store
+        n = s.n
+        order = np.lexsort((s.clock[:n], s.client[:n]))
+        cols = {
+            name: getattr(s, name)[:n][order]
+            for name in (
+                "client", "clock", "parent_client", "parent_clock",
+                "origin_client", "origin_clock", "right_client",
+                "right_clock",
+            )
+        }
+        cols.update(
+            parent_root=s.parent_root[:n][order].astype(np.int32),
+            key_id=s.key_id[:n][order].astype(np.int32),
+            kind=s.kind[:n][order].astype(np.int32),
+            type_ref=s.type_ref[:n][order].astype(np.int32),
+            contents=[s.content[int(r)] for r in order],
+            roots=list(s.root_names),
+            keys=list(s.keys),
+            ds=ds_to_triples(ds if ds is not None else self.delete_set()),
+        )
+        return cols
+
     def records_since(self, sv: Optional[StateVector] = None) -> List[ItemRecord]:
         """All records with clock >= sv[client] (full state when sv None).
 

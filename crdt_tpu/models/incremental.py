@@ -833,9 +833,6 @@ class IncrementalReplay:
         pr[has] = t_root[pref_col[has]]
         pc[has] = t_pc[pref_col[has]]
         pk_[has] = t_pk[pref_col[has]]
-        trips = []
-        for cl, st, ln in self.ds.iter_all():
-            trips.extend((int(cl), int(st), int(ln)))
         return {
             "client": c.col("client")[order],
             "clock": c.col("clock")[order],
@@ -852,7 +849,7 @@ class IncrementalReplay:
             "contents": [c.contents[int(r)] for r in order],
             "roots": roots,
             "keys": list(self._key_names),
-            "ds": np.asarray(trips, np.int64),
+            "ds": native.ds_to_triples(self.ds),
         }
 
     def encode_state_as_update(self, sv=None) -> bytes:
