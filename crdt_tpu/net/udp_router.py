@@ -9,18 +9,26 @@ crdt.js:172-317) over the native transport seam
 (:mod:`crdt_tpu.net.transport`): reliable-datagram UDP + X25519 /
 XChaCha20-Poly1305 encrypted peer links.
 
-Documented divergence: peer discovery is an explicit bootstrap list
-(``add_peer``) instead of a global DHT — the rebuild targets
-datacenter fabrics where peers are known addresses; DHT walking is
-out of scope. Everything after discovery (key exchange, encrypted
-links, topic membership, the four verbs, the sync handshake riding
-them) matches the reference's shape.
+Peer discovery is rendezvous-based, the datacenter reduction of
+Hyperswarm's DHT (consumed at crdt.js:315): a router constructed with
+``bootstrap=[(ip, port), ...]`` dials those known nodes, and any
+router running with ``rendezvous=True`` INTRODUCES peers that
+announce a shared topic to each other — each side receives the
+other's (public key, address) over the established encrypted link and
+dials it, after which the ordinary hello/key-exchange/announce/sync
+machinery takes over. A swarm therefore forms from one well-known
+address, no static peer lists (``add_peer`` remains for fabrics where
+peers ARE known addresses). Full DHT walking stays out of scope —
+the rendezvous node is the trust anchor the reference's bootstrap DHT
+nodes are; a wrong introduction is only a dial to a peer that cannot
+complete the key exchange.
 
 Wire protocol (each transport message, after reassembly):
   kind 0x00  plaintext hello       {pk: hex, ack: bool}
   kind 0x01  encrypted envelope    sender_pk(32 raw) || SecureBox
              payload (AAD = sender pk), decrypting to one lib0 `any`:
-             {t:'topics', topics:[...]} | {t:'m', topic, msg}
+             {t:'topics', topics:[...]} | {t:'m', topic, msg} |
+             {t:'intro', peers:[{pk, ip, port}...]} (rendezvous)
 
 Like the loopback fabric, nothing is delivered until ``poll()`` runs —
 single-threaded, event-loop style (udx's own model).
@@ -49,7 +57,8 @@ def _unpack_any(data: bytes) -> Any:
 
 
 class _Peer:
-    __slots__ = ("pk_hex", "addr", "topics", "topics_v", "inst", "box")
+    __slots__ = ("pk_hex", "addr", "topics", "topics_v", "inst", "box",
+                 "last_seen")
 
     def __init__(self, pk_hex: str, addr: Tuple[str, int], inst: str,
                  box: SecureBox):
@@ -59,6 +68,7 @@ class _Peer:
         self.topics_v = -1  # last applied announcement version
         self.inst = inst  # incarnation token: resets topics_v on restart
         self.box = box
+        self.last_seen = time.monotonic()  # last AUTHENTICATED traffic
 
     def new_incarnation(self, inst: str) -> None:
         """A restarted process announces from version 1 again; carrying
@@ -81,6 +91,9 @@ class UdpRouter:
         port: int = 0,
         seed: Optional[bytes] = None,
         username: Optional[str] = None,
+        rendezvous: bool = False,
+        bootstrap: Optional[List[Tuple[str, int]]] = None,
+        announce_ttl: float = 60.0,
     ):
         self.endpoint = UdpEndpoint(bind_ip, port)
         pub, sec = keypair(seed)
@@ -117,6 +130,17 @@ class UdpRouter:
         # (set peer.inst to a dead token that no genuine announcement
         # matches)
         self._rebind_nonce: Dict[str, Tuple[str, Tuple[str, int]]] = {}
+        # rendezvous discovery (Hyperswarm reduction; module docstring).
+        # Announcements carry a liveness TTL, like the DHT's: members
+        # with a bootstrap refresh their announcement every ttl/3, and
+        # a rendezvous node only introduces holders heard from within
+        # the ttl — a crashed member ages out instead of being handed
+        # to every future joiner as a dead address to dial (reliable-
+        # transport retries against it would count as hard failures)
+        self._rendezvous = rendezvous
+        self._bootstrap = list(bootstrap or [])
+        self._announce_ttl = announce_ttl
+        self._last_announce = 0.0
 
     # -- options bag (crdt.js:175-180) ----------------------------------
     def update_options(self, opts: Dict[str, Any]) -> None:
@@ -130,6 +154,8 @@ class UdpRouter:
     def start(self, network_name: Optional[str] = None) -> None:
         self.options.setdefault("network_name", network_name)
         self.started = True
+        for ip, port in self._bootstrap:
+            self.add_peer(ip, port)
 
     def close(self) -> None:
         self.endpoint.close()
@@ -218,6 +244,8 @@ class UdpRouter:
         targets = [peer] if peer is not None else list(self._peers.values())
         for p in targets:
             self._send_envelope(p, msg)
+        if peer is None:
+            self._last_announce = time.monotonic()
 
     def _register_peer(
         self, pk_hex: str, addr: Tuple[str, int], inst: str
@@ -251,6 +279,16 @@ class UdpRouter:
     def poll(self) -> int:
         """One pump: transport poll + dispatch every complete message.
         Returns the number of router-level messages handled."""
+        # announcement refresh (TTL liveness; see __init__): members
+        # that joined through a bootstrap keep their topic announcement
+        # warm so rendezvous introductions never hand out aged entries
+        if (
+            self._bootstrap
+            and self._handlers
+            and time.monotonic() - self._last_announce
+            > self._announce_ttl / 3
+        ):
+            self._announce_topics()
         self.endpoint.poll()
         handled = 0
         for src_ip, src_port, data in self.endpoint.recv_all():
@@ -325,6 +363,7 @@ class UdpRouter:
             payload = _unpack_any(peer.box.decrypt(sealed, aad=sender_raw))
         except ValueError:
             return False  # forged or corrupted
+        peer.last_seen = time.monotonic()
         t = payload.get("t") if isinstance(payload, dict) else None
         if t == "topics":
             if payload.get("inst") != peer.inst:
@@ -346,13 +385,38 @@ class UdpRouter:
             peer.topics_v = v
             before = set(peer.topics)
             peer.topics = set(payload.get("topics", ()))
-            for topic in peer.topics - before:
+            new_topics = peer.topics - before
+            for topic in new_topics:
                 if topic in self._handlers:
                     self._on_peer_joined_topic(topic, pk_hex)
+            if self._rendezvous and new_topics:
+                self._introduce(peer, new_topics)
         elif t == "m":
             handler = self._handlers.get(payload.get("topic"))
             if handler is not None:
                 handler(payload.get("msg"), pk_hex)
+        elif t == "intro":
+            # rendezvous introduction: dial every listed peer we do
+            # not already know. The address is only a hint — the
+            # hello/key-exchange (and, for known identities, the
+            # liveness challenge) authenticates; a malformed or bogus
+            # entry must never escape this loop (it would kill the
+            # router's event loop), so every per-entry failure —
+            # wrong-typed fields included — just skips the entry
+            peers_list = payload.get("peers", ())
+            if not isinstance(peers_list, (list, tuple)):
+                peers_list = ()
+            for entry in peers_list:
+                try:
+                    pk = entry["pk"].lower()
+                    ip, port = entry["ip"], int(entry["port"])
+                    if not isinstance(ip, str):
+                        continue
+                    if pk != self.public_key and pk not in self._peers:
+                        self.add_peer(ip, port)
+                except (KeyError, TypeError, ValueError,
+                        AttributeError, OSError):
+                    continue
         elif t == "ping":
             # liveness challenge: echo the nonce (proving this address
             # holds our key, NOW — the nonce is fresh) and report our
@@ -383,6 +447,42 @@ class UdpRouter:
                     self._send_hello(addr[0], addr[1], ack=True)
                 self._announce_topics(peer)
         return True
+
+    def _introduce(self, newcomer: _Peer, new_topics: Set[str]) -> None:
+        """Rendezvous: tell the newcomer about every other LIVE holder
+        of its newly announced topics, and each holder about it — one
+        intro envelope per side, holders unioned across topics. Fires
+        only on NEWLY announced topics, so refresh re-announcements
+        cost nothing; symmetric convergence comes from every
+        announcement introducing against the then-current holder set.
+        Holders silent past the announce TTL are aged out (they are
+        expected to refresh; see __init__)."""
+        cutoff = time.monotonic() - self._announce_ttl
+        holders = {
+            pk: p for pk, p in self._peers.items()
+            if pk != newcomer.pk_hex
+            and p.last_seen >= cutoff
+            and p.topics & new_topics
+        }
+        if not holders:
+            return
+        self._send_envelope(newcomer, {
+            "t": "intro",
+            "peers": [
+                {"pk": p.pk_hex, "ip": p.addr[0], "port": p.addr[1]}
+                for p in holders.values()
+            ],
+        })
+        about_new = {
+            "t": "intro",
+            "peers": [{
+                "pk": newcomer.pk_hex,
+                "ip": newcomer.addr[0],
+                "port": newcomer.addr[1],
+            }],
+        }
+        for p in holders.values():
+            self._send_envelope(p, about_new)
 
     # -- topology hook driving the injected sync contract ----------------
     def _on_peer_joined_topic(self, topic: str, pk_hex: str) -> None:
