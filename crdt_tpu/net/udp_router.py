@@ -58,7 +58,7 @@ def _unpack_any(data: bytes) -> Any:
 
 class _Peer:
     __slots__ = ("pk_hex", "addr", "topics", "topics_v", "inst", "box",
-                 "last_seen")
+                 "last_seen", "announce_ttl")
 
     def __init__(self, pk_hex: str, addr: Tuple[str, int], inst: str,
                  box: SecureBox):
@@ -69,6 +69,7 @@ class _Peer:
         self.inst = inst  # incarnation token: resets topics_v on restart
         self.box = box
         self.last_seen = time.monotonic()  # last AUTHENTICATED traffic
+        self.announce_ttl = 0.0  # the peer's own wire-declared TTL
 
     def new_incarnation(self, inst: str) -> None:
         """A restarted process announces from version 1 again; carrying
@@ -131,16 +132,22 @@ class UdpRouter:
         # matches)
         self._rebind_nonce: Dict[str, Tuple[str, Tuple[str, int]]] = {}
         # rendezvous discovery (Hyperswarm reduction; module docstring).
-        # Announcements carry a liveness TTL, like the DHT's: members
-        # with a bootstrap refresh their announcement every ttl/3, and
-        # a rendezvous node only introduces holders heard from within
-        # the ttl — a crashed member ages out instead of being handed
-        # to every future joiner as a dead address to dial (reliable-
-        # transport retries against it would count as hard failures)
+        # Announcements carry the announcer's liveness TTL on the wire:
+        # bootstrap-joined members refresh their announcement to their
+        # RENDEZVOUS peers every ttl/3, and a rendezvous node only
+        # introduces holders heard from within each holder's OWN
+        # declared TTL — a crashed member ages out instead of being
+        # handed to every future joiner as a dead address to dial
+        # (reliable-transport retries against it would count as hard
+        # failures), and asymmetric TTL configuration cannot silently
+        # drop a live member. Introductions are honored only from
+        # peers reached at a configured bootstrap address — the stated
+        # trust anchor — never from arbitrary swarm members.
         self._rendezvous = rendezvous
         self._bootstrap = list(bootstrap or [])
         self._announce_ttl = announce_ttl
         self._last_announce = 0.0
+        self._rendezvous_pks: Set[str] = set()
 
     # -- options bag (crdt.js:175-180) ----------------------------------
     def update_options(self, opts: Dict[str, Any]) -> None:
@@ -229,7 +236,11 @@ class UdpRouter:
         ip, port = addr if addr is not None else peer.addr
         self.endpoint.send(ip, port, bytes([_ENVELOPE]) + me + body)
 
-    def _announce_topics(self, peer: Optional[_Peer] = None) -> None:
+    def _announce_topics(
+        self,
+        peer: Optional[_Peer] = None,
+        targets: Optional[List[_Peer]] = None,
+    ) -> None:
         msg = {
             "t": "topics",
             "v": self._topics_v,
@@ -239,9 +250,13 @@ class UdpRouter:
             # watermark above the new incarnation's counter and wedge
             # topic membership until v caught up
             "inst": self._inst,
+            # our liveness TTL, on the wire: a rendezvous node ages our
+            # entry by THIS value, not its local config
+            "ttl": self._announce_ttl,
             "topics": sorted(self._handlers),
         }
-        targets = [peer] if peer is not None else list(self._peers.values())
+        if targets is None:
+            targets = [peer] if peer is not None else list(self._peers.values())
         for p in targets:
             self._send_envelope(p, msg)
         if peer is None:
@@ -281,14 +296,20 @@ class UdpRouter:
         Returns the number of router-level messages handled."""
         # announcement refresh (TTL liveness; see __init__): members
         # that joined through a bootstrap keep their topic announcement
-        # warm so rendezvous introductions never hand out aged entries
+        # warm AT THE RENDEZVOUS PEERS ONLY, so introductions never
+        # hand out aged entries — refreshing the whole swarm would be
+        # O(N^2) steady-state traffic nobody consumes
         if (
-            self._bootstrap
+            self._rendezvous_pks
             and self._handlers
             and time.monotonic() - self._last_announce
             > self._announce_ttl / 3
         ):
-            self._announce_topics()
+            self._announce_topics(targets=[
+                p for pk, p in self._peers.items()
+                if pk in self._rendezvous_pks
+            ])
+            self._last_announce = time.monotonic()
         self.endpoint.poll()
         handled = 0
         for src_ip, src_port, data in self.endpoint.recv_all():
@@ -321,6 +342,10 @@ class UdpRouter:
             return
         if pk_hex == self.public_key:
             return
+        # a peer reached at a configured bootstrap address is a trusted
+        # introducer (the rendezvous trust anchor; intro gate below)
+        if addr in self._bootstrap:
+            self._rendezvous_pks.add(pk_hex)
         inst = info.get("inst", "")
         peer = self._peers.get(pk_hex)
         if peer is None:
@@ -383,6 +408,10 @@ class UdpRouter:
             if v < peer.topics_v:
                 return True  # stale retransmit must not regress the set
             peer.topics_v = v
+            try:
+                peer.announce_ttl = float(payload.get("ttl", 0.0))
+            except (TypeError, ValueError):
+                peer.announce_ttl = 0.0
             before = set(peer.topics)
             peer.topics = set(payload.get("topics", ()))
             new_topics = peer.topics - before
@@ -396,13 +425,19 @@ class UdpRouter:
             if handler is not None:
                 handler(payload.get("msg"), pk_hex)
         elif t == "intro":
-            # rendezvous introduction: dial every listed peer we do
-            # not already know. The address is only a hint — the
-            # hello/key-exchange (and, for known identities, the
-            # liveness challenge) authenticates; a malformed or bogus
-            # entry must never escape this loop (it would kill the
-            # router's event loop), so every per-entry failure —
-            # wrong-typed fields included — just skips the entry
+            # rendezvous introduction — honored ONLY from peers reached
+            # at a configured bootstrap address (the trust anchor): an
+            # ordinary swarm member must not be able to direct us to
+            # spray dials at arbitrary third-party addresses
+            if pk_hex not in self._rendezvous_pks:
+                return True
+            # dial every listed peer we do not already know. The
+            # address is only a hint — the hello/key-exchange (and,
+            # for known identities, the liveness challenge)
+            # authenticates; a malformed or bogus entry must never
+            # escape this loop (it would kill the router's event
+            # loop), so every per-entry failure — wrong-typed fields
+            # included — just skips the entry
             peers_list = payload.get("peers", ())
             if not isinstance(peers_list, (list, tuple)):
                 peers_list = ()
@@ -455,13 +490,13 @@ class UdpRouter:
         only on NEWLY announced topics, so refresh re-announcements
         cost nothing; symmetric convergence comes from every
         announcement introducing against the then-current holder set.
-        Holders silent past the announce TTL are aged out (they are
-        expected to refresh; see __init__)."""
-        cutoff = time.monotonic() - self._announce_ttl
+        Holders silent past their own wire-declared announce TTL are
+        aged out (they are expected to refresh; see __init__)."""
+        now = time.monotonic()
         holders = {
             pk: p for pk, p in self._peers.items()
             if pk != newcomer.pk_hex
-            and p.last_seen >= cutoff
+            and now - p.last_seen <= (p.announce_ttl or self._announce_ttl)
             and p.topics & new_topics
         }
         if not holders:

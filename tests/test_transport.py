@@ -884,7 +884,8 @@ class TestRendezvousRobustness:
         """A crashed member past the announce TTL is not handed to new
         joiners as a dial target."""
         boot = UdpRouter(rendezvous=True, announce_ttl=0.2)
-        a = UdpRouter(bootstrap=[boot.addr])
+        # the TTL rides the wire: aging uses the MEMBER's declared ttl
+        a = UdpRouter(bootstrap=[boot.addr], announce_ttl=0.2)
         routers = [boot, a]
         try:
             Replica(a, topic="room", client_id=1)
@@ -898,6 +899,32 @@ class TestRendezvousRobustness:
             # pump only boot+late: a is dead and must NOT be introduced
             pump([boot, late], timeout_s=20.0)
             assert a_pk not in late.peers
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_intro_from_non_bootstrap_peer_ignored(self):
+        """Only peers reached at a configured bootstrap address may
+        introduce: an ordinary member's intro must not make us dial."""
+        boot = UdpRouter(rendezvous=True)
+        a = UdpRouter(bootstrap=[boot.addr])
+        b = UdpRouter(bootstrap=[boot.addr])
+        routers = [boot, a, b]
+        try:
+            Replica(a, topic="room", client_id=1)
+            Replica(b, topic="room", client_id=2)
+            pump(routers, timeout_s=20.0)
+            assert b.public_key in a.peers  # mesh formed via boot
+            # b (an ordinary member) tries to introduce a to a fake peer
+            peer_a = b._peers[a.public_key]
+            b._send_envelope(peer_a, {"t": "intro", "peers": [
+                {"pk": "ab" * 32, "ip": "127.0.0.1", "port": 1}
+            ]})
+            pump(routers, timeout_s=20.0)
+            assert "ab" * 32 not in a.peers
+            # while the same intro FROM the bootstrap would be honored
+            assert boot.public_key in a._rendezvous_pks
+            assert b.public_key not in a._rendezvous_pks
         finally:
             for r in routers:
                 r.close()
