@@ -143,6 +143,11 @@ def kernel_columns(dec: Dict) -> Dict[str, np.ndarray]:
         "key_id": dec["key_id"],
         "origin_client": dec["origin_client"],
         "origin_clock": dec["origin_clock"],
+        # right origins ride along so staging can order attachment
+        # groups (mid-inserts/prepends) without a records detour; the
+        # general kernels ignore them
+        "right_client": dec["right_client"],
+        "right_clock": dec["right_clock"],
         "valid": np.ones(len(dec["client"]), bool),
     }
 

@@ -117,24 +117,27 @@ def gather(dec: Dict, ds: DeleteSet, handle):
     by parent spec — root name or item id) from a :func:`converge`
     handle.
 
-    The device kernels' sibling/argmax models are exact for unions
-    without right origins (append-only gossip, map sets — the firehose
-    shape). Rows carrying rights — honest prepends/mid-inserts, or
-    crafted updates — re-order on the host through the exact machinery
-    so the result always matches the scalar document."""
+    Right origins (honest prepends/mid-inserts): the packed path
+    orders attachment groups AT STAGING — the exact conflict-scan
+    ranks ride the client column into the fused dispatch
+    (ops.packed._stage_rights) — so only segments carrying shapes the
+    sibling-rank model cannot express (dangling/cross-parent rights,
+    rights into a member's subtree, orphan subtrees: the plan's
+    ``hard_rows``) re-order on the host. The resident fallback keeps
+    the blanket host detour for every right-bearing parent."""
     if handle[0] == "packed":
         win_rows, seq_orders = _assemble_packed(dec, handle[1])
+        hard = getattr(handle[1], "hard_rows", ())
+        if hard:
+            affected = {parent_spec(dec, int(r)) for r in hard}
+            seq_orders.update(_host_seq_orders(dec, affected))
     else:
         win_rows, seq_orders = _assemble_resident(dec, handle[1])
-
-    rc_col, kid_col = dec["right_client"], dec["key_id"]
-    right_seq_rows = np.flatnonzero((rc_col >= 0) & (kid_col < 0))
-    if len(right_seq_rows):
-        # right-bearing sequences: replace exactly the AFFECTED
-        # parents' device orders with the exact host machinery;
-        # untouched (append-only) sequences keep the kernel result
-        affected = {parent_spec(dec, int(r)) for r in right_seq_rows}
-        seq_orders.update(_host_seq_orders(dec, affected))
+        rc_col, kid_col = dec["right_client"], dec["key_id"]
+        right_seq_rows = np.flatnonzero((rc_col >= 0) & (kid_col < 0))
+        if len(right_seq_rows):
+            affected = {parent_spec(dec, int(r)) for r in right_seq_rows}
+            seq_orders.update(_host_seq_orders(dec, affected))
     win_rows = _fix_map_chains_with_rights(dec, win_rows)
     win_vis = visible_mask(dec, win_rows, ds)
     return win_rows, win_vis, seq_orders

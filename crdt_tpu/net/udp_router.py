@@ -147,7 +147,14 @@ class UdpRouter:
         self._bootstrap = list(bootstrap or [])
         self._announce_ttl = announce_ttl
         self._last_announce = 0.0
+        # introducer trust is granted ONLY by proven key possession at
+        # a configured bootstrap address (nonce challenge/pong, the
+        # same machinery that guards address rebinds) — a plaintext
+        # hello with a spoofed bootstrap source must not mint trust.
+        # Intros arriving before the proof completes buffer here and
+        # replay on grant (bounded: latest per claimant, few claimants)
         self._rendezvous_pks: Set[str] = set()
+        self._pending_intros: Dict[str, Any] = {}
 
     # -- options bag (crdt.js:175-180) ----------------------------------
     def update_options(self, opts: Dict[str, Any]) -> None:
@@ -305,11 +312,11 @@ class UdpRouter:
             and time.monotonic() - self._last_announce
             > self._announce_ttl / 3
         ):
+            # peer=None path: _announce_topics stamps _last_announce
             self._announce_topics(targets=[
                 p for pk, p in self._peers.items()
                 if pk in self._rendezvous_pks
             ])
-            self._last_announce = time.monotonic()
         self.endpoint.poll()
         handled = 0
         for src_ip, src_port, data in self.endpoint.recv_all():
@@ -342,10 +349,6 @@ class UdpRouter:
             return
         if pk_hex == self.public_key:
             return
-        # a peer reached at a configured bootstrap address is a trusted
-        # introducer (the rendezvous trust anchor; intro gate below)
-        if addr in self._bootstrap:
-            self._rendezvous_pks.add(pk_hex)
         inst = info.get("inst", "")
         peer = self._peers.get(pk_hex)
         if peer is None:
@@ -370,6 +373,12 @@ class UdpRouter:
             # the pong reports the live inst
             self._challenge_liveness(peer, peer.addr)
             return
+        # introducer trust needs PROOF, not a claimed hello source: a
+        # peer presenting from a bootstrap address is challenged there;
+        # only the pong (fresh nonce, decrypted under its key, FROM
+        # that address) grants it (see the pong branch)
+        if addr in self._bootstrap and pk_hex not in self._rendezvous_pks:
+            self._challenge_liveness(peer, addr)
         # key exchange is done on both ends; tell THIS peer our topics
         # (announcing to everyone here would be O(N^2) per join wave)
         self._announce_topics(peer)
@@ -409,9 +418,18 @@ class UdpRouter:
                 return True  # stale retransmit must not regress the set
             peer.topics_v = v
             try:
-                peer.announce_ttl = float(payload.get("ttl", 0.0))
+                ttl = float(payload.get("ttl", 0.0))
             except (TypeError, ValueError):
-                peer.announce_ttl = 0.0
+                ttl = 0.0
+            # clamp the declared TTL: an unbounded (or inf) value would
+            # pin a crashed peer in introductions forever, and a
+            # negative/NaN one would silently exclude a live member
+            # (NaN fails every comparison, so it clamps to 0 -> the
+            # local default applies)
+            cap = 10.0 * self._announce_ttl
+            peer.announce_ttl = ttl if 0.0 < ttl <= cap else (
+                cap if ttl > cap else 0.0
+            )
             before = set(peer.topics)
             peer.topics = set(payload.get("topics", ()))
             new_topics = peer.topics - before
@@ -425,33 +443,23 @@ class UdpRouter:
             if handler is not None:
                 handler(payload.get("msg"), pk_hex)
         elif t == "intro":
-            # rendezvous introduction — honored ONLY from peers reached
-            # at a configured bootstrap address (the trust anchor): an
-            # ordinary swarm member must not be able to direct us to
-            # spray dials at arbitrary third-party addresses
+            # rendezvous introduction — honored ONLY from peers whose
+            # key possession was nonce-proven at a configured bootstrap
+            # address (the trust anchor): an ordinary swarm member —
+            # or an attacker spoofing a bootstrap source on a
+            # plaintext hello — must not be able to direct us to
+            # spray dials at arbitrary third-party addresses. An intro
+            # racing its sender's proof buffers (latest per claimant,
+            # claimants bounded by the bootstrap list) and replays on
+            # grant.
             if pk_hex not in self._rendezvous_pks:
+                if (
+                    peer.addr in self._bootstrap
+                    and len(self._pending_intros) < 8
+                ):
+                    self._pending_intros[pk_hex] = payload
                 return True
-            # dial every listed peer we do not already know. The
-            # address is only a hint — the hello/key-exchange (and,
-            # for known identities, the liveness challenge)
-            # authenticates; a malformed or bogus entry must never
-            # escape this loop (it would kill the router's event
-            # loop), so every per-entry failure — wrong-typed fields
-            # included — just skips the entry
-            peers_list = payload.get("peers", ())
-            if not isinstance(peers_list, (list, tuple)):
-                peers_list = ()
-            for entry in peers_list:
-                try:
-                    pk = entry["pk"].lower()
-                    ip, port = entry["ip"], int(entry["port"])
-                    if not isinstance(ip, str):
-                        continue
-                    if pk != self.public_key and pk not in self._peers:
-                        self.add_peer(ip, port)
-                except (KeyError, TypeError, ValueError,
-                        AttributeError, OSError):
-                    continue
+            self._apply_intro(payload)
         elif t == "ping":
             # liveness challenge: echo the nonce (proving this address
             # holds our key, NOW — the nonce is fresh) and report our
@@ -472,6 +480,14 @@ class UdpRouter:
             ):
                 del self._rebind_nonce[pk_hex]
                 peer.addr = addr  # proven: reroute to the new address
+                if addr in self._bootstrap:
+                    # key possession proven AT a bootstrap address:
+                    # grant introducer trust and replay any intro that
+                    # arrived while the proof was in flight
+                    self._rendezvous_pks.add(pk_hex)
+                    held = self._pending_intros.pop(pk_hex, None)
+                    if held is not None:
+                        self._apply_intro(held)
                 live_inst = payload.get("inst", peer.inst)
                 if live_inst != peer.inst:
                     # fresh-nonce-proven incarnation change: reset the
@@ -482,6 +498,28 @@ class UdpRouter:
                     self._send_hello(addr[0], addr[1], ack=True)
                 self._announce_topics(peer)
         return True
+
+    def _apply_intro(self, payload: Any) -> None:
+        """Dial every listed peer we do not already know. The address
+        is only a hint — the hello/key-exchange (and, for known
+        identities, the liveness challenge) authenticates; a malformed
+        or bogus entry must never escape this loop (it would kill the
+        router's event loop), so every per-entry failure — wrong-typed
+        fields included — just skips the entry."""
+        peers_list = payload.get("peers", ())
+        if not isinstance(peers_list, (list, tuple)):
+            return
+        for entry in peers_list:
+            try:
+                pk = entry["pk"].lower()
+                ip, port = entry["ip"], int(entry["port"])
+                if not isinstance(ip, str):
+                    continue
+                if pk != self.public_key and pk not in self._peers:
+                    self.add_peer(ip, port)
+            except (KeyError, TypeError, ValueError,
+                    AttributeError, OSError):
+                continue
 
     def _introduce(self, newcomer: _Peer, new_topics: Set[str]) -> None:
         """Rendezvous: tell the newcomer about every other LIVE holder

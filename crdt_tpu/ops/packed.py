@@ -94,12 +94,135 @@ class PackedPlan(NamedTuple):
     client_bits: int          # dense client rank width (static)
     rank_rounds: int          # doubling rounds bound (seq DFS)
     map_rounds: int           # doubling rounds bound (map chains)
+    hard_rows: tuple = ()     # caller-space rows marking segments the
+                              # scalar fallback must re-order (gather)
 
 
 def _even_up(x: int) -> int:
     """Round a doubling-rounds bound up to even: halves the static
     variants the jit cache sees at a cost of at most one extra round."""
     return x + (x & 1)
+
+
+def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
+                  seq_rows, uniq_valid, kid_s, client_s, client_raw_s,
+                  clock_raw_s):
+    """Exact right-origin (attachment) ordering, computed at staging
+    in column space — the device kernel needs NO change: a simulated
+    group's conflict-scan ranks are written over its members' entries
+    in the client column, and since ranks are unique within a group
+    the kernel's (client, position) tie-break never fires.
+
+    Semantics match ops.yata.order_sequences exactly. A segment is
+    HARD — routed to the scalar fallback at gather via the returned
+    representative rows — when any member's declared origin is
+    unresolved (orphan subtrees take the fallback's dropping rules),
+    or any member's right is dangling/unknown, cross-segment, or
+    inside another member's subtree (right_walk_is_hard). Groups with
+    in-group anchors replay the Yjs conflict scan (_simulate_group);
+    attachment-free groups keep the plain (client, clock-desc) key.
+
+    Returns (client column, caller-space hard rows, max rank written).
+    """
+    from crdt_tpu.ops.yata import _simulate_group
+
+    n = len(client_s)
+    rr = np.asarray(cols["right_client"], np.int64)[order]
+    rk = np.asarray(cols["right_clock"], np.int64)[order]
+    rows_r = np.flatnonzero(uniq_valid & (kid_s < 0) & (rr >= 0))
+    if not len(rows_r):
+        return client_s, [], 0
+
+    # resolve right-target rows through the dense id table (leftmost
+    # match is the kept duplicate representative, like origins)
+    posu = np.clip(
+        np.searchsorted(uniq, np.clip(rr, uniq[0], None)), 0, len(uniq) - 1
+    )
+    known_c = (
+        (rr >= 0) & (uniq[posu] == rr)
+        & (rk >= 0) & (rk < (1 << _CLOCK_BITS))
+    )
+    rkey = np.where(known_c, (posu << _CLOCK_BITS) | rk, np.int64(-1))
+    pos = np.clip(np.searchsorted(ikey_s, rkey), 0, n - 1)
+    right_row = np.where((rkey >= 0) & (ikey_s[pos] == rkey), pos, -1)
+
+    # segment -> member rows (one stable sort over the seq rows)
+    seg_of_seq = seg[seq_rows]
+    so = np.argsort(seg_of_seq, kind="stable")
+    ss, sr = seg_of_seq[so], seq_rows[so]
+    seg_cuts = np.r_[0, np.flatnonzero(ss[1:] != ss[:-1]) + 1, len(ss)]
+    seg_slices = {
+        int(ss[a]): sr[a:b] for a, b in zip(seg_cuts[:-1], seg_cuts[1:])
+    }
+
+    hard_reps: list = []
+    max_rank = 0
+    for S in np.unique(seg[rows_r]).tolist():
+        members = seg_slices.get(int(S))
+        if members is None:
+            continue
+        mlist = members.tolist()
+        hard = False
+        # orphan member: declared origin that resolved nowhere
+        for row in mlist:
+            if oc_s[row] >= 0 and origin_row[row] < 0:
+                hard = True
+                break
+        # groups within the segment, keyed by in-union origin row
+        groups: Dict[int, list] = {}
+        if not hard:
+            for row in mlist:
+                groups.setdefault(int(origin_row[row]), []).append(row)
+            for grows in groups.values():
+                grow_set = set(grows)
+                for r in grows:
+                    if rr[r] < 0:
+                        continue
+                    rt = int(right_row[r])
+                    if rt < 0 or seg[rt] != S:
+                        hard = True  # dangling/unknown or cross-parent
+                        break
+                    if rt in grow_set:
+                        continue  # in-group anchor: simulated below
+                    # out-of-group right: hard if its origin chain
+                    # passes through a GROUP member (the scan would
+                    # stop inside that member's subtree)
+                    cur, steps = rt, 0
+                    while cur >= 0 and steps <= n:
+                        steps += 1
+                        if cur in grow_set:
+                            hard = True
+                            break
+                        cur = int(origin_row[cur])
+                    if hard:
+                        break
+                if hard:
+                    break
+        if hard:
+            hard_reps.append(int(order[mlist[0]]))
+            continue
+        for grows in groups.values():
+            grow_set = set(grows)
+            if not any(
+                rr[r] >= 0 and int(right_row[r]) in grow_set
+                for r in grows
+            ):
+                continue  # attachment-free: plain keys are exact
+            sibs = [
+                {
+                    "id": int(ikey_s[r]),
+                    "client": int(client_raw_s[r]),
+                    "clock": int(clock_raw_s[r]),
+                    "right": int(rkey[r]) if rr[r] >= 0 else None,
+                }
+                for r in grows
+            ]
+            ordered = _simulate_group(sibs, {int(ikey_s[r]) for r in grows})
+            for rank_pos, sid in enumerate(ordered):
+                row = int(np.searchsorted(ikey_s, sid))
+                client_s[row] = rank_pos
+            max_rank = max(max_rank, len(ordered) - 1)
+    return client_s, hard_reps, max_rank
 
 
 def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
@@ -221,8 +344,26 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     else:
         c_parent = np.empty(0, np.int64)
 
-    # size buckets + static key widths
-    cbits = _even_up(max(8, len(uniq).bit_length()))
+    # right-origin attachment ordering (mid-inserts/prepends): groups
+    # with in-group anchors get their exact conflict-scan ranks
+    # written INTO the client column (ranks are unique per group, so
+    # the id tie-break never fires and the device kernel needs no
+    # change); inexpressible shapes mark their segments hard for the
+    # scalar fallback at gather
+    hard_rep_rows: list = []
+    max_rank = 0
+    if "right_client" in cols:
+        client_s, hard_rep_rows, max_rank = _stage_rights(
+            cols, order, ikey_s, uniq, seg, origin_row, oc_s, seq_rows,
+            uniq_valid, kid_s, client_s.copy(), client[order],
+            clock[order],
+        )
+
+    # size buckets + static key widths (the client field must also
+    # hold the largest simulated group rank)
+    cbits = _even_up(max(
+        8, len(uniq).bit_length(), (max_rank + 1).bit_length()
+    ))
     kpad = bucket_grid(n, floor=6)
     qbits = (kpad - 1).bit_length()
     B = min(kpad, bucket_grid(max(n_seq, 1), floor=6))
@@ -254,6 +395,7 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
         client_bits=cbits,
         rank_rounds=_even_up((max_seq + 2).bit_length() + 1),
         map_rounds=_even_up((max_map + 2).bit_length() + 1),
+        hard_rows=tuple(hard_rep_rows),
     )
 
 
@@ -555,6 +697,9 @@ class PackedResult(NamedTuple):
     win_rows: np.ndarray     # [S] original row of each map winner (-1 none)
     stream_seg: np.ndarray   # [B] doc-order segment ids (-1 padding)
     stream_row: np.ndarray   # [B] doc-order original rows (-1 padding)
+    hard_rows: tuple = ()    # rows marking segments needing the scalar
+                             # fallback (right shapes the sibling-rank
+                             # model cannot express)
 
 
 def converge(plan: PackedPlan) -> PackedResult:
@@ -582,4 +727,5 @@ def converge(plan: PackedPlan) -> PackedResult:
         win_rows=np.where(win >= 0, order[np.clip(win, 0, last)], NULLI),
         stream_seg=h[s:s + b],
         stream_row=np.where(srow >= 0, order[np.clip(srow, 0, last)], NULLI),
+        hard_rows=plan.hard_rows,
     )

@@ -153,3 +153,177 @@ class TestConverge:
         n_rows = narrow.stream_row[narrow.stream_row >= 0]
         w_rows = wide.stream_row[wide.stream_row >= 0]
         assert list(n_rows) == list(w_rows)
+
+
+class TestStagedRightOrdering:
+    """The packed path orders attachment groups at staging
+    (ops.packed._stage_rights): exact conflict-scan ranks ride the
+    client column into the fused dispatch. These differentials target
+    the shapes that killed the earlier closed-form attempt —
+    prepend trees with client drift — plus hard shapes that must
+    still take the scalar fallback."""
+
+    @staticmethod
+    def _replay_vs_engine(blobs):
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.engine import Engine
+        from crdt_tpu.models import replay_trace
+
+        out = replay_trace(blobs)
+        eng = Engine(10**6)
+        for b in blobs:
+            v1.apply_update(eng, b)
+        assert out.cache == eng_cache(eng), (out.cache, eng_cache(eng))
+        return out
+
+    def test_prepend_storm_with_client_drift(self):
+        """Every writer keeps prepending at the head (origin None,
+        right = current head) — the order depends on the full conflict
+        scan, and writers' client ids interleave both ways."""
+        import numpy as np
+
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+
+        for seed in range(6):
+            rng = np.random.default_rng(seed)
+            # client ids straddle each other so the scan's client
+            # comparisons flip direction between groups
+            clients = [int(c) for c in rng.permutation([3, 50, 7000, 2])]
+            blobs = []
+            heads: dict = {}
+            for client in clients:
+                recs = []
+                head = None
+                for k in range(12):
+                    recs.append(ItemRecord(
+                        client=client, clock=k, parent_root="L",
+                        origin=None, right=head, content=f"{client}:{k}"))
+                    head = (client, k)
+                heads[client] = head
+                blobs.append(v1.encode_update(recs, DeleteSet()))
+            order = rng.permutation(len(blobs))
+            self._replay_vs_engine([blobs[i] for i in order])
+
+    def test_mixed_mid_inserts_vs_engine(self):
+        """Random interleaved typing with 35% mid-inserts carrying
+        both origins, shuffled delivery with duplicates."""
+        import numpy as np
+
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+
+        for seed in range(5):
+            rng = np.random.default_rng(100 + seed)
+            blobs = []
+            for r in range(4):
+                client = [5, 80, 3, 900][r]
+                recs, chain = [], []
+                for k in range(25):
+                    if chain and rng.random() < 0.35:
+                        j = int(rng.integers(0, len(chain)))
+                        recs.append(ItemRecord(
+                            client=client, clock=k, parent_root="text",
+                            origin=chain[j - 1] if j > 0 else None,
+                            right=chain[j], content=k))
+                        chain.insert(j, (client, k))
+                    else:
+                        recs.append(ItemRecord(
+                            client=client, clock=k, parent_root="text",
+                            origin=chain[-1] if chain else None,
+                            content=k))
+                        chain.append((client, k))
+                blobs.append(v1.encode_update(recs, DeleteSet()))
+            delivery = blobs + [blobs[int(rng.integers(0, 4))]]  # dup
+            rng.shuffle(delivery)
+            self._replay_vs_engine(delivery)
+
+    def test_hard_shape_takes_fallback(self):
+        """A right pointing INTO a member's subtree is inexpressible
+        by sibling ranks: the plan must mark the segment hard and the
+        result must still match the engine."""
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+        from crdt_tpu.models import replay as rp
+        from crdt_tpu.ops import packed
+
+        recs = [
+            ItemRecord(client=1, clock=0, parent_root="L", content="a"),
+            ItemRecord(client=1, clock=1, parent_root="L",
+                       origin=(1, 0), content="b"),
+            # c attaches under b (subtree of a's sibling group member)
+            ItemRecord(client=1, clock=2, parent_root="L",
+                       origin=(1, 1), content="c"),
+            # hostile: same-origin sibling whose right dives into b's
+            # subtree (points at c, a DESCENDANT of member b)
+            ItemRecord(client=2, clock=0, parent_root="L",
+                       origin=(1, 0), right=(1, 2), content="X"),
+        ]
+        blob = v1.encode_update(recs, DeleteSet())
+        dec = rp.decode([blob])
+        cols, _ = rp.stage(dec)
+        plan = packed.stage(cols)
+        assert plan is not None and len(plan.hard_rows) > 0
+        self._replay_vs_engine([blob])
+
+    def test_dangling_right_marks_hard(self):
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+        from crdt_tpu.models import replay as rp
+        from crdt_tpu.ops import packed
+
+        recs = [
+            ItemRecord(client=1, clock=0, parent_root="L", content="a"),
+            ItemRecord(client=2, clock=0, parent_root="L",
+                       origin=(1, 0), right=(77, 5), content="X"),
+        ]
+        blob = v1.encode_update(recs, DeleteSet())
+        dec = rp.decode([blob])
+        cols, _ = rp.stage(dec)
+        plan = packed.stage(cols)
+        assert plan is not None and len(plan.hard_rows) > 0
+
+    def test_clean_attachments_stage_without_fallback(self):
+        """The bench's text shape (mid-inserts, all refs resolvable)
+        must produce ZERO hard segments — the whole point of staged
+        ordering."""
+        import numpy as np
+
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+        from crdt_tpu.models import replay as rp
+        from crdt_tpu.ops import packed
+
+        rng = np.random.default_rng(7)
+        blobs = []
+        for r in range(3):
+            client, recs, chain = r + 1, [], []
+            for k in range(30):
+                if chain and rng.random() < 0.3:
+                    j = int(rng.integers(0, len(chain)))
+                    recs.append(ItemRecord(
+                        client=client, clock=k, parent_root="text",
+                        origin=chain[j - 1] if j > 0 else None,
+                        right=chain[j], content=k))
+                    chain.insert(j, (client, k))
+                else:
+                    recs.append(ItemRecord(
+                        client=client, clock=k, parent_root="text",
+                        origin=chain[-1] if chain else None, content=k))
+                    chain.append((client, k))
+            blobs.append(v1.encode_update(recs, DeleteSet()))
+        dec = rp.decode(blobs)
+        cols, _ = rp.stage(dec)
+        plan = packed.stage(cols)
+        assert plan is not None and len(plan.hard_rows) == 0
+        self._replay_vs_engine(blobs)
+
+
+def eng_cache(eng):
+    """Visible JSON of an engine — same shape replay_trace's cache has."""
+    return eng.to_json()

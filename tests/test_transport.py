@@ -928,3 +928,42 @@ class TestRendezvousRobustness:
         finally:
             for r in routers:
                 r.close()
+
+    def test_spoofed_bootstrap_hello_does_not_mint_trust(self):
+        """A plaintext hello claiming a bootstrap source address must
+        not grant introducer trust: only a nonce-proven pong FROM the
+        bootstrap address does."""
+        boot = UdpRouter(rendezvous=True)
+        victim = UdpRouter(bootstrap=[boot.addr])
+        attacker = UdpRouter()
+        routers = [boot, victim, attacker]
+        try:
+            Replica(victim, topic="room", client_id=1)
+            pump(routers, timeout_s=20.0)
+            assert boot.public_key in victim._rendezvous_pks
+            # attacker completes an ordinary key exchange with victim
+            attacker.add_peer(*victim.addr)
+            pump(routers, timeout_s=20.0)
+            assert attacker.public_key in victim.peers
+            # forge a hello whose claimed source is the bootstrap addr
+            # (simulate source spoofing by calling the handler with the
+            # bootstrap address directly)
+            from crdt_tpu.net.udp_router import _pack_any
+
+            body = _pack_any({
+                "pk": attacker.public_key, "ack": True,
+                "inst": attacker._inst,
+            })
+            victim._on_hello(body, boot.addr)
+            # trust NOT granted from the unauthenticated claim...
+            assert attacker.public_key not in victim._rendezvous_pks
+            # ...and the attacker's authenticated intro is ignored
+            peer_v = attacker._peers[victim.public_key]
+            attacker._send_envelope(peer_v, {"t": "intro", "peers": [
+                {"pk": "cd" * 32, "ip": "127.0.0.1", "port": 9}
+            ]})
+            pump(routers, timeout_s=20.0)
+            assert "cd" * 32 not in victim.peers
+        finally:
+            for r in routers:
+                r.close()
