@@ -38,8 +38,11 @@ run a 16x-larger workload end to end on both paths; 0 skips),
 BENCH_CONFLICT (default 1: also run the shared-anchor conflict
 workload, oracle-checked; 0 skips), BENCH_TEXT (default 1: also run
 the right-bearing collaborative-text workload, oracle-checked; 0
-skips), BENCH_ROUNDS (default 1: steady-state incremental rounds on
-the scale doc; 0 skips; requires the scale run).
+skips), BENCH_SWARM (default 1: replica-level loopback swarm timing
+in all three merge modes; 0 skips), BENCH_ROUNDS (default 1:
+steady-state incremental rounds on the scale doc with a host/device
+crossover table; 0 skips; requires the scale run), BENCH_ROUND_SIZES
+(comma list of per-round delta op counts, default 250,1000,4000,16000).
 """
 
 from __future__ import annotations
@@ -729,6 +732,57 @@ def main():
         text_result = text_result or {}
         text_result["error"] = repr(exc)
 
+    # ---- PRODUCT swarm run (BENCH_SWARM=0 to skip) -------------------
+    # The replica-level gate, not the firehose models: a loopback
+    # swarm converges through the live sync protocol in each merge
+    # mode. Scalar/resident pay host merges; "device" routes every
+    # buffered round through the engine's TPU gate — its overhead
+    # through this tunnel is a published number here, not a private
+    # one (VERDICT r2 item 8).
+    swarm_result = None
+    try:
+      if os.environ.get("BENCH_SWARM", "1") != "0":
+        from crdt_tpu.net import LoopbackNetwork, LoopbackRouter, ypear_crdt
+
+        n_reps, n_ops = 12, 25
+
+        def swarm_round(mode):
+            net = LoopbackNetwork()
+            reps = [
+                ypear_crdt(LoopbackRouter(net, f"pk{i}"), topic="b",
+                           client_id=i + 1, merge_mode=mode,
+                           batch_incoming=True)
+                for i in range(n_reps)
+            ]
+            net.run()
+            t0 = time.perf_counter()
+            for i, r in enumerate(reps):
+                for j in range(n_ops):
+                    if j % 2:
+                        r.set("m", f"k{i}-{j}", j)
+                    else:
+                        r.push("l", f"v{i}-{j}")
+            net.run()
+            dt = time.perf_counter() - t0
+            first = dict(reps[0].c)
+            assert all(dict(r.c) == first for r in reps[1:]), mode
+            return dt
+
+        swarm_result = {"replicas": n_reps, "ops": n_reps * n_ops}
+        for mode in ("scalar", "resident", "device"):
+            if mode == "device":
+                swarm_round(mode)  # warm the gate's compiled shapes
+            swarm_result[f"{mode}_s"] = round(swarm_round(mode), 3)
+        log(f"product swarm ({n_reps} replicas x {n_ops} ops, "
+            f"buffered rounds): "
+            + "  ".join(f"{m}={swarm_result[f'{m}_s']}s"
+                        for m in ("scalar", "resident", "device")))
+    except AssertionError:
+        raise
+    except Exception as exc:
+        log(f"swarm run failed: {exc!r}")
+        swarm_result = {"error": repr(exc)}
+
     # ---- larger-scale crossover run (BENCH_SCALE=0 to skip) ----------
     scale_result = None
     scale = int(os.environ.get("BENCH_SCALE", 16))
@@ -764,32 +818,77 @@ def main():
             from crdt_tpu.models.incremental import IncrementalReplay
             from crdt_tpu.ops.device import bucket_pow2 as _b2
 
-            n_rounds, R_d, K_d = 4, 20, 50  # 1k-op deltas
-            # map-write deltas: each round touches a few hundred
-            # per-key segments, not whole lists — the segment-rich
-            # shape where touched state is a sliver of the doc
-            deltas = [
-                build_trace(R_d, K_d, seed=500 + i,
-                            client_base=R * scale + 1000 + i * R_d,
-                            map_frac=1.0)
-                for i in range(n_rounds)
-            ]
+            # crossover table: the same steady-state round through the
+            # exact HOST path (against the resident columns) and the
+            # forced DEVICE path (one upload + one dispatch + one
+            # fetch), per delta size, plus the scalar engine reference.
+            # The product's auto rule (device_min_rows) picks per
+            # round; this table IS the measured basis for its default.
+            K_d = 50
+            sizes = sorted(int(s) for s in os.environ.get(
+                "BENCH_ROUND_SIZES", "250,1000,4000,16000").split(","))
+            # four deltas per size: warm, host-timed, backlog flush,
+            # device-timed
+            total_delta = 4 * sum(sizes)
             inc = IncrementalReplay(
-                capacity=_b2(R * scale * K + 2 * n_rounds * R_d * K_d)
+                capacity=_b2(R * scale * K + 2 * total_delta)
             )
             t0 = time.perf_counter()
             inc.apply(blobs_l)
             t_ingest = time.perf_counter() - t0
-            inc_times = []
-            for d in deltas:
-                t0 = time.perf_counter()
-                inc.apply(d)
-                inc_times.append(time.perf_counter() - t0)
-            # references: ONE cold full replay of doc+deltas, and the
-            # scalar engine applying just a delta to the loaded doc
             all_blobs = list(blobs_l)
-            for d in deltas:
-                all_blobs += d
+            table = {}
+            crossover = None
+            cbase = R * scale + 1000
+            default_min = inc.device_min_rows
+            from crdt_tpu.codec import v1 as _v1r
+
+            for d_ops in sizes:
+                R_d = max(1, d_ops // K_d)
+                mk = lambda i: build_trace(  # noqa: E731
+                    R_d, K_d, seed=500 + cbase + i,
+                    client_base=cbase + i * R_d, map_frac=1.0)
+                d_warm, d_host, d_flush, d_dev = mk(0), mk(1), mk(2), mk(3)
+                cbase += 4 * R_d
+                all_blobs += d_warm + d_host + d_flush + d_dev
+                # warm the device shapes for this size bucket so the
+                # timed round measures execution, not XLA compiles
+                inc.device_min_rows = 0
+                inc.apply(d_warm)
+                inc.device_min_rows = 1 << 62  # force host
+                t0 = time.perf_counter()
+                inc.apply(d_host)
+                t_host = time.perf_counter() - t0
+                inc.device_min_rows = 0        # force device
+                # flush the host round's unspliced backlog (its tail
+                # bucket differs from a steady round's — untimed)
+                inc.apply(d_flush)
+                t0 = time.perf_counter()
+                inc.apply(d_dev)
+                t_dev_r = time.perf_counter() - t0
+                inc.device_min_rows = default_min  # restore auto rule
+                scalar_s = None
+                if not skip_oracle:
+                    rr_d = []
+                    for blob in d_host:
+                        rr, _dd = _v1r.decode_update(blob)
+                        rr_d.extend(rr)
+                    t0 = time.perf_counter()
+                    eng.apply_records(rr_d)
+                    scalar_s = round(time.perf_counter() - t0, 3)
+                table[str(R_d * K_d)] = {
+                    "host_round_s": round(t_host, 3),
+                    "device_round_s": round(t_dev_r, 3),
+                    "scalar_round_s": scalar_s,
+                }
+                if crossover is None and t_dev_r < t_host:
+                    crossover = R_d * K_d
+                log(f"  round {R_d * K_d:>6} ops: host {t_host:.3f}s  "
+                    f"device {t_dev_r:.3f}s"
+                    + (f"  scalar {scalar_s:.3f}s" if scalar_s else ""))
+
+            # exactness net across every round + mode, and the cold
+            # reference the steady state is measured against
             t0 = time.perf_counter()
             from crdt_tpu.models import replay_trace as _rt
 
@@ -797,39 +896,26 @@ def main():
             t_cold_round = time.perf_counter() - t0
             assert inc.cache == res_full.cache, \
                 "incremental diverges from cold replay"
-            # scalar-incremental reference: apply one delta to the
-            # ALREADY-LOADED main-run engine (engine application is
-            # O(delta); loading the scale doc into it would cost
-            # minutes and measure nothing new)
-            oracle_round = None
-            if not skip_oracle:
-                rr_d = []
-                from crdt_tpu.codec import v1 as _v1r
-
-                for blob in deltas[-1]:
-                    rr, _dd = _v1r.decode_update(blob)
-                    rr_d.extend(rr)
-                t0 = time.perf_counter()
-                eng.apply_records(rr_d)
-                oracle_round = time.perf_counter() - t0
-            med = sorted(inc_times)[len(inc_times) // 2]
+            ref = table.get("1000") or table[next(iter(table))]
+            med = min(ref["host_round_s"], ref["device_round_s"])
             rounds_result = {
                 "doc_ops": R * scale * K,
-                "delta_ops": R_d * K_d,
-                "incremental_round_s": round(med, 3),
+                "per_delta": table,
+                "crossover_delta_ops": crossover,
+                "incremental_round_s": med,
                 "cold_replay_round_s": round(t_cold_round, 2),
-                "vs_cold_replay": round(t_cold_round / med, 1),
-                "scalar_incremental_round_s": (
-                    round(oracle_round, 3) if oracle_round else None
-                ),
+                "vs_cold_replay": round(t_cold_round / max(med, 1e-9), 1),
                 "ingest_s": round(t_ingest, 2),
             }
             scale_result["rounds"] = rounds_result
-            log(f"steady-state rounds ({R_d * K_d}-op deltas on the "
-                f"{R * scale * K}-op doc): incremental {med:.3f}s/round "
-                f"vs cold replay {t_cold_round:.2f}s/round"
-                + (f" vs scalar incremental {oracle_round:.3f}s"
-                   if oracle_round else ""))
+            xmsg = (
+                f"host/device crossover at {crossover} delta ops"
+                if crossover else
+                "host wins at every measured size"
+            )
+            log(f"steady-state rounds on the {R * scale * K}-op doc: "
+                f"best small round {med:.3f}s vs cold replay "
+                f"{t_cold_round:.2f}s; {xmsg}")
 
     except AssertionError:
         raise
@@ -872,6 +958,8 @@ def main():
         out["conflict_run"] = conflict_result
     if text_result:
         out["text_run"] = text_result
+    if swarm_result:
+        out["swarm_run"] = swarm_result
     if scale_result:
         out["scale_run"] = scale_result
     print(json.dumps(out))

@@ -100,14 +100,16 @@ class IncrementalReplay:
 
     ``device_min_rows`` is the host/device crossover: when the rows of
     a round's touched segments total fewer than this, convergence runs
-    through the exact host machinery against the resident columns
-    (the delta still splices into the device matrix, keeping HBM state
-    current for later large rounds). Measured through the tunnelled
-    single chip a device round costs ~0.1-0.3s of fixed interaction
-    latency regardless of size, so small deltas — a collaborator's
-    keystrokes, a replica's own ops — are host-won; firehose rounds
-    and cold gaps go to the device. BENCH_r0N.json's ``rounds`` table
-    publishes the measured crossover."""
+    through the exact host machinery against the resident columns and
+    the round does ZERO device work — its rows accumulate, and the
+    next device round splices the whole unspliced tail in its one
+    upload (``n_dev`` marks the boundary; admission appends in order,
+    so host row ids and device positions stay identical). Measured
+    through the tunnelled single chip a device round costs ~0.1-0.3s
+    of fixed interaction latency regardless of size, so small deltas —
+    a collaborator's keystrokes, a replica's own ops — are host-won;
+    firehose rounds and cold gaps go to the device. BENCH_r0N.json's
+    ``rounds`` table publishes the measured crossover."""
 
     def __init__(self, capacity: int = 1 << 14,
                  device_min_rows: Optional[int] = None):
@@ -284,7 +286,7 @@ class IncrementalReplay:
                     pk.segkey_of(pref[ok], kid[ok])
                 )
             )
-            self._device_round(new_rows, touched)
+            self._device_round(touched)
         self._rebuild_cache(touched)
         return self.cache
 
@@ -592,12 +594,8 @@ class IncrementalReplay:
         return root
 
     # -- device round -------------------------------------------------
-    def _device_round(self, new_rows: np.ndarray, touched: set) -> None:
+    def _device_round(self, touched: set) -> None:
         jax, jnp = self._jax, self._jnp
-        oc_new = self.cols.col("oc")[new_rows]
-        self._intern_clients(np.concatenate([
-            self.cols.col("client")[new_rows], oc_new[oc_new >= 0],
-        ]))
 
         # split touched: device-convergeable vs right-bearing (host)
         dev_segs = sorted(
@@ -610,62 +608,71 @@ class IncrementalReplay:
         ]
         # host/device crossover: small rounds are exact on host against
         # the resident columns (the fixed per-dispatch cost dominates
-        # below the threshold; see the class docstring), and the delta
-        # still splices below so HBM stays current
+        # below the threshold; see the class docstring). Host rounds do
+        # ZERO device work — their rows accumulate, and the next device
+        # round splices the whole unspliced tail (n_dev marks the
+        # boundary: admission appends rows in order, so host row ids
+        # and device positions stay identical)
         if dev_segs and sum(
             len(self._seg_rows[sk]) for sk in dev_segs
         ) < self.device_min_rows:
             host_segs.extend(dev_segs)
             dev_segs = []
 
-        # stage the delta (rows in this batch) as a packed matrix
-        k = len(new_rows)
-        rows = np.asarray(new_rows)
-        kpad = bucket_pow2(k, floor=6)
-        delta = np.zeros((7, kpad), np.int64)
-        delta[3:6, :] = -1
-        oc_raw = self.cols.col("oc")[rows]
-        delta[0, :k] = self._dense_of(self.cols.col("client")[rows])
-        delta[1, :k] = self.cols.col("clock")[rows]
-        delta[2, :k] = np.maximum(self.cols.col("pref")[rows], 0)
-        delta[3, :k] = self.cols.col("kid")[rows]
-        delta[4, :k] = np.where(oc_raw >= 0, self._dense_of(
-            np.clip(oc_raw, self._clients[0] if self._clients else 0, None)
-        ), -1)
-        delta[5, :k] = self.cols.col("ock")[rows]
-        delta[6, :k] = self.cols.col("pref")[rows] >= 0
-        # rows without a resolvable parent (incl. GC fillers) stay
-        # invalid on device: origin lookups that miss them fall back to
-        # root attachment, the same convention as the cold path
-
-        need = self.n_dev + kpad
-        if need > self._mat.shape[1]:
-            with jax.enable_x64(True):
-                self._mat = pk._grow_mat(
-                    self._mat, new_cap=bucket_pow2(need)
-                )
-
         if dev_segs:
+            # stage the UNSPLICED TAIL (this batch + any rows host
+            # rounds left behind) as a packed matrix; row 7 carries
+            # the touched-segment keys so the whole round is ONE
+            # upload + ONE dispatch + ONE fetch (crossover floor)
+            rows = np.arange(self.n_dev, self.cols.n)
+            k = len(rows)
+            oc_tail = self.cols.col("oc")[rows]
+            self._intern_clients(np.concatenate([
+                self.cols.col("client")[rows], oc_tail[oc_tail >= 0],
+            ]))
+            tpad = bucket_pow2(max(len(dev_segs), 1), floor=10)
+            kpad = max(bucket_pow2(max(k, 1), floor=6), tpad)
+            delta = np.zeros((8, kpad), np.int64)
+            delta[3:6, :] = -1
+            delta[7, :] = np.iinfo(np.int64).max
+            delta[7, : len(dev_segs)] = dev_segs
+            oc_raw = oc_tail
+            delta[0, :k] = self._dense_of(self.cols.col("client")[rows])
+            delta[1, :k] = self.cols.col("clock")[rows]
+            delta[2, :k] = np.maximum(self.cols.col("pref")[rows], 0)
+            delta[3, :k] = self.cols.col("kid")[rows]
+            delta[4, :k] = np.where(oc_raw >= 0, self._dense_of(
+                np.clip(oc_raw, self._clients[0] if self._clients else 0,
+                        None)
+            ), -1)
+            delta[5, :k] = self.cols.col("ock")[rows]
+            delta[6, :k] = self.cols.col("pref")[rows] >= 0
+            # rows without a resolvable parent (incl. GC fillers) stay
+            # invalid on device: origin lookups that miss them fall
+            # back to root attachment, same convention as the cold path
+
+            need = self.n_dev + kpad
+            if need > self._mat.shape[1]:
+                with jax.enable_x64(True):
+                    self._mat = pk._grow_mat(
+                        self._mat, new_cap=bucket_pow2(need)
+                    )
             n_sel = sum(len(self._seg_rows[sk]) for sk in dev_segs)
             # generous floors: steady-state rounds with fluctuating
             # touch counts share ONE compiled shape instead of paying
             # a fresh XLA compile per pow2 bucket
-            tpad = bucket_pow2(len(dev_segs), floor=10)
-            tarr = np.full(tpad, np.iinfo(np.int64).max, np.int64)
-            tarr[: len(dev_segs)] = dev_segs
             sel_bucket = min(
                 bucket_pow2(max(n_sel, 1), floor=13),
                 self._mat.shape[1],
             )
             with jax.enable_x64(True):
-                self._mat, out, sel_rows_d = pk._splice_select_converge(
+                self._mat, packed_out = pk._splice_select_converge(
                     self._mat, jnp.asarray(delta),
-                    jnp.int32(self.n_dev), jnp.asarray(tarr),
+                    jnp.int32(self.n_dev),
                     num_segments=tpad,
                     sel_bucket=sel_bucket, seq_bucket=sel_bucket,
                 )
-                h = np.asarray(out)
-                sel_rows = np.asarray(sel_rows_d)
+                h = np.asarray(packed_out)       # the round's ONE fetch
             # advance by the REAL row count: the padded tail is
             # invalid and the next splice overwrites it, keeping
             # device positions identical to host row ids
@@ -675,6 +682,7 @@ class IncrementalReplay:
             win_local = h[:s]
             stream_seg = h[s : s + b]
             stream_row = h[s + b : s + 2 * b]
+            sel_rows = h[s + 2 * b : s + 3 * b]
             # map winners: local -> resident row -> segkey
             for w in win_local[win_local >= 0]:
                 row = int(sel_rows[w])
@@ -692,13 +700,8 @@ class IncrementalReplay:
                 for a, bnd in zip(cuts[:-1], cuts[1:]):
                     chunk = res_rows[a:bnd].tolist()
                     self._order[self._row_segkey(chunk[0])] = chunk
-        else:
-            # no device-convergeable segments: still splice the delta
-            with jax.enable_x64(True):
-                self._mat = pk._splice_mat(
-                    self._mat, jnp.asarray(delta), jnp.int32(self.n_dev)
-                )
-            self.n_dev += k
+        # host rounds: no device work at all — the unspliced tail
+        # waits for the next device round (see the crossover comment)
 
         for sk in host_segs:
             self._host_order_segment(sk)

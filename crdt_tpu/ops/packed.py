@@ -623,20 +623,25 @@ def segkey_of(pref, kid):
     donate_argnums=(0,),
     static_argnames=("num_segments", "sel_bucket", "seq_bucket"),
 )
-def _splice_select_converge(mat, delta, n_off, touched_sorted,
+def _splice_select_converge(mat, delta8, n_off,
                             num_segments: int, sel_bucket: int,
                             seq_bucket: int):
-    """Incremental warm dispatch: splice a packed delta into the
-    resident matrix (donated), select the rows of the TOUCHED segments
-    (touched_sorted: ascending segkeys, padded with int64 max), and
-    re-converge only that compact subset. Returns
+    """Incremental warm dispatch — exactly THREE host<->device
+    interactions per round: ONE upload (``delta8``: the packed delta
+    columns with the touched-segment keys riding as row 7 — ascending
+    segkeys, int64-max padding), ONE dispatch, and ONE fetch of a
+    single packed array (the caller splits it). Splices the delta into
+    the resident matrix (donated), selects the rows of the touched
+    segments, and re-converges only that compact subset. Returns
 
-      (resident_mat, out[S + 2B] int32, sel_rows[sel_bucket] int32)
+      (resident_mat, [ out[S + 2B] | sel_rows[sel_bucket] ] int32)
 
     where out's row indices are LOCAL to sel_rows; callers map back
     with sel_rows (resident row ids, -1 padding)."""
+    touched_sorted = delta8[7]
     mat = jax.lax.dynamic_update_slice(
-        mat, delta.astype(mat.dtype), (jnp.int32(0), n_off.astype(jnp.int32))
+        mat, delta8[:7].astype(mat.dtype),
+        (jnp.int32(0), n_off.astype(jnp.int32)),
     )
     client = mat[0].astype(jnp.int32)
     clock = mat[1].astype(jnp.int64)
@@ -659,16 +664,10 @@ def _splice_select_converge(mat, delta, n_off, touched_sorted,
         oc[sel_rows], ock[sel_rows], sub_valid,
         num_segments=num_segments, seq_bucket=seq_bucket,
     )
-    return mat, out, jnp.where(sub_valid, sel_rows, NULLI)
-
-
-@partial(jax.jit, donate_argnums=(0,))
-def _splice_mat(mat, delta, n_off):
-    """Delta splice without convergence (delete-only / host-only
-    rounds still need the rows resident for later dispatches)."""
-    return jax.lax.dynamic_update_slice(
-        mat, delta.astype(mat.dtype), (jnp.int32(0), n_off.astype(jnp.int32))
-    )
+    packed_out = jnp.concatenate([
+        out, jnp.where(sub_valid, sel_rows, NULLI).astype(jnp.int32)
+    ])
+    return mat, packed_out
 
 
 @partial(jax.jit, donate_argnums=(0,), static_argnames=("new_cap",))
