@@ -714,6 +714,15 @@ class IncrementalReplay:
         from crdt_tpu.ops.yata import order_sequences
 
         rows = self._seg_rows[sk]
+        if not self._seg_rights.get(sk):
+            # right-free segment on the host path (below the device
+            # crossover): the exact sibling model — (client asc,
+            # clock DESC) under origin trees — in plain Python, with
+            # no kernel dispatch and no throwaway engine. This is the
+            # keystroke path: a replica's own op or a peer's small
+            # delta costs O(segment), not a jit round-trip.
+            self._host_order_fast(sk, rows)
+            return
         if self._seg_kid.get(sk, -1) >= 0:
             # right-bearing MAP chain: exact tail via chain order
             from crdt_tpu.ops.yata import order_hard_segment
@@ -741,6 +750,66 @@ class IncrementalReplay:
             spec if spec[0] == "root" else ("item", spec[1], spec[2]), []
         )
         self._order[sk] = [self._id_row[i] for i in ids]
+
+    def _host_order_fast(self, sk: int, rows: List[int]) -> None:
+        """Exact convergence of one RIGHT-FREE segment in plain
+        Python: origins resolved within the segment form the tree
+        (missing/cross-segment origins attach to the root, the shared
+        GC'd-origin convention), siblings order by (client asc, clock
+        DESC). Maps take the last-child walk to the chain tail
+        (= ``map_winners``); sequences take the DFS pre-order
+        (= ``tree_order_ranks`` with the same keys)."""
+        c = self.cols
+        cl = c.col("client")
+        ck = c.col("clock")
+        oc = c.col("oc")
+        ock = c.col("ock")
+        rowset = set(rows)
+
+        def parent_of(r: int):
+            o = int(oc[r])
+            if o < 0:
+                return None
+            p = self._id_row.get((o, int(ock[r])))
+            return p if p is not None and p in rowset else None
+
+        children: Dict[Optional[int], list] = {}
+        for r in rows:
+            children.setdefault(parent_of(r), []).append(r)
+
+        if self._seg_kid.get(sk, -1) >= 0:
+            # chain tail: repeatedly step to the (max client, min
+            # clock) child
+            cur: Optional[int] = None
+            while True:
+                kids = children.get(cur)
+                if not kids:
+                    break
+                cur = max(kids, key=lambda r: (int(cl[r]), -int(ck[r])))
+            if cur is not None:
+                self._win[sk] = cur
+            return
+        # sequence DFS pre-order with the sibling key
+        for kids in children.values():
+            kids.sort(key=lambda r: (int(cl[r]), -int(ck[r])))
+        out: List[int] = []
+        stack = list(reversed(children.get(None, [])))
+        while stack:
+            r = stack.pop()
+            out.append(r)
+            kids = children.get(r)
+            if kids:
+                stack.extend(reversed(kids))
+        # every row sits in exactly one children list, so the DFS
+        # visits each reachable row once. Admission leaves pref < 0 on
+        # origin-cycle members (they never reach _seg_rows), so
+        # normally nothing is unreachable — but if that invariant ever
+        # bends, rank the leftovers at the tail instead of silently
+        # dropping them (the device path ranks everything too)
+        if len(out) != len(rows):
+            emitted = set(out)
+            out.extend(r for r in rows if r not in emitted)
+        self._order[sk] = out
 
     def _record_of(self, row: int, parent_root: Optional[str] = None):
         from crdt_tpu.core.records import ItemRecord

@@ -352,3 +352,37 @@ class TestIncrementalRounds:
             blobs.append(_blob(recs, ds))
             inc.apply(blobs[-1])
             assert inc.cache == replay_trace(blobs).cache, f"round {rnd}"
+
+
+def test_host_and_device_modes_converge_identically():
+    """The same delta stream through forced-host rounds (pure-Python
+    segment ordering, zero device work) and forced-device rounds must
+    land on the identical cache — the crossover rule may pick either
+    side at any time."""
+    import bench as _bench  # the canonical workload generators
+
+    base = _bench.build_trace(40, 40, seed=3)
+    deltas = [
+        _bench.build_trace(4, 40, seed=60 + i, client_base=900 + 4 * i,
+                           map_frac=0.5)
+        for i in range(3)
+    ]
+    from crdt_tpu.models.incremental import IncrementalReplay
+
+    host = IncrementalReplay(capacity=1 << 13, device_min_rows=1 << 62)
+    dev = IncrementalReplay(capacity=1 << 13, device_min_rows=0)
+    host.apply(base)
+    dev.apply(base)
+    for d in deltas:
+        host.apply(d)
+        dev.apply(d)
+    assert host.cache == dev.cache
+    # and a mode FLIP mid-stream converges too (lazy tail flushes)
+    flip = IncrementalReplay(capacity=1 << 13, device_min_rows=1 << 62)
+    flip.apply(base)
+    flip.apply(deltas[0])
+    flip.device_min_rows = 0
+    flip.apply(deltas[1])
+    flip.device_min_rows = 1 << 62
+    flip.apply(deltas[2])
+    assert flip.cache == dev.cache
