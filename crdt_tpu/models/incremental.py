@@ -62,6 +62,21 @@ from crdt_tpu.ops.device import bucket_pow2
 from crdt_tpu.ops import packed as pk
 
 
+def _octave(n: int, floor: int) -> int:
+    """Factor-8 size bucket for the incremental dispatch's static
+    shapes. A long-lived replica's touched-segment populations GROW
+    monotonically, so fine-grained (pow2) buckets cross a boundary —
+    and pay a fresh ~50s XLA compile — every doubling; factor-8 steps
+    compile a handful of variants over the store's whole lifetime.
+    The kernel's width-dependent cost (sorts, gathers) scales far
+    sublinearly, so 8x padding costs milliseconds against compiles
+    that cost minutes."""
+    b = floor
+    while b < n:
+        b *= 8
+    return b
+
+
 class _Cols:
     """Growing host-side row store (the union's metadata columns)."""
 
@@ -630,8 +645,8 @@ class IncrementalReplay:
             self._intern_clients(np.concatenate([
                 self.cols.col("client")[rows], oc_tail[oc_tail >= 0],
             ]))
-            tpad = bucket_pow2(max(len(dev_segs), 1), floor=10)
-            kpad = max(bucket_pow2(max(k, 1), floor=6), tpad)
+            tpad = _octave(len(dev_segs), floor=1 << 10)
+            kpad = max(_octave(k, floor=1 << 6), tpad)
             delta = np.zeros((8, kpad), np.int64)
             delta[3:6, :] = -1
             delta[7, :] = np.iinfo(np.int64).max
@@ -658,11 +673,8 @@ class IncrementalReplay:
                         self._mat, new_cap=bucket_pow2(need)
                     )
             n_sel = sum(len(self._seg_rows[sk]) for sk in dev_segs)
-            # generous floors: steady-state rounds with fluctuating
-            # touch counts share ONE compiled shape instead of paying
-            # a fresh XLA compile per pow2 bucket
             sel_bucket = min(
-                bucket_pow2(max(n_sel, 1), floor=13),
+                _octave(n_sel, floor=1 << 13),
                 self._mat.shape[1],
             )
             with jax.enable_x64(True):
