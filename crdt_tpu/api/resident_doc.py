@@ -192,7 +192,14 @@ class ResidentCrdt(DocOpsMixin):
 
     def _order_rows(self, spec: Tuple) -> List[int]:
         sk = self._sk(spec, None)
-        return [] if sk is None else self._replay._order.get(sk, [])
+        return [] if sk is None else self._replay.order_list(sk)
+
+    def _iter_rows(self, spec: Tuple):
+        """Forward document-order iteration — O(1) per step on linked
+        segments, no stale-list materialization."""
+        sk = self._sk(spec, None)
+        if sk is not None:
+            yield from self._replay.iter_order(sk)
 
     def _countable(self, row: int) -> bool:
         kind = int(self._replay.cols.col("kind")[row])
@@ -205,7 +212,7 @@ class ResidentCrdt(DocOpsMixin):
         if index <= 0:
             return None
         seen = 0
-        for row in self._order_rows(spec):
+        for row in self._iter_rows(spec):
             if self._countable(row):
                 seen += 1
                 if seen == index:
@@ -214,16 +221,28 @@ class ResidentCrdt(DocOpsMixin):
 
     def _right_of(self, spec: Tuple, left: Optional[int]) -> Optional[int]:
         """The item immediately after ``left`` in FULL order, tombstones
-        included (Engine's ``_next``) — or the head when left is None."""
-        rows = self._order_rows(spec)
-        if left is None:
-            return rows[0] if rows else None
-        # left was just applied, so the order cache is current
-        try:
-            i = rows.index(left)
-        except ValueError:
+        included (Engine's ``_next``) — or the head when left is None.
+        O(1) on linked segments (advisor, round 3)."""
+        sk = self._sk(spec, None)
+        if sk is None:
             return None
-        return rows[i + 1] if i + 1 < len(rows) else None
+        if left is None:
+            for row in self._replay.iter_order(sk):
+                return row
+            return None
+        return self._replay.order_next_row(sk, left)
+
+    def _append_anchor(self, spec: Tuple) -> Optional[int]:
+        """Last countable row — the left anchor of an append — found by
+        scanning from the TAIL (O(trailing tombstones), usually O(1),
+        vs the head scan's O(document); advisor finding, round 3)."""
+        sk = self._sk(spec, None)
+        if sk is None:
+            return None
+        for row in self._replay.iter_order_reversed(sk):
+            if self._countable(row):
+                return row
+        return None
 
     # ------------------------------------------------------------------
     # record building: each primitive allocates clocks, SELF-APPLIES
@@ -234,9 +253,12 @@ class ResidentCrdt(DocOpsMixin):
 
     def _apply_own(self, recs: List[ItemRecord],
                    ds: Optional[DeleteSet] = None) -> None:
-        blob = v1.encode_update(recs, ds or DeleteSet())
         r = self._replay
-        r.apply([blob])
+        # direct admission: no per-op v1 encode/decode round-trip —
+        # the broadcast blob is built once per txn in _finish_txn
+        # (VERDICT r3 item 3); admit_local itself falls back to the
+        # exact blob path when its preflight fails
+        r.admit_local(recs, ds)
         for rec in recs:
             if (rec.client, rec.clock) not in r._id_row:
                 raise AssertionError("local op must always be integrable")
@@ -281,7 +303,7 @@ class ResidentCrdt(DocOpsMixin):
         self._apply_own([], ds)
         return True
 
-    def _seq_insert(self, name: str, spec: Tuple, index: int,
+    def _seq_insert(self, name: str, spec: Tuple, index: Optional[int],
                     values: List[Any]) -> None:
         """All values of one insert go out as ONE chained record run in
         ONE blob/apply: value k's origin is value k-1's id and every
@@ -290,8 +312,13 @@ class ResidentCrdt(DocOpsMixin):
         so each chained record integrates immediately after its
         predecessor with no conflict scan the intermediate state could
         influence (the engine's per-value ``_next`` walk reduces to the
-        same placement)."""
-        left = self._visible_left(spec, index)
+        same placement). ``index=None`` means append: the left anchor
+        comes from a tail scan instead of a head walk (O(1) for the
+        keystroke path instead of O(document))."""
+        if index is None:
+            left = self._append_anchor(spec)
+        else:
+            left = self._visible_left(spec, index)
         right = self._right_of(spec, left)
         right_id = self._row_id(right) if right is not None else None
         origin = self._row_id(left) if left is not None else None
@@ -317,7 +344,7 @@ class ResidentCrdt(DocOpsMixin):
     def _seq_delete(self, spec: Tuple, index: int, length: int) -> int:
         targets = []
         seen = 0
-        for row in self._order_rows(spec):
+        for row in self._iter_rows(spec):
             if not self._countable(row):
                 continue
             if seen >= index:
@@ -370,6 +397,10 @@ class ResidentCrdt(DocOpsMixin):
 
     def _fire_observers(self, touched, touched_keys, origin) -> None:
         if not touched:
+            return
+        if self.observer_function is None and not self._observers:
+            # no listeners: do not force the lazy cache to materialize
+            # (the firehose steady state depends on this)
             return
         cache = self._replay.cache
         event = {
@@ -490,10 +521,7 @@ class ResidentCrdt(DocOpsMixin):
             if array_method == "insert":
                 self._seq_insert(name, spec, index, _as_list(value))
             elif array_method == "push":
-                n = sum(
-                    1 for r in self._order_rows(spec) if self._countable(r)
-                )
-                self._seq_insert(name, spec, n, _as_list(value))
+                self._seq_insert(name, spec, None, _as_list(value))
             elif array_method == "unshift":
                 self._seq_insert(name, spec, 0, _as_list(value))
             else:  # cut
@@ -541,13 +569,10 @@ class ResidentCrdt(DocOpsMixin):
 
     def push(self, name: str, value: Any, batch: bool = False):
         vals = _as_list(value)
-
-        def body():
-            spec = ("root", name)
-            n = sum(1 for r in self._order_rows(spec) if self._countable(r))
-            self._seq_insert(name, spec, n, vals)
-
-        return self._seq_op(name, batch, body)
+        return self._seq_op(
+            name, batch,
+            lambda: self._seq_insert(name, ("root", name), None, vals),
+        )
 
     def unshift(self, name: str, value: Any, batch: bool = False):
         vals = _as_list(value)

@@ -35,16 +35,24 @@ split as the cold path's gather. Delete sets only change visibility,
 never winners or order, so delete-only batches rebuild caches without
 any device work.
 
-Caveat (advisor finding, round 2): the right-origin marking is STICKY
-per segment. Once a segment has seen one right-bearing row, every
-later touch re-runs the exact host ordering over that ENTIRE segment,
-so for a long-lived collaborative-TEXT sequence the per-round cost
-grows with the document, not the delta — "cost scales with the delta"
-holds for map segments and append-shaped sequences. Splicing
-right-bearing deltas incrementally into the cached order is possible
-(the YATA insertion point is deterministic given the cached
-neighborhood) but is not implemented; the honest bench number for
-this shape is the text run, not the steady-state round.
+Host-path segments below the crossover converge INCREMENTALLY
+(round 4; fixes the round-3 advisor/VERDICT finding that right-origin
+marking made every later touch re-order the whole segment): each
+sequence segment keeps an engine-style linked chain (``_lnk_next`` /
+``_lnk_prev``, the same structure ``Engine._next/_prev`` uses), and a
+remote delta integrates row by row through the verbatim YATA conflict
+scan (``Engine._integrate_into_chain``, crdt.js:294) — O(delta x scan
+window), independent of document size. Map deltas whose origin is the
+current chain tail advance the winner in O(1). Any shape outside the
+incremental preconditions (cross-segment/GC origins, unresolvable
+refs, accounting mismatches) falls back to the exact whole-segment
+machinery, so exactness never rests on the fast path.
+
+The plain-JSON cache is LAZY: a round marks touched segments dirty
+and the ``cache`` property flushes them on read, so a replica
+consuming a firehose of updates pays zero materialization until
+someone actually looks (local fast-path ops still patch it in place
+when it is fresh).
 
 Differential-tested against the cold replay and the scalar engine in
 tests/test_incremental.py.
@@ -109,6 +117,33 @@ class _Cols:
         self.contents.extend(contents)
         self.n += k
 
+    def append_row(self, client, clock, kid, pref, oc, ock, rc, rk,
+                   kind, tref, content) -> int:
+        """Scalar append for the local-op fast path: one row, plain
+        Python ints, no numpy temporaries."""
+        i = self.n
+        if i + 1 > self._cap:
+            while i + 1 > self._cap:
+                self._cap *= 2
+            for name in self.INT_COLS:
+                grown = np.zeros(self._cap, np.int64)
+                grown[:i] = self._a[name][:i]
+                self._a[name] = grown
+        a = self._a
+        a["client"][i] = client
+        a["clock"][i] = clock
+        a["kid"][i] = kid
+        a["pref"][i] = pref
+        a["oc"][i] = oc
+        a["ock"][i] = ock
+        a["right_client"][i] = rc
+        a["right_clock"][i] = rk
+        a["kind"][i] = kind
+        a["type_ref"][i] = tref
+        self.contents.append(content)
+        self.n = i + 1
+        return i
+
 
 class IncrementalReplay:
     """A long-lived replica state fed by v1 update blobs.
@@ -141,7 +176,8 @@ class IncrementalReplay:
         self.device_min_rows = device_min_rows
         self.cols = _Cols()
         self.ds = DeleteSet()
-        self.cache: dict = {}
+        self._cache: dict = {}
+        self._dirty: set = set()  # segkeys whose cache view is stale
         self.last_touched_roots: List[str] = []
         self.last_touched_keys: Dict[str, set] = {}
         # stable interners
@@ -161,6 +197,21 @@ class IncrementalReplay:
         self._seg_rights: Dict[int, bool] = {}
         self._win: Dict[int, int] = {}            # map segkey -> winner row
         self._order: Dict[int, List[int]] = {}    # seq segkey -> rows
+        # lazy row->position maps over _order (O(1) anchor lookups for
+        # the resident doc's local ops — advisor finding, round 3).
+        # Invalidated whenever a segment's order is reassigned
+        # (_set_order) or mid-spliced; rebuilt on demand.
+        self._order_pos: Dict[int, Dict[int, int]] = {}
+        # engine-style linked chains (Engine._next/_prev) for host-path
+        # sequence segments: the incremental integrate scan splices
+        # these in O(window); the _order list is then a stale
+        # materialization rebuilt lazily by order_list()
+        self._lnk_next: Dict[int, int] = {}
+        self._lnk_prev: Dict[int, int] = {}
+        self._lnk_head: Dict[int, int] = {}       # segkey -> first row
+        self._lnk_tail: Dict[int, int] = {}
+        self._linked: set = set()                 # segkeys with live links
+        self._order_stale: set = set()            # linked, list out of date
         self._root_segs: Dict[str, set] = {}      # root name -> segkeys
         self._spec_root: Dict[Tuple, str] = {}
         self._rootless: set = set()               # segkeys awaiting a root
@@ -169,9 +220,16 @@ class IncrementalReplay:
         # (columns + content keyed by id) and retry on every apply
         self._pending: Dict[Tuple[int, int], Tuple] = {}
         # expanded tombstone ids, appended per batch (visibility tests
-        # must not re-expand the whole accumulated DeleteSet per round)
+        # must not re-expand the whole accumulated DeleteSet per round).
+        # Local single-id deletes buffer in plain lists and consolidate
+        # lazily — per-keystroke np.concatenate over the whole history
+        # would make backspace O(total deletes ever) (review, round 4)
         self._del_c = np.empty(0, np.int64)
         self._del_k = np.empty(0, np.int64)
+        self._del_buf_c: List[int] = []
+        self._del_buf_k: List[int] = []
+        # per-apply scratch: segkey -> this batch's admitted rows
+        self._new_by_seg: Dict[int, List[int]] = {}
         with jax.enable_x64(True):
             self._mat = jnp.zeros((7, bucket_pow2(capacity)), jnp.int64)
             self._mat = self._mat.at[3:6, :].set(-1)
@@ -234,8 +292,9 @@ class IncrementalReplay:
         return kid
 
     # -- apply --------------------------------------------------------
-    def apply(self, blobs) -> dict:
-        """Consume a batch of update blobs; returns the updated cache."""
+    def apply(self, blobs) -> None:
+        """Consume a batch of update blobs. The JSON view is marked
+        dirty, not rebuilt — read ``.cache`` for the flushed state."""
         if isinstance(blobs, (bytes, bytearray)):
             blobs = [bytes(blobs)]
         dec = native.dedup_columns(native.decode_updates_columns_any(blobs))
@@ -257,7 +316,8 @@ class IncrementalReplay:
             ]).astype(np.int64)
             # drop ids already recorded (rows_visible == True means
             # "not in the recorded set")
-            new_m = rows_visible(exp_c, exp_k, self._del_c, self._del_k)
+            del_c, del_k = self._del_arrays()
+            new_m = rows_visible(exp_c, exp_k, del_c, del_k)
             exp_c, exp_k = exp_c[new_m], exp_k[new_m]
             self._del_c = np.concatenate([self._del_c, exp_c])
             self._del_k = np.concatenate([self._del_k, exp_k])
@@ -282,6 +342,7 @@ class IncrementalReplay:
                 if sk is not None:
                     touched.add(sk)
 
+        self._new_by_seg = {}
         new_rows = self._admit(dec) if n_raw else None
         # segments delivered before their parent item: retry now that
         # this batch may have supplied the missing ancestors
@@ -293,17 +354,267 @@ class IncrementalReplay:
                     self._root_segs.setdefault(root, set()).add(sk)
                     touched.add(sk)
         if new_rows is not None and len(new_rows):
-            pref = self.cols.col("pref")[new_rows]
-            kid = self.cols.col("kid")[new_rows]
-            ok = pref >= 0
-            touched.update(
-                int(s) for s in np.unique(
-                    pk.segkey_of(pref[ok], kid[ok])
-                )
+            by_seg = self._new_by_seg
+            touched.update(by_seg)
+            self._device_round(by_seg)
+        self._touch_bookkeeping(touched)
+        self._dirty.update(
+            sk for sk in touched if sk in self._seg_rows
+        )
+
+    # -- local-op fast path -------------------------------------------
+    def admit_local(self, recs, ds: Optional[DeleteSet] = None) -> None:
+        """Direct admission for locally-born records — the resident
+        doc's self-applied ops (crdt.js:294's integrate, local
+        direction). The caller anchors every record on resident state
+        (origins/rights/parents resident, per-client clocks
+        contiguous), so the wire decode, the dedup pass, and the
+        vectorized admission gate of :meth:`apply` are all skipped and
+        the winner/order caches splice incrementally — O(delta) per op
+        instead of a v1 encode/decode round-trip plus an O(segment)
+        reorder (VERDICT r3 item 3). Any violated assumption falls
+        back to the exact blob path; while stashed or rootless rows
+        are outstanding the fast path is skipped entirely (only the
+        full pass retries them)."""
+        if self._pending or self._rootless or not self._can_fast(recs):
+            from crdt_tpu.codec import v1 as _v1
+
+            self.apply([_v1.encode_update(list(recs), ds or DeleteSet())])
+            return
+
+        touched: set = set()
+        # delete ranges: visibility-only. Callers only delete rows that
+        # are currently visible (checked against the live delete set
+        # before building ``ds``), so these ids are never already in
+        # the expanded arrays — the redelivery dedup scan of apply() is
+        # unnecessary here.
+        if ds is not None and ds.ranges:
+            exp_c: List[int] = []
+            exp_k: List[int] = []
+            for c, k, length in ds.iter_all():
+                self.ds.add(c, k, length)
+                for kk in range(k, k + length):
+                    exp_c.append(c)
+                    exp_k.append(kk)
+                    row = self._id_row.get((c, kk))
+                    if row is not None:
+                        sk = self._row_segkey(row)
+                        if sk is not None:
+                            touched.add(sk)
+            self._del_buf_c.extend(exp_c)
+            self._del_buf_k.extend(exp_k)
+
+        runs: Dict[int, List[int]] = {}  # segkey -> rows, op order
+        for rec in recs:
+            spec = (
+                ("root", rec.parent_root)
+                if rec.parent_root is not None
+                else ("item",) + tuple(rec.parent_item)
             )
-            self._device_round(touched)
-        self._rebuild_cache(touched)
-        return self.cache
+            pref = self._pref_of_spec(spec)
+            kid = self._kid_of_key(rec.key) if rec.key is not None else -1
+            oc, ock = rec.origin if rec.origin is not None else (-1, -1)
+            rc, rk = rec.right if rec.right is not None else (-1, -1)
+            row = self.cols.append_row(
+                rec.client, rec.clock, kid, pref, oc, ock, rc, rk,
+                rec.kind, rec.type_ref, rec.content,
+            )
+            self._id_row[(rec.client, rec.clock)] = row
+            self._next_clock[rec.client] = rec.clock + 1
+            sk = pk.segkey_int(pref, kid)
+            seg_rows = self._seg_rows.get(sk)
+            if seg_rows is None:
+                seg_rows = self._seg_rows[sk] = []
+                self._seg_kid[sk] = kid
+                root = self._root_of(spec)
+                if root is not None:
+                    self._root_segs.setdefault(root, set()).add(sk)
+                else:  # unreachable for local ops; mirrors _admit
+                    self._rootless.add(sk)
+            seg_rows.append(row)
+            if rc >= 0:
+                self._seg_rights[sk] = True
+            runs.setdefault(sk, []).append(row)
+
+        # convergence + cache: fast shapes (root-map K_ANY set, root-
+        # list tail append) patch the plain-JSON cache directly; every
+        # other segment goes through _rebuild_cache. Cache values are
+        # the stored contents, same references _build_collection uses.
+        from crdt_tpu.core.store import K_ANY as _K_ANY
+
+        # ``touched`` here holds ONLY delete-touched segments (the
+        # record loop tracks its segments in ``runs``, not here) — a
+        # visibility change always rebuilds fully
+        slow: set = set(touched)
+        fast_roots: Dict[str, set] = {}
+        for sk, new_rows in runs.items():
+            kid = self._seg_kid.get(sk, -1)
+            if kid >= 0:
+                ok = self._splice_map_local(sk, new_rows)
+            else:
+                ok = self._splice_seq_local(sk, new_rows)
+            if not ok or sk in slow:
+                slow.add(sk)
+                continue
+            spec = self._seg_spec(sk)
+            root = spec[1] if spec is not None and spec[0] == "root" else None
+            if root is None or root == "ix":
+                slow.add(sk)  # nested / index: full bookkeeping path
+                continue
+            kinds = self.cols.col("kind")
+            if kid >= 0:
+                row = self._win[sk]
+                tgt = self._cache.get(root)
+                if (
+                    row in new_rows
+                    and int(kinds[row]) == _K_ANY
+                    and isinstance(tgt, dict)
+                ):
+                    kname = self._key_names[kid]
+                    tgt[kname] = self.cols.contents[row]
+                    fast_roots.setdefault(root, set()).add(kname)
+                else:
+                    slow.add(sk)
+            else:
+                tgt = self._cache.get(root)
+                if (
+                    ok == "append"
+                    and isinstance(tgt, list)
+                    and all(int(kinds[r]) == _K_ANY for r in new_rows)
+                ):
+                    tgt.extend(self.cols.contents[r] for r in new_rows)
+                    fast_roots.setdefault(root, set())
+                else:
+                    slow.add(sk)
+        if slow:
+            self._touch_bookkeeping(slow)
+            self._dirty.update(sk for sk in slow if sk in self._seg_rows)
+            roots = set(self.last_touched_roots)
+            keys = self.last_touched_keys
+        else:
+            roots, keys = set(), {}
+        for root, ks in fast_roots.items():
+            roots.add(root)
+            if ks:
+                keys.setdefault(root, set()).update(ks)
+        self.last_touched_roots = sorted(roots)
+        self.last_touched_keys = keys
+
+    def _can_fast(self, recs) -> bool:
+        """Cheap preflight for :meth:`admit_local`: contiguous clocks
+        and resident (or in-batch) dependencies for every record."""
+        nxt: Dict[int, int] = {}
+        batch_ids: set = set()
+        for rec in recs:
+            want = nxt.get(rec.client)
+            if want is None:
+                want = self._next_clock.get(rec.client, 0)
+            if rec.clock != want:
+                return False
+            nxt[rec.client] = rec.clock + 1
+            for dep in rec.dep_ids():
+                if dep not in self._id_row and dep not in batch_ids:
+                    return False
+            batch_ids.add((rec.client, rec.clock))
+        return True
+
+    def _anchor_rows(self, row: int):
+        """Resolve a row's declared origin/right to resident rows via
+        the id index. Returns (left, right, left_declared,
+        right_declared); a declared-but-unresolvable reference comes
+        back None with its declared flag True (callers decide whether
+        that is a fallback condition)."""
+        c = self.cols
+        o = int(c.col("oc")[row])
+        left = (
+            self._id_row.get((o, int(c.col("ock")[row])))
+            if o >= 0 else None
+        )
+        r = int(c.col("right_client")[row])
+        right = (
+            self._id_row.get((r, int(c.col("right_clock")[row])))
+            if r >= 0 else None
+        )
+        return left, right, o >= 0, r >= 0
+
+    def _splice_map_local(self, sk: int, new_rows: List[int]) -> bool:
+        """Local map sets share the remote path's O(1) tail advance
+        (one rule, one implementation); a bent anchor re-derives the
+        chain exactly — _host_order_segment repairs any partial _win
+        advance wholesale."""
+        if self._advance_map_tail(sk, new_rows):
+            return True
+        self._host_order_segment(sk)
+        return False
+
+    def _splice_seq_local(self, sk: int, new_rows: List[int]):
+        """One local insert run: chained records sharing an insertion
+        point. The caller read ``left``/``right`` as ADJACENT rows of
+        the cached full order, so the YATA conflict scan between them
+        is empty and the run splices verbatim at that point — exact
+        regardless of how the surrounding rows were ordered. Moved
+        anchors (contract bent) re-derive exactly. Returns "append" /
+        "mid" for a fast splice, False after a full re-derive."""
+        if sk in self._linked:
+            return self._splice_seq_local_linked(sk, new_rows)
+        order = self._order.get(sk)
+        if order is None:
+            order = []
+            self._set_order(sk, order)
+        if len(order) + len(new_rows) != len(self._seg_rows[sk]):
+            # the cached order does not account for every admitted row
+            # of this segment — never splice against a partial view
+            self._host_order_segment(sk)
+            return False
+        head = new_rows[0]
+        left_row, right_row, _, right_decl = self._anchor_rows(head)
+        if right_decl and right_row is None:
+            self._host_order_segment(sk)  # dangling right: full path
+            return False
+        if right_row is None:
+            if (left_row is None and not order) or (
+                order and left_row == order[-1]
+            ):
+                pos_map = self._order_pos.get(sk)
+                if pos_map is not None:
+                    base = len(order)
+                    for i, row in enumerate(new_rows):
+                        pos_map[row] = base + i
+                order.extend(new_rows)
+                return "append"
+        else:
+            pos = self.order_position(sk, right_row)
+            if pos is not None and (
+                (pos == 0 and left_row is None)
+                or (pos > 0 and left_row == order[pos - 1])
+            ):
+                order[pos:pos] = new_rows
+                self._order_pos.pop(sk, None)  # positions shifted
+                return "mid"
+        self._host_order_segment(sk)
+        return False
+
+    def _splice_seq_local_linked(self, sk: int, new_rows: List[int]):
+        """The linked-chain variant of the local splice: O(1) pointer
+        surgery, same adjacency contract."""
+        head = new_rows[0]
+        left_row, right_row, _, right_decl = self._anchor_rows(head)
+        if right_decl and right_row is None:
+            self._host_order_segment(sk)  # dangling right: full path
+            return False
+        expected = (
+            self._lnk_next.get(left_row, -1) if left_row is not None
+            else self._lnk_head.get(sk, -1)
+        )
+        if expected != (right_row if right_row is not None else -1):
+            self._host_order_segment(sk)  # anchors moved: re-derive
+            return False
+        prev = left_row
+        for row in new_rows:
+            self._link_splice(sk, row, prev)
+            prev = row
+        self._order_stale.add(sk)
+        return "append" if right_row is None else "mid"
 
     def _row_segkey(self, row: int) -> Optional[int]:
         pref = int(self.cols.col("pref")[row])
@@ -561,7 +872,11 @@ class IncrementalReplay:
             for a, b in zip(cuts[:-1], cuts[1:]):
                 sk = int(sks_s[a])
                 grp = rows_s[a:b]
-                self._seg_rows.setdefault(sk, []).extend(grp.tolist())
+                grp_list = grp.tolist()
+                # batch order within the segment (stable sort): the
+                # incremental integrate's deferral loop relies on it
+                self._seg_rows.setdefault(sk, []).extend(grp_list)
+                self._new_by_seg[sk] = grp_list
                 if sk not in self._seg_kid:
                     self._seg_kid[sk] = int(
                         self.cols.col("kid")[int(grp[0])]
@@ -574,6 +889,260 @@ class IncrementalReplay:
                 else:
                     self._rootless.add(sk)
         return rows
+
+    # -- cache laziness -----------------------------------------------
+    @property
+    def cache(self) -> dict:
+        """The plain-JSON view, flushed on read: rounds only mark
+        touched segments dirty, so a replica that is never read pays
+        no materialization (crdt.js's `c` equivalent)."""
+        if self._dirty:
+            dirty, self._dirty = self._dirty, set()
+            self._rebuild_cache(dirty)
+        return self._cache
+
+    # -- order access (list, positions, linked chains) ----------------
+    def _set_order(self, sk: int, rows: List[int]) -> None:
+        """Every whole-order reassignment goes through here so the
+        lazy position map and the linked chain can never serve a
+        stale view."""
+        self._drop_links(sk)
+        self._order[sk] = rows
+        self._order_pos.pop(sk, None)
+
+    def order_list(self, sk: int) -> List[int]:
+        """The segment's document order as a list, materializing from
+        the linked chain when the list is stale."""
+        if sk in self._order_stale:
+            out = []
+            nxt = self._lnk_next
+            cur = self._lnk_head.get(sk, -1)
+            while cur != -1:
+                out.append(cur)
+                cur = nxt.get(cur, -1)
+            self._order[sk] = out
+            self._order_pos.pop(sk, None)
+            self._order_stale.discard(sk)
+        return self._order.get(sk, [])
+
+    def order_position(self, sk: int, row: int) -> Optional[int]:
+        """Position of ``row`` in segment ``sk``'s cached order, O(1)
+        amortized via the lazy row->position map."""
+        pos = self._order_pos.get(sk)
+        if pos is None:
+            pos = {r: i for i, r in enumerate(self.order_list(sk))}
+            self._order_pos[sk] = pos
+        return pos.get(row)
+
+    def iter_order(self, sk: int):
+        """Forward document-order iteration without materializing a
+        stale list (O(1) per step on linked segments)."""
+        if sk in self._linked:
+            nxt = self._lnk_next
+            cur = self._lnk_head.get(sk, -1)
+            while cur != -1:
+                yield cur
+                cur = nxt.get(cur, -1)
+        else:
+            yield from self._order.get(sk, ())
+
+    def iter_order_reversed(self, sk: int):
+        if sk in self._linked:
+            prv = self._lnk_prev
+            cur = self._lnk_tail.get(sk, -1)
+            while cur != -1:
+                yield cur
+                cur = prv.get(cur, -1)
+        else:
+            yield from reversed(self._order.get(sk, ()))
+
+    def order_next_row(self, sk: int, row: int) -> Optional[int]:
+        """The row immediately after ``row`` in full document order
+        (None at the tail / when the row is unknown)."""
+        if sk in self._linked:
+            n = self._lnk_next.get(row, -1)
+            return None if n == -1 else n
+        rows = self._order.get(sk, [])
+        i = self.order_position(sk, row)
+        if i is None or i + 1 >= len(rows):
+            return None
+        return rows[i + 1]
+
+    def _build_links(self, sk: int, n_new: int) -> bool:
+        """Thread the linked chain through the current (fresh) order.
+        False when the order does not account for every admitted row
+        except the ``n_new`` incoming ones — callers then re-derive."""
+        order = self._order.get(sk, [])
+        if len(order) + n_new != len(self._seg_rows[sk]):
+            return False
+        nxt, prv = self._lnk_next, self._lnk_prev
+        prev = -1
+        for r in order:
+            if prev == -1:
+                self._lnk_head[sk] = r
+            else:
+                nxt[prev] = r
+            prv[r] = prev
+            prev = r
+        if prev != -1:
+            nxt[prev] = -1
+            self._lnk_tail[sk] = prev
+        self._linked.add(sk)
+        return True
+
+    def _drop_links(self, sk: int) -> None:
+        if sk not in self._linked:
+            return
+        nxt, prv = self._lnk_next, self._lnk_prev
+        cur = self._lnk_head.pop(sk, -1)
+        while cur != -1:
+            nn = nxt.pop(cur, -1)
+            prv.pop(cur, None)
+            cur = nn
+        self._lnk_tail.pop(sk, None)
+        self._linked.discard(sk)
+        self._order_stale.discard(sk)
+
+    def _link_splice(self, sk: int, row: int, left: Optional[int]) -> None:
+        """Insert ``row`` immediately after ``left`` (None = head)."""
+        nxt, prv = self._lnk_next, self._lnk_prev
+        if left is None:
+            n = self._lnk_head.get(sk, -1)
+            self._lnk_head[sk] = row
+            prv[row] = -1
+        else:
+            n = nxt.get(left, -1)
+            nxt[left] = row
+            prv[row] = left
+        nxt[row] = n
+        if n != -1:
+            prv[n] = row
+        else:
+            self._lnk_tail[sk] = row
+
+    # -- incremental convergence (the round-4 steady-state core) ------
+    def _advance_map_tail(self, sk: int, new_rows: List[int]) -> bool:
+        """Map delta whose every row chains onto the then-current
+        winner: the tail has no children (or it would not be the
+        walk's endpoint), so each row becomes the new tail — O(1),
+        any client. Anything else returns False for the full walk."""
+        c = self.cols
+        oc = c.col("oc")
+        ock = c.col("ock")
+        cl = c.col("client")
+        ck = c.col("clock")
+        for row in new_rows:
+            prev = self._win.get(sk)
+            if prev is not None:
+                if (
+                    int(oc[row]) == int(cl[prev])
+                    and int(ock[row]) == int(ck[prev])
+                ):
+                    self._win[sk] = row
+                    continue
+                return False
+            if (
+                int(oc[row]) < 0
+                and len(self._seg_rows[sk]) <= len(new_rows)
+            ):
+                self._win[sk] = row  # first row of a fresh chain
+                continue
+            return False
+        return True
+
+    def _integrate_remote_seq(self, sk: int, new_rows: List[int]) -> bool:
+        """Engine-verbatim YATA conflict scan (crdt.js:294 via
+        core/engine.py ``_integrate_into_chain``) splicing a delta
+        into this segment's linked chain: O(delta x scan window), not
+        O(segment). Preconditions — every new row's declared origin
+        and right must resolve to a row of THIS segment (or be an
+        in-batch new row, handled by deferral) — keep cross-segment /
+        GC / dangling-reference shapes on the full path, whose
+        dropping conventions differ. Returns False untouched when any
+        precondition fails."""
+        c = self.cols
+        cl = c.col("client")
+        oc = c.col("oc")
+        ock = c.col("ock")
+        rc = c.col("right_client")
+        rk = c.col("right_clock")
+        newset = set(new_rows)
+        resolved: Dict[int, Tuple[Optional[int], Optional[int]]] = {}
+        for row in new_rows:
+            left, right, left_decl, right_decl = self._anchor_rows(row)
+            if left_decl and (
+                left is None
+                or (left not in newset and self._row_segkey(left) != sk)
+            ):
+                return False
+            if right_decl and (
+                right is None
+                or (right not in newset and self._row_segkey(right) != sk)
+            ):
+                return False
+            resolved[row] = (left, right)
+        if sk not in self._linked and not self._build_links(
+            sk, len(new_rows)
+        ):
+            return False
+
+        nxt = self._lnk_next
+        unplaced = set(new_rows)
+        queue = list(new_rows)
+        while queue:
+            progress = False
+            defer = []
+            for row in queue:
+                left0, right0 = resolved[row]
+                if left0 in unplaced or right0 in unplaced:
+                    defer.append(row)
+                    continue
+                x_client = int(cl[row])
+                x_right = (int(rc[row]), int(rk[row]))
+                left = left0
+                o = (
+                    nxt.get(left, -1) if left is not None
+                    else self._lnk_head.get(sk, -1)
+                )
+                conflicting: set = set()
+                before: set = set()
+                while o != -1 and (right0 is None or o != right0):
+                    before.add(o)
+                    conflicting.add(o)
+                    o_oc = int(oc[o])
+                    o_origin_row = (
+                        self._id_row.get((o_oc, int(ock[o])))
+                        if o_oc >= 0 else None
+                    )
+                    if o_origin_row == left0:
+                        # case 1: same left origin -> client id order
+                        if int(cl[o]) < x_client:
+                            left = o
+                            conflicting.clear()
+                        elif (int(rc[o]), int(rk[o])) == x_right:
+                            break
+                    elif (
+                        o_origin_row is not None
+                        and o_origin_row in before
+                    ):
+                        # case 2: o's origin inside the scanned region
+                        if o_origin_row not in conflicting:
+                            left = o
+                            conflicting.clear()
+                    else:
+                        break
+                    o = nxt.get(o, -1)
+                self._link_splice(sk, row, left)
+                unplaced.discard(row)
+                progress = True
+            if not progress:
+                # in-batch reference cycle: the full path's conventions
+                # decide (links now hold a prefix; re-derive wholesale)
+                self._host_order_segment(sk)
+                return True
+            queue = defer
+        self._order_stale.add(sk)
+        return True
 
     def _seg_spec(self, sk: int) -> Optional[Tuple]:
         rows = self._seg_rows.get(sk)
@@ -609,8 +1178,9 @@ class IncrementalReplay:
         return root
 
     # -- device round -------------------------------------------------
-    def _device_round(self, touched: set) -> None:
+    def _device_round(self, by_seg: Dict[int, List[int]]) -> None:
         jax, jnp = self._jax, self._jnp
+        touched = set(by_seg)
 
         # split touched: device-convergeable vs right-bearing (host)
         dev_segs = sorted(
@@ -711,11 +1281,20 @@ class IncrementalReplay:
                 ]
                 for a, bnd in zip(cuts[:-1], cuts[1:]):
                     chunk = res_rows[a:bnd].tolist()
-                    self._order[self._row_segkey(chunk[0])] = chunk
+                    self._set_order(self._row_segkey(chunk[0]), chunk)
         # host rounds: no device work at all — the unspliced tail
-        # waits for the next device round (see the crossover comment)
-
+        # waits for the next device round (see the crossover comment).
+        # Each segment first tries the INCREMENTAL path (O(delta), the
+        # round-4 steady-state fix); shapes outside its preconditions
+        # re-derive wholesale, exactly as before.
         for sk in host_segs:
+            new = by_seg.get(sk)
+            if new:
+                if self._seg_kid.get(sk, -1) >= 0:
+                    if self._advance_map_tail(sk, new):
+                        continue
+                elif self._integrate_remote_seq(sk, new):
+                    continue
             self._host_order_segment(sk)
 
     def _host_order_segment(self, sk: int) -> None:
@@ -761,7 +1340,7 @@ class IncrementalReplay:
         ids = orders.get(
             spec if spec[0] == "root" else ("item", spec[1], spec[2]), []
         )
-        self._order[sk] = [self._id_row[i] for i in ids]
+        self._set_order(sk, [self._id_row[i] for i in ids])
 
     def _host_order_fast(self, sk: int, rows: List[int]) -> None:
         """Exact convergence of one RIGHT-FREE segment in plain
@@ -816,12 +1395,25 @@ class IncrementalReplay:
         # visits each reachable row once. Admission leaves pref < 0 on
         # origin-cycle members (they never reach _seg_rows), so
         # normally nothing is unreachable — but if that invariant ever
-        # bends, rank the leftovers at the tail instead of silently
-        # dropping them (the device path ranks everything too)
+        # bends, rank the leftovers at the tail DETERMINISTICALLY by
+        # (client, clock) — arbitrary residual order could silently
+        # diverge from a device-round replica in the same swarm
+        # (advisor finding, round 3) — and log that the invariant bent
         if len(out) != len(rows):
+            import logging
+
             emitted = set(out)
-            out.extend(r for r in rows if r not in emitted)
-        self._order[sk] = out
+            leftovers = sorted(
+                (r for r in rows if r not in emitted),
+                key=lambda r: (int(cl[r]), int(ck[r])),
+            )
+            logging.getLogger(__name__).warning(
+                "host-order fast path: %d unreachable rows in segment "
+                "%d ranked at tail by (client, clock) — cyclic-origin "
+                "admission invariant bent", len(leftovers), sk,
+            )
+            out.extend(leftovers)
+        self._set_order(sk, out)
 
     def _record_of(self, row: int, parent_root: Optional[str] = None):
         from crdt_tpu.core.records import ItemRecord
@@ -969,11 +1561,9 @@ class IncrementalReplay:
         return None
 
     # -- cache --------------------------------------------------------
-    def _rebuild_cache(self, touched: set) -> None:
-        # root-level map keys patch IN PLACE (a delta touching a few
-        # hundred keys of a 25k-key map must not pay a full-collection
-        # python rebuild); sequences, nested collections, and roots
-        # not yet materialized rebuild whole
+    def _touch_bookkeeping(self, touched: set) -> None:
+        """Observer bookkeeping for a round's touched segments —
+        separated from cache materialization so rounds can stay lazy."""
         t_roots: set = set()
         t_keys: Dict[str, set] = {}
         for sk in touched:
@@ -989,6 +1579,11 @@ class IncrementalReplay:
         self.last_touched_roots = sorted(t_roots)
         self.last_touched_keys = t_keys
 
+    def _rebuild_cache(self, touched: set) -> None:
+        # root-level map keys patch IN PLACE (a delta touching a few
+        # hundred keys of a 25k-key map must not pay a full-collection
+        # python rebuild); sequences, nested collections, and roots
+        # not yet materialized rebuild whole
         full_roots: set = set()
         patches: List[Tuple[str, int]] = []
         for sk in touched:
@@ -1001,7 +1596,7 @@ class IncrementalReplay:
             if (
                 spec == ("root", root)
                 and self._seg_kid.get(sk, -1) >= 0
-                and isinstance(self.cache.get(root), dict)
+                and isinstance(self._cache.get(root), dict)
             ):
                 patches.append((root, sk))
             else:
@@ -1015,7 +1610,7 @@ class IncrementalReplay:
             r
             for root in full_roots
             for sk in self._root_segs.get(root, ())
-            for r in self._order.get(sk, ())
+            for r in self.order_list(sk)
         })
         self._vis = dict(zip(seq_rows, self._visible(seq_rows)))
         for root in full_roots:
@@ -1024,15 +1619,15 @@ class IncrementalReplay:
                 # the cold materialize surfaces a map root only while
                 # it has a visible winner (ix-registered empties come
                 # back through the ix pass below)
-                self.cache.pop(root, None)
+                self._cache.pop(root, None)
             else:
-                self.cache[root] = built
+                self._cache[root] = built
 
         c = self.cols
         maybe_empty: set = set()
         for root, sk in patches:
             key = self._key_names[self._seg_kid[sk]]
-            tgt = self.cache.setdefault(root, {})
+            tgt = self._cache.setdefault(root, {})
             row = self._win.get(sk)
             if row is None or self.ds.contains(
                 int(c.col("client")[row]), int(c.col("clock")[row])
@@ -1052,8 +1647,8 @@ class IncrementalReplay:
             else:
                 tgt[key] = c.contents[row]
         for root in maybe_empty:
-            if self.cache.get(root) == {}:
-                self.cache.pop(root, None)  # same rule as above
+            if self._cache.get(root) == {}:
+                self._cache.pop(root, None)  # same rule as above
         # ix-registered collections with no visible content still
         # materialize (empty), exactly like the cold materialize
         for sk in self._root_segs.get("ix", ()):
@@ -1061,10 +1656,23 @@ class IncrementalReplay:
             if row is None:
                 continue
             name = self._key_names[int(self.cols.col("kid")[row])]
-            if name not in self.cache and name != "ix":
-                self.cache[name] = (
+            if name not in self._cache and name != "ix":
+                self._cache[name] = (
                     [] if self.cols.contents[row] == "array" else {}
                 )
+
+    def _del_arrays(self):
+        """The expanded tombstone id columns, with any buffered local
+        deletions consolidated in."""
+        if self._del_buf_c:
+            self._del_c = np.concatenate(
+                [self._del_c, np.asarray(self._del_buf_c, np.int64)]
+            )
+            self._del_k = np.concatenate(
+                [self._del_k, np.asarray(self._del_buf_k, np.int64)]
+            )
+            self._del_buf_c, self._del_buf_k = [], []
+        return self._del_c, self._del_k
 
     def _visible(self, rows: List[int]) -> List[bool]:
         if not rows:
@@ -1072,11 +1680,12 @@ class IncrementalReplay:
         from crdt_tpu.models.replay import rows_visible
 
         idx = np.asarray(rows)
+        del_c, del_k = self._del_arrays()
         return list(rows_visible(
             self.cols.col("client")[idx],
             self.cols.col("clock")[idx],
-            self._del_c,
-            self._del_k,
+            del_c,
+            del_k,
         ))
 
     def _build_collection_root(self, root: str):
@@ -1130,7 +1739,7 @@ class IncrementalReplay:
             if self._seg_spec(sk) == spec and self._seg_kid[sk] < 0:
                 return [
                     value_of(r)
-                    for r in self._order.get(sk, [])
+                    for r in self.order_list(sk)
                     if vis(r)
                 ]
         return []

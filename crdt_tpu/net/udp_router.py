@@ -36,6 +36,8 @@ single-threaded, event-loop style (udx's own model).
 
 from __future__ import annotations
 
+import logging
+import socket
 import time
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
@@ -44,6 +46,36 @@ from crdt_tpu.net.transport import SecureBox, UdpEndpoint, keypair
 
 _HELLO = 0
 _ENVELOPE = 1
+
+_log = logging.getLogger(__name__)
+
+# protocol-level ceiling for a peer's wire-declared announce TTL. The
+# clamp must NOT derive from the receiver's local announce_ttl: a
+# member legitimately configured with a longer refresh than the
+# rendezvous node would be silently clamped below its own schedule and
+# age out of introductions while still refreshing on time (advisor
+# finding, round 3). One hour bounds how long a crashed peer can pin
+# itself into introductions regardless of either side's local config.
+_TTL_CAP = 3600.0
+
+
+def _canon_addr(host: str, port: int) -> Tuple[str, int]:
+    """Resolve a configured bootstrap entry to the canonical (ip, port)
+    tuple that will appear as a UDP source address. Introducer trust
+    compares observed sources against the bootstrap list; a hostname
+    entry would never match its numeric source and trust would silently
+    never be granted (advisor finding, round 3)."""
+    try:
+        infos = socket.getaddrinfo(
+            host, port, socket.AF_INET, socket.SOCK_DGRAM
+        )
+        return (infos[0][4][0], int(port))
+    except OSError:
+        _log.warning(
+            "bootstrap entry %s:%s did not resolve; introducer trust "
+            "will never match this entry until restart", host, port,
+        )
+        return (host, int(port))
 
 
 def _pack_any(v: Any) -> bytes:
@@ -145,8 +177,23 @@ class UdpRouter:
         # trust anchor — never from arbitrary swarm members.
         self._rendezvous = rendezvous
         self._bootstrap = list(bootstrap or [])
+        # canonical (ip, port) forms of the bootstrap entries — the set
+        # observed UDP sources are compared against for introducer
+        # trust. Resolved eagerly; start() re-resolves in case DNS
+        # changed between construction and start.
+        self._bootstrap_canon: Set[Tuple[str, int]] = {
+            _canon_addr(h, p) for h, p in self._bootstrap
+        }
         self._announce_ttl = announce_ttl
         self._last_announce = 0.0
+        # discovery diagnostics: a wedged swarm (intros never applied,
+        # claimants never proving) must be visible, not silent
+        self.stats: Dict[str, int] = {
+            "intros_applied": 0,
+            "intros_buffered": 0,
+            "intros_dropped": 0,
+            "intros_refused": 0,
+        }
         # introducer trust is granted ONLY by proven key possession at
         # a configured bootstrap address (nonce challenge/pong, the
         # same machinery that guards address rebinds) — a plaintext
@@ -168,8 +215,26 @@ class UdpRouter:
     def start(self, network_name: Optional[str] = None) -> None:
         self.options.setdefault("network_name", network_name)
         self.started = True
-        for ip, port in self._bootstrap:
-            self.add_peer(ip, port)
+        # EVERY configured bootstrap is dialed (not rotated through):
+        # a dead rendezvous node then costs only its own unanswered
+        # hello, and any live one introduces — the failover the
+        # reference gets from Hyperswarm's multi-node DHT bootstrap
+        self._bootstrap_canon = {
+            _canon_addr(h, p) for h, p in self._bootstrap
+        }
+        # dial the RESOLVED addresses: the native transport sends to
+        # numeric IPs only (a hostname entry would raise at the
+        # socket). Per-entry failures are logged and skipped — one
+        # unresolved/dead entry must not abort dialing the rest, or
+        # multi-bootstrap failover is lost
+        for ip, port in sorted(self._bootstrap_canon):
+            try:
+                self.add_peer(ip, port)
+            except OSError as exc:
+                _log.warning(
+                    "bootstrap %s:%s not dialable (%s); trying others",
+                    ip, port, exc,
+                )
 
     def close(self) -> None:
         self.endpoint.close()
@@ -377,7 +442,10 @@ class UdpRouter:
         # peer presenting from a bootstrap address is challenged there;
         # only the pong (fresh nonce, decrypted under its key, FROM
         # that address) grants it (see the pong branch)
-        if addr in self._bootstrap and pk_hex not in self._rendezvous_pks:
+        if (
+            addr in self._bootstrap_canon
+            and pk_hex not in self._rendezvous_pks
+        ):
             self._challenge_liveness(peer, addr)
         # key exchange is done on both ends; tell THIS peer our topics
         # (announcing to everyone here would be O(N^2) per join wave)
@@ -425,10 +493,11 @@ class UdpRouter:
             # pin a crashed peer in introductions forever, and a
             # negative/NaN one would silently exclude a live member
             # (NaN fails every comparison, so it clamps to 0 -> the
-            # local default applies)
-            cap = 10.0 * self._announce_ttl
-            peer.announce_ttl = ttl if 0.0 < ttl <= cap else (
-                cap if ttl > cap else 0.0
+            # local default applies). The cap is the PROTOCOL constant
+            # _TTL_CAP, not a multiple of the receiver's local refresh
+            # default — asymmetric configs stay live (advisor, round 3)
+            peer.announce_ttl = ttl if 0.0 < ttl <= _TTL_CAP else (
+                _TTL_CAP if ttl > _TTL_CAP else 0.0
             )
             before = set(peer.topics)
             peer.topics = set(payload.get("topics", ()))
@@ -453,12 +522,29 @@ class UdpRouter:
             # claimants bounded by the bootstrap list) and replays on
             # grant.
             if pk_hex not in self._rendezvous_pks:
-                if (
-                    peer.addr in self._bootstrap
-                    and len(self._pending_intros) < 8
-                ):
-                    self._pending_intros[pk_hex] = payload
+                if peer.addr in self._bootstrap_canon:
+                    if len(self._pending_intros) < 8:
+                        if pk_hex not in self._pending_intros:
+                            self.stats["intros_buffered"] += 1
+                        self._pending_intros[pk_hex] = payload
+                    else:
+                        self.stats["intros_dropped"] += 1
+                        _log.warning(
+                            "intro from unproven claimant %s dropped: "
+                            "pending-intro buffer full (%d claimants "
+                            "awaiting liveness proof)",
+                            pk_hex[:8], len(self._pending_intros),
+                        )
+                else:
+                    self.stats["intros_refused"] += 1
+                    _log.debug(
+                        "intro from %s at %s refused: not a configured "
+                        "bootstrap address %s",
+                        pk_hex[:8], peer.addr,
+                        sorted(self._bootstrap_canon),
+                    )
                 return True
+            self.stats["intros_applied"] += 1
             self._apply_intro(payload)
         elif t == "ping":
             # liveness challenge: echo the nonce (proving this address
@@ -480,13 +566,14 @@ class UdpRouter:
             ):
                 del self._rebind_nonce[pk_hex]
                 peer.addr = addr  # proven: reroute to the new address
-                if addr in self._bootstrap:
+                if addr in self._bootstrap_canon:
                     # key possession proven AT a bootstrap address:
                     # grant introducer trust and replay any intro that
                     # arrived while the proof was in flight
                     self._rendezvous_pks.add(pk_hex)
                     held = self._pending_intros.pop(pk_hex, None)
                     if held is not None:
+                        self.stats["intros_applied"] += 1
                         self._apply_intro(held)
                 live_inst = payload.get("inst", peer.inst)
                 if live_inst != peer.inst:

@@ -64,6 +64,14 @@ from crdt_tpu.ops.device import _CLOCK_BITS  # pack_id's clock width
 
 _SEQ_FLAG = 1 << 30          # bit in the seg column marking sequence rows
 
+# floor of _stage_rights' per-SEGMENT origin-chain walk budget (the
+# real budget is linear in the segment's row count): exhaustion marks
+# the segment hard (exact scalar fallback) instead of letting hostile
+# updates buy O(n^2) staging time, while benign long chains — whose
+# total walk work stays linear-ish in segment size — keep the staged
+# device path
+_RIGHT_WALK_CAP = 1024
+
 
 class PackedPlan(NamedTuple):
     """Host-side staging result: one matrix + static metadata.
@@ -170,6 +178,12 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
                 break
         # groups within the segment, keyed by in-union origin row
         groups: Dict[int, list] = {}
+        # shared walk budget for ALL of this segment's out-of-group
+        # right walks: linear in segment size (hostile staging cost
+        # stays O(n) total — advisor finding, round 3), generous for
+        # benign shapes; exhaustion marks the segment hard, which the
+        # exact scalar fallback absorbs
+        walk_budget = max(_RIGHT_WALK_CAP, 8 * len(mlist))
         if not hard:
             for row in mlist:
                 groups.setdefault(int(origin_row[row]), []).append(row)
@@ -186,12 +200,16 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
                         continue  # in-group anchor: simulated below
                     # out-of-group right: hard if its origin chain
                     # passes through a GROUP member (the scan would
-                    # stop inside that member's subtree)
-                    cur, steps = rt, 0
-                    while cur >= 0 and steps <= n:
-                        steps += 1
+                    # stop inside that member's subtree). Walks draw on
+                    # the segment's shared linear budget (see above)
+                    cur = rt
+                    while cur >= 0:
                         if cur in grow_set:
                             hard = True
+                            break
+                        walk_budget -= 1
+                        if walk_budget < 0:
+                            hard = True  # budget spent: exact fallback
                             break
                         cur = int(origin_row[cur])
                     if hard:
@@ -606,6 +624,14 @@ def _converge_core(client, clock, pref, kid, oc, ock, valid, *,
         client_bits=23, qbits=int(max(n - 1, 1)).bit_length(),
     )
     return jnp.concatenate([win_rows, stream_seg, stream_row])
+
+
+def segkey_int(pref: int, kid: int) -> int:
+    """Scalar-Python :func:`segkey_of` for per-op hot paths (the
+    resident doc's local ops): no numpy temporaries, same key."""
+    if kid >= 0:
+        return ((pref << _KID_BITS) | kid) | (1 << 62)
+    return pref << _KID_BITS
 
 
 def segkey_of(pref, kid):

@@ -32,7 +32,8 @@ class TestIncrementalRounds:
                 for c in (1, 2) for j in range(4)
             ]
             blobs.append(_blob(recs))
-            cache = inc.apply(blobs[-1])
+            inc.apply(blobs[-1])
+            cache = inc.cache
             assert cache == replay_trace(blobs).cache, f"round {rnd}"
 
     def test_sequence_append_rounds(self):
@@ -49,7 +50,8 @@ class TestIncrementalRounds:
                         content=(c, k)))
                     prev[c] = k
             blobs.append(_blob(recs))
-            cache = inc.apply(blobs[-1])
+            inc.apply(blobs[-1])
+            cache = inc.cache
             assert cache == replay_trace(blobs).cache, f"round {rnd}"
 
     def test_mixed_with_deletes_and_redelivery(self):
@@ -95,7 +97,8 @@ class TestIncrementalRounds:
                                origin=(1, j % 3), content=(c, j))
                     for j in range(4)]
             blobs.append(_blob(recs))
-            cache = inc.apply(blobs[-1])
+            inc.apply(blobs[-1])
+            cache = inc.cache
             assert cache == replay_trace(blobs).cache, f"round {rnd}"
 
     def test_right_bearing_rounds(self):
@@ -113,7 +116,8 @@ class TestIncrementalRounds:
                     ItemRecord(client=c, clock=1, parent_root="t",
                                origin=(c, 0), right=(1, 2), content=(c, 1))]
             blobs.append(_blob(recs))
-            cache = inc.apply(blobs[-1])
+            inc.apply(blobs[-1])
+            cache = inc.cache
             assert cache == replay_trace(blobs).cache, f"round {rnd}"
 
     def test_nested_collections(self):
@@ -134,7 +138,8 @@ class TestIncrementalRounds:
         recs = [ItemRecord(client=2, clock=0, parent_item=(1, 0),
                            origin=(1, 1), content="b")]
         blobs.append(_blob(recs))
-        cache = inc.apply(blobs[-1])
+        inc.apply(blobs[-1])
+        cache = inc.cache
         assert cache == replay_trace(blobs).cache
         assert cache["root"]["list"] == ["a", "b"]
 
@@ -154,7 +159,8 @@ class TestIncrementalRounds:
                               key="sub", kind=K_TYPE, type_ref=TYPE_MAP)]),
         ]
         inc.apply(blobs[0])
-        cache = inc.apply(blobs[1])
+        inc.apply(blobs[1])
+        cache = inc.cache
         assert cache == replay_trace(blobs).cache
         assert cache["r"]["sub"] == {"a": 5}
 
@@ -172,7 +178,8 @@ class TestIncrementalRounds:
                         content=k))
                     prev[c] = k
             blobs.append(_blob(recs))
-            cache = inc.apply(blobs[-1])
+            inc.apply(blobs[-1])
+            cache = inc.cache
             assert cache == replay_trace(blobs).cache, f"round {rnd}"
 
     def test_late_small_client_relabel(self):
@@ -187,7 +194,8 @@ class TestIncrementalRounds:
         recs = [ItemRecord(client=7, clock=0, parent_root="m", key="k",
                            content="small")]
         blobs.append(_blob(recs))
-        cache = inc.apply(blobs[-1])
+        inc.apply(blobs[-1])
+        cache = inc.cache
         assert cache == replay_trace(blobs).cache
         assert cache["m"]["k"] == "big"  # client 50 still wins
 
@@ -203,7 +211,8 @@ class TestIncrementalRounds:
             ItemRecord(client=2, clock=0, parent_item=(1, 0), key="b",
                        kind=K_TYPE, type_ref=TYPE_MAP),
         ])
-        cache = inc.apply(blob)
+        inc.apply(blob)
+        cache = inc.cache
         assert cache == replay_trace([blob]).cache
 
     def test_redelivered_deletes_do_not_grow(self):
@@ -231,7 +240,8 @@ class TestIncrementalRounds:
         ds = DeleteSet()
         ds.add(1, 0, 45)  # one compacted range -> vectorized scan path
         b2 = _blob([], ds)
-        cache = inc.apply(b2)
+        inc.apply(b2)
+        cache = inc.cache
         assert cache == replay_trace([b1, b2]).cache
 
     def test_out_of_order_delivery_pends_like_engine(self):
