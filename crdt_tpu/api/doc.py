@@ -184,15 +184,12 @@ class Crdt(DocOpsMixin):
         self.observer_function = observer_function
         self.on_update = on_update
         self.full_state_updates = full_state_updates
-        if device_merge is None:
-            # CRDT_TPU_DEVICE=1 routes every remote merge through the
-            # TPU kernels (the device-side applyUpdate of crdt.js:294)
-            import os
-
-            device_merge = os.environ.get("CRDT_TPU_DEVICE", "0") not in (
-                "", "0", "false", "False",
-            )
-        self.device_merge = device_merge
+        # CRDT_TPU_DEVICE is a PRODUCT-level knob consumed by the
+        # replica layer, where it selects merge_mode="resident"
+        # (net/replica.py; VERDICT r3 item 4). The standalone Crdt
+        # keeps the engine device gate strictly explicit — one env
+        # var must not mean different things at different layers.
+        self.device_merge = bool(device_merge)
         self._c: Dict[str, Any] = {}
         self._batched: List[Callable[[], Any]] = []
         self._observers: List[_Observer] = []
@@ -588,8 +585,8 @@ class Crdt(DocOpsMixin):
         This is the buffering gate of the north star: a sync backlog,
         a persistence log replay, or a gossip round's worth of updates
         decodes into one record union and pays one integration pass —
-        and in device mode (``CRDT_TPU_DEVICE=1`` or
-        ``device_merge=True``) that pass runs on the TPU kernels
+        and in device mode (``device_merge=True``) that pass runs on
+        the TPU kernels
         (admit on host, chain rebuild via converge_maps +
         tree_order_ranks; see crdt_tpu.core.device_apply), replacing
         the reference's per-update scalar loop (crdt.js:294).

@@ -205,6 +205,13 @@ class Decoder:
             shift += 7
             if shift > 70:
                 raise ValueError("varInt too long")
+        # int64-representability bound, shared with the native codec:
+        # magnitudes in [2^63, 2^64) wrap negative through its int64
+        # cast, so a python-decoding and a native-decoding replica
+        # would silently diverge on the same blob (honest lib0 writers
+        # emit JS safe integers, < 2^53)
+        if n >= (1 << 63):
+            raise ValueError("varInt magnitude exceeds int64")
         return sign * n
 
     def read_var_string(self) -> str:

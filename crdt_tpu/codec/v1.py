@@ -56,6 +56,22 @@ from crdt_tpu.core.store import (
 )
 
 # wire content refs
+# Wire sanity bound shared with the kernels' 40-bit clock packing
+# (ops/device pack_id): any struct clock, run end, origin clock, or
+# delete-range end at or beyond this is hostile — honest clocks count
+# ops actually created. Bounding here keeps run expansion and every
+# downstream clock computation finite (adversarial matrix,
+# tests/test_yjs_fixtures.py).
+_MAX_CLOCK = 1 << 40
+
+# client-id fields get a looser bound: honest Yjs clients are random
+# 32-bit ints; anything at or beyond 2^62 is hostile, and values in
+# [2^63, 2^64) would wrap negative through an int64 cast in the native
+# codec — 2^64-1 would even collide with its -1 "absent" sentinel.
+# Both codecs reject the whole band so a hostile blob cannot make a
+# python-decoding replica and a native-decoding replica disagree.
+_MAX_ID = 1 << 62
+
 REF_GC = 0
 REF_DELETED = 1
 REF_JSON = 2
@@ -125,6 +141,29 @@ def decode_state_vector(data: bytes) -> StateVector:
 
 
 # ---------------------------------------------------------------------------
+# bounded wire reads (shared rejection semantics with the native
+# codec's Reader::field — see _MAX_ID / _MAX_CLOCK)
+# ---------------------------------------------------------------------------
+
+def _read_client_id(d: Decoder) -> int:
+    v = d.read_var_uint()
+    if v >= _MAX_ID:
+        raise ValueError("client id exceeds wire bound")
+    return v
+
+
+def _read_clock_val(d: Decoder) -> int:
+    v = d.read_var_uint()
+    if v >= _MAX_CLOCK:
+        raise ValueError("clock exceeds wire bound")
+    return v
+
+
+def _read_id(d: Decoder) -> tuple:
+    return (_read_client_id(d), _read_clock_val(d))
+
+
+# ---------------------------------------------------------------------------
 # delete set
 # ---------------------------------------------------------------------------
 
@@ -148,10 +187,12 @@ def _write_delete_set(e: Encoder, ds: Optional[DeleteSet]) -> None:
 def _read_delete_set(d: Decoder) -> DeleteSet:
     ds = DeleteSet()
     for _ in range(d.read_var_uint()):
-        client = d.read_var_uint()
+        client = _read_client_id(d)
         for _ in range(d.read_var_uint()):
             clock = d.read_var_uint()
             length = d.read_var_uint()
+            if clock + length >= _MAX_CLOCK:
+                raise ValueError("delete range exceeds wire clock bound")
             if length:
                 ds.add(client, clock, length)
     ds.normalize()
@@ -358,19 +399,30 @@ def _split_units(
 def decode_update(data: bytes) -> Tuple[List[ItemRecord], DeleteSet]:
     d = Decoder(data)
     records: List[ItemRecord] = []
+    # expansion budget: GC/Deleted runs decode to unit records, so a
+    # few declared bytes must never buy unbounded allocation. Honest
+    # compacted histories stay far under 4096 units per blob byte;
+    # hostile declarations fail fast instead of hanging the decoder.
+    budget = max(1 << 20, 4096 * len(data))
     num_clients = d.read_var_uint()
     for _ in range(num_clients):
         num_structs = d.read_var_uint()
-        client = d.read_var_uint()
-        clock = d.read_var_uint()
+        client = _read_client_id(d)
+        clock = _read_clock_val(d)
         for _ in range(num_structs):
             info = d.read_uint8()
             ref = info & 0x1F
             if ref == REF_SKIP:
                 clock += d.read_var_uint()
+                if clock >= _MAX_CLOCK:
+                    raise ValueError("skip run exceeds wire clock bound")
                 continue
             if ref == REF_GC:
                 length = d.read_var_uint()
+                if clock + length >= _MAX_CLOCK:
+                    raise ValueError("gc run exceeds wire clock bound")
+                if len(records) + length > budget:
+                    raise ValueError("expansion budget exceeded")
                 records.extend(
                     _split_units(
                         client,
@@ -392,14 +444,14 @@ def decode_update(data: bytes) -> Tuple[List[ItemRecord], DeleteSet]:
             parent_item = None
             key = None
             if info & 0x80:
-                origin = (d.read_var_uint(), d.read_var_uint())
+                origin = _read_id(d)
             if info & 0x40:
-                right = (d.read_var_uint(), d.read_var_uint())
+                right = _read_id(d)
             if not (info & 0xC0):
                 if d.read_var_uint() == 1:
                     parent_root = d.read_var_string()
                 else:
-                    parent_item = (d.read_var_uint(), d.read_var_uint())
+                    parent_item = _read_id(d)
                 if info & 0x20:
                     key = d.read_var_string()
             common = dict(
@@ -411,6 +463,10 @@ def decode_update(data: bytes) -> Tuple[List[ItemRecord], DeleteSet]:
             )
             if ref == REF_DELETED:
                 length = d.read_var_uint()
+                if clock + length >= _MAX_CLOCK:
+                    raise ValueError("deleted run exceeds wire clock bound")
+                if len(records) + length > budget:
+                    raise ValueError("expansion budget exceeded")
                 recs = _split_units(
                     client, clock, kind=K_DELETED, length=length, **common
                 )
@@ -452,6 +508,8 @@ def decode_update(data: bytes) -> Tuple[List[ItemRecord], DeleteSet]:
                 )
             elif ref == REF_TYPE:
                 tref = d.read_var_uint()
+                if tref >= (1 << 31):
+                    raise ValueError("type ref exceeds wire bound")
                 recs = _split_units(
                     client, clock, kind=K_TYPE, type_ref=tref, length=1, **common
                 )

@@ -382,26 +382,32 @@ class Engine:
         self.last_txn_deletes = DeleteSet()
 
     def _apply_delete_set(self, ds: DeleteSet) -> None:
-        for client, clock, length in ds.iter_all():
-            for k in range(clock, clock + length):
-                row = self.store.find(client, k)
-                if row is None:
-                    self.pending_deletes.add(client, k)
-                else:
-                    self._delete_row(row)
+        self._clamped_delete(ds, self.pending_deletes)
 
     def _retry_pending_deletes(self) -> None:
         if not self.pending_deletes.ranges:
             return
-        remaining = DeleteSet()
-        for client, clock, length in self.pending_deletes.iter_all():
-            for k in range(clock, clock + length):
+        pending, self.pending_deletes = self.pending_deletes, DeleteSet()
+        self._clamped_delete(pending, self.pending_deletes)
+
+    def _clamped_delete(self, ds: DeleteSet, pend_into: DeleteSet) -> None:
+        """Delete every range's integrated clocks; the portion at or
+        above the client's contiguity watermark pends as a RANGE, not
+        per clock — a hostile (or merely early) range covering clocks
+        that may never exist must cost O(ranges), never O(declared
+        length) (adversarial matrix, tests/test_yjs_fixtures.py)."""
+        for client, clock, length in ds.iter_all():
+            end = clock + length
+            wm = self._next_clock.get(client, 0)
+            for k in range(clock, min(end, wm)):
                 row = self.store.find(client, k)
                 if row is None:
-                    remaining.add(client, k)
+                    pend_into.add(client, k)
                 else:
                     self._delete_row(row)
-        self.pending_deletes = remaining
+            if end > wm:
+                tail = max(clock, wm)
+                pend_into.add(client, tail, end - tail)
 
     def _try_integrate(self, rec: ItemRecord) -> bool:
         handled, row = self._try_admit(rec)

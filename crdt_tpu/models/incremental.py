@@ -158,8 +158,67 @@ class IncrementalReplay:
     through the tunnelled single chip a device round costs ~0.1-0.3s
     of fixed interaction latency regardless of size, so small deltas —
     a collaborator's keystrokes, a replica's own ops — are host-won;
-    firehose rounds and cold gaps go to the device. BENCH_r0N.json's
-    ``rounds`` table publishes the measured crossover."""
+    firehose rounds and cold gaps go to the device. The default
+    (``device_min_rows=None``) AUTO-CALIBRATES per session: one
+    dispatch-latency probe on the first device-eligible round feeds
+    the cost model in :meth:`_calibrate` (the tunnel's weather moves
+    2-4x between sessions, so no static number is ever right — VERDICT
+    r3 item 2). ``CRDT_TPU_DEVICE_MIN`` or the constructor argument
+    pin it explicitly; BENCH_r0N.json's ``rounds`` table publishes
+    both the measured crossover and the session's calibration."""
+
+    # process-wide host/device crossover calibration (one probe per
+    # session — the tunnel's per-dispatch latency moves 2-4x between
+    # sessions, so any static default is wrong somewhere; VERDICT r3
+    # item 2). Filled lazily by _calibrate().
+    _calib: Dict[str, Optional[float]] = {
+        "t_interact_ms": None, "threshold": None,
+    }
+    # measured per-row costs behind the threshold model (host: the
+    # incremental admit+integrate python path; device: upload + select
+    # + kernel share per selected row). See BENCH rounds table.
+    _HOST_US_PER_ROW = 3.0
+    _DEV_US_PER_ROW = 1.0
+
+    @classmethod
+    def _calibrate(cls) -> Dict[str, Optional[float]]:
+        """One-time session probe: median single-shot dispatch latency
+        -> the row count where a 3-interaction device round beats the
+        host path's per-row cost. Floored at 4096 so a fast local
+        backend never routes keystroke rounds to a compile."""
+        if cls._calib["threshold"] is None:
+            import time as _t
+
+            import jax
+            import jax.numpy as jnp
+
+            f = jax.jit(lambda v: v + 1)
+            x = jnp.arange(128)
+            jax.block_until_ready(f(x))  # compile, and flip lazy mode
+            import numpy as _np
+
+            _np.asarray(f(x))  # force sync execution mode (axon trap)
+            lat = []
+            for _ in range(3):
+                t0 = _t.perf_counter()
+                jax.block_until_ready(f(x))
+                lat.append(_t.perf_counter() - t0)
+            t_i = sorted(lat)[1]
+            per_row_us = max(
+                cls._HOST_US_PER_ROW - cls._DEV_US_PER_ROW, 0.5
+            )
+            cls._calib = {
+                "t_interact_ms": round(t_i * 1e3, 2),
+                "threshold": max(4096, int(3 * t_i * 1e9 / per_row_us
+                                           / 1e3)),
+            }
+        return cls._calib
+
+    @classmethod
+    def calibration_info(cls) -> Dict[str, Optional[float]]:
+        """The session's measured crossover (probing if needed) — the
+        bench records this next to the crossover table it implies."""
+        return dict(cls._calibrate())
 
     def __init__(self, capacity: int = 1 << 14,
                  device_min_rows: Optional[int] = None):
@@ -170,9 +229,11 @@ class IncrementalReplay:
         if device_min_rows is None:
             import os
 
-            device_min_rows = int(
-                os.environ.get("CRDT_TPU_DEVICE_MIN", 4096)
-            )
+            env = os.environ.get("CRDT_TPU_DEVICE_MIN")
+            # None = AUTO: calibrate on the first device-eligible
+            # round (never at construction — replicas must come up
+            # without touching the device)
+            device_min_rows = int(env) if env else None
         self.device_min_rows = device_min_rows
         self.cols = _Cols()
         self.ds = DeleteSet()
@@ -219,15 +280,12 @@ class IncrementalReplay:
         # has a gap, or whose origin/right has not arrived, stash here
         # (columns + content keyed by id) and retry on every apply
         self._pending: Dict[Tuple[int, int], Tuple] = {}
-        # expanded tombstone ids, appended per batch (visibility tests
-        # must not re-expand the whole accumulated DeleteSet per round).
-        # Local single-id deletes buffer in plain lists and consolidate
-        # lazily — per-keystroke np.concatenate over the whole history
-        # would make backspace O(total deletes ever) (review, round 4)
-        self._del_c = np.empty(0, np.int64)
-        self._del_k = np.empty(0, np.int64)
-        self._del_buf_c: List[int] = []
-        self._del_buf_k: List[int] = []
+        # packed delete-RANGE cache over self.ds (client, start, end
+        # arrays for rows_visible) — tombstones are never expanded to
+        # per-clock ids: a few delete-set bytes can declare ranges
+        # covering billions of clocks (adversarial matrix). Invalidated
+        # on every ds mutation, rebuilt O(ranges) on demand.
+        self._ds_pack = None
         # per-apply scratch: segkey -> this batch's admitted rows
         self._new_by_seg: Dict[int, List[int]] = {}
         with jax.enable_x64(True):
@@ -310,31 +368,40 @@ class IncrementalReplay:
         if len(trips):
             from crdt_tpu.models.replay import rows_visible
 
-            exp_c = np.repeat(trips[:, 0], trips[:, 2]).astype(np.int64)
-            exp_k = np.concatenate([
-                np.arange(s, s + length) for _, s, length in trips
-            ]).astype(np.int64)
-            # drop ids already recorded (rows_visible == True means
-            # "not in the recorded set")
-            del_c, del_k = self._del_arrays()
-            new_m = rows_visible(exp_c, exp_k, del_c, del_k)
-            exp_c, exp_k = exp_c[new_m], exp_k[new_m]
-            self._del_c = np.concatenate([self._del_c, exp_c])
-            self._del_k = np.concatenate([self._del_k, exp_k])
             for c, k, length in trips:
                 self.ds.add(int(c), int(k), int(length))
-            if len(exp_c) * 4 > self.cols.n:
+            self._ds_pack = None
+            # touched segments: resident rows the batch's ranges cover.
+            # Ranges coalesce first (disjointness is rows_visible's
+            # contract) and clamp at each client's admitted watermark —
+            # rows cannot exist beyond it, so a hostile range covering
+            # clocks that may never exist costs O(ranges), not
+            # O(declared length); late rows check visibility against
+            # the range set at admission
+            batch_ds = DeleteSet()
+            for c, k, length in trips:
+                batch_ds.add(int(c), int(k), int(length))
+            spans = []
+            for c, s, length in batch_ds.iter_all():
+                end = min(s + length, self._next_clock.get(c, 0))
+                if end > s:
+                    spans.append((c, s, end))
+            total = sum(e - s for _, s, e in spans)
+            if spans and total * 4 > self.cols.n and self.cols.n:
                 # bulk range: one vectorized scan over the id columns
                 hit = ~rows_visible(
                     self.cols.col("client"), self.cols.col("clock"),
-                    exp_c, exp_k,
+                    np.asarray([c for c, _, _ in spans], np.int64),
+                    np.asarray([s for _, s, _ in spans], np.int64),
+                    np.asarray([e for _, _, e in spans], np.int64),
                 )
                 rows_hit = np.flatnonzero(hit)
             else:
                 rows_hit = [
                     r for r in (
-                        self._id_row.get((int(c), int(k)))
-                        for c, k in zip(exp_c, exp_k)
+                        self._id_row.get((c, kk))
+                        for c, s, e in spans
+                        for kk in range(s, e)
                     ) if r is not None
                 ]
             for row in rows_hit:
@@ -389,20 +456,15 @@ class IncrementalReplay:
         # the expanded arrays — the redelivery dedup scan of apply() is
         # unnecessary here.
         if ds is not None and ds.ranges:
-            exp_c: List[int] = []
-            exp_k: List[int] = []
             for c, k, length in ds.iter_all():
                 self.ds.add(c, k, length)
                 for kk in range(k, k + length):
-                    exp_c.append(c)
-                    exp_k.append(kk)
                     row = self._id_row.get((c, kk))
                     if row is not None:
                         sk = self._row_segkey(row)
                         if sk is not None:
                             touched.add(sk)
-            self._del_buf_c.extend(exp_c)
-            self._del_buf_k.extend(exp_k)
+            self._ds_pack = None
 
         runs: Dict[int, List[int]] = {}  # segkey -> rows, op order
         for rec in recs:
@@ -1198,11 +1260,20 @@ class IncrementalReplay:
         # round splices the whole unspliced tail (n_dev marks the
         # boundary: admission appends rows in order, so host row ids
         # and device positions stay identical)
-        if dev_segs and sum(
-            len(self._seg_rows[sk]) for sk in dev_segs
-        ) < self.device_min_rows:
-            host_segs.extend(dev_segs)
-            dev_segs = []
+        if dev_segs:
+            n_sel = sum(len(self._seg_rows[sk]) for sk in dev_segs)
+            thr = self.device_min_rows
+            if thr is None:
+                # AUTO: a static floor spares keystroke rounds the
+                # probe; beyond it the session-calibrated threshold
+                # decides (VERDICT r3 item 2)
+                thr = (
+                    16384 if n_sel < 16384
+                    else self._calibrate()["threshold"]
+                )
+            if n_sel < thr:
+                host_segs.extend(dev_segs)
+                dev_segs = []
 
         if dev_segs:
             # stage the UNSPLICED TAIL (this batch + any rows host
@@ -1661,18 +1732,17 @@ class IncrementalReplay:
                     [] if self.cols.contents[row] == "array" else {}
                 )
 
-    def _del_arrays(self):
-        """The expanded tombstone id columns, with any buffered local
-        deletions consolidated in."""
-        if self._del_buf_c:
-            self._del_c = np.concatenate(
-                [self._del_c, np.asarray(self._del_buf_c, np.int64)]
+    def _ds_ranges(self):
+        """Packed (client, start, end) arrays over the accumulated
+        delete set — O(ranges), rebuilt only after a ds mutation."""
+        if self._ds_pack is None:
+            trip = list(self.ds.iter_all())
+            self._ds_pack = (
+                np.asarray([c for c, _, _ in trip], np.int64),
+                np.asarray([s for _, s, _ in trip], np.int64),
+                np.asarray([s + n for _, s, n in trip], np.int64),
             )
-            self._del_k = np.concatenate(
-                [self._del_k, np.asarray(self._del_buf_k, np.int64)]
-            )
-            self._del_buf_c, self._del_buf_k = [], []
-        return self._del_c, self._del_k
+        return self._ds_pack
 
     def _visible(self, rows: List[int]) -> List[bool]:
         if not rows:
@@ -1680,12 +1750,13 @@ class IncrementalReplay:
         from crdt_tpu.models.replay import rows_visible
 
         idx = np.asarray(rows)
-        del_c, del_k = self._del_arrays()
+        del_c, del_s, del_e = self._ds_ranges()
         return list(rows_visible(
             self.cols.col("client")[idx],
             self.cols.col("clock")[idx],
             del_c,
-            del_k,
+            del_s,
+            del_e,
         ))
 
     def _build_collection_root(self, root: str):

@@ -138,7 +138,26 @@ class Replica:
         #                "cache rebuilt from HBM")
         merge_mode_explicit = merge_mode is not None
         if merge_mode is None:
-            merge_mode = "device" if device_merge else "scalar"
+            if device_merge:
+                merge_mode = "device"
+            else:
+                # CRDT_TPU_DEVICE=1 selects RESIDENT, the device-
+                # resident product mode: the engine-backed device gate
+                # pays a tunnel round-trip per small merge and lost to
+                # both other modes at interactive scale in BENCH_r03's
+                # swarm run (VERDICT r3 item 4). merge_mode="device"
+                # stays available explicitly as a differential oracle.
+                import os
+
+                env = os.environ.get("CRDT_TPU_DEVICE", "0") not in (
+                    "", "0", "false", "False",
+                )
+                # an explicit device_merge=False still means scalar
+                # even with the env var set (same precedence Crdt uses)
+                merge_mode = (
+                    "resident" if env and device_merge is None
+                    else "scalar"
+                )
         if merge_mode not in ("scalar", "device", "resident"):
             raise ValueError(f"unknown merge_mode {merge_mode!r}")
         self.merge_mode = merge_mode

@@ -72,6 +72,12 @@ _SEQ_FLAG = 1 << 30          # bit in the seg column marking sequence rows
 # device path
 _RIGHT_WALK_CAP = 1024
 
+# row count above which eager per-row device shipping (stage(put=...))
+# beats one matrix put: below it the extra per-put fixed latencies
+# outweigh any staging/transfer overlap. One constant so the bench
+# and the product replay always measure the same pipeline shape.
+EAGER_PUT_MIN_ROWS = 1 << 19
+
 
 class PackedPlan(NamedTuple):
     """Host-side staging result: one matrix + static metadata.
@@ -87,12 +93,14 @@ class PackedPlan(NamedTuple):
     time and drop the matrix from 7 to 5 rows (one int32 transfer).
     """
 
-    mat: np.ndarray           # [5, kpad] int32, rows in id-sorted order:
+    mat: Optional[np.ndarray]  # [5, kpad] int32, rows in id-sorted order:
                               #   0: dense client rank
                               #   1: dense segment id | _SEQ_FLAG (-1 dead)
                               #   2: origin row (map rows; -1 root)
                               #   3: compact block - seq row ids (-1 pad)
                               #   4: compact block - compact parent (-1 root)
+                              # None when rows were shipped eagerly via
+                              # ``stage(put=...)`` — see ``dev``
     n: int                    # real rows (rest is padding)
     num_segments: int         # size bucket over distinct segments
     seq_bucket: int           # size bucket over sequence-row count
@@ -104,6 +112,11 @@ class PackedPlan(NamedTuple):
     map_rounds: int           # doubling rounds bound (map chains)
     hard_rows: tuple = ()     # caller-space rows marking segments the
                               # scalar fallback must re-order (gather)
+    dev: tuple = ()           # device refs (r0, r1, r2, r34) when rows
+                              # were shipped eagerly during staging:
+                              # r0/r1/r2 are [kpad], r34 is [2, B] (the
+                              # compact sequence block never needs the
+                              # full row width on the wire)
 
 
 def _even_up(x: int) -> int:
@@ -243,7 +256,8 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
     return client_s, hard_reps, max_rank
 
 
-def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
+def stage(cols: Dict[str, np.ndarray],
+          put=None) -> Optional[PackedPlan]:
     """Pack kernel columns into the single-transfer matrix.
 
     Returns None when the batch exceeds the packed path's bounds
@@ -251,6 +265,15 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     parents, >=2^21 distinct map keys, clocks >= 2^40 (the shared
     ``pack_id`` bound), >=2^30 segments, or composite sibling keys
     that do not fit an int64 at this row count.
+
+    ``put`` (e.g. ``jax.device_put``) switches staging to EAGER row
+    shipping: each packed row starts its (async) host->device transfer
+    the moment its layout pass finishes, so the upload overlaps the
+    remaining staging work instead of serializing after it — on the
+    tunnelled platform that hides most of one of the two costs. The
+    compact sequence block also ships at its own bucket width (B, not
+    kpad), cutting the transfer by up to a third. The plan then has
+    ``mat=None`` and device refs in ``dev``.
     """
     client = np.asarray(cols["client"], np.int64)
     clock = np.asarray(cols["clock"], np.int64)
@@ -333,6 +356,15 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     max_map = int(seg_counts[map_seg].max()) if map_seg.any() else 1
     max_seq = int(seg_counts[~map_seg].max()) if (~map_seg).any() else 1
 
+    # size buckets early: eager shipping needs the padded widths now
+    kpad = bucket_grid(n, floor=6)
+    Sb = bucket_grid(max(n_segs, 1), floor=6)
+    r1 = np.full(kpad, -1, np.int32)
+    r1[:n] = np.where(
+        seg >= 0, seg | np.where(kid_s < 0, _SEQ_FLAG, 0), -1
+    )
+    d1 = put(r1) if put is not None else None
+
     # origin rows by binary search over the sorted ids (leftmost match
     # is the kept representative of any duplicate run)
     okey = np.where(
@@ -345,6 +377,10 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     )
     is_map_row = uniq_valid & (kid_s >= 0)
     origin_map = np.where(is_map_row, origin_row, -1)
+    if put is not None:
+        r2 = np.full(kpad, -1, np.int32)
+        r2[:n] = origin_map
+        d2 = put(r2)
 
     # compact sequence block: seq rows ascending (= id rank ascending),
     # same-segment origins resolved to compact positions
@@ -361,6 +397,12 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
         )
     else:
         c_parent = np.empty(0, np.int64)
+    B = min(kpad, bucket_grid(max(n_seq, 1), floor=6))
+    if put is not None:
+        r34 = np.full((2, B), -1, np.int32)
+        r34[0, :n_seq] = seq_rows
+        r34[1, :n_seq] = c_parent
+        d34 = put(r34)
 
     # right-origin attachment ordering (mid-inserts/prepends): groups
     # with in-group anchors get their exact conflict-scan ranks
@@ -377,34 +419,36 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
             clock[order],
         )
 
-    # size buckets + static key widths (the client field must also
-    # hold the largest simulated group rank)
+    # static key widths (the client field must also hold the largest
+    # simulated group rank)
     cbits = _even_up(max(
         8, len(uniq).bit_length(), (max_rank + 1).bit_length()
     ))
-    kpad = bucket_grid(n, floor=6)
     qbits = (kpad - 1).bit_length()
-    B = min(kpad, bucket_grid(max(n_seq, 1), floor=6))
-    Sb = bucket_grid(max(n_segs, 1), floor=6)
     if max(kpad, B) + Sb >= (1 << 31) - 1:
         return None
     pbits = int(max(kpad, B) + Sb + 1).bit_length()
     if pbits + cbits + qbits > 63:
         return None
 
-    mat = np.full((5, kpad), -1, np.int32)
-    mat[0, :] = 0
-    mat[0, :n] = client_s
-    mat[1, :n] = np.where(
-        seg >= 0,
-        seg | np.where(kid_s < 0, _SEQ_FLAG, 0),
-        -1,
-    )
-    mat[2, :n] = origin_map
-    mat[3, :n_seq] = seq_rows
-    mat[4, :n_seq] = c_parent
+    if put is not None:
+        r0 = np.zeros(kpad, np.int32)
+        r0[:n] = client_s
+        d0 = put(r0)
+        mat = None
+        dev = (d0, d1, d2, d34)
+    else:
+        mat = np.full((5, kpad), -1, np.int32)
+        mat[0, :] = 0
+        mat[0, :n] = client_s
+        mat[1, :] = r1
+        mat[2, :n] = origin_map
+        mat[3, :n_seq] = seq_rows
+        mat[4, :n_seq] = c_parent
+        dev = ()
     return PackedPlan(
         mat=mat,
+        dev=dev,
         n=n,
         num_segments=Sb,
         seq_bucket=B,
@@ -417,47 +461,42 @@ def stage(cols: Dict[str, np.ndarray]) -> Optional[PackedPlan]:
     )
 
 
-@partial(
-    jax.jit,
-    static_argnames=("num_segments", "seq_bucket", "rank_rounds",
-                     "map_rounds", "client_bits"),
-)
-def _converge_packed(mat, num_segments: int, seq_bucket: int,
-                     rank_rounds: int, map_rounds: int,
-                     client_bits: int):
-    """The single fused dispatch over the STAGED matrix (rows already
-    id-sorted, deduped, origin-resolved, segment-numbered — see
-    :class:`PackedPlan`). Returns one packed int32 array:
+def _converge_packed_body(client, segf, origin_map, sub, cp,
+                          num_segments: int, seq_bucket: int,
+                          rank_rounds: int, map_rounds: int,
+                          client_bits: int):
+    """The fused convergence over STAGED rows (id-sorted, deduped,
+    origin-resolved, segment-numbered — see :class:`PackedPlan`).
+    Returns one packed int32 array:
 
-      [ win_rows[S] | stream_seg[B] | stream_row[B] ]
+      [ win_rows[S] | seg_counts[S] | stream_row[B] ]
 
     - win_rows: id-sorted row index of each map segment's winner (-1
       for non-map / empty segments; the host maps back through
       ``plan.order``);
-    - stream_seg/stream_row: sequence rows in document order, grouped
-      by segment id (B = seq_bucket; -1 padding at the tail).
+    - seg_counts: ranked-row count per segment — the host rebuilds the
+      per-segment stream boundaries from these instead of fetching a
+      B-wide segment column (one third less result transfer);
+    - stream_row: sequence rows in document order, grouped by segment
+      id ascending (B = seq_bucket; -1 padding at the tail).
     """
-    n = mat.shape[1]
-    client = mat[0]
-    segf = mat[1]
+    n = client.shape[0]
     live = segf >= 0
     seg = jnp.where(live, segf & (_SEQ_FLAG - 1), NULLI)
     is_map = live & ((segf & _SEQ_FLAG) == 0)
     seg_map = jnp.where(is_map, seg, NULLI)
 
     winners = map_winners(
-        seg_map, client, None, mat[2], is_map, num_segments,
+        seg_map, client, None, origin_map, is_map, num_segments,
         rows_id_ranked=True, chain_rounds=map_rounds,
         client_bits=client_bits,
     )
     win_rows = winners.astype(jnp.int32)
 
     B = seq_bucket
-    sub = mat[3, :B]
     c_ok = sub >= 0
     subc = jnp.clip(sub, 0, n - 1)
     c_seg = jnp.where(c_ok, seg[subc], NULLI)
-    cp = mat[4, :B]
     parent = jnp.where(c_ok & (cp >= 0), cp, B + jnp.maximum(c_seg, 0))
     parent = jnp.where(c_ok, parent, B + num_segments).astype(jnp.int32)
     c_client = client[subc]
@@ -468,7 +507,50 @@ def _converge_packed(mat, num_segments: int, seq_bucket: int,
         client_bits=client_bits,
         qbits=int(max(n - 1, 1)).bit_length(),
     )
-    return jnp.concatenate([win_rows, stream_seg, stream_row])
+    # stream_seg is ascending over its valid prefix (doc order groups
+    # by segment) with -1 padding at the tail: counts come from one
+    # searchsorted over the monotone remap
+    ss = jnp.where(stream_seg >= 0, stream_seg, num_segments)
+    bounds = jnp.searchsorted(
+        ss, jnp.arange(num_segments + 1, dtype=ss.dtype), method="sort"
+    )
+    seg_counts = (bounds[1:] - bounds[:-1]).astype(jnp.int32)
+    return jnp.concatenate([win_rows, seg_counts, stream_row])
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_segments", "seq_bucket", "rank_rounds",
+                     "map_rounds", "client_bits"),
+)
+def _converge_packed(mat, num_segments: int, seq_bucket: int,
+                     rank_rounds: int, map_rounds: int,
+                     client_bits: int):
+    """Single-matrix entry over :func:`_converge_packed_body` (the
+    bench sweep and matrix-staged plans)."""
+    return _converge_packed_body(
+        mat[0], mat[1], mat[2], mat[3, :seq_bucket], mat[4, :seq_bucket],
+        num_segments=num_segments, seq_bucket=seq_bucket,
+        rank_rounds=rank_rounds, map_rounds=map_rounds,
+        client_bits=client_bits,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_segments", "seq_bucket", "rank_rounds",
+                     "map_rounds", "client_bits"),
+)
+def _converge_rows(r0, r1, r2, r34, num_segments: int, seq_bucket: int,
+                   rank_rounds: int, map_rounds: int, client_bits: int):
+    """Separate-row entry for eagerly shipped plans (``stage(put=)``):
+    same fused body, rows already resident on device."""
+    return _converge_packed_body(
+        r0, r1, r2, r34[0], r34[1],
+        num_segments=num_segments, seq_bucket=seq_bucket,
+        rank_rounds=rank_rounds, map_rounds=map_rounds,
+        client_bits=client_bits,
+    )
 
 
 
@@ -730,27 +812,38 @@ class PackedResult(NamedTuple):
 def converge(plan: PackedPlan) -> PackedResult:
     """Stage -> single dispatch -> single fetch. Device outputs are in
     id-sorted row space; the plan's sort permutation maps them back to
-    the caller's rows (one numpy gather, off the device clock)."""
+    the caller's rows (one numpy gather, off the device clock). Plans
+    staged with ``put=`` skip the transfer here — their rows are
+    already (asynchronously) on device."""
+    args = dict(
+        num_segments=plan.num_segments,
+        seq_bucket=plan.seq_bucket,
+        rank_rounds=plan.rank_rounds,
+        map_rounds=plan.map_rounds,
+        client_bits=plan.client_bits,
+    )
     with jax.enable_x64(True):
-        dev_mat = jnp.asarray(plan.mat)                      # 1 transfer
-        out = _converge_packed(
-            dev_mat,
-            num_segments=plan.num_segments,
-            seq_bucket=plan.seq_bucket,
-            rank_rounds=plan.rank_rounds,
-            map_rounds=plan.map_rounds,
-            client_bits=plan.client_bits,
-        )                                                    # 1 dispatch
+        if plan.dev:
+            out = _converge_rows(*plan.dev, **args)          # 1 dispatch
+        else:
+            dev_mat = jnp.asarray(plan.mat)                  # 1 transfer
+            out = _converge_packed(dev_mat, **args)          # 1 dispatch
         h = np.asarray(out)                                  # 1 fetch
     s = plan.num_segments
     b = plan.seq_bucket
     order = plan.order
     win = h[:s]
-    srow = h[s + b:s + 2 * b]
+    counts = h[s:2 * s]
+    srow = h[2 * s:2 * s + b]
+    k = int(counts.sum())
+    stream_seg = np.full(b, NULLI, np.int32)
+    stream_seg[:k] = np.repeat(
+        np.arange(s, dtype=np.int32), counts
+    )
     last = max(len(order) - 1, 0)
     return PackedResult(
         win_rows=np.where(win >= 0, order[np.clip(win, 0, last)], NULLI),
-        stream_seg=h[s:s + b],
+        stream_seg=stream_seg,
         stream_row=np.where(srow >= 0, order[np.clip(srow, 0, last)], NULLI),
         hard_rows=plan.hard_rows,
     )

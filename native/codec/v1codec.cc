@@ -41,6 +41,16 @@ static const int K_GC = 0, K_DELETED = 1, K_JSON = 2, K_BINARY = 3,
                  K_STRING = 4, K_ANY = 5, K_TYPE = 6, K_EMBED = 7,
                  K_FORMAT = 8, K_DOC = 9;
 // wire refs (crdt_tpu/codec/v1.py)
+// wire sanity bound shared with the Python codec's _MAX_CLOCK (and the
+// kernels' 40-bit clock packing): declared clocks/run ends past this
+// are hostile, and GC/Deleted expansion is budgeted per blob byte so a
+// few declared bytes can never buy unbounded allocation
+static const int64_t MAX_CLOCK = (int64_t)1 << 40;
+// client-id bound (mirrors v1.py _MAX_ID): [2^63, 2^64) would wrap
+// negative through the int64 cast and bypass every downstream check
+// (2^64-1 even collides with the -1 "absent" sentinel)
+static const uint64_t MAX_ID = (uint64_t)1 << 62;
+
 static const int REF_GC = 0, REF_DELETED = 1, REF_JSON = 2, REF_BINARY = 3,
                  REF_STRING = 4, REF_EMBED = 5, REF_FORMAT = 6, REF_TYPE = 7,
                  REF_ANY = 8, REF_DOC = 9, REF_SKIP = 10;
@@ -99,15 +109,31 @@ struct Reader {
     if (!need(1)) return 0;
     return *p++;
   }
+  // bounded identity/clock/length field: validated against the cap
+  // BEFORE the signed cast (see MAX_ID) — rejection semantics shared
+  // with the Python codec's _read_client_id/_read_clock_val
+  int64_t field(uint64_t cap) {
+    uint64_t v = varuint();
+    if (!ok) return 0;
+    if (v >= cap) { ok = false; return 0; }
+    return (int64_t)v;
+  }
   uint64_t varuint() {
     uint64_t n = 0; int shift = 0;
     while (true) {
       if (!need(1)) return 0;
       uint8_t b = *p++;
-      n |= (uint64_t)(b & 0x7F) << shift;
+      uint64_t part = (uint64_t)(b & 0x7F);
+      // overflow must REJECT, not wrap: a silently wrapped length
+      // would sail under every downstream sanity cap (the Python
+      // codec's arbitrary-precision ints reject the same bytes)
+      if (shift >= 64 || (shift > 0 && part > (UINT64_MAX >> shift))) {
+        ok = false;
+        return 0;
+      }
+      n |= part << shift;
       if (!(b & 0x80)) return n;
       shift += 7;
-      if (shift > 70) { ok = false; return 0; }
     }
   }
   int64_t varint() {
@@ -119,10 +145,18 @@ struct Reader {
     while (b & 0x80) {
       if (!need(1)) return 0;
       b = *p++;
-      n |= (uint64_t)(b & 0x7F) << shift;
+      uint64_t part = (uint64_t)(b & 0x7F);
+      if (shift >= 64 || part > (UINT64_MAX >> shift)) {
+        ok = false;  // overflow rejects, never wraps (see varuint)
+        return 0;
+      }
+      n |= part << shift;
       shift += 7;
-      if (shift > 70) { ok = false; return 0; }
     }
+    // int64-representability (mirrors lib0.py read_var_int):
+    // magnitudes in [2^63, 2^64) would wrap negative through the
+    // cast below and silently diverge from the Python codec
+    if (n >= ((uint64_t)1 << 63)) { ok = false; return 0; }
     return sign * (int64_t)n;
   }
   bool raw(size_t n, const uint8_t** out) {
@@ -318,25 +352,32 @@ static void push_run(Columns& C, int64_t client, int64_t clock, int64_t len,
 
 static bool decode_one(Reader& r, Columns& C,
                        std::vector<int64_t>& ds_out /* triples */) {
+  // expansion budget (mirrors v1.py): GC/Deleted runs expand to unit
+  // rows; bound the total against the blob's byte size
+  const int64_t budget =
+      std::max((int64_t)1 << 20, 4096 * (int64_t)(r.end - r.p));
+  const int64_t n0 = (int64_t)C.n();
   uint64_t num_clients = r.varuint();
   if (!r.ok) return false;
   for (uint64_t ci = 0; ci < num_clients; ci++) {
     uint64_t num_structs = r.varuint();
-    int64_t client = (int64_t)r.varuint();
-    int64_t clock = (int64_t)r.varuint();
+    int64_t client = r.field(MAX_ID);
+    int64_t clock = r.field((uint64_t)MAX_CLOCK);
     if (!r.ok) return false;
     for (uint64_t si = 0; si < num_structs; si++) {
       uint8_t info = r.u8();
       if (!r.ok) return false;
       int ref = info & 0x1F;
       if (ref == REF_SKIP) {
-        clock += (int64_t)r.varuint();
-        if (!r.ok) return false;
+        clock += r.field((uint64_t)MAX_CLOCK);
+        if (!r.ok || clock >= MAX_CLOCK) { r.ok = false; return false; }
         continue;
       }
       if (ref == REF_GC) {
-        int64_t len = (int64_t)r.varuint();
+        int64_t len = r.field((uint64_t)MAX_CLOCK);
         if (!r.ok) return false;
+        if (clock + len >= MAX_CLOCK ||
+            (int64_t)C.n() - n0 + len > budget) { r.ok = false; return false; }
         // parts after the first carry chain origins, mirroring the
         // Python _split_units (the engine ignores them for GC)
         for (int64_t j = 0; j < len; j++)
@@ -350,8 +391,12 @@ static bool decode_one(Reader& r, Columns& C,
       if (kind < 0) { r.ok = false; return false; }
       bool has_origin = info & 0x80, has_right = info & 0x40;
       int64_t oc = -1, ok_ = -1, rc = -1, rk = -1;
-      if (has_origin) { oc = (int64_t)r.varuint(); ok_ = (int64_t)r.varuint(); }
-      if (has_right) { rc = (int64_t)r.varuint(); rk = (int64_t)r.varuint(); }
+      if (has_origin) {
+        oc = r.field(MAX_ID); ok_ = r.field((uint64_t)MAX_CLOCK);
+      }
+      if (has_right) {
+        rc = r.field(MAX_ID); rk = r.field((uint64_t)MAX_CLOCK);
+      }
       int32_t pr = -1, kid = -1;
       int64_t pc = -1, pk = -1;
       if (!(info & 0xC0)) {
@@ -360,8 +405,8 @@ static bool decode_one(Reader& r, Columns& C,
           if (!r.cstring(&name)) return false;
           pr = C.intern_root(name);
         } else {
-          pc = (int64_t)r.varuint();
-          pk = (int64_t)r.varuint();
+          pc = r.field(MAX_ID);
+          pk = r.field((uint64_t)MAX_CLOCK);
         }
         if (info & 0x20) {
           std::string key;
@@ -376,11 +421,13 @@ static bool decode_one(Reader& r, Columns& C,
       int32_t tref = -1;
       switch (ref) {
         case REF_DELETED:
-          len = (int64_t)r.varuint();
+          len = r.field((uint64_t)MAX_CLOCK);
+          if (!r.ok || clock + len >= MAX_CLOCK ||
+              (int64_t)C.n() - n0 + len > budget) { r.ok = false; return false; }
           contents.assign(len, nullptr);
           break;
         case REF_JSON: {
-          len = (int64_t)r.varuint();
+          len = r.field((uint64_t)MAX_CLOCK);
           for (int64_t j = 0; r.ok && j < len; j++) {
             PyObject* s = r.pystring();
             if (!s) break;
@@ -459,11 +506,11 @@ static bool decode_one(Reader& r, Columns& C,
           break;
         }
         case REF_TYPE:
-          tref = (int32_t)r.varuint();
+          tref = (int32_t)r.field((uint64_t)1 << 31);
           contents.push_back(nullptr);
           break;
         case REF_ANY: {
-          len = (int64_t)r.varuint();
+          len = r.field((uint64_t)MAX_CLOCK);
           for (int64_t j = 0; r.ok && j < len; j++) {
             PyObject* v = r.any();
             if (!v) break;
@@ -496,13 +543,18 @@ static bool decode_one(Reader& r, Columns& C,
   uint64_t ds_clients = r.varuint();
   if (!r.ok) return false;
   for (uint64_t i = 0; i < ds_clients; i++) {
-    int64_t client = (int64_t)r.varuint();
+    int64_t client = r.field(MAX_ID);
     uint64_t nr = r.varuint();
     if (!r.ok) return false;
     for (uint64_t j = 0; j < nr; j++) {
       int64_t clk = (int64_t)r.varuint();
       int64_t len = (int64_t)r.varuint();
       if (!r.ok) return false;
+      if ((uint64_t)clk >= (uint64_t)MAX_CLOCK ||
+          (uint64_t)len >= (uint64_t)MAX_CLOCK) {
+        r.ok = false; return false;
+      }
+      if (clk + len >= MAX_CLOCK) { r.ok = false; return false; }
       if (len) {
         ds_out.push_back(client);
         ds_out.push_back(clk);

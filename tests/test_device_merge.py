@@ -1,6 +1,6 @@
 """Device merge mode: the TPU kernels in the PRODUCT hot path.
 
-``Crdt(device_merge=True)`` (or ``CRDT_TPU_DEVICE=1``) routes every
+``Crdt(device_merge=True)`` routes every
 remote merge through converge_maps + tree_order_ranks instead of the
 scalar integrate loop. These tests assert the two paths produce
 IDENTICAL engine state — visible JSON, chain order, delete sets,
@@ -156,11 +156,13 @@ class TestDifferentialModes:
 
 
 class TestDeviceModePlumbing:
-    def test_env_flag_enables_device(self, monkeypatch):
+    def test_env_flag_does_not_touch_standalone_crdt(self, monkeypatch):
+        """CRDT_TPU_DEVICE is the replica layer's knob (it selects
+        merge_mode="resident" there); the standalone Crdt's engine
+        device gate is strictly explicit."""
         monkeypatch.setenv("CRDT_TPU_DEVICE", "1")
-        assert Crdt(1).device_merge
-        monkeypatch.setenv("CRDT_TPU_DEVICE", "0")
         assert not Crdt(1).device_merge
+        assert Crdt(1, device_merge=True).device_merge
         monkeypatch.delenv("CRDT_TPU_DEVICE")
         assert not Crdt(1).device_merge
 

@@ -224,11 +224,11 @@ class TestIncrementalRounds:
                            content=k) for k in range(12)]
         blob = _blob(recs, ds)
         inc.apply(blob)
-        size = len(inc._del_c)
-        assert size == 10
+        size = len(inc._ds_ranges()[0])
+        assert size == 1  # ten unit deletes coalesce to one range
         for _ in range(3):
-            inc.apply(blob)  # redelivery must not re-append
-        assert len(inc._del_c) == size
+            inc.apply(blob)  # redelivery must not grow the range set
+        assert len(inc._ds_ranges()[0]) == size
         assert inc.cache == replay_trace([blob]).cache
 
     def test_bulk_delete_range(self):

@@ -135,7 +135,7 @@ class TestConverge:
             blobs.append(v1.encode_update(recs, ds))
 
         want = replay_trace(blobs)  # packed path
-        monkeypatch.setattr(packed, "stage", lambda cols: None)
+        monkeypatch.setattr(packed, "stage", lambda cols, **kw: None)
         got = replay_trace(blobs)   # resident fallback
         assert got.cache == want.cache
         assert got.snapshot == want.snapshot

@@ -366,6 +366,152 @@ class TestRemainingRefsDecode:
             assert native.encode_from_columns(dec) == blob, blob.hex()
 
 
+class TestAdversarialRejectionMatrix:
+    """VERDICT r3 item 7: with no channel for ground-truth Yjs bytes
+    (no Node/Yjs in the image, zero egress), the decoders' REJECTION
+    behavior is pinned instead. Every entry states a verdict —
+    "reject" (ValueError, both codecs) or "accept" (parses, both
+    codecs, same record/ds counts) — and the python and native
+    decoders must AGREE case by case: a silent divergence would let a
+    hostile blob split a mixed swarm. The hostile classes that
+    motivated the matrix (all found live, round 4): GC/Deleted runs
+    whose declared length bought unbounded per-clock expansion (both
+    decoders hung), varuint 64-bit overflow silently WRAPPING in the C
+    reader (a 2^69 length sailed under every sanity cap as 32), and
+    delete ranges whose expansion was deferred to the apply path.
+    Wire bounds now enforced at decode: clocks and run/range ends <
+    2^40 (the kernels' pack_id clock width), GC/Deleted expansion
+    budgeted per blob byte, varuint overflow rejects."""
+
+    # (name, hex blob, verdict, note)
+    MATRIX = [
+        # --- truncated varints mid-struct --------------------------------
+        ("trunc_numclients_only", "01", "reject",
+         "numClients then EOF"),
+        ("trunc_before_client", "0101", "reject",
+         "numStructs then EOF before client id"),
+        ("trunc_client_continuation", "0101b0", "reject",
+         "client varuint ends with continuation bit set"),
+        ("trunc_clock_continuation", "01010780", "reject",
+         "clock varuint ends with continuation bit set"),
+        ("trunc_mid_parent", "010107002801", "reject",
+         "parentInfo=root then EOF before the name"),
+        ("trunc_mid_origin", "010107008805", "reject",
+         "origin client read, EOF before origin clock"),
+        ("trunc_mid_parentsub", "01010700280101740561", "reject",
+         "parentSub length 5 with 1 byte left"),
+        # --- over-length declarations ------------------------------------
+        ("string_overlength", "0101070004010174056868", "reject",
+         "ContentString declares 5 bytes, 2 present"),
+        ("any_count_huge", "0101070008010174808080808001", "reject",
+         "ContentAny count 2^35 with no bodies: fail, not allocate"),
+        ("numstructs_exceed_bytes", "01030700280101740161017d05",
+         "reject", "3 structs declared, bytes for 1"),
+        ("gc_len_huge", "0101070000808080808080800100", "reject",
+         "GC run length 2^49: expansion budget, was a live hang"),
+        ("deleted_len_huge",
+         "01010700210101748080808080808001" + "00", "reject",
+         "Deleted run length 2^49: budget, not an allocation"),
+        ("skip_len_overflow", "010107000a8080808080808080804000",
+         "reject", "skip length 2^69: varuint overflow must not wrap"),
+        # the [2^63, 2^64) band fits a uint64 but wraps negative
+        # through an int64 cast — the native codec must bound BEFORE
+        # casting (found live: python rejected, native accepted with
+        # clock = -2^63)
+        ("client_in_wrap_band",
+         "0101" "80808080808080808001" "0008010174017d0500", "reject",
+         "client id 2^63 would wrap negative in a 64-bit codec"),
+        ("clock_in_wrap_band",
+         "010107" "80808080808080808001" "08010174017d0500", "reject",
+         "start clock 2^63 would wrap negative in a 64-bit codec"),
+        ("gc_len_in_wrap_band",
+         "01010700" "00" "80808080808080808001" "00", "reject",
+         "GC length 2^63: negative after a wrap would skip the "
+         "expansion loop and accept silently"),
+        ("any_int_in_wrap_band",
+         "010107000801017401" "7d" "80808080808080808002" "00",
+         "reject", "ContentAny varint magnitude 2^63: python would "
+         "keep the bigint, a 64-bit codec would wrap it negative — "
+         "same blob, different document (found live)"),
+        ("origin_client_sentinel_wrap",
+         "01010700" "88" "ffffffffffffffffff01" "00" "017d0500",
+         "reject", "origin client 2^64-1 would wrap to the -1 "
+         "'absent' sentinel — an origin-bearing row would decode as "
+         "origin-free"),
+        # --- hostile but well-formed: pinned accepts ---------------------
+        ("numstructs_zero", "0100070000", "accept",
+         "empty client group is vacuous, not an error"),
+        ("skip_only_group", "010107000a0300", "accept",
+         "skip-only group advances the clock, no records"),
+        ("skip_len_zero", "010107000a0000", "accept",
+         "zero-length skip is vacuous"),
+        ("gc_len_zero", "010107000000" + "00", "accept",
+         "zero-length GC run is vacuous"),
+        ("dup_client_group",
+         "0201070008010174017d05" + "01070008010174017d0600", "accept",
+         "same client twice with colliding clocks decodes to both "
+         "rows; duplicate-id arbitration is admission's job (the "
+         "first admitted id wins, redeliveries drop)"),
+        # --- delete-set hostiles -----------------------------------------
+        ("ds_numclients_huge", "00808080808001", "reject",
+         "ds numClients 2^35 with no bodies"),
+        ("ds_truncated_mid_range", "000107020005", "reject",
+         "2 ranges declared, EOF mid first"),
+        ("ds_overlapping_ranges", "0001070200050203", "accept",
+         "overlapping ranges coalesce (merge semantics)"),
+        ("ds_len_overflow", "000107010580808080808080808040",
+         "reject", "range length 2^69: overflow rejects in BOTH "
+         "codecs (the C reader used to wrap it to 32)"),
+        ("ds_len_past_clock_bound",
+         "000107010580808080808080800100", "reject",
+         "range end 2^49 exceeds the 2^40 wire clock bound"),
+        # --- parent-field hostiles (pinned accepts) ----------------------
+        ("parentinfo_2", "0101070008020174017d0500", "accept",
+         "parentInfo=2 reads as the item-id arm like Yjs's boolean "
+         "decode of nonzero"),
+        ("parentsub_with_origin", "01010700a80500017d0500", "accept",
+         "origin present: parent/parentSub fields are not read, the "
+         "0x20 bit is inert (Yjs layout)"),
+    ]
+
+    def _py(self, blob):
+        try:
+            recs, ds = v1.decode_update(blob)
+            return ("accept", len(recs), len(ds.ranges))
+        except ValueError:
+            return ("reject",)
+
+    def test_matrix(self):
+        from crdt_tpu.codec import native
+
+        for name, hx, verdict, _note in self.MATRIX:
+            blob = bytes.fromhex(hx)
+            py = self._py(blob)
+            assert py[0] == verdict, f"{name}: python={py[0]}, " \
+                f"matrix says {verdict}"
+            if not native.available():
+                continue
+            try:
+                dec = native.decode_updates_columns([blob])
+                nat = ("accept", len(dec["client"]))
+            except ValueError:
+                nat = ("reject",)
+            assert nat[0] == verdict, f"{name}: native={nat[0]}, " \
+                f"matrix says {verdict}"
+            if verdict == "accept":
+                # both accepted: unit-record counts must agree (GC
+                # runs expand identically on both sides)
+                assert nat[1] == py[1], f"{name}: native decoded " \
+                    f"{nat[1]} rows, python {py[1]} records"
+
+    def test_verdicts_are_exhaustive_over_outcomes(self):
+        """Every entry names one of the two pinned outcomes — the
+        matrix is a contract, not a survey."""
+        for name, _hx, verdict, note in self.MATRIX:
+            assert verdict in ("reject", "accept"), name
+            assert note, name
+
+
 class TestMalformedRejected:
     """Corrupt or hostile bytes must raise ValueError — never crash,
     hang, or silently misparse (the receive path isolates the blob,
