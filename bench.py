@@ -41,8 +41,9 @@ the right-bearing collaborative-text workload, oracle-checked; 0
 skips), BENCH_SWARM (default 1: replica-level loopback swarm timing
 in all three merge modes; 0 skips), BENCH_ROUNDS (default 1:
 steady-state incremental rounds on the scale doc with a host/device
-crossover table; 0 skips; requires the scale run), BENCH_ROUND_SIZES
-(comma list of per-round delta op counts, default 250,1000,4000,16000).
+crossover table + the session's auto-calibration; 0 skips; requires
+the scale run), BENCH_ROUND_SIZES (comma list of per-round delta op
+counts, default 250,1000,4000,16000,64000).
 """
 
 from __future__ import annotations
@@ -528,10 +529,9 @@ def main():
     import jax
 
     jax.config.update("jax_enable_x64", True)
-    # persistent compile cache: the untimed warmup costs real compile
-    # only on a cold machine
-    jax.config.update("jax_compilation_cache_dir", "/tmp/crdt_tpu_jax_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # the persistent compile cache is configured by the package itself
+    # (crdt_tpu/ops/device.py, per-user path): the untimed warmup
+    # costs real compile only on a cold machine
 
     R = int(os.environ.get("BENCH_REPLICAS", 1000))
     K = int(os.environ.get("BENCH_OPS", 100))

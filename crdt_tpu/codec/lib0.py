@@ -189,6 +189,14 @@ class Decoder:
             b = self.read_uint8()
             n |= (b & 0x7F) << shift
             if not (b & 0x80):
+                # uint64-representability, mirroring the native
+                # reader's overflow rejection at EVERY varuint
+                # position (flag/count positions included): a value
+                # only python's bigints can hold would make a
+                # python-decoding and a native-decoding replica
+                # disagree on the same blob
+                if n >= (1 << 64):
+                    raise ValueError("varUint exceeds uint64")
                 return n
             shift += 7
             if shift > 70:

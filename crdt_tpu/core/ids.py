@@ -120,6 +120,28 @@ class DeleteSet:
                 del self.ranges[c]
         self._dirty = False
 
+    def covers(self, client: int, clock: int, length: int = 1) -> bool:
+        """True when [clock, clock+length) lies inside ONE recorded
+        range (ranges are normalized disjoint, so full coverage
+        requires a single containing range)."""
+        if self._dirty:
+            self.normalize()
+        rs = self.ranges.get(client)
+        if not rs:
+            return False
+        end = clock + length
+        lo, hi = 0, len(rs)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            s, e = rs[mid]
+            if clock < s:
+                hi = mid
+            elif clock >= e:
+                lo = mid + 1
+            else:
+                return end <= e
+        return False
+
     def contains(self, client: int, clock: int) -> bool:
         if self._dirty:
             self.normalize()

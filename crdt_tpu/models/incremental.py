@@ -360,32 +360,32 @@ class IncrementalReplay:
         touched: set = set()
 
         # delete ranges: visibility-only — record which segments they
-        # tombstone so their cache entries rebuild. Expansions append
-        # only the ids NOT already recorded (redelivered delete sets
-        # must not grow the arrays), and resident-row mapping flips to
-        # a vectorized column scan for bulk ranges.
+        # tombstone so their cache entries rebuild. Spans already
+        # fully covered by the recorded delete set are REDELIVERY and
+        # mark nothing (a duplicate gossip delivery must not re-scan
+        # the columns or rebuild every covered segment's cache); fresh
+        # spans clamp at each client's admitted watermark — rows
+        # cannot exist beyond it, so a hostile range covering clocks
+        # that may never exist costs O(ranges), not O(declared
+        # length); late rows check visibility against the range set
+        # at admission.
         trips = np.asarray(dec["ds"]).reshape(-1, 3)
         if len(trips):
             from crdt_tpu.models.replay import rows_visible
 
-            for c, k, length in trips:
-                self.ds.add(int(c), int(k), int(length))
-            self._ds_pack = None
-            # touched segments: resident rows the batch's ranges cover.
-            # Ranges coalesce first (disjointness is rows_visible's
-            # contract) and clamp at each client's admitted watermark —
-            # rows cannot exist beyond it, so a hostile range covering
-            # clocks that may never exist costs O(ranges), not
-            # O(declared length); late rows check visibility against
-            # the range set at admission
             batch_ds = DeleteSet()
             for c, k, length in trips:
                 batch_ds.add(int(c), int(k), int(length))
             spans = []
             for c, s, length in batch_ds.iter_all():
+                if self.ds.covers(c, s, length):
+                    continue  # redelivered: already recorded
                 end = min(s + length, self._next_clock.get(c, 0))
                 if end > s:
                     spans.append((c, s, end))
+            for c, k, length in trips:
+                self.ds.add(int(c), int(k), int(length))
+            self._ds_pack = None
             total = sum(e - s for _, s, e in spans)
             if spans and total * 4 > self.cols.n and self.cols.n:
                 # bulk range: one vectorized scan over the id columns

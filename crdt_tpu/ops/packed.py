@@ -178,67 +178,79 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
 
     hard_reps: list = []
     max_rank = 0
+    # accumulated conflict-scan ranks, written with ONE bulk
+    # searchsorted at the end (a per-sid binary search dominated text
+    # staging time — profiled round 4)
+    rank_sids: list = []
+    rank_vals: list = []
     for S in np.unique(seg[rows_r]).tolist():
         members = seg_slices.get(int(S))
         if members is None:
             continue
-        mlist = members.tolist()
+        # orphan member (declared origin that resolved nowhere):
+        # vectorized — member loops in python made staging the text
+        # replay's dominant cost
+        if bool(np.any((oc_s[members] >= 0) & (origin_row[members] < 0))):
+            hard_reps.append(int(order[int(members[0])]))
+            continue
+        # groups within the segment, keyed by in-union origin row:
+        # one stable sort + run split instead of a python setdefault
+        # walk over every member
+        og = origin_row[members]
+        gorder = np.argsort(og, kind="stable")
+        og_s, mem_s = og[gorder], members[gorder]
+        gcuts = np.r_[
+            0, np.flatnonzero(og_s[1:] != og_s[:-1]) + 1, len(og_s)
+        ]
         hard = False
-        # orphan member: declared origin that resolved nowhere
-        for row in mlist:
-            if oc_s[row] >= 0 and origin_row[row] < 0:
-                hard = True
-                break
-        # groups within the segment, keyed by in-union origin row
-        groups: Dict[int, list] = {}
         # shared walk budget for ALL of this segment's out-of-group
         # right walks: linear in segment size (hostile staging cost
         # stays O(n) total — advisor finding, round 3), generous for
         # benign shapes; exhaustion marks the segment hard, which the
         # exact scalar fallback absorbs
-        walk_budget = max(_RIGHT_WALK_CAP, 8 * len(mlist))
-        if not hard:
-            for row in mlist:
-                groups.setdefault(int(origin_row[row]), []).append(row)
-            for grows in groups.values():
-                grow_set = set(grows)
-                for r in grows:
-                    if rr[r] < 0:
-                        continue
-                    rt = int(right_row[r])
-                    if rt < 0 or seg[rt] != S:
-                        hard = True  # dangling/unknown or cross-parent
+        walk_budget = max(_RIGHT_WALK_CAP, 8 * len(members))
+        seg_rank_sids: list = []
+        seg_rank_vals: list = []
+        seg_max_rank = 0
+        for a, b in zip(gcuts[:-1], gcuts[1:]):
+            grows = mem_s[a:b]
+            # only right-bearing members need the per-row checks
+            gr = grows[rr[grows] >= 0]
+            if not len(gr):
+                continue
+            grow_set = set(grows.tolist())
+            has_anchor = False
+            # one fused python pass (groups are tiny — typically the
+            # few writers racing one position — so per-group numpy
+            # reductions cost more than they save)
+            for rt in right_row[gr].tolist():
+                if rt < 0 or seg[rt] != S:
+                    hard = True  # dangling/unknown or cross-parent
+                    break
+                if rt in grow_set:
+                    has_anchor = True  # in-group anchor: simulated
+                    continue
+                # out-of-group right: hard if its origin chain passes
+                # through a GROUP member (the scan would stop inside
+                # that member's subtree). Walks draw on the segment's
+                # shared linear budget (see above)
+                cur = rt
+                while cur >= 0:
+                    if cur in grow_set:
+                        hard = True
                         break
-                    if rt in grow_set:
-                        continue  # in-group anchor: simulated below
-                    # out-of-group right: hard if its origin chain
-                    # passes through a GROUP member (the scan would
-                    # stop inside that member's subtree). Walks draw on
-                    # the segment's shared linear budget (see above)
-                    cur = rt
-                    while cur >= 0:
-                        if cur in grow_set:
-                            hard = True
-                            break
-                        walk_budget -= 1
-                        if walk_budget < 0:
-                            hard = True  # budget spent: exact fallback
-                            break
-                        cur = int(origin_row[cur])
-                    if hard:
+                    walk_budget -= 1
+                    if walk_budget < 0:
+                        hard = True  # budget spent: exact fallback
                         break
+                    cur = int(origin_row[cur])
                 if hard:
                     break
-        if hard:
-            hard_reps.append(int(order[mlist[0]]))
-            continue
-        for grows in groups.values():
-            grow_set = set(grows)
-            if not any(
-                rr[r] >= 0 and int(right_row[r]) in grow_set
-                for r in grows
-            ):
+            if hard:
+                break
+            if not has_anchor:
                 continue  # attachment-free: plain keys are exact
+            glist = grows.tolist()
             sibs = [
                 {
                     "id": int(ikey_s[r]),
@@ -246,13 +258,23 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
                     "clock": int(clock_raw_s[r]),
                     "right": int(rkey[r]) if rr[r] >= 0 else None,
                 }
-                for r in grows
+                for r in glist
             ]
-            ordered = _simulate_group(sibs, {int(ikey_s[r]) for r in grows})
-            for rank_pos, sid in enumerate(ordered):
-                row = int(np.searchsorted(ikey_s, sid))
-                client_s[row] = rank_pos
-            max_rank = max(max_rank, len(ordered) - 1)
+            ordered = _simulate_group(
+                sibs, {int(ikey_s[r]) for r in glist}
+            )
+            seg_rank_sids.extend(ordered)
+            seg_rank_vals.extend(range(len(ordered)))
+            seg_max_rank = max(seg_max_rank, len(ordered) - 1)
+        if hard:
+            hard_reps.append(int(order[int(members[0])]))
+            continue
+        rank_sids.extend(seg_rank_sids)
+        rank_vals.extend(seg_rank_vals)
+        max_rank = max(max_rank, seg_max_rank)
+    if rank_sids:
+        rows = np.searchsorted(ikey_s, np.asarray(rank_sids, np.int64))
+        client_s[rows] = np.asarray(rank_vals, np.int64)
     return client_s, hard_reps, max_rank
 
 
@@ -356,9 +378,24 @@ def stage(cols: Dict[str, np.ndarray],
     max_map = int(seg_counts[map_seg].max()) if map_seg.any() else 1
     max_seq = int(seg_counts[~map_seg].max()) if (~map_seg).any() else 1
 
-    # size buckets early: eager shipping needs the padded widths now
+    # size buckets early: eager shipping needs the padded widths now,
+    # and the width feasibility checks must run BEFORE the first put —
+    # an infeasible plan must not queue dead transfers through the
+    # tunnel only to fall back and re-ship via the general path
     kpad = bucket_grid(n, floor=6)
     Sb = bucket_grid(max(n_segs, 1), floor=6)
+    n_seq_early = int(np.count_nonzero(uniq_valid & (kid_s < 0)))
+    B = min(kpad, bucket_grid(max(n_seq_early, 1), floor=6))
+    if max(kpad, B) + Sb >= (1 << 31) - 1:
+        return None
+    # rank-0 lower-bound width precheck (the exact check re-runs after
+    # _stage_rights can only RAISE cbits via simulated group ranks)
+    if (
+        int(max(kpad, B) + Sb + 1).bit_length()
+        + _even_up(max(8, len(uniq).bit_length()))
+        + (kpad - 1).bit_length()
+    ) > 63:
+        return None
     r1 = np.full(kpad, -1, np.int32)
     r1[:n] = np.where(
         seg >= 0, seg | np.where(kid_s < 0, _SEQ_FLAG, 0), -1
@@ -397,7 +434,7 @@ def stage(cols: Dict[str, np.ndarray],
         )
     else:
         c_parent = np.empty(0, np.int64)
-    B = min(kpad, bucket_grid(max(n_seq, 1), floor=6))
+    assert B == min(kpad, bucket_grid(max(n_seq, 1), floor=6))
     if put is not None:
         r34 = np.full((2, B), -1, np.int32)
         r34[0, :n_seq] = seq_rows
