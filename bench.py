@@ -833,7 +833,15 @@ def main():
             assert all(dict(r.c) == first for r in reps[1:]), mode
             return dt
 
-        swarm_result = {"replicas": n_reps, "ops": n_reps * n_ops}
+        swarm_result = {
+            "replicas": n_reps,
+            "ops": n_reps * n_ops,
+            # the engine device gate pays a tunnel round-trip per
+            # buffered round; it is kept as a differential oracle
+            # (merge_mode="device"), NOT a product default — resident
+            # is the device-resident product mode (VERDICT r3 item 4)
+            "note": "device = explicit differential-oracle mode",
+        }
         for mode in ("scalar", "resident", "device"):
             if mode == "device":
                 swarm_round(mode)  # warm the gate's compiled shapes

@@ -143,23 +143,7 @@ class DeleteSet:
         return False
 
     def contains(self, client: int, clock: int) -> bool:
-        if self._dirty:
-            self.normalize()
-        rs = self.ranges.get(client)
-        if not rs:
-            return False
-        # binary search over sorted disjoint ranges
-        lo, hi = 0, len(rs)
-        while lo < hi:
-            mid = (lo + hi) // 2
-            s, e = rs[mid]
-            if clock < s:
-                hi = mid
-            elif clock >= e:
-                lo = mid + 1
-            else:
-                return True
-        return False
+        return self.covers(client, clock, 1)
 
     def merge(self, other: "DeleteSet") -> "DeleteSet":
         out = DeleteSet({c: list(r) for c, r in self.ranges.items()})

@@ -26,14 +26,36 @@ import jax.numpy as jnp
 # another local user pre-seed compiled artifacts this process would
 # load (cache poisoning). Set CRDT_TPU_COMPILE_CACHE="" to disable,
 # or point it elsewhere.
-_cache_dir = os.environ.get("CRDT_TPU_COMPILE_CACHE")
-if _cache_dir is None:
-    import tempfile
+def _safe_cache_dir() -> str:
+    """Owner-only cache directory, ownership-verified: a
+    pre-created attacker-owned dir in shared /tmp must never be
+    adopted (its compiled artifacts would be deserialized and run).
+    Returns "" when no safe directory can be established."""
+    path = os.environ.get("CRDT_TPU_COMPILE_CACHE")
+    if path == "":
+        return ""  # explicitly disabled
+    if path is None:
+        import tempfile
 
-    _cache_dir = os.path.join(
-        tempfile.gettempdir(), f"crdt_tpu_jax_cache_{os.getuid()}"
-    )
-if _cache_dir:
+        path = os.path.join(
+            tempfile.gettempdir(), f"crdt_tpu_jax_cache_{os.getuid()}"
+        )
+    try:
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        st = os.stat(path)
+        if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+            return ""  # foreign or group/world-writable: refuse
+    except OSError:
+        return ""
+    return path
+
+
+_cache_dir = _safe_cache_dir()
+# never clobber a host application's own cache configuration: this is
+# a library — only fill the knob when it is unset
+if _cache_dir and not getattr(
+    jax.config, "jax_compilation_cache_dir", None
+):
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
         jax.config.update(

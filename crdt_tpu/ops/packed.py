@@ -434,7 +434,6 @@ def stage(cols: Dict[str, np.ndarray],
         )
     else:
         c_parent = np.empty(0, np.int64)
-    assert B == min(kpad, bucket_grid(max(n_seq, 1), floor=6))
     if put is not None:
         r34 = np.full((2, B), -1, np.int32)
         r34[0, :n_seq] = seq_rows
@@ -462,8 +461,8 @@ def stage(cols: Dict[str, np.ndarray],
         8, len(uniq).bit_length(), (max_rank + 1).bit_length()
     ))
     qbits = (kpad - 1).bit_length()
-    if max(kpad, B) + Sb >= (1 << 31) - 1:
-        return None
+    # (the 2^31 width guard already ran before the first eager put;
+    # only the rank-dependent cbits can have grown since)
     pbits = int(max(kpad, B) + Sb + 1).bit_length()
     if pbits + cbits + qbits > 63:
         return None

@@ -364,6 +364,53 @@ class TestIncrementalRounds:
             assert inc.cache == replay_trace(blobs).cache, f"round {rnd}"
 
 
+    def test_forced_host_device_alternation_with_rights(self):
+        """The round-4 interleave: linked-chain integrate rounds (host)
+        alternating with whole-segment device reconvergence, plus
+        redeliveries — every transition between the incremental links
+        and the wholesale orders must land on the cold replay's exact
+        state (links drop on _set_order, rebuild on the next
+        incremental round, stale lists materialize on read)."""
+        rng = np.random.default_rng(23)
+        inc = IncrementalReplay()
+        blobs, clk = [], {}
+        own: dict = {}
+        for rnd in range(10):
+            recs, ds = [], DeleteSet()
+            for c in (1, 2, 3, 4):
+                for _ in range(6):
+                    k = clk[c] = clk.get(c, -1) + 1
+                    p = rng.random()
+                    chain = own.setdefault(c, [])
+                    if p < 0.25:
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root="m",
+                            key=f"q{rng.integers(0, 4)}", content=k))
+                    elif p < 0.6 or not chain:
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root="s",
+                            origin=chain[-1] if chain else None,
+                            content=k))
+                        chain.append((c, k))
+                    else:
+                        j = int(rng.integers(0, len(chain)))
+                        recs.append(ItemRecord(
+                            client=c, clock=k, parent_root="s",
+                            origin=chain[j - 1] if j else None,
+                            right=chain[j], content=k))
+                        chain.insert(j, (c, k))
+            if rnd >= 2 and rng.random() < 0.5:
+                ds.add(int(rng.integers(1, 5)), int(rng.integers(0, 12)))
+            blobs.append(_blob(recs, ds))
+            # force the path per round: even rounds host (incremental
+            # links), odd rounds device (wholesale reconvergence)
+            inc.device_min_rows = (1 << 62) if rnd % 2 == 0 else 0
+            inc.apply(blobs[-1])
+            if rng.random() < 0.4:
+                inc.apply(blobs[int(rng.integers(0, len(blobs)))])
+            assert inc.cache == replay_trace(blobs).cache, f"round {rnd}"
+
+
 def test_host_and_device_modes_converge_identically():
     """The same delta stream through forced-host rounds (pure-Python
     segment ordering, zero device work) and forced-device rounds must
@@ -396,3 +443,55 @@ def test_host_and_device_modes_converge_identically():
     flip.device_min_rows = 1 << 62
     flip.apply(deltas[2])
     assert flip.cache == dev.cache
+
+
+class TestAutoCalibration:
+    """The host/device crossover default is measured per session, not
+    shipped (VERDICT r3 item 2)."""
+
+    def test_explicit_and_env_override_auto(self, monkeypatch):
+        from crdt_tpu.models.incremental import IncrementalReplay
+
+        monkeypatch.delenv("CRDT_TPU_DEVICE_MIN", raising=False)
+        assert IncrementalReplay().device_min_rows is None  # AUTO
+        assert IncrementalReplay(device_min_rows=7).device_min_rows == 7
+        monkeypatch.setenv("CRDT_TPU_DEVICE_MIN", "123")
+        assert IncrementalReplay().device_min_rows == 123
+
+    def test_probe_yields_floored_threshold(self):
+        from crdt_tpu.models.incremental import IncrementalReplay
+
+        info = IncrementalReplay.calibration_info()
+        assert info["threshold"] >= 4096  # keystroke rounds never probe
+        assert info["t_interact_ms"] is not None
+        # cached: the probe runs once per process
+        assert IncrementalReplay.calibration_info() == info
+
+
+class TestLazyCache:
+    """Rounds mark segments dirty; only reads materialize the JSON
+    view (the firehose steady state depends on this)."""
+
+    def test_apply_defers_materialization(self):
+        from crdt_tpu.models.incremental import IncrementalReplay
+
+        inc = IncrementalReplay(device_min_rows=1 << 62)
+        recs = [ItemRecord(client=1, clock=k, parent_root="m",
+                           key=f"k{k}", content=k) for k in range(8)]
+        inc.apply(_blob(recs, DeleteSet()))
+        assert inc._dirty  # nothing read yet: segments pend
+        assert inc.cache["m"]["k3"] == 3  # read flushes...
+        assert not inc._dirty  # ...and clears the pending set
+
+    def test_bookkeeping_without_read(self):
+        """Observer bookkeeping (touched roots/keys) is computed per
+        round even when nothing reads the cache."""
+        from crdt_tpu.models.incremental import IncrementalReplay
+
+        inc = IncrementalReplay(device_min_rows=1 << 62)
+        recs = [ItemRecord(client=1, clock=0, parent_root="m",
+                           key="a", content=1)]
+        inc.apply(_blob(recs, DeleteSet()))
+        assert inc.last_touched_roots == ["m"]
+        assert inc.last_touched_keys == {"m": {"a"}}
+        assert inc._dirty  # still unmaterialized
