@@ -717,10 +717,18 @@ def main():
         blobs_t = build_text_trace(R_t, K)
         from crdt_tpu.models import replay_trace as _replay
 
-        _replay(blobs_t)  # warm shapes
+        _replay(blobs_t)  # warm shapes (device route)
         t0 = time.perf_counter()
         res_t = _replay(blobs_t)
         t_dev_t = time.perf_counter() - t0
+        # the host route is exactness-checked (it is the integrate
+        # machinery a resident replica would use on this backlog) but
+        # NOT the headline: multi-writer mid-insert backlogs are the
+        # staged device path's home turf — stale anchors make the
+        # scalar-scan route degenerate toward the oracle's cost, which
+        # is precisely what the sibling-rank model vectorizes away
+        res_h = _replay(blobs_t, route="host")
+        assert res_h.cache == res_t.cache, "text routes diverge"
         text_result = {
             "ops": R_t * K,
             "device_s": round(t_dev_t, 3),
@@ -785,10 +793,12 @@ def main():
             eng_t, t_oracle_t = run_oracle(blobs_t)
             assert res_t.cache == eng_t.to_json(), \
                 "text run diverges from oracle"
-            text_result["vs_python_oracle"] = round(t_oracle_t / t_dev_t, 1)
+            text_result["vs_python_oracle"] = round(
+                t_oracle_t / t_dev_t, 1
+            )
             oracle_note = f"oracle {t_oracle_t:.2f}s; exact"
         log(f"text e2e ({R_t * K} ops, 20% right-bearing mid-inserts): "
-            f"{t_dev_t:.3f}s; {oracle_note}")
+            f"{t_dev_t:.3f}s (host route exact too); {oracle_note}")
 
     except AssertionError:
         raise

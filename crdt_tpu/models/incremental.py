@@ -355,7 +355,15 @@ class IncrementalReplay:
         dirty, not rebuilt — read ``.cache`` for the flushed state."""
         if isinstance(blobs, (bytes, bytearray)):
             blobs = [bytes(blobs)]
-        dec = native.dedup_columns(native.decode_updates_columns_any(blobs))
+        self.apply_decoded(
+            native.dedup_columns(native.decode_updates_columns_any(blobs))
+        )
+
+    def apply_decoded(self, dec) -> None:
+        """Consume an already-decoded (deduped) columnar union —
+        the seam for callers that decoded once for their own purposes
+        (replay_trace's host route) and must not pay the codec
+        twice."""
         n_raw = len(dec["client"])
         touched: set = set()
 
@@ -1151,6 +1159,14 @@ class IncrementalReplay:
         nxt = self._lnk_next
         unplaced = set(new_rows)
         queue = list(new_rows)
+        # total scan-step budget: the conflict scan walks the window
+        # between a row's anchors, and for a COLD multi-writer backlog
+        # (anchors thousands of items stale) that degenerates to the
+        # scalar engine's quadratic cost — the exact wholesale reorder
+        # handles that shape in one vectorized pass instead. Live
+        # steady-state rounds never approach the budget (anchors are
+        # near-adjacent when deltas are fresh).
+        scan_budget = max(4096, 32 * len(new_rows))
         while queue:
             progress = False
             defer = []
@@ -1169,6 +1185,10 @@ class IncrementalReplay:
                 conflicting: set = set()
                 before: set = set()
                 while o != -1 and (right0 is None or o != right0):
+                    scan_budget -= 1
+                    if scan_budget < 0:
+                        self._host_order_segment(sk)
+                        return True
                     before.add(o)
                     conflicting.add(o)
                     o_oc = int(oc[o])

@@ -33,6 +33,7 @@ class ReplayResult(NamedTuple):
     cache: dict        # converged plain-JSON state (crdt.c)
     snapshot: bytes    # compacted single-blob log
     n_ops: int         # unit items replayed
+    path: str = "device"  # which engine converged (see replay_trace)
 
 
 def decode(blobs: Sequence[bytes]) -> Dict:
@@ -423,13 +424,63 @@ def replay_trace(
     blobs: Sequence[bytes],
     *,
     clients: Optional[Sequence[int]] = None,
+    route: str = "device",
 ) -> ReplayResult:
-    """One-shot: blobs in, converged cache + compacted snapshot out."""
+    """One-shot: blobs in, converged cache + compacted snapshot out.
+
+    ``route`` picks the convergence engine:
+
+    - ``"device"`` (default) — the packed single-dispatch pipeline,
+      always. The default stays pinned so differential suites that
+      use this function as their independent cold oracle keep
+      exercising the device kernels, and published device numbers are
+      never silently host numbers.
+    - ``"auto"`` — the PRODUCT rule: apply the same session-calibrated
+      host/device crossover the live replica uses. On a tunnelled
+      platform a small replay is floored by fixed per-interaction
+      latency, not merge speed — below the threshold the union
+      converges through the exact host machinery (the identical path
+      a resident replica takes when it ingests this backlog), above
+      it the device pipeline runs.
+    - ``"host"`` — force the host machinery.
+
+    Both engines are differential-tested against each other and the
+    scalar oracle; ``ReplayResult.path`` records which one ran."""
     dec = decode(blobs)
+    n = len(dec["client"])
+    use_host = False
+    if route == "host":
+        use_host = True
+    elif route == "auto":
+        if n < 16384:
+            # same static floor the live replica's crossover uses:
+            # small work must never pay the calibration probe's device
+            # interactions just to learn it should stay off the device
+            use_host = True
+        else:
+            from crdt_tpu.models.incremental import IncrementalReplay
+
+            use_host = n < IncrementalReplay._calibrate()["threshold"]
+    elif route != "device":
+        raise ValueError(f"unknown route {route!r}")
+    if use_host:
+        from crdt_tpu.models.incremental import IncrementalReplay
+        from crdt_tpu.ops.device import bucket_pow2
+
+        inc = IncrementalReplay(
+            capacity=bucket_pow2(max(n, 1)),
+            device_min_rows=1 << 62,  # host path, zero device work
+        )
+        inc.apply_decoded(dec)  # decoded once above, never twice
+        ds = native.ds_from_triples(dec["ds"])
+        return ReplayResult(
+            cache=dict(inc.cache), snapshot=compact(dec, ds), n_ops=n,
+            path="host",
+        )
     cols, ds = stage(dec)
     handle = converge(cols, clients=clients)
     win_rows, win_vis, seq_orders = gather(dec, ds, handle)
     cache = materialize(dec, ds, win_rows, win_vis, seq_orders)
     return ReplayResult(
-        cache=cache, snapshot=compact(dec, ds), n_ops=len(dec["client"])
+        cache=cache, snapshot=compact(dec, ds), n_ops=n, path="device"
     )

@@ -311,3 +311,40 @@ class TestFleetIntegration:
         assert res.cache == dict(oracle.c)
         assert res.cache["edity"] == ["pre", "base"]
         assert res.cache["appendy"] == list(range(10))
+
+
+from crdt_tpu.models import replay_trace
+
+
+class TestReplayRoutes:
+    """replay_trace's convergence engines must be interchangeable:
+    "device" (packed pipeline, the differential-oracle default) and
+    "host" (the incremental machinery a resident replica uses to
+    ingest the same backlog) produce identical results; "auto" picks
+    by the session-calibrated crossover and records its choice."""
+
+    def test_host_and_device_routes_agree(self):
+        import bench as B
+
+        for builder in (B.build_trace, B.build_conflict_trace,
+                        B.build_text_trace):
+            blobs = builder(30, 20)
+            dev = replay_trace(blobs, route="device")
+            host = replay_trace(blobs, route="host")
+            assert dev.path == "device" and host.path == "host"
+            assert host.cache == dev.cache, builder.__name__
+            assert host.snapshot == dev.snapshot, builder.__name__
+
+    def test_auto_records_its_choice(self):
+        import bench as B
+
+        blobs = B.build_trace(10, 10)
+        res = replay_trace(blobs, route="auto")
+        assert res.path in ("host", "device")
+        assert res.cache == replay_trace(blobs, route="device").cache
+
+    def test_unknown_route_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            replay_trace([], route="warp")
