@@ -214,6 +214,19 @@ class IncrementalReplay:
             }
         return cls._calib
 
+    # static floor: below this, never pay the calibration probe's
+    # device interactions just to learn the work belongs on host
+    _CROSSOVER_FLOOR = 16384
+
+    @classmethod
+    def crossover_use_host(cls, n_rows: int) -> bool:
+        """The host/device crossover decision for ``n_rows`` of
+        touched work — the ONE implementation shared by the live
+        replica's rounds and the cold replay's "auto" route."""
+        if n_rows < cls._CROSSOVER_FLOOR:
+            return True
+        return n_rows < cls._calibrate()["threshold"]
+
     @classmethod
     def calibration_info(cls) -> Dict[str, Optional[float]]:
         """The session's measured crossover (probing if needed) — the
@@ -1284,14 +1297,12 @@ class IncrementalReplay:
             n_sel = sum(len(self._seg_rows[sk]) for sk in dev_segs)
             thr = self.device_min_rows
             if thr is None:
-                # AUTO: a static floor spares keystroke rounds the
-                # probe; beyond it the session-calibrated threshold
-                # decides (VERDICT r3 item 2)
-                thr = (
-                    16384 if n_sel < 16384
-                    else self._calibrate()["threshold"]
-                )
-            if n_sel < thr:
+                # AUTO: the shared crossover rule (static floor, then
+                # the session-calibrated threshold — VERDICT r3 item 2)
+                go_host = self.crossover_use_host(n_sel)
+            else:
+                go_host = n_sel < thr
+            if go_host:
                 host_segs.extend(dev_segs)
                 dev_segs = []
 

@@ -452,23 +452,21 @@ def replay_trace(
     if route == "host":
         use_host = True
     elif route == "auto":
-        if n < 16384:
-            # same static floor the live replica's crossover uses:
-            # small work must never pay the calibration probe's device
-            # interactions just to learn it should stay off the device
-            use_host = True
-        else:
-            from crdt_tpu.models.incremental import IncrementalReplay
+        from crdt_tpu.models.incremental import IncrementalReplay
 
-            use_host = n < IncrementalReplay._calibrate()["threshold"]
+        # the live replica's exact rule (one shared implementation:
+        # static floor first, session probe beyond it)
+        use_host = IncrementalReplay.crossover_use_host(n)
     elif route != "device":
         raise ValueError(f"unknown route {route!r}")
     if use_host:
         from crdt_tpu.models.incremental import IncrementalReplay
-        from crdt_tpu.ops.device import bucket_pow2
 
+        # minimal capacity: the resident device matrix is never used
+        # on this route (device_min_rows pins every round to host), so
+        # sizing it to the trace would allocate a large dead buffer
         inc = IncrementalReplay(
-            capacity=bucket_pow2(max(n, 1)),
+            capacity=1 << 10,
             device_min_rows=1 << 62,  # host path, zero device work
         )
         inc.apply_decoded(dec)  # decoded once above, never twice

@@ -26,11 +26,15 @@ import jax.numpy as jnp
 # another local user pre-seed compiled artifacts this process would
 # load (cache poisoning). Set CRDT_TPU_COMPILE_CACHE="" to disable,
 # or point it elsewhere.
-def _safe_cache_dir() -> str:
+def _safe_cache_dir(suffix: str = "") -> str:
     """Owner-only cache directory, ownership-verified: a
     pre-created attacker-owned dir in shared /tmp must never be
     adopted (its compiled artifacts would be deserialized and run).
-    Returns "" when no safe directory can be established."""
+    ``suffix`` separates per-backend caches — XLA:CPU AOT artifacts
+    cached under one flag configuration can SIGILL when loaded under
+    another, so CPU-pinned consumers (the test suite) must never
+    share a directory with TPU processes. Returns "" when no safe
+    directory can be established."""
     path = os.environ.get("CRDT_TPU_COMPILE_CACHE")
     if path == "":
         return ""  # explicitly disabled
@@ -40,6 +44,7 @@ def _safe_cache_dir() -> str:
         path = os.path.join(
             tempfile.gettempdir(), f"crdt_tpu_jax_cache_{os.getuid()}"
         )
+    path += suffix
     try:
         os.makedirs(path, mode=0o700, exist_ok=True)
         st = os.stat(path)
@@ -52,9 +57,25 @@ def _safe_cache_dir() -> str:
 
 _cache_dir = _safe_cache_dir()
 # never clobber a host application's own cache configuration: this is
-# a library — only fill the knob when it is unset
-if _cache_dir and not getattr(
-    jax.config, "jax_compilation_cache_dir", None
+# a library — only fill the knob when it is unset. CPU-pinned
+# processes (tests, the multichip dry run) skip the cache entirely:
+# XLA:CPU AOT artifacts cached under one flag/feature configuration
+# load under another with a SIGILL warning, CPU compiles are cheap,
+# and the cache's whole value is the expensive TPU compiles.
+def _cpu_pinned() -> bool:
+    """Best-effort CPU-backend detection WITHOUT initializing a
+    backend (resolving for real could hang on a dead TPU tunnel).
+    Machines with no accelerator and no pin keep the cache — their
+    artifacts are at least self-consistent per configuration."""
+    env = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    cfg = (getattr(jax.config, "jax_platforms", None) or "").strip().lower()
+    return env == "cpu" or cfg == "cpu"
+
+
+if (
+    _cache_dir
+    and not _cpu_pinned()
+    and not getattr(jax.config, "jax_compilation_cache_dir", None)
 ):
     try:
         jax.config.update("jax_compilation_cache_dir", _cache_dir)

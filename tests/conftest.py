@@ -34,13 +34,22 @@ import pytest
 
 def pytest_configure(config):
     # persistent XLA compilation cache: kernel tests compile each shape
-    # bucket once per machine instead of once per run
+    # bucket once per machine instead of once per run. Per-user,
+    # ownership-verified, and SEPARATE from the TPU processes' cache
+    # (a CPU backend must never load AOT artifacts cached under
+    # another flag configuration — SIGILL risk; see ops/device.py)
     import jax
 
-    jax.config.update("jax_compilation_cache_dir", "/tmp/crdt_tpu_jax_cache")
+    from crdt_tpu.ops.device import _safe_cache_dir
+
+    path = _safe_cache_dir(suffix="_cpu_tests")
+    if path:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.5
+        )
     # tests drive the jitted kernels directly with packed int64 ids
     jax.config.update("jax_enable_x64", True)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 @pytest.fixture(autouse=True)
