@@ -301,10 +301,24 @@ class IncrementalReplay:
         self._ds_pack = None
         # per-apply scratch: segkey -> this batch's admitted rows
         self._new_by_seg: Dict[int, List[int]] = {}
-        with jax.enable_x64(True):
-            self._mat = jnp.zeros((7, bucket_pow2(capacity)), jnp.int64)
-            self._mat = self._mat.at[3:6, :].set(-1)
+        # the resident device matrix allocates LAZILY on the first
+        # device round: construction must never touch the device (a
+        # swarm of host-path replicas would otherwise pay two tunnel
+        # dispatches each just to exist — measured as the resident
+        # mode's whole swarm deficit on bad-weather sessions)
+        self._capacity = capacity
+        self._mat = None
         self.n_dev = 0
+
+    def _ensure_mat(self):
+        if self._mat is None:
+            jax, jnp = self._jax, self._jnp
+            with jax.enable_x64(True):
+                m = jnp.zeros(
+                    (7, bucket_pow2(self._capacity)), jnp.int64
+                )
+                self._mat = m.at[3:6, :].set(-1)
+        return self._mat
 
     # -- interning ----------------------------------------------------
     def _intern_clients(self, raw_ids: np.ndarray) -> None:
@@ -1338,6 +1352,7 @@ class IncrementalReplay:
             # invalid on device: origin lookups that miss them fall
             # back to root attachment, same convention as the cold path
 
+            self._ensure_mat()
             need = self.n_dev + kpad
             if need > self._mat.shape[1]:
                 with jax.enable_x64(True):
