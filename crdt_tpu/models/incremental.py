@@ -644,6 +644,31 @@ class IncrementalReplay:
         self._host_order_segment(sk)
         return False
 
+    def _is_chained_run(self, new_rows: List[int]) -> bool:
+        """Verify the contract both local seq splices rely on: the
+        batch is ONE chained run at ONE insertion point — each row
+        after the head declares the preceding new row as its origin
+        and shares the head's right anchor. A caller that batches two
+        independent inserts on the same segment into one call bends
+        this; verifying here turns silent misordering into the exact
+        fallback (advisor finding, round 4)."""
+        if len(new_rows) <= 1:
+            return True
+        c = self.cols
+        cl, ck = c.col("client"), c.col("clock")
+        oc, ock = c.col("oc"), c.col("ock")
+        rc, rk = c.col("right_client"), c.col("right_clock")
+        head = new_rows[0]
+        hr = (int(rc[head]), int(rk[head]))
+        prev = head
+        for row in new_rows[1:]:
+            if (int(oc[row]), int(ock[row])) != (int(cl[prev]), int(ck[prev])):
+                return False
+            if (int(rc[row]), int(rk[row])) != hr:
+                return False
+            prev = row
+        return True
+
     def _splice_seq_local(self, sk: int, new_rows: List[int]):
         """One local insert run: chained records sharing an insertion
         point. The caller read ``left``/``right`` as ADJACENT rows of
@@ -652,6 +677,9 @@ class IncrementalReplay:
         regardless of how the surrounding rows were ordered. Moved
         anchors (contract bent) re-derive exactly. Returns "append" /
         "mid" for a fast splice, False after a full re-derive."""
+        if not self._is_chained_run(new_rows):
+            self._host_order_segment(sk)
+            return False
         if sk in self._linked:
             return self._splice_seq_local_linked(sk, new_rows)
         order = self._order.get(sk)
@@ -995,7 +1023,14 @@ class IncrementalReplay:
         no materialization (crdt.js's `c` equivalent)."""
         if self._dirty:
             dirty, self._dirty = self._dirty, set()
-            self._rebuild_cache(dirty)
+            try:
+                self._rebuild_cache(dirty)
+            except BaseException:
+                # a failed rebuild must not mark the segments clean:
+                # the JSON view would stay permanently stale while
+                # reporting fresh (advisor finding, round 4)
+                self._dirty |= dirty
+                raise
         return self._cache
 
     # -- order access (list, positions, linked chains) ----------------

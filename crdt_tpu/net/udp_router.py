@@ -64,17 +64,33 @@ def _canon_addr(host: str, port: int) -> Tuple[str, int]:
     tuple that will appear as a UDP source address. Introducer trust
     compares observed sources against the bootstrap list; a hostname
     entry would never match its numeric source and trust would silently
-    never be granted (advisor finding, round 3)."""
+    never be granted (advisor finding, round 3).
+
+    Resolution is pinned to AF_INET because the native transport's
+    sockets are IPv4-only (``native/transport/transport.cc`` binds
+    ``AF_INET``) — an AAAA-only answer could never appear as a source
+    address on that socket anyway. IPv6 bootstrap entries (literals or
+    IPv6-only hostnames) are therefore unsupported; they fail loudly
+    here instead of silently never matching (advisor finding, round 4).
+    """
     try:
         infos = socket.getaddrinfo(
             host, port, socket.AF_INET, socket.SOCK_DGRAM
         )
         return (infos[0][4][0], int(port))
     except OSError:
-        _log.warning(
-            "bootstrap entry %s:%s did not resolve; introducer trust "
-            "will never match this entry until restart", host, port,
-        )
+        if ":" in host:
+            _log.error(
+                "bootstrap entry %s:%s looks like an IPv6 literal; the "
+                "transport is IPv4-only — this entry can never grant "
+                "introducer trust", host, port,
+            )
+        else:
+            _log.warning(
+                "bootstrap entry %s:%s did not resolve over IPv4 (the "
+                "transport is IPv4-only); introducer trust will never "
+                "match this entry until restart", host, port,
+            )
         return (host, int(port))
 
 
@@ -176,6 +192,8 @@ class UdpRouter:
         # peers reached at a configured bootstrap address — the stated
         # trust anchor — never from arbitrary swarm members.
         self._rendezvous = rendezvous
+        # bootstrap entries are (host, port) with IPv4-only resolution:
+        # the native transport's sockets are AF_INET (_canon_addr).
         self._bootstrap = list(bootstrap or [])
         # canonical (ip, port) forms of the bootstrap entries — the set
         # observed UDP sources are compared against for introducer

@@ -38,6 +38,7 @@ def _safe_cache_dir(suffix: str = "") -> str:
     path = os.environ.get("CRDT_TPU_COMPILE_CACHE")
     if path == "":
         return ""  # explicitly disabled
+    explicit = path is not None
     if path is None:
         import tempfile
 
@@ -46,8 +47,34 @@ def _safe_cache_dir(suffix: str = "") -> str:
         )
     path += suffix
     try:
-        os.makedirs(path, mode=0o700, exist_ok=True)
-        st = os.stat(path)
+        if explicit:
+            # a user-configured path may deliberately be a symlink
+            # (e.g. onto a larger disk); the planting attack needs the
+            # PREDICTABLE default name in shared /tmp, so here we
+            # follow links but still require the resolved directory
+            # be owner-only
+            os.makedirs(path, mode=0o700, exist_ok=True)
+            st = os.stat(path)
+        else:
+            # default shared-/tmp path: never create through or adopt
+            # a pre-planted symlink. mkdir (unlike makedirs+stat)
+            # fails on an existing symlink instead of following it,
+            # so a dangling link cannot make us create the attacker's
+            # target; lstat then refuses the link itself (advisor
+            # finding, round 4: the previous stat-based check was a
+            # symlink TOCTOU).
+            parent = os.path.dirname(path)
+            if parent:
+                os.makedirs(parent, mode=0o700, exist_ok=True)
+            try:
+                os.mkdir(path, 0o700)
+            except FileExistsError:
+                pass
+            st = os.lstat(path)
+            import stat as _stat
+
+            if not _stat.S_ISDIR(st.st_mode):
+                return ""  # symlink or non-directory: refuse
         if st.st_uid != os.getuid() or (st.st_mode & 0o022):
             return ""  # foreign or group/world-writable: refuse
     except OSError:

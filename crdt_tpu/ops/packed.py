@@ -390,17 +390,29 @@ def stage(cols: Dict[str, np.ndarray],
         return None
     # rank-0 lower-bound width precheck (the exact check re-runs after
     # _stage_rights can only RAISE cbits via simulated group ranks)
-    if (
-        int(max(kpad, B) + Sb + 1).bit_length()
-        + _even_up(max(8, len(uniq).bit_length()))
-        + (kpad - 1).bit_length()
-    ) > 63:
+    pbits = int(max(kpad, B) + Sb + 1).bit_length()
+    qbits = (kpad - 1).bit_length()
+    if pbits + _even_up(max(8, len(uniq).bit_length())) + qbits > 63:
         return None
+    # eagerness gate: a group's simulated rank is bounded by its
+    # segment's row count, so if even the pessimistic cbits (max_seq
+    # as the rank bound) fit, _stage_rights cannot push the exact
+    # check past 63 and the stages may ship before it runs. A batch
+    # near the width limit defers its puts until the exact check —
+    # otherwise three dead tunnel transfers would queue before the
+    # fallback (advisor finding, round 4).
+    eager = put is not None and (
+        pbits
+        + _even_up(max(
+            8, len(uniq).bit_length(), (max_seq + 1).bit_length()
+        ))
+        + qbits
+    ) <= 63
     r1 = np.full(kpad, -1, np.int32)
     r1[:n] = np.where(
         seg >= 0, seg | np.where(kid_s < 0, _SEQ_FLAG, 0), -1
     )
-    d1 = put(r1) if put is not None else None
+    d1 = put(r1) if eager else None
 
     # origin rows by binary search over the sorted ids (leftmost match
     # is the kept representative of any duplicate run)
@@ -417,7 +429,8 @@ def stage(cols: Dict[str, np.ndarray],
     if put is not None:
         r2 = np.full(kpad, -1, np.int32)
         r2[:n] = origin_map
-        d2 = put(r2)
+        if eager:
+            d2 = put(r2)
 
     # compact sequence block: seq rows ascending (= id rank ascending),
     # same-segment origins resolved to compact positions
@@ -438,7 +451,8 @@ def stage(cols: Dict[str, np.ndarray],
         r34 = np.full((2, B), -1, np.int32)
         r34[0, :n_seq] = seq_rows
         r34[1, :n_seq] = c_parent
-        d34 = put(r34)
+        if eager:
+            d34 = put(r34)
 
     # right-origin attachment ordering (mid-inserts/prepends): groups
     # with in-group anchors get their exact conflict-scan ranks
@@ -460,14 +474,16 @@ def stage(cols: Dict[str, np.ndarray],
     cbits = _even_up(max(
         8, len(uniq).bit_length(), (max_rank + 1).bit_length()
     ))
-    qbits = (kpad - 1).bit_length()
     # (the 2^31 width guard already ran before the first eager put;
     # only the rank-dependent cbits can have grown since)
-    pbits = int(max(kpad, B) + Sb + 1).bit_length()
     if pbits + cbits + qbits > 63:
         return None
 
     if put is not None:
+        if not eager:  # width-deferred stages ship now, post-check
+            d1 = put(r1)
+            d2 = put(r2)
+            d34 = put(r34)
         r0 = np.zeros(kpad, np.int32)
         r0[:n] = client_s
         d0 = put(r0)
