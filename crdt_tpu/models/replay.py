@@ -101,10 +101,7 @@ def _converge_resident(cols, clients):
     )
     # tight segment bound: distinct (map parent, key) pairs + sequence
     # roots (the capacity default doubles the ranking kernel's span)
-    n_segs = len(np.unique(
-        (cols["parent_a"] << 21)
-        | np.where(cols["key_id"] >= 0, cols["key_id"], 1 << 20)
-    ))
+    n_segs = segment_bound(cols)
     # fused: splice + both kernels = ONE dispatch
     maps_out, seq_out = rc.append_converge(
         cols, num_segments=bucket_pow2(n_segs)
@@ -145,8 +142,23 @@ def gather(dec: Dict, ds: DeleteSet, handle):
         if hard:
             affected = {parent_spec(dec, int(r)) for r in hard}
             seq_orders.update(_host_seq_orders(dec, affected))
-    else:
-        win_rows, seq_orders = _assemble_resident(dec, handle[1])
+        return finish_assembly(
+            dec, ds, win_rows, seq_orders, blanket_rights=False
+        )
+    win_rows, seq_orders = _assemble_resident(dec, handle[1])
+    return finish_assembly(dec, ds, win_rows, seq_orders)
+
+
+def finish_assembly(dec: Dict, ds: DeleteSet, win_rows, seq_orders,
+                    *, blanket_rights: bool = True):
+    """Shared assembly tail for every convergence engine (resident,
+    packed, fleet): the blanket right-origin host detour — applied
+    when the producing kernels ignore rights entirely, skipped when
+    the producer already ordered its expressible rights at staging —
+    then crafted-map-chain repair and winner visibility. One
+    implementation, so a future right-origin fix reaches every
+    route."""
+    if blanket_rights:
         rc_col, kid_col = dec["right_client"], dec["key_id"]
         right_seq_rows = np.flatnonzero((rc_col >= 0) & (kid_col < 0))
         if len(right_seq_rows):
@@ -155,6 +167,18 @@ def gather(dec: Dict, ds: DeleteSet, handle):
     win_rows = _fix_map_chains_with_rights(dec, win_rows)
     win_vis = visible_mask(dec, win_rows, ds)
     return win_rows, win_vis, seq_orders
+
+
+def segment_bound(cols: Dict[str, np.ndarray]) -> int:
+    """Tight distinct-segment count for the convergence kernels:
+    distinct (map parent, key) pairs + sequence parents, computed in
+    one packed unique (parents shifted past the 2^20 key space; the
+    no-key sentinel occupies its own slot per parent)."""
+    pa = np.asarray(cols["parent_a"], np.int64)
+    kid = np.asarray(cols["key_id"], np.int64)
+    if not len(pa):
+        return 1
+    return len(np.unique((pa << 21) | np.where(kid >= 0, kid, 1 << 20)))
 
 
 def _assemble_packed(dec: Dict, res):
@@ -443,9 +467,19 @@ def replay_trace(
       a resident replica takes when it ingests this backlog), above
       it the device pipeline runs.
     - ``"host"`` — force the host machinery.
+    - ``"fleet"`` — the mesh axis: each blob is treated as one
+      replica's pending broadcast and the whole set converges as ONE
+      sharded gossip+merge round over the device mesh
+      (:func:`crdt_tpu.models.fleet.fleet_replay` — the reference's
+      full-mesh propagate round, crdt.js:385,445, as a collective).
+      Requires a causally complete union, like the device route.
 
-    Both engines are differential-tested against each other and the
+    All engines are differential-tested against each other and the
     scalar oracle; ``ReplayResult.path`` records which one ran."""
+    if route == "fleet":
+        from crdt_tpu.models.fleet import fleet_replay
+
+        return fleet_replay(blobs)
     dec = decode(blobs)
     n = len(dec["client"])
     use_host = False

@@ -174,7 +174,7 @@ def test_hierarchical_2d_mesh_matches_flat_gossip():
 
     for name, a, b in zip(
         ("sv_local", "global_sv", "deficit", "winners", "winner_visible",
-         "seq_order", "seq_seg", "seq_rank", "seq_len"),
+         "seq_order", "seq_seg", "seq_rank", "seq_len", "map_order"),
         flat, hier,
     ):
         np.testing.assert_array_equal(a, b, err_msg=f"{name} diverges")
