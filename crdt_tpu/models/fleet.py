@@ -137,32 +137,42 @@ class ReplicaFleet:
         cols: Dict[str, np.ndarray],
         dels: Tuple[np.ndarray, np.ndarray, np.ndarray],
     ) -> FleetStep:
-        """One full gossip round: fan-in, converge, handshake."""
+        """One full gossip round: fan-in, converge, handshake. One
+        packed upload, one dispatch, one packed fetch — the tunnel
+        pays three fixed interaction latencies per round, not ~20."""
         import jax
         import jax.numpy as jnp
+
+        from crdt_tpu.parallel.gossip import (
+            fleet_out_sizes,
+            pack_cols,
+            pack_dels,
+            unpack_fleet_out,
+        )
 
         tracer = get_tracer()
         with tracer.span("fleet.step"):
             out = self._step(
-                jnp.asarray(cols["client"]),
-                jnp.asarray(cols["clock"]),
-                jnp.asarray(cols["parent_is_root"]),
-                jnp.asarray(cols["parent_a"]),
-                jnp.asarray(cols["parent_b"]),
-                jnp.asarray(cols["key_id"]),
-                jnp.asarray(cols["origin_client"]),
-                jnp.asarray(cols["origin_clock"]),
-                jnp.asarray(cols["valid"]),
-                jnp.asarray(dels[0]),
-                jnp.asarray(dels[1]),
-                jnp.asarray(dels[2]),
+                jnp.asarray(pack_cols(cols)),
+                jnp.asarray(pack_dels(dels)),
             )
             jax.block_until_ready(out)
+            vec = np.asarray(out)
         if tracer.enabled:  # the mask reduction isn't free at 100M ops
             tracer.count(
                 "fleet.ops_converged", int(np.asarray(cols["valid"]).sum())
             )
-        return FleetStep(*(np.asarray(x) for x in out))
+        R = self.n_replicas
+        N = self.ops_per_replica
+        parts = unpack_fleet_out(
+            vec, R, N, self.num_clients, self.num_segments
+        )
+        return FleetStep(**{
+            name: parts[name]
+            for name, _ in fleet_out_sizes(
+                R, N, self.num_clients, self.num_segments
+            )
+        })
 
     def delta_round(
         self,
@@ -421,34 +431,294 @@ def gather_fleet(
     :func:`crdt_tpu.models.replay.gather` produces, so materialization
     is shared. Right-origin shapes take the identical exact host
     detours as the resident fallback."""
-    from crdt_tpu.models.replay import finish_assembly, parent_spec
+    from crdt_tpu.models.replay import finish_assembly
 
     dec, ds = trace.dec, trace.ds
     rm = trace.row_map.reshape(-1)
-    sorder = out.seq_order  # id-sorted position -> flattened [R*N] row
-    morder = out.map_order  # the MAP kernel's own permutation
+    win_rows = _winner_rows(
+        rm, np.asarray(out.winners), np.asarray(out.map_order)
+    )
+    seq_orders = _seq_orders_from(
+        dec, rm,
+        np.asarray(out.seq_order),
+        np.asarray(out.seq_seg),
+        np.asarray(out.seq_rank),
+    )
+    return finish_assembly(dec, ds, win_rows, seq_orders)
+
+
+def _winner_rows(rm: np.ndarray, winners: np.ndarray,
+                 map_order: np.ndarray) -> List[int]:
+    """Union winner rows from one device's (winners, id-sort perm)."""
+    w = winners[winners >= 0]
+    rows = rm[map_order[w].astype(np.int64)]
+    return rows[rows >= 0].astype(np.int64).tolist()
+
+
+def _seq_orders_from(dec, rm: np.ndarray, sorder: np.ndarray,
+                     sseg: np.ndarray, srank: np.ndarray,
+                     into: Optional[dict] = None) -> dict:
+    """Vectorized per-sequence document orders (same lexsort +
+    run-cuts shape as replay._assemble_packed): ranked positions ->
+    union rows grouped by segment, ordered by rank."""
+    from crdt_tpu.models.replay import parent_spec
+
+    seq_orders: dict = {} if into is None else into
+    pos = np.flatnonzero(srank >= 0)
+    if not len(pos):
+        return seq_orders
+    rows = rm[sorder[pos].astype(np.int64)]
+    keep = rows >= 0
+    pos, rows = pos[keep], rows[keep]
+    if not len(pos):
+        return seq_orders
+    order2 = np.lexsort((srank[pos], sseg[pos]))
+    segs_s = sseg[pos][order2]
+    rows_s = rows[order2]
+    cuts = np.r_[
+        0, np.flatnonzero(segs_s[1:] != segs_s[:-1]) + 1, len(segs_s)
+    ]
+    for a, b in zip(cuts[:-1], cuts[1:]):
+        chunk = rows_s[a:b].astype(np.int64).tolist()
+        seq_orders[parent_spec(dec, chunk[0])] = chunk
+    return seq_orders
+
+
+class SegStep(NamedTuple):
+    """Outputs of one segment-sharded round (local spaces per device;
+    see :func:`crdt_tpu.parallel.gossip.make_segment_sharded_step`).
+    ``svs``/``global_sv`` are the trace's host-built handshake vectors
+    (pure functions of the staged columns), carried here for API
+    parity with :class:`FleetStep`."""
+
+    svs: np.ndarray             # [R, C] per-replica own-op vectors
+    global_sv: np.ndarray       # [C]
+    deficit: np.ndarray         # [R, R]
+    winners: np.ndarray         # [nd, S] local id-sorted winner indices
+    winner_visible: np.ndarray  # [nd, S]
+    seq_order: np.ndarray       # [nd, N_d] local id-sort permutations
+    seq_seg: np.ndarray         # [nd, N_d] per-device dense sequence ids
+    seq_rank: np.ndarray        # [nd, N_d]
+    seq_len: np.ndarray         # [nd, S]
+    map_order: np.ndarray       # [nd, N_d]
+
+
+class ShardedTrace(NamedTuple):
+    """A :class:`FleetTrace` re-partitioned BY SEGMENT over a mesh:
+    one device owns every row of each (parent, key) chain and each
+    sequence, so convergence divides across devices instead of
+    replicating (the scaling mode). ``row_map`` is [nd, N_d] -> union
+    decode row."""
+
+    cols: Dict[str, np.ndarray]  # [nd, N_d], incl. "replica"
+    dels: Tuple[np.ndarray, np.ndarray, np.ndarray]
+    row_map: np.ndarray
+    dec: Dict
+    ds: object
+    n_replicas: int
+    num_clients: int
+    num_segments: int  # per-device bound
+    svs: np.ndarray    # [R, C] host-built per-replica own-op vectors
+    global_sv: np.ndarray  # [C]
+
+    @property
+    def n_devices(self) -> int:
+        return self.row_map.shape[0]
+
+    @property
+    def n_ops(self) -> int:
+        return int((self.row_map >= 0).sum())
+
+
+def shard_trace(trace: FleetTrace, n_devices: int) -> ShardedTrace:
+    """Partition a replica-sharded trace's union BY SEGMENT into
+    ``n_devices`` balanced shards (greedy largest-first by row
+    count). Rows keep a ``replica`` attribution column so the SV
+    handshake can still produce every replica's own-op vector."""
+    from crdt_tpu.ops.device import bucket_pow2
+
+    N = trace.ops_per_replica
+    flat_valid = trace.cols["valid"].reshape(-1)
+    idx = np.flatnonzero(flat_valid)
+    replica = (idx // N).astype(np.int32)
+    cf = {k: v.reshape(-1)[idx] for k, v in trace.cols.items()}
+    union_rows = trace.row_map.reshape(-1)[idx]
+
+    from crdt_tpu.models.replay import segment_key
+
+    segkey = segment_key(cf["parent_a"], cf["key_id"])
+    uniq_sk, seg_inv, seg_counts = np.unique(
+        segkey, return_inverse=True, return_counts=True
+    )
+    # greedy balance: largest segments first, always into the
+    # lightest bin (a single huge sequence still bounds the critical
+    # path — that is the honest limit of segment parallelism)
+    bins = np.zeros(len(uniq_sk), np.int32)
+    loads = np.zeros(n_devices, np.int64)
+    segs_per = np.zeros(n_devices, np.int64)
+    for s in np.argsort(-seg_counts):
+        b = int(np.argmin(loads))
+        bins[s] = b
+        loads[b] += int(seg_counts[s])
+        segs_per[b] += 1
+    row_bin = bins[seg_inv]
+
+    N_d = bucket_pow2(max(int(loads.max()), 16))
+    nd = n_devices
+    defaults = {
+        "client": 0, "clock": 0, "parent_is_root": False,
+        "parent_a": -2, "parent_b": -2, "key_id": -1,
+        "origin_client": -1, "origin_clock": -1, "valid": False,
+    }
+    cols = {
+        k: np.full((nd, N_d), fill, dtype=cf[k].dtype)
+        for k, fill in defaults.items()
+    }
+    cols["replica"] = np.zeros((nd, N_d), np.int32)
+    row_map = np.full((nd, N_d), -1, np.int64)
+    for b in range(nd):
+        sel = np.flatnonzero(row_bin == b)
+        for k in defaults:
+            cols[k][b, : len(sel)] = cf[k][sel]
+        cols["replica"][b, : len(sel)] = replica[sel]
+        row_map[b, : len(sel)] = union_rows[sel]
+    # the handshake's per-replica own-op vectors are a pure O(rows)
+    # function of the staged columns — built here once, on host; the
+    # mesh keeps only the O(R^2 C) pairwise deficit (the superlinear
+    # term), rows sharded
+    R = trace.n_replicas
+    C = trace.num_clients
+    svs = np.zeros((R, C), np.int64)
+    if len(idx):
+        np.maximum.at(
+            svs,
+            (replica, cf["client"].astype(np.int64)),
+            cf["clock"].astype(np.int64) + 1,
+        )
+    return ShardedTrace(
+        cols=cols,
+        dels=trace.dels,
+        row_map=row_map,
+        dec=trace.dec,
+        ds=trace.ds,
+        n_replicas=R,
+        num_clients=C,
+        num_segments=bucket_pow2(max(int(segs_per.max()), 16)),
+        svs=svs,
+        global_sv=svs.max(axis=0) if R else np.zeros(C, np.int64),
+    )
+
+
+_SEG_COL_ORDER = (  # device-facing; "replica" stays host-side (SV build)
+    "client", "clock", "parent_is_root", "parent_a",
+    "parent_b", "key_id", "origin_client", "origin_clock", "valid",
+)
+
+
+class SegmentedFleet:
+    """The segment-sharded sibling of :class:`ReplicaFleet` — the
+    mode where the mesh DIVIDES merge work instead of replicating it.
+    Static shapes come from the staged trace; any trace staged with
+    the same buckets reuses the compiled step."""
+
+    def __init__(
+        self,
+        sharded: ShardedTrace,
+        *,
+        mesh=None,
+        n_devices: Optional[int] = None,
+    ):
+        import jax
+
+        from crdt_tpu.parallel.gossip import make_segment_sharded_step
+
+        jax.config.update("jax_enable_x64", True)
+        self.mesh = mesh if mesh is not None else make_mesh(n_devices)
+        nd = self.mesh.devices.size
+        if sharded.n_devices != nd:
+            raise ValueError(
+                f"trace sharded for {sharded.n_devices} devices, "
+                f"mesh has {nd}"
+            )
+        self.num_clients = sharded.num_clients
+        self.num_segments = sharded.num_segments
+        self.n_replicas = sharded.n_replicas
+        self._step = make_segment_sharded_step(
+            self.mesh,
+            num_segments=sharded.num_segments,
+            n_replicas=sharded.n_replicas,
+        )
+
+    def step(self, sharded: ShardedTrace) -> SegStep:
+        """One packed upload per operand, one dispatch, one packed
+        fetch (the per-device blocks concatenate into one vector)."""
+        import jax
+        import jax.numpy as jnp
+
+        from crdt_tpu.parallel.gossip import (
+            pack_cols,
+            pack_dels,
+            segment_out_sizes,
+        )
+
+        tracer = get_tracer()
+        nd, N_d = sharded.row_map.shape
+        R = self.n_replicas
+        blk = -(-R // nd)
+        with tracer.span("fleet.seg_step"):
+            out = self._step(
+                jnp.asarray(pack_cols(sharded.cols)),
+                jnp.asarray(sharded.svs),
+                jnp.asarray(pack_dels(sharded.dels)),
+            )
+            jax.block_until_ready(out)
+            vec = np.asarray(out).reshape(nd, -1)
+        sizes = segment_out_sizes(blk, R, N_d, self.num_segments)
+        parts: Dict[str, np.ndarray] = {}
+        off = 0
+        for name, size in sizes:
+            parts[name] = vec[:, off: off + size]
+            off += size
+        deficit = parts["deficit"].reshape(nd * blk, R)[:R]
+        return SegStep(
+            svs=sharded.svs,
+            global_sv=sharded.global_sv,
+            deficit=deficit,
+            winners=parts["winners"],
+            winner_visible=parts["winner_visible"],
+            seq_order=parts["seq_order"],
+            seq_seg=parts["seq_seg"],
+            seq_rank=parts["seq_rank"],
+            seq_len=parts["seq_len"],
+            map_order=parts["map_order"],
+        )
+
+
+def gather_sharded(
+    sharded: ShardedTrace, out: SegStep
+) -> Tuple[list, list, dict]:
+    """Assemble a segment-sharded round back into document form (the
+    per-device blocks are independent segment sets, so assembly is a
+    concatenation keyed by (device, local segment))."""
+    from crdt_tpu.models.replay import finish_assembly
+
+    dec, ds = sharded.dec, sharded.ds
+    nd = sharded.n_devices
 
     win_rows: List[int] = []
-    for w in out.winners:
-        if w < 0:
-            continue
-        row = int(rm[int(morder[int(w)])])
-        if row >= 0:
-            win_rows.append(row)
-
-    seq_pairs: Dict[int, List[Tuple[int, int]]] = {}
-    for p in np.flatnonzero(out.seq_rank >= 0):
-        row = int(rm[int(sorder[p])])
-        if row >= 0:
-            seq_pairs.setdefault(int(out.seq_seg[p]), []).append(
-                (int(out.seq_rank[p]), row)
-            )
     seq_orders: dict = {}
-    for _, pairs in seq_pairs.items():
-        pairs.sort()
-        rows = [r for _, r in pairs]
-        seq_orders[parent_spec(dec, rows[0])] = rows
-
+    for d in range(nd):  # devices hold disjoint segments: no merging
+        rm = sharded.row_map[d]
+        win_rows.extend(_winner_rows(
+            rm, np.asarray(out.winners[d]), np.asarray(out.map_order[d])
+        ))
+        _seq_orders_from(
+            dec, rm,
+            np.asarray(out.seq_order[d]),
+            np.asarray(out.seq_seg[d]),
+            np.asarray(out.seq_rank[d]),
+            into=seq_orders,
+        )
     return finish_assembly(dec, ds, win_rows, seq_orders)
 
 
@@ -459,25 +729,65 @@ def fleet_replay(
     n_devices: Optional[int] = None,
     trace: Optional[FleetTrace] = None,
     fleet: Optional["ReplicaFleet"] = None,
+    shard: str = "replicas",
 ):
     """One-shot PRODUCT entry: per-replica update blobs in, converged
     cache + compacted snapshot out, convergence computed as ONE
     sharded gossip+merge round over the device mesh. This is
     ``replay_trace(route="fleet")``'s engine — the swarm firehose
     (every peer's pending broadcast merged at once) as opposed to the
-    single-chip cold replay's one-union dispatch."""
+    single-chip cold replay's one-union dispatch.
+
+    ``shard`` picks the mesh mapping:
+
+    - ``"replicas"`` (default) — the reference's full-mesh shape:
+      replica-sharded columns, all-gather fan-in, REPLICATED converge
+      (every device ends the round holding the whole result).
+    - ``"segments"`` — the scaling mode: the union partitions by
+      segment, each device converges only its shard (per-device work
+      ~1/nd), and only the SV handshake crosses the mesh."""
     from crdt_tpu.models.replay import ReplayResult, compact, materialize
 
     if mesh is None and fleet is not None:
         mesh = fleet.mesh
     if mesh is None:
         mesh = make_mesh(n_devices)
-    if trace is None:
-        trace = load_trace(blobs, replicas_multiple=mesh.devices.size)
-    if fleet is None:
-        fleet = fleet_for_trace(trace, mesh=mesh)
-    out = fleet.step(trace.cols, trace.dels)
-    win_rows, win_vis, seq_orders = gather_fleet(trace, out)
+    if shard == "segments":
+        if trace is None:
+            trace = load_trace(blobs, replicas_multiple=1)
+        sharded = shard_trace(trace, mesh.devices.size)
+        seg_fleet = SegmentedFleet(sharded, mesh=mesh)
+        out = seg_fleet.step(sharded)
+        win_rows, win_vis, seq_orders = gather_sharded(sharded, out)
+    elif shard == "replicas":
+        if trace is None:
+            trace = load_trace(blobs, replicas_multiple=mesh.devices.size)
+        if fleet is None:
+            fleet = fleet_for_trace(trace, mesh=mesh)
+        elif (
+            trace.num_clients > fleet.num_clients
+            or trace.num_segments > fleet.num_segments
+            or trace.row_map.shape
+            != (fleet.n_replicas, fleet.ops_per_replica)
+        ):
+            # input SHAPES alone can match a compiled step whose
+            # client/segment tables are too small — interned ids then
+            # fall off the SV table and the anti-entropy plan comes
+            # back silently wrong. Reuse requires trace buckets to fit
+            # the fleet's compiled bounds.
+            raise ValueError(
+                f"trace buckets (R,N)={trace.row_map.shape} "
+                f"clients={trace.num_clients} "
+                f"segments={trace.num_segments} do not fit the reused "
+                f"fleet (R,N)=({fleet.n_replicas},"
+                f"{fleet.ops_per_replica}) "
+                f"clients={fleet.num_clients} "
+                f"segments={fleet.num_segments}"
+            )
+        out = fleet.step(trace.cols, trace.dels)
+        win_rows, win_vis, seq_orders = gather_fleet(trace, out)
+    else:
+        raise ValueError(f"unknown shard mode {shard!r}")
     cache = materialize(trace.dec, trace.ds, win_rows, win_vis, seq_orders)
     return ReplayResult(
         cache=cache,

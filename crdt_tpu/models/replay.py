@@ -169,16 +169,24 @@ def finish_assembly(dec: Dict, ds: DeleteSet, win_rows, seq_orders,
     return win_rows, win_vis, seq_orders
 
 
+def segment_key(pa: np.ndarray, kid: np.ndarray) -> np.ndarray:
+    """ONE packed (parent, key) segment identity, shared by the
+    segment-count bound below and the mesh partitioner
+    (crdt_tpu.models.fleet.shard_trace): parents shifted past the
+    2^20 key space; the no-key sentinel occupies its own slot per
+    parent. Both consumers must agree bit-for-bit — the partitioner's
+    correctness rests on whole segments staying co-located."""
+    pa = np.asarray(pa, np.int64)
+    kid = np.asarray(kid, np.int64)
+    return (pa << 21) | np.where(kid >= 0, kid, 1 << 20)
+
+
 def segment_bound(cols: Dict[str, np.ndarray]) -> int:
     """Tight distinct-segment count for the convergence kernels:
-    distinct (map parent, key) pairs + sequence parents, computed in
-    one packed unique (parents shifted past the 2^20 key space; the
-    no-key sentinel occupies its own slot per parent)."""
-    pa = np.asarray(cols["parent_a"], np.int64)
-    kid = np.asarray(cols["key_id"], np.int64)
-    if not len(pa):
+    distinct (map parent, key) pairs + sequence parents."""
+    if not len(np.asarray(cols["parent_a"])):
         return 1
-    return len(np.unique((pa << 21) | np.where(kid >= 0, kid, 1 << 20)))
+    return len(np.unique(segment_key(cols["parent_a"], cols["key_id"])))
 
 
 def _assemble_packed(dec: Dict, res):

@@ -54,17 +54,28 @@ def merge(svs: jnp.ndarray) -> jnp.ndarray:
     return jnp.max(svs, axis=0)
 
 
+def exact_missing_rows(rows: jnp.ndarray, svs: jnp.ndarray) -> jnp.ndarray:
+    """[B, C] x [R, C] -> [B, R] deficit rows: what each of ``rows``'s
+    replicas holds that every replica in ``svs`` lacks. The block form
+    of :func:`exact_missing` — the mesh-sharded handshake computes
+    each device's row block with the SAME scan body (the Pallas
+    deficit tile is square-only, so sharded blocks take this exact
+    path; each block is R/nd rows, so the superlinear term is already
+    divided)."""
+
+    def row(_, sv_i):
+        return None, jnp.maximum(sv_i[None, :] - svs, 0).sum(axis=-1)
+
+    _, out = jax.lax.scan(row, None, rows)
+    return out
+
+
 def exact_missing(svs: jnp.ndarray) -> jnp.ndarray:
     """Exact [R, R] deficit matrix in the input dtype, O(R·C) live
     memory: a scan over rows keeps one [R, C] broadcast alive per step
     instead of materializing [R, R, C] (4 GB at the north-star
     1k replicas × 1k clients)."""
-
-    def row(_, sv_i):
-        return None, jnp.maximum(sv_i[None, :] - svs, 0).sum(axis=-1)
-
-    _, out = jax.lax.scan(row, None, svs)
-    return out
+    return exact_missing_rows(svs, svs)
 
 
 def missing(svs: jnp.ndarray) -> jnp.ndarray:

@@ -192,6 +192,60 @@ class TestFleetReplay:
                 out = fleet_replay(blobs, trace=tr, fleet=fleet)
                 assert out.cache == oracle_cache(blobs)
 
+    def test_reused_fleet_rejects_oversized_trace(self, mesh8):
+        """A trace whose buckets exceed a reused fleet's compiled
+        bounds must raise, not silently overflow the SV table."""
+        b1 = build_round_blobs(4, 5, seed=9)
+        b2 = build_round_blobs(16, 8, seed=10)  # more clients
+        t1 = load_trace(b1, replicas_multiple=8)
+        t2 = load_trace(b2, replicas_multiple=8)
+        fleet = fleet_for_trace(t1, mesh=mesh8)
+        assert t2.num_clients > fleet.num_clients
+        with pytest.raises(ValueError):
+            fleet_replay(b2, trace=t2, fleet=fleet)
+
+    def test_segment_sharded_matches_engine(self, mesh8):
+        """The scaling mode: union partitioned by segment, each device
+        converging only its shard, must still reproduce the engine —
+        and its SV handshake must match the replica-sharded step's."""
+        from crdt_tpu.models.fleet import (
+            SegmentedFleet,
+            gather_sharded,
+            load_trace,
+            shard_trace,
+        )
+
+        for seed in range(3):
+            blobs = build_round_blobs(8, 10, seed=30 + seed)
+            want = oracle_cache(blobs)
+            out = fleet_replay(blobs, mesh=mesh8, shard="segments")
+            assert out.cache == want, f"seed {seed} diverges"
+            # handshake parity: per-replica SVs from the segment
+            # layout equal the replica layout's
+            tr = load_trace(blobs, replicas_multiple=8)
+            fl = fleet_for_trace(tr, mesh=mesh8)
+            rep_out = fl.step(tr.cols, tr.dels)
+            tr1 = load_trace(blobs, replicas_multiple=1)
+            sh = shard_trace(tr1, 8)
+            seg_out = SegmentedFleet(sh, mesh=mesh8).step(sh)
+            np.testing.assert_array_equal(
+                rep_out.global_sv, seg_out.global_sv
+            )
+            R = len(blobs)
+            np.testing.assert_array_equal(
+                rep_out.sv_local[:R], seg_out.svs[:R]
+            )
+            np.testing.assert_array_equal(
+                rep_out.deficit[:R, :R], seg_out.deficit[:R, :R]
+            )
+
+    def test_segment_sharded_single_device(self):
+        from crdt_tpu.parallel.gossip import make_mesh
+
+        blobs = build_round_blobs(5, 8, seed=40)
+        out = fleet_replay(blobs, mesh=make_mesh(1), shard="segments")
+        assert out.cache == oracle_cache(blobs)
+
     def test_snapshot_replays_to_same_cache(self, mesh8):
         """The compacted snapshot a fleet round emits is a valid v1
         blob that cold-replays to the identical document."""

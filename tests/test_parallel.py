@@ -20,12 +20,23 @@ def mesh():
 
 
 def run_step(mesh, cols, dels, num_segments, num_clients):
+    from crdt_tpu.parallel.gossip import (
+        fleet_out_sizes,
+        pack_cols,
+        pack_dels,
+        unpack_fleet_out,
+    )
+
     step = make_gossip_step(mesh, num_segments=num_segments, num_clients=num_clients)
-    args = [jnp.asarray(cols[k]) for k in (
-        "client", "clock", "parent_is_root", "parent_a", "parent_b",
-        "key_id", "origin_client", "origin_clock", "valid",
-    )] + [jnp.asarray(d) for d in dels]
-    return [np.asarray(x) for x in step(*args)]
+    vec = np.asarray(step(
+        jnp.asarray(pack_cols(cols)), jnp.asarray(pack_dels(dels))
+    ))
+    R, N = np.asarray(cols["client"]).shape
+    parts = unpack_fleet_out(vec, R, N, num_clients, num_segments)
+    return [
+        parts[name]
+        for name, _ in fleet_out_sizes(R, N, num_clients, num_segments)
+    ]
 
 
 def test_gossip_step_shapes_and_svs(mesh):
@@ -158,6 +169,13 @@ def test_hierarchical_2d_mesh_matches_flat_gossip():
         make_mesh2d,
     )
 
+    from crdt_tpu.parallel.gossip import (
+        fleet_out_sizes,
+        pack_cols,
+        pack_dels,
+        unpack_fleet_out,
+    )
+
     R, N = 16, 24
     cols, dels = synth_columns(R, N, num_maps=2, keys_per_map=8,
                                num_lists=2, seed=21)
@@ -166,15 +184,15 @@ def test_hierarchical_2d_mesh_matches_flat_gossip():
     mesh2d = make_mesh2d(n_hosts=2, devices_per_host=4)
     step2d = make_hierarchical_gossip_step(mesh2d, num_segments=256,
                                            num_clients=R + 2)
-    args = [jnp.asarray(cols[k]) for k in (
-        "client", "clock", "parent_is_root", "parent_a", "parent_b",
-        "key_id", "origin_client", "origin_clock", "valid",
-    )] + [jnp.asarray(d) for d in dels]
-    hier = [np.asarray(x) for x in step2d(*args)]
+    vec = np.asarray(step2d(
+        jnp.asarray(pack_cols(cols)), jnp.asarray(pack_dels(dels))
+    ))
+    parts = unpack_fleet_out(vec, R, N, R + 2, 256)
+    hier = [
+        parts[name] for name, _ in fleet_out_sizes(R, N, R + 2, 256)
+    ]
 
-    for name, a, b in zip(
-        ("sv_local", "global_sv", "deficit", "winners", "winner_visible",
-         "seq_order", "seq_seg", "seq_rank", "seq_len", "map_order"),
-        flat, hier,
+    for (name, _), a, b in zip(
+        fleet_out_sizes(R, N, R + 2, 256), flat, hier,
     ):
         np.testing.assert_array_equal(a, b, err_msg=f"{name} diverges")
