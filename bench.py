@@ -802,20 +802,37 @@ def main():
         from crdt_tpu.models import replay_trace as _replay
 
         _replay(blobs_t)  # warm shapes (device route)
-        t0 = time.perf_counter()
-        res_t = _replay(blobs_t)
-        t_dev_t = time.perf_counter() - t0
-        # the host route is exactness-checked (it is the integrate
-        # machinery a resident replica would use on this backlog) but
-        # NOT the headline: multi-writer mid-insert backlogs are the
-        # staged device path's home turf — stale anchors make the
-        # scalar-scan route degenerate toward the oracle's cost, which
-        # is precisely what the sibling-rank model vectorizes away
-        res_h = _replay(blobs_t, route="host")
-        assert res_h.cache == res_t.cache, "text routes diverge"
+        # ALL FOUR routes recorded, min-of-2 each; the HEADLINE ratio
+        # is the auto route — the product's real behavior (VERDICT r4
+        # item 4). "host" is the identical fused kernel on the local
+        # CPU backend (zero tunnel interactions); "replica" is the
+        # resident replica's own ingest machinery.
+        routes = {}
+        res_t = None
+        for route in ("device", "host", "auto", "replica"):
+            runs = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                res_r = _replay(blobs_t, route=route)
+                runs.append(round(time.perf_counter() - t0, 3))
+            if route == "device":
+                res_t = res_r
+            else:
+                assert res_r.cache == res_t.cache, \
+                    f"text route {route} diverges"
+            routes[route] = {
+                "s": min(runs), "runs_s": runs, "path": res_r.path,
+            }
+        t_dev_t = routes["device"]["s"]
+        t_auto_t = routes["auto"]["s"]
+        log("text routes: " + "  ".join(
+            f"{r}={routes[r]['s']}s({routes[r]['path']})"
+            for r in routes))
         text_result = {
             "ops": R_t * K,
-            "device_s": round(t_dev_t, 3),
+            "device_s": t_dev_t,
+            "auto_s": t_auto_t,
+            "routes": routes,
             "vs_python_oracle": None,
         }
 
@@ -877,12 +894,16 @@ def main():
             eng_t, t_oracle_t = run_oracle(blobs_t)
             assert res_t.cache == eng_t.to_json(), \
                 "text run diverges from oracle"
+            # the HEADLINE is the auto route — what the product does
             text_result["vs_python_oracle"] = round(
-                t_oracle_t / t_dev_t, 1
+                t_oracle_t / t_auto_t, 1
             )
+            text_result["vs_python_oracle_by_route"] = {
+                r: round(t_oracle_t / routes[r]["s"], 1) for r in routes
+            }
             oracle_note = f"oracle {t_oracle_t:.2f}s; exact"
         log(f"text e2e ({R_t * K} ops, 20% right-bearing mid-inserts): "
-            f"{t_dev_t:.3f}s (host route exact too); {oracle_note}")
+            f"auto {t_auto_t:.3f}s, device {t_dev_t:.3f}s; {oracle_note}")
 
     except AssertionError:
         raise
@@ -903,9 +924,7 @@ def main():
       if os.environ.get("BENCH_SWARM", "1") != "0":
         from crdt_tpu.net import LoopbackNetwork, LoopbackRouter, ypear_crdt
 
-        n_reps, n_ops = 12, 25
-
-        def swarm_round(mode):
+        def swarm_round(mode, n_reps, n_ops, mixed=False):
             net = LoopbackNetwork()
             reps = [
                 ypear_crdt(LoopbackRouter(net, f"pk{i}"), topic="b",
@@ -917,16 +936,39 @@ def main():
             t0 = time.perf_counter()
             for i, r in enumerate(reps):
                 for j in range(n_ops):
-                    if j % 2:
-                        r.set("m", f"k{i}-{j}", j)
-                    else:
+                    if not mixed:
+                        if j % 2:
+                            r.set("m", f"k{i}-{j}", j)
+                        else:
+                            r.push("l", f"v{i}-{j}")
+                        continue
+                    k = j % 5
+                    if k == 0:
+                        r.set("m", f"k{i % 16}-{j % 32}", [i, j])
+                    elif k == 1:
                         r.push("l", f"v{i}-{j}")
+                    elif k == 2:  # nested array-in-map
+                        r.set("nest", f"arr{i % 8}", value=f"n{i}-{j}",
+                              array_method="push")
+                    elif k == 3:  # mid-insert at a live index
+                        cur = r.get("l") or []
+                        r.insert("l", (i * 7 + j) % (len(cur) + 1),
+                                 f"ins{i}-{j}")
+                    else:
+                        r.set("m", f"solo{i}", j)
+                if mixed and i % 8 == 7:
+                    net.run()  # interleaved delivery mid-stream
             net.run()
             dt = time.perf_counter() - t0
             first = dict(reps[0].c)
             assert all(dict(r.c) == first for r in reps[1:]), mode
             return dt
 
+        # single-run swarm numbers flip on session weather (the r4
+        # artifact recorded a resident loss its own commit could not
+        # reproduce) — every published number is a min-of-N with the
+        # runs recorded
+        n_reps, n_ops = 12, 25
         swarm_result = {
             "replicas": n_reps,
             "ops": n_reps * n_ops,
@@ -934,16 +976,48 @@ def main():
             # buffered round; it is kept as a differential oracle
             # (merge_mode="device"), NOT a product default — resident
             # is the device-resident product mode (VERDICT r3 item 4)
-            "note": "device = explicit differential-oracle mode",
+            "note": "device = explicit differential-oracle mode; "
+                    "min-of-N, runs recorded",
         }
         for mode in ("scalar", "resident", "device"):
             if mode == "device":
-                swarm_round(mode)  # warm the gate's compiled shapes
-            swarm_result[f"{mode}_s"] = round(swarm_round(mode), 3)
+                swarm_round(mode, n_reps, n_ops)  # warm compiled shapes
+            runs = [
+                round(swarm_round(mode, n_reps, n_ops), 3)
+                for _ in range(2 if mode == "device" else 3)
+            ]
+            swarm_result[f"{mode}_s"] = min(runs)
+            swarm_result[f"{mode}_runs_s"] = runs
         log(f"product swarm ({n_reps} replicas x {n_ops} ops, "
             f"buffered rounds): "
             + "  ".join(f"{m}={swarm_result[f'{m}_s']}s"
                         for m in ("scalar", "resident", "device")))
+
+        # the non-toy shape (BASELINE configs 3/4): 64 replicas x 200
+        # mixed ops each — maps, list appends, live-index mid-inserts,
+        # nested array-in-map — with interleaved delivery. The scalar
+        # engine pays every peer's re-merge per buffered round; the
+        # resident replica's linked-chain integrate is the product
+        # claim under test at this size (VERDICT r4 item 2).
+        n_big_reps = int(os.environ.get("BENCH_SWARM_BIG_REPS", 64))
+        n_big_ops = int(os.environ.get("BENCH_SWARM_BIG_OPS", 200))
+        if n_big_reps > 0:
+            big = {"replicas": n_big_reps, "ops": n_big_reps * n_big_ops,
+                   "workload": "mixed map/array + nested + mid-inserts, "
+                               "interleaved delivery"}
+            for mode in ("scalar", "resident"):
+                runs = [
+                    round(swarm_round(mode, n_big_reps, n_big_ops,
+                                      mixed=True), 2)
+                    for _ in range(2)
+                ]
+                big[f"{mode}_s"] = min(runs)
+                big[f"{mode}_runs_s"] = runs
+                log(f"big swarm {mode}: {min(runs)}s {runs}")
+            big["resident_vs_scalar"] = round(
+                big["scalar_s"] / max(big["resident_s"], 1e-9), 2
+            )
+            swarm_result["big"] = big
     except AssertionError:
         raise
     except Exception as exc:
@@ -1174,12 +1248,23 @@ def main():
             # six deltas per size: warm, 2x host-timed, backlog
             # flush, 2x device-timed
             total_delta = 6 * sum(sizes)
-            inc = IncrementalReplay(
-                capacity=_b2(R * scale * K + 2 * total_delta)
-            )
+            cap = _b2(R * scale * K + 2 * total_delta)
+            inc = IncrementalReplay(capacity=cap)
             t0 = time.perf_counter()
             inc.apply(blobs_l)
             t_ingest = time.perf_counter() - t0
+            # second fresh ingest, same shapes: the run-to-run delta
+            # isolates one-off compile/cache cost from steady ingest
+            # (VERDICT r4 item 5 — the r3->r4 ingest doubling was the
+            # new 64k rounds legs growing the capacity bucket 2M->4M,
+            # whose giant-bucket kernels compile fresh on a cold
+            # cache; warm runs do not pay it)
+            inc2 = IncrementalReplay(capacity=cap)
+            t0 = time.perf_counter()
+            inc2.apply(blobs_l)
+            t_ingest2 = time.perf_counter() - t0
+            del inc2
+            ingest_runs = [round(t_ingest, 2), round(t_ingest2, 2)]
             all_blobs = list(blobs_l)
             table = {}
             crossover = None
@@ -1240,11 +1325,14 @@ def main():
 
             # exactness net across every round + mode, and the cold
             # reference the steady state is measured against
-            t0 = time.perf_counter()
             from crdt_tpu.models import replay_trace as _rt
 
-            res_full = _rt(all_blobs)
-            t_cold_round = time.perf_counter() - t0
+            cold_runs = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                res_full = _rt(all_blobs)
+                cold_runs.append(round(time.perf_counter() - t0, 2))
+            t_cold_round = min(cold_runs)
             assert inc.cache == res_full.cache, \
                 "incremental diverges from cold replay"
             ref = table.get("1000") or table[next(iter(table))]
@@ -1254,9 +1342,18 @@ def main():
                 "per_delta": table,
                 "crossover_delta_ops": crossover,
                 "incremental_round_s": med,
-                "cold_replay_round_s": round(t_cold_round, 2),
+                "cold_replay_round_s": t_cold_round,
+                "cold_replay_runs_s": cold_runs,
                 "vs_cold_replay": round(t_cold_round / max(med, 1e-9), 1),
-                "ingest_s": round(t_ingest, 2),
+                "ingest_s": min(ingest_runs),
+                "ingest_runs_s": ingest_runs,
+                "ingest_note": (
+                    "run1-run2 delta = one-off compile/cache cost; the "
+                    "r3->r4 ingest doubling was the 64k rounds legs "
+                    "growing the capacity bucket 2M->4M (fresh "
+                    "giant-bucket compiles on a cold cache), not the "
+                    "eager-staging change"
+                ),
                 # the product default is measured-per-session, not a
                 # static number: this is the probe + threshold the auto
                 # rule (device_min_rows=None) uses in THIS session

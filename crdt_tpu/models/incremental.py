@@ -172,31 +172,47 @@ class IncrementalReplay:
     # sessions, so any static default is wrong somewhere; VERDICT r3
     # item 2). Filled lazily by _calibrate().
     _calib: Dict[str, Optional[float]] = {
-        "t_interact_ms": None, "threshold": None,
+        "t_interact_ms": None, "host_us_per_row": None,
+        "dev_us_per_row": None, "threshold": None,
     }
-    # measured per-row costs behind the threshold model (host: the
-    # incremental admit+integrate python path; device: upload + select
-    # + kernel share per selected row). See BENCH rounds table.
-    _HOST_US_PER_ROW = 3.0
-    _DEV_US_PER_ROW = 1.0
+    # FALLBACK per-row costs, used only if a probe fails (its jax
+    # call raising): every session normally MEASURES both — the host
+    # cost by ingesting a real synthetic blob through the pinned host
+    # path, the device cost from the tunnel's measured round-trip
+    # bandwidth (VERDICT r4 item 6: no hardcoded constants behind the
+    # threshold).
+    _HOST_US_PER_ROW_FALLBACK = 3.0
+    _DEV_US_PER_ROW_FALLBACK = 1.0
 
     @classmethod
     def _calibrate(cls) -> Dict[str, Optional[float]]:
-        """One-time session probe: median single-shot dispatch latency
-        -> the row count where a 3-interaction device round beats the
-        host path's per-row cost. Floored at 4096 so a fast local
-        backend never routes keystroke rounds to a compile."""
+        """One-time session probes -> the row count where a
+        3-interaction device round beats the host path's per-row
+        cost. Floored at 4096 so a fast local backend never routes
+        keystroke rounds to a compile.
+
+        Three measurements, all recorded (``calibration_info``):
+
+        - ``t_interact_ms`` — median single-shot dispatch latency
+          (the tunnel's fixed per-interaction cost);
+        - ``host_us_per_row`` — a REAL 4096-op map blob ingested by a
+          throwaway replay pinned to the host path (decode + admit +
+          integrate, the exact code a host round runs);
+        - ``dev_us_per_row`` — the measured device round-trip
+          bandwidth, charged at the round's ~72 bytes/row (8 int64
+          delta lanes up, one int64 result lane down); on-device
+          kernel time per row is negligible against the transfer.
+        """
         if cls._calib["threshold"] is None:
             import time as _t
 
             import jax
             import jax.numpy as jnp
+            import numpy as _np
 
             f = jax.jit(lambda v: v + 1)
             x = jnp.arange(128)
             jax.block_until_ready(f(x))  # compile, and flip lazy mode
-            import numpy as _np
-
             _np.asarray(f(x))  # force sync execution mode (axon trap)
             lat = []
             for _ in range(3):
@@ -204,11 +220,58 @@ class IncrementalReplay:
                 jax.block_until_ready(f(x))
                 lat.append(_t.perf_counter() - t0)
             t_i = sorted(lat)[1]
-            per_row_us = max(
-                cls._HOST_US_PER_ROW - cls._DEV_US_PER_ROW, 0.5
-            )
+
+            # host per-row: a real map-set blob through the pinned
+            # host path of a throwaway replay (min of 2 fresh ingests)
+            host_us: Optional[float] = None
+            try:
+                from crdt_tpu.codec import v1 as _v1c
+                from crdt_tpu.core.ids import DeleteSet as _DSp
+                from crdt_tpu.core.records import ItemRecord as _IRp
+
+                n_p = 4096
+                recs = [
+                    _IRp(client=1, clock=k, parent_root="_calib",
+                         key=f"k{k & 255}", content=k,
+                         origin=(1, k - 256) if k >= 256 else None)
+                    for k in range(n_p)
+                ]
+                blob_p = _v1c.encode_update(recs, _DSp())
+                best = float("inf")
+                for _ in range(2):
+                    probe = cls(capacity=n_p + 64,
+                                device_min_rows=1 << 62)
+                    t0 = _t.perf_counter()
+                    probe.apply([blob_p])
+                    best = min(best, _t.perf_counter() - t0)
+                host_us = best * 1e6 / n_p
+            except Exception:
+                pass
+            if host_us is None:
+                host_us = cls._HOST_US_PER_ROW_FALLBACK
+
+            # device per-row: measured round-trip bandwidth at the
+            # round's bytes/row (device_put compiles nothing)
+            dev_us: Optional[float] = None
+            try:
+                n_b = 1 << 18
+                buf = _np.zeros(n_b, _np.int64)
+                _np.asarray(jax.device_put(buf))  # warm the path
+                t0 = _t.perf_counter()
+                _np.asarray(jax.device_put(buf))
+                t_rt = _t.perf_counter() - t0
+                bw = (2 * 8 * n_b) / max(t_rt - t_i, 1e-6)  # bytes/s
+                dev_us = 72.0 / bw * 1e6
+            except Exception:
+                pass
+            if dev_us is None:
+                dev_us = cls._DEV_US_PER_ROW_FALLBACK
+
+            per_row_us = max(host_us - dev_us, 0.5)
             cls._calib = {
                 "t_interact_ms": round(t_i * 1e3, 2),
+                "host_us_per_row": round(host_us, 2),
+                "dev_us_per_row": round(dev_us, 2),
                 "threshold": max(4096, int(3 * t_i * 1e9 / per_row_us
                                            / 1e3)),
             }
@@ -1445,8 +1508,18 @@ class IncrementalReplay:
                 if self._seg_kid.get(sk, -1) >= 0:
                     if self._advance_map_tail(sk, new):
                         continue
-                elif self._integrate_remote_seq(sk, new):
-                    continue
+                else:
+                    existing = len(self._seg_rows[sk]) - len(new)
+                    # bulk deltas (cold merge, long catch-up) have
+                    # anchors stale by construction: the budgeted
+                    # conflict scan would exhaust its whole budget and
+                    # THEN re-derive (measured: ~0.9s burnt on a 20k
+                    # cold text backlog before the identical wholesale
+                    # pass ran). When the delta rivals the resident
+                    # segment, re-derive directly.
+                    if len(new) <= max(256, existing // 2) and \
+                            self._integrate_remote_seq(sk, new):
+                        continue
             self._host_order_segment(sk)
 
     def _host_order_segment(self, sk: int) -> None:

@@ -467,14 +467,22 @@ def replay_trace(
       use this function as their independent cold oracle keep
       exercising the device kernels, and published device numbers are
       never silently host numbers.
+    - ``"host"`` — the IDENTICAL fused convergence executed on the
+      process's local CPU backend (:func:`crdt_tpu.ops.packed.
+      converge_host`): zero accelerator interactions, byte-identical
+      kernel outputs. Unions the packed stager cannot express fall
+      back to the replica machinery below.
     - ``"auto"`` — the PRODUCT rule: apply the same session-calibrated
       host/device crossover the live replica uses. On a tunnelled
       platform a small replay is floored by fixed per-interaction
       latency, not merge speed — below the threshold the union
-      converges through the exact host machinery (the identical path
-      a resident replica takes when it ingests this backlog), above
-      it the device pipeline runs.
-    - ``"host"`` — force the host machinery.
+      converges on the local backend (``"host"``), above it the
+      accelerator pipeline runs.
+    - ``"replica"`` — ingest through :class:`crdt_tpu.models.
+      incremental.IncrementalReplay` pinned to its host path: the
+      identical code a LIVE resident replica runs on this backlog
+      (kept as a third independent engine for differential suites and
+      for measuring the replica ingest itself).
     - ``"fleet"`` — the mesh axis: each blob is treated as one
       replica's pending broadcast and the whole set converges as ONE
       sharded gossip+merge round over the device mesh
@@ -491,7 +499,7 @@ def replay_trace(
     dec = decode(blobs)
     n = len(dec["client"])
     use_host = False
-    if route == "host":
+    if route in ("host", "replica"):
         use_host = True
     elif route == "auto":
         from crdt_tpu.models.incremental import IncrementalReplay
@@ -501,6 +509,20 @@ def replay_trace(
         use_host = IncrementalReplay.crossover_use_host(n)
     elif route != "device":
         raise ValueError(f"unknown route {route!r}")
+    if use_host and route != "replica":
+        from crdt_tpu.ops import packed
+
+        cols, ds = stage(dec)
+        plan = packed.stage(cols)
+        if plan is not None:
+            handle = ("packed", packed.converge_host(plan))
+            win_rows, win_vis, seq_orders = gather(dec, ds, handle)
+            cache = materialize(dec, ds, win_rows, win_vis, seq_orders)
+            return ReplayResult(
+                cache=cache, snapshot=compact(dec, ds), n_ops=n,
+                path="host",
+            )
+        # inexpressible plan (key-width overflow): replica machinery
     if use_host:
         from crdt_tpu.models.incremental import IncrementalReplay
 
@@ -515,7 +537,7 @@ def replay_trace(
         ds = native.ds_from_triples(dec["ds"])
         return ReplayResult(
             cache=dict(inc.cache), snapshot=compact(dec, ds), n_ops=n,
-            path="host",
+            path="replica",
         )
     cols, ds = stage(dec)
     handle = converge(cols, clients=clients)

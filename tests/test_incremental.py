@@ -464,6 +464,12 @@ class TestAutoCalibration:
         info = IncrementalReplay.calibration_info()
         assert info["threshold"] >= 4096  # keystroke rounds never probe
         assert info["t_interact_ms"] is not None
+        # both per-row constants are MEASURED per session, recorded
+        # for reproducibility (VERDICT r4 item 6)
+        assert info["host_us_per_row"] is not None
+        assert info["host_us_per_row"] > 0
+        assert info["dev_us_per_row"] is not None
+        assert info["dev_us_per_row"] > 0
         # cached: the probe runs once per process
         assert IncrementalReplay.calibration_info() == info
 

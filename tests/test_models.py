@@ -318,12 +318,13 @@ from crdt_tpu.models import replay_trace
 
 class TestReplayRoutes:
     """replay_trace's convergence engines must be interchangeable:
-    "device" (packed pipeline, the differential-oracle default) and
-    "host" (the incremental machinery a resident replica uses to
+    "device" (packed pipeline, the differential-oracle default),
+    "host" (the identical fused kernel on the local CPU backend), and
+    "replica" (the incremental machinery a resident replica uses to
     ingest the same backlog) produce identical results; "auto" picks
     by the session-calibrated crossover and records its choice."""
 
-    def test_host_and_device_routes_agree(self):
+    def test_all_routes_agree(self):
         import bench as B
 
         for builder in (B.build_trace, B.build_conflict_trace,
@@ -331,16 +332,20 @@ class TestReplayRoutes:
             blobs = builder(30, 20)
             dev = replay_trace(blobs, route="device")
             host = replay_trace(blobs, route="host")
+            rep = replay_trace(blobs, route="replica")
             assert dev.path == "device" and host.path == "host"
+            assert rep.path == "replica"
             assert host.cache == dev.cache, builder.__name__
             assert host.snapshot == dev.snapshot, builder.__name__
+            assert rep.cache == dev.cache, builder.__name__
+            assert rep.snapshot == dev.snapshot, builder.__name__
 
     def test_auto_records_its_choice(self):
         import bench as B
 
         blobs = B.build_trace(10, 10)
         res = replay_trace(blobs, route="auto")
-        assert res.path in ("host", "device")
+        assert res.path in ("host", "replica", "device")
         assert res.cache == replay_trace(blobs, route="device").cache
 
     def test_unknown_route_rejected(self):
