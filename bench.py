@@ -465,7 +465,9 @@ def run_device(blobs, phases):
         lambda c: packed.stage(c, put=jax.device_put if big else None),
         cols,
     )
-    res = timed("converge", packed.converge, plan)
+    detail = {}
+    res = timed("converge", packed.converge, plan, detail)
+    phases["converge_detail"] = detail  # upload_wait/dispatch/fetch
     win_rows, win_vis, seq_orders = timed(
         "gather", rp.gather, dec, ds, ("packed", res)
     )

@@ -196,8 +196,17 @@ def resolve_parents(records: List[ItemRecord]) -> List[ItemRecord]:
     origin chain). The kernel needs every row explicit. Unresolvable
     records (origin outside the batch) keep parent unset and simply
     fall out of map segmentation.
+
+    Duplicate ids (a hostile blob forging a client block twice, or
+    redelivered runs) resolve against the FIRST occurrence — the
+    convention every other consumer applies (``native.dedup_columns``,
+    engine admission, Yjs's clock-watermark skip). The differential
+    fuzz found the previous last-wins dict here splitting decoders on
+    forged duplicates.
     """
-    by_id = {(r.client, r.clock): r for r in records}
+    by_id: dict = {}
+    for r in records:
+        by_id.setdefault((r.client, r.clock), r)
     out = []
     for r in records:
         if r.parent_root is None and r.parent_item is None and r.kind != 0:

@@ -894,20 +894,55 @@ def _assemble_result(plan: PackedPlan, h: np.ndarray) -> PackedResult:
     )
 
 
-def converge(plan: PackedPlan) -> PackedResult:
+def converge(plan: PackedPlan,
+             phases: Optional[dict] = None) -> PackedResult:
     """Stage -> single dispatch -> single fetch. Device outputs are in
     id-sorted row space; the plan's sort permutation maps them back to
     the caller's rows (one numpy gather, off the device clock). Plans
     staged with ``put=`` skip the transfer here — their rows are
-    already (asynchronously) on device."""
+    already (asynchronously) on device.
+
+    ``phases``, when given, receives the span's sub-costs
+    (``upload_wait``/``dispatch``/``fetch`` seconds) so published
+    numbers itemize against the floor derivation (ROOFLINE.md) instead
+    of reporting one opaque "converge"."""
+    import time as _t
+
     args = _plan_args(plan)
+
+    def mark(name, t0):
+        if phases is not None:
+            phases[name] = round(_t.perf_counter() - t0, 4)
+
+    # the sync barriers below exist ONLY for instrumentation: the
+    # production call (phases=None) must keep its original single-sync
+    # shape, where the dispatch enqueue overlaps the eager-upload tail
+    # and np.asarray is the one blocking point
     with jax.enable_x64(True):
         if plan.dev:
+            if phases is not None:
+                t0 = _t.perf_counter()
+                jax.block_until_ready(plan.dev)  # eager uploads land
+                mark("upload_wait", t0)
+            t0 = _t.perf_counter()
             out = _converge_rows(*plan.dev, **args)          # 1 dispatch
+            if phases is not None:
+                jax.block_until_ready(out)
+                mark("dispatch", t0)
         else:
+            t0 = _t.perf_counter()
             dev_mat = jnp.asarray(plan.mat)                  # 1 transfer
+            if phases is not None:
+                jax.block_until_ready(dev_mat)
+                mark("upload_wait", t0)
+                t0 = _t.perf_counter()
             out = _converge_packed(dev_mat, **args)          # 1 dispatch
+            if phases is not None:
+                jax.block_until_ready(out)
+                mark("dispatch", t0)
+        t0 = _t.perf_counter()
         h = np.asarray(out)                                  # 1 fetch
+        mark("fetch", t0)
     return _assemble_result(plan, h)
 
 
