@@ -888,6 +888,37 @@ def main():
         text_result["steady_flat_ratio"] = round(
             steady[ks[-1]] / max(steady[ks[0]], 1e-9), 2
         )
+
+        # keystroke regime: LOCAL mid-document inserts on a growing
+        # resident doc. Anchor resolution is cursor-local (epoch-
+        # validated), so per-insert cost must stay flat in doc size
+        # (VERDICT r4 item 8; previously O(index) per insert).
+        from crdt_tpu.api.resident_doc import ResidentCrdt as _RC
+
+        kdoc = _RC(91)
+        kdoc.array("kt")
+        kdoc.push("kt", 0)
+        keys_tbl = {}
+        for _ in range(4):
+            for i in range(4000):
+                kdoc.push("kt", i)
+            nvis = len(kdoc.c["kt"])
+            mid = nvis // 2
+            kdoc.insert("kt", mid, "w")  # seed the cursor (amortized)
+            t0 = time.perf_counter()
+            for j in range(100):
+                kdoc.insert("kt", mid + (j % 7) - 3, f"m{j}")
+            keys_tbl[str(nvis)] = round(
+                (time.perf_counter() - t0) / 100 * 1e6, 1
+            )
+        kk = sorted(keys_tbl, key=int)
+        text_result["keystroke_insert_us_by_doc_rows"] = keys_tbl
+        text_result["keystroke_flat_ratio"] = round(
+            keys_tbl[kk[-1]] / max(keys_tbl[kk[0]], 1e-9), 2
+        )
+        log("keystroke mid-inserts (us/op by doc rows): "
+            + ", ".join(f"{k}: {keys_tbl[k]}" for k in kk)
+            + f" (last/first {text_result['keystroke_flat_ratio']})")
         log("text steady-state rounds (100 mid-inserts each): "
             + ", ".join(f"{k} rows: {steady[k]}ms" for k in ks)
             + f" (last/first {text_result['steady_flat_ratio']})")

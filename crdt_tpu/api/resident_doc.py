@@ -110,6 +110,15 @@ class ResidentCrdt(DocOpsMixin):
         self._txn_ds = DeleteSet()
         self._txn_roots: set = set()
         self._txn_keys: Dict[str, set] = {}
+        # per-sequence edit cursor: spec -> (k, row, epoch) where
+        # ``row`` is the k-th visible item (1-based) as of the
+        # segment's order ``epoch``. Indexed edits resolve their
+        # anchors by walking FROM the cursor (O(|index - k|)) instead
+        # of from the head (O(index)) — interactive editing is
+        # position-local, so mid-document typing stays ~O(1) in doc
+        # size (VERDICT r4 item 8). Any non-local mutation bumps the
+        # epoch and the cursor falls back to one full scan.
+        self._seq_cursor: Dict[Tuple, Tuple[int, int, int]] = {}
 
     # ------------------------------------------------------------------
     # cache / reads (same contract as Crdt)
@@ -208,16 +217,66 @@ class ResidentCrdt(DocOpsMixin):
         return not self._row_deleted(row)
 
     def _visible_left(self, spec: Tuple, index: int) -> Optional[int]:
-        """Row of the (index-1)-th visible item (Engine._visible_left)."""
+        """Row of the (index-1)-th visible item (Engine._visible_left).
+
+        Resolution is cursor-local: the last indexed edit's anchor
+        position is cached per sequence (epoch-validated against the
+        replay's order epoch), so a run of nearby edits walks
+        O(position delta) links instead of O(index) from the head."""
         if index <= 0:
             return None
+        sk = self._sk(spec, None)
+        r = self._replay
+        if sk is not None:
+            cur = self._seq_cursor.get(spec)
+            if cur is not None:
+                ck, crow, epoch = cur
+                if epoch == r.order_epoch(sk):
+                    row = self._walk_from_cursor(sk, ck, crow, index)
+                    if row is not None:
+                        self._seq_cursor[spec] = (
+                            index, row, r.order_epoch(sk)
+                        )
+                        return row
         seen = 0
         for row in self._iter_rows(spec):
             if self._countable(row):
                 seen += 1
                 if seen == index:
+                    if sk is not None:
+                        self._seq_cursor[spec] = (
+                            index, row, r.order_epoch(sk)
+                        )
                     return row
         raise IndexError(f"index {index} out of range (len={seen})")
+
+    def _walk_from_cursor(
+        self, sk: int, ck: int, crow: int, index: int
+    ) -> Optional[int]:
+        """The index-th visible row, walking from the validated cursor
+        (crow = ck-th visible). Returns None when the backward walk
+        cannot satisfy the cursor's own claim (callers re-scan);
+        raises IndexError when the document really is too short."""
+        r = self._replay
+        if index == ck:
+            return crow
+        if index > ck:
+            seen = ck
+            for row in r.iter_order_after(sk, crow):
+                if self._countable(row):
+                    seen += 1
+                    if seen == index:
+                        return row
+            raise IndexError(
+                f"index {index} out of range (len={seen})"
+            )
+        need = ck - index
+        for prev in r.iter_order_before(sk, crow):
+            if self._countable(prev):
+                need -= 1
+                if need == 0:
+                    return prev
+        return None
 
     def _right_of(self, spec: Tuple, left: Optional[int]) -> Optional[int]:
         """The item immediately after ``left`` in FULL order, tombstones
@@ -340,11 +399,36 @@ class ResidentCrdt(DocOpsMixin):
             clock += 1
         if recs:
             self._apply_own(recs)
+            if index is not None:
+                # the run's last row is now the (index+V)-th visible
+                # item: seed the cursor there so the next nearby edit
+                # walks O(delta) instead of O(index)
+                sk = self._sk(spec, None)
+                last = self._replay._id_row.get(
+                    (recs[-1].client, recs[-1].clock)
+                )
+                if sk is not None and last is not None:
+                    self._seq_cursor[spec] = (
+                        index + len(recs), last,
+                        self._replay.order_epoch(sk),
+                    )
 
     def _seq_delete(self, spec: Tuple, index: int, length: int) -> int:
         targets = []
         seen = 0
-        for row in self._iter_rows(spec):
+        try:
+            anchor = (
+                self._visible_left(spec, index) if index > 0 else None
+            )
+        except IndexError:
+            return 0  # cut past the visible tail deletes nothing
+        if anchor is not None:
+            sk = self._sk(spec, None)
+            it = self._replay.iter_order_after(sk, anchor)
+            seen = index
+        else:
+            it = self._iter_rows(spec)
+        for row in it:
             if not self._countable(row):
                 continue
             if seen >= index:
@@ -358,6 +442,17 @@ class ResidentCrdt(DocOpsMixin):
         for row in targets:
             ds.add(*self._row_id(row))
         self._apply_own([], ds)
+        if anchor is not None:
+            # the delete bumped the epoch, but every deleted row sits
+            # strictly AFTER the anchor — its visible rank is intact,
+            # so reseed the cursor instead of forcing the next edit
+            # (type-backspace-type is the common keystroke mix) back
+            # to a full head scan
+            sk = self._sk(spec, None)
+            if sk is not None:
+                self._seq_cursor[spec] = (
+                    index, anchor, self._replay.order_epoch(sk)
+                )
         return len(targets)
 
     # ------------------------------------------------------------------
