@@ -23,6 +23,29 @@ the rendezvous node is the trust anchor the reference's bootstrap DHT
 nodes are; a wrong introduction is only a dial to a peer that cannot
 complete the key exchange.
 
+NAT traversal (cone NATs) falls out of the introduction mechanics by
+construction — the same simultaneous-open recipe Hyperswarm's
+holepuncher runs, minus its relay fallback:
+
+- the rendezvous advertises each member's OBSERVED UDP source
+  address (``_Peer.addr`` is the packet source, i.e. the NAT's
+  public mapping, held open by the member's TTL'd announce refresh);
+- one introduction is sent to BOTH sides (:meth:`UdpRouter._introduce`
+  tells the newcomer about every holder AND every holder about the
+  newcomer), so both ends dial out at once — each outbound hello
+  opens its own NAT's mapping toward the other;
+- hellos ride the reliable transport (40 ms initial RTO, exponential
+  backoff — native/transport/transport.cc), so whichever side's
+  first packet loses the race against the other NAT's mapping
+  creation is retransmitted straight through once it exists.
+
+Full-cone and (address-)restricted-cone NATs traverse; symmetric
+NATs (per-destination port mappings) need port prediction or a relay
+and are OUT of scope — the documented delta against Hyperswarm,
+whose DHT-assisted relaying covers that tail. The mechanism
+properties are pinned by tests/test_transport.py
+(TestIntroductionPunch).
+
 Wire protocol (each transport message, after reassembly):
   kind 0x00  plaintext hello       {pk: hex, ack: bool}
   kind 0x01  encrypted envelope    sender_pk(32 raw) || SecureBox

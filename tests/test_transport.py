@@ -1064,3 +1064,71 @@ class TestRendezvousRobustness:
         finally:
             for r in routers:
                 r.close()
+
+
+class TestIntroductionPunch:
+    """The cone-NAT traversal mechanics (udp_router module docstring):
+    hole punching IS (a) observed-address introductions, (b) BOTH
+    sides dialing out on one introduction, (c) hellos that retransmit
+    through the window where the other side's mapping does not exist
+    yet. A real NAT cannot be interposed on loopback sockets, so each
+    property is pinned directly."""
+
+    def test_intro_makes_both_sides_dial_observed_addresses(self):
+        boot = UdpRouter(rendezvous=True)
+        a = UdpRouter(bootstrap=[boot.addr])
+        routers = [boot, a]
+        try:
+            ra = Replica(a, topic="room", client_id=1)
+            pump(routers, timeout_s=20.0)
+
+            dials: dict = {"a": [], "b": []}
+            orig_a = a._send_hello
+            a._send_hello = lambda ip, port, **kw: (
+                dials["a"].append((ip, port)), orig_a(ip, port, **kw)
+            )[-1]
+            b = UdpRouter(bootstrap=[boot.addr])
+            routers.append(b)
+            orig_b = b._send_hello
+            b._send_hello = lambda ip, port, **kw: (
+                dials["b"].append((ip, port)), orig_b(ip, port, **kw)
+            )[-1]
+            rb = Replica(b, topic="room", client_id=2)
+            pump(routers, timeout_s=20.0)
+
+            # (a)+(b): the EXISTING member dialed the newcomer's
+            # observed transport address, and the newcomer dialed the
+            # existing member's — one introduction, two outbound
+            # opens, which is the punch
+            assert b.addr in dials["a"], (dials, b.addr)
+            assert a.addr in dials["b"], (dials, a.addr)
+            assert a.public_key in b.peers and b.public_key in a.peers
+            rb.set("m", "k", 1)
+            pump(routers, timeout_s=20.0)
+            assert dict(ra.c) == dict(rb.c)
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_hello_survives_unopened_window(self):
+        """The race half of the punch: A dials an address whose owner
+        is not processing packets yet (the NAT-mapping-not-open
+        window); once the owner starts polling, the retransmitting
+        hello completes the link with no new dial from A."""
+        a = UdpRouter()
+        b = UdpRouter()
+        try:
+            a.start()
+            b.start()
+            a.add_peer(*b.addr)  # ONE dial, before b ever polls
+            deadline = time.monotonic() + 1.0
+            while time.monotonic() < deadline:
+                a.poll()  # only A pumps: hello keeps retransmitting
+                time.sleep(0.002)
+            assert b.public_key not in a.peers  # window still closed
+            pump([a, b], timeout_s=20.0)  # b joins the loop
+            assert b.public_key in a.peers
+            assert a.public_key in b.peers
+        finally:
+            a.close()
+            b.close()
