@@ -765,24 +765,45 @@ def main():
         cache_cn, _ = run_numpy(blobs_c, {})
         t_np_c = time.perf_counter() - t0
         assert cache_c == cache_cn, "conflict run: contenders diverge"
+        # the PRODUCT route (auto: session crossover — at this size
+        # the local-backend fused kernel), min-of-3, same headline
+        # treatment as text_run's routes
+        from crdt_tpu.models import replay_trace as _rt_c
+
+        t_auto_c = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            res_ac = _rt_c(blobs_c, route="auto")
+            t_auto_c = min(t_auto_c, time.perf_counter() - t0)
+        assert res_ac.cache == cache_c, "conflict auto route diverges"
         conflict_result = {
             "ops": R_c * K,
             "device_s": round(t_dev_c, 3),
             "numpy_s": round(t_np_c, 3),
+            "auto_s": round(t_auto_c, 3),
+            "auto_path": res_ac.path,
             "vs_baseline": round(t_np_c / t_dev_c, 2),
             "vs_python_oracle": None,
         }
         oracle_note = "oracle skipped"
         if not skip_oracle:
+            # min-of-2 oracle: the ratio's numerator gets the same
+            # noise treatment as its min-of-N denominator
             eng_c, t_oracle_c = run_oracle(blobs_c)
+            _, t_oracle_c2 = run_oracle(blobs_c)
+            t_oracle_c = min(t_oracle_c, t_oracle_c2)
             assert cache_c == eng_c.to_json(), \
                 "conflict run diverges from oracle"
             conflict_result["vs_python_oracle"] = round(
+                t_oracle_c / t_auto_c, 1
+            )
+            conflict_result["vs_python_oracle_device"] = round(
                 t_oracle_c / t_dev_c, 1
             )
             oracle_note = f"oracle {t_oracle_c:.2f}s; exact"
         log(f"conflict e2e ({R_c * K} ops, shared-anchor siblings): "
-            f"device {t_dev_c:.3f}s vs numpy {t_np_c:.3f}s; {oracle_note}")
+            f"auto {t_auto_c:.3f}s ({res_ac.path}), device "
+            f"{t_dev_c:.3f}s vs numpy {t_np_c:.3f}s; {oracle_note}")
 
     except AssertionError:
         raise  # a correctness divergence must FAIL the bench
@@ -804,7 +825,7 @@ def main():
         from crdt_tpu.models import replay_trace as _replay
 
         _replay(blobs_t)  # warm shapes (device route)
-        # ALL FOUR routes recorded, min-of-2 each; the HEADLINE ratio
+        # ALL FOUR routes recorded, min-of-3 each; the HEADLINE ratio
         # is the auto route — the product's real behavior (VERDICT r4
         # item 4). "host" is the identical fused kernel on the local
         # CPU backend (zero tunnel interactions); "replica" is the
@@ -813,7 +834,10 @@ def main():
         res_t = None
         for route in ("device", "host", "auto", "replica"):
             runs = []
-            for _ in range(2):
+            # min-of-3: the box's CPU contention moves host-side spans
+            # ~2x between sessions, and the headline ratio hangs off
+            # this minimum
+            for _ in range(3):
                 t0 = time.perf_counter()
                 res_r = _replay(blobs_t, route=route)
                 runs.append(round(time.perf_counter() - t0, 3))
@@ -905,12 +929,13 @@ def main():
             nvis = len(kdoc.c["kt"])
             mid = nvis // 2
             kdoc.insert("kt", mid, "w")  # seed the cursor (amortized)
-            t0 = time.perf_counter()
-            for j in range(100):
-                kdoc.insert("kt", mid + (j % 7) - 3, f"m{j}")
-            keys_tbl[str(nvis)] = round(
-                (time.perf_counter() - t0) / 100 * 1e6, 1
-            )
+            best = float("inf")  # min-of-2 batches: ~50us/op numbers
+            for b in range(2):   # are easily doubled by box noise
+                t0 = time.perf_counter()
+                for j in range(100):
+                    kdoc.insert("kt", mid + (j % 7) - 3, f"m{b}-{j}")
+                best = min(best, time.perf_counter() - t0)
+            keys_tbl[str(nvis)] = round(best / 100 * 1e6, 1)
         kk = sorted(keys_tbl, key=int)
         text_result["keystroke_insert_us_by_doc_rows"] = keys_tbl
         text_result["keystroke_flat_ratio"] = round(
@@ -924,7 +949,12 @@ def main():
             + f" (last/first {text_result['steady_flat_ratio']})")
         oracle_note = "oracle skipped"
         if not skip_oracle:
+            # min-of-2 on the oracle too: the headline ratio is a
+            # quotient of two host-side timings — both sides get the
+            # same noise treatment
             eng_t, t_oracle_t = run_oracle(blobs_t)
+            _, t_oracle_t2 = run_oracle(blobs_t)
+            t_oracle_t = min(t_oracle_t, t_oracle_t2)
             assert res_t.cache == eng_t.to_json(), \
                 "text run diverges from oracle"
             # the HEADLINE is the auto route — what the product does
