@@ -343,6 +343,21 @@ def numpy_gather(dec, ds, np_win, np_seg, np_rank):
 # ---------------------------------------------------------------------------
 
 
+def min_time(fn, n):
+    """(best_seconds, runs) for n timed calls of ``fn`` — the ONE
+    min-of-N idiom every published headline uses, so both sides of
+    any ratio get identical noise treatment. Returns the last call's
+    result too: (best_s, runs_s, last_result)."""
+    best, runs, out = float("inf"), [], None
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn()
+        dt = time.perf_counter() - t0
+        runs.append(round(dt, 3))
+        best = min(best, dt)
+    return best, runs, out
+
+
 def run_oracle(blobs, *, with_deletes=True):
     """Decode a trace and replay it through the scalar-semantics
     engine (BASELINE.md's named baseline). Returns (engine, seconds)."""
@@ -758,23 +773,23 @@ def main():
         R_c = min(R, 200)
         blobs_c = build_conflict_trace(R_c, K)
         run_device(blobs_c, {})  # warm shapes
-        t0 = time.perf_counter()
-        cache_c, *_ = run_device(blobs_c, {})
-        t_dev_c = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        cache_cn, _ = run_numpy(blobs_c, {})
-        t_np_c = time.perf_counter() - t0
+        # min-of-2 on EVERY contender (one shared idiom: min_time), so
+        # no ratio ever divides differently-treated quantities
+        t_dev_c, _, dev_out = min_time(
+            lambda: run_device(blobs_c, {}), 2
+        )
+        cache_c = dev_out[0]
+        t_np_c, _, np_out = min_time(lambda: run_numpy(blobs_c, {}), 2)
+        cache_cn = np_out[0]
         assert cache_c == cache_cn, "conflict run: contenders diverge"
         # the PRODUCT route (auto: session crossover — at this size
         # the local-backend fused kernel), min-of-3, same headline
         # treatment as text_run's routes
         from crdt_tpu.models import replay_trace as _rt_c
 
-        t_auto_c = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            res_ac = _rt_c(blobs_c, route="auto")
-            t_auto_c = min(t_auto_c, time.perf_counter() - t0)
+        t_auto_c, _, res_ac = min_time(
+            lambda: _rt_c(blobs_c, route="auto"), 3
+        )
         assert res_ac.cache == cache_c, "conflict auto route diverges"
         conflict_result = {
             "ops": R_c * K,
@@ -833,21 +848,19 @@ def main():
         routes = {}
         res_t = None
         for route in ("device", "host", "auto", "replica"):
-            runs = []
-            # min-of-3: the box's CPU contention moves host-side spans
-            # ~2x between sessions, and the headline ratio hangs off
-            # this minimum
-            for _ in range(3):
-                t0 = time.perf_counter()
-                res_r = _replay(blobs_t, route=route)
-                runs.append(round(time.perf_counter() - t0, 3))
+            # min-of-3 (shared min_time idiom): the box's CPU
+            # contention moves host-side spans ~2x between sessions,
+            # and the headline ratio hangs off this minimum
+            best, runs, res_r = min_time(
+                lambda route=route: _replay(blobs_t, route=route), 3
+            )
             if route == "device":
                 res_t = res_r
             else:
                 assert res_r.cache == res_t.cache, \
                     f"text route {route} diverges"
             routes[route] = {
-                "s": min(runs), "runs_s": runs, "path": res_r.path,
+                "s": round(best, 3), "runs_s": runs, "path": res_r.path,
             }
         t_dev_t = routes["device"]["s"]
         t_auto_t = routes["auto"]["s"]

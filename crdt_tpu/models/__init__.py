@@ -1,11 +1,28 @@
-from crdt_tpu.models.fleet import FleetStep, ReplicaFleet
+from crdt_tpu.models.fleet import (
+    FleetStep,
+    FleetTrace,
+    ReplicaFleet,
+    SegmentedFleet,
+    SegStep,
+    ShardedTrace,
+    fleet_replay,
+    load_trace,
+    shard_trace,
+)
 from crdt_tpu.models.incremental import IncrementalReplay
 from crdt_tpu.models.replay import ReplayResult, replay_trace
 
 __all__ = [
     "FleetStep",
+    "FleetTrace",
     "IncrementalReplay",
     "ReplayResult",
     "ReplicaFleet",
+    "SegStep",
+    "SegmentedFleet",
+    "ShardedTrace",
+    "fleet_replay",
+    "load_trace",
     "replay_trace",
+    "shard_trace",
 ]
