@@ -270,8 +270,12 @@ class IncrementalReplay:
             per_row_us = max(host_us - dev_us, 0.5)
             cls._calib = {
                 "t_interact_ms": round(t_i * 1e3, 2),
-                "host_us_per_row": round(host_us, 2),
-                "dev_us_per_row": round(dev_us, 2),
+                # 6 decimals: a fast LOCAL backend's measured per-row
+                # transfer cost can be ~1e-5 us (the clamp regime) —
+                # recorded as the tiny number it is, never as a
+                # fabricated floor
+                "host_us_per_row": round(host_us, 6),
+                "dev_us_per_row": round(dev_us, 6),
                 "threshold": max(4096, int(3 * t_i * 1e9 / per_row_us
                                            / 1e3)),
             }

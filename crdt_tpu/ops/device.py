@@ -15,6 +15,7 @@ Conventions for all kernels in this package:
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager
 
 import jax
 import jax.numpy as jnp
@@ -114,6 +115,66 @@ if (
 
 NULLI = -1
 _CLOCK_BITS = 40
+
+
+# shapes whose local-CPU executable already exists in-process (the
+# persistent-cache suppression below is only needed around a fresh
+# compile)
+_LOCAL_CPU_COMPILED: set = set()
+
+
+def _cache_singleton_reset(cache_dir) -> bool:
+    """Point the persistent-cache config at ``cache_dir`` AND drop the
+    initialized singleton so the new value actually takes effect
+    (flipping the flag alone is a no-op against jax's process-wide
+    cache singleton). Returns False when the private reset hook is
+    unavailable (callers must then not assume suppression worked)."""
+    import jax as _jax
+
+    try:
+        from jax._src import compilation_cache as _cc
+    except Exception:
+        return False  # no reset hook: leave the config untouched
+    _jax.config.update("jax_compilation_cache_dir", cache_dir)
+    try:
+        _cc.reset_cache()
+    except Exception:
+        pass  # config did change; restoring it is still required
+    return True
+
+
+@contextmanager
+def on_local_cpu(cache_key=None):
+    """Execute jitted work on the process's LOCAL CPU backend.
+
+    This is the host path's escape hatch on tunnelled platforms: the
+    same XLA program, zero accelerator interactions (a single tunnel
+    dispatch costs 25-110 ms fixed — more than many whole host
+    rounds). The persistent compile cache is suppressed around fresh
+    compiles (``cache_key`` identifies the shape family): XLA:CPU AOT
+    artifacts written from a TPU process can feature-mismatch a later
+    loader (SIGILL hazard, see the cache setup above)."""
+    import jax as _jax
+
+    cpu = _jax.devices("cpu")[0]
+    fresh = cache_key is None or cache_key not in _LOCAL_CPU_COMPILED
+    old = getattr(_jax.config, "jax_compilation_cache_dir", None)
+    # the SIGILL hazard exists only when this process's DEFAULT
+    # backend is an accelerator (its cache dir would mix TPU-process
+    # CPU artifacts); a CPU-pinned process (tests, the dry run) owns a
+    # self-consistent CPU cache that SHOULD persist these compiles
+    suppress = (
+        fresh and bool(old) and not _cpu_pinned()
+        and _cache_singleton_reset(None)
+    )
+    try:
+        with _jax.default_device(cpu):
+            yield
+        if cache_key is not None:
+            _LOCAL_CPU_COMPILED.add(cache_key)
+    finally:
+        if suppress:
+            _cache_singleton_reset(old)
 
 
 def bucket_pow2(n: int, floor: int = 9) -> int:

@@ -957,59 +957,21 @@ def converge_host(plan: PackedPlan) -> PackedResult:
 
     Requires a matrix-staged plan (``stage(put=None)``); eagerly
     shipped plans already live on the accelerator — converge them
-    there. The persistent compile cache is suppressed around FIRST
-    compiles of each shape: XLA:CPU AOT artifacts written from a TPU
-    process can feature-mismatch a later loader (SIGILL hazard, see
-    ops/device.py's cache setup). Flipping the config flag alone is
-    NOT enough — jax initializes the persistent cache as a
-    process-wide singleton on first use — so the singleton is reset
-    around the compile and again after restoring the flag (later
-    accelerator compiles re-initialize against the restored dir)."""
+    there. Compilation-cache handling (suppression of XLA:CPU AOT
+    artifacts from TPU processes) lives in
+    :func:`crdt_tpu.ops.device.on_local_cpu`."""
     if plan.dev:
         raise ValueError(
             "converge_host needs a matrix-staged plan (stage(put=None))"
         )
     import jax as _jax
 
+    from crdt_tpu.ops.device import on_local_cpu
+
     args = _plan_args(plan)
-    cpu = _jax.devices("cpu")[0]
-    key = (plan.mat.shape, tuple(sorted(args.items())))
-    fresh = key not in _HOST_COMPILED
-    old = getattr(_jax.config, "jax_compilation_cache_dir", None)
-    suppress = fresh and bool(old)
-    if suppress:
-        suppress = _cache_singleton_reset(None)
-    try:
-        with _jax.enable_x64(True), _jax.default_device(cpu):
-            h = np.asarray(
-                _converge_packed(jnp.asarray(plan.mat), **args)
-            )
-        _HOST_COMPILED.add(key)
-    finally:
-        if suppress:
-            _cache_singleton_reset(old)
+    key = ("converge_host", plan.mat.shape, tuple(sorted(args.items())))
+    with on_local_cpu(cache_key=key), _jax.enable_x64(True):
+        h = np.asarray(
+            _converge_packed(jnp.asarray(plan.mat), **args)
+        )
     return _assemble_result(plan, h)
-
-
-# shapes whose local-CPU executable already exists in-process (the
-# cache-suppression dance is only needed around a fresh compile)
-_HOST_COMPILED: set = set()
-
-
-def _cache_singleton_reset(cache_dir) -> bool:
-    """Point the persistent-cache config at ``cache_dir`` AND drop the
-    initialized singleton so the new value actually takes effect.
-    Returns False when the private reset hook is unavailable (then
-    the caller must not assume suppression worked)."""
-    import jax as _jax
-
-    try:
-        from jax._src import compilation_cache as _cc
-    except Exception:
-        return False  # no reset hook: leave the config untouched
-    _jax.config.update("jax_compilation_cache_dir", cache_dir)
-    try:
-        _cc.reset_cache()
-    except Exception:
-        pass  # config did change; restoring it is still required
-    return True

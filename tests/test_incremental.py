@@ -468,8 +468,11 @@ class TestAutoCalibration:
         # for reproducibility (VERDICT r4 item 6)
         assert info["host_us_per_row"] is not None
         assert info["host_us_per_row"] > 0
+        # a fast local backend's measured per-row transfer cost can be
+        # arbitrarily small — recorded, non-negative, never required
+        # to clear an arbitrary floor
         assert info["dev_us_per_row"] is not None
-        assert info["dev_us_per_row"] > 0
+        assert info["dev_us_per_row"] >= 0
         # cached: the probe runs once per process
         assert IncrementalReplay.calibration_info() == info
 
