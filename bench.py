@@ -492,6 +492,17 @@ def run_device(blobs, phases):
     return cache, snap, dec, ds, win_rows, win_vis, seq_orders
 
 
+def run_stream(blobs, phases):
+    """The overlapped streaming executor (the device path's DEFAULT
+    engine for the scale replay): chunked decode, async double-
+    buffered converge, incremental materialize. ``phases`` receives
+    per-lane busy seconds + overlap accounting (wall vs sum-of-phases,
+    overlap_efficiency) from crdt_tpu.models.streaming."""
+    from crdt_tpu.models import stream_replay
+
+    return stream_replay(blobs, phases=phases)
+
+
 def run_numpy(blobs, phases):
     def timed(name, fn, *a):
         t = time.perf_counter()
@@ -625,9 +636,87 @@ def fleet_mesh_child(argv):
     print(json.dumps(out))
 
 
+def smoke():
+    """Fast pipeline-accounting smoke: a tiny trace through all three
+    contenders (numpy, one-shot device pipeline, streaming executor)
+    on the CPU backend, equality-asserted, one JSON line out. Run by
+    a tier-1 test so a phase silently re-serializing (or the streamed
+    path diverging) is caught without a full scale run. Target <30s.
+    """
+    # CPU-pinned BEFORE any backend init: drop the axon pool var so
+    # the sitecustomize hook never dials the tunnel (a dead tunnel
+    # hangs backend init even under JAX_PLATFORMS=cpu — the same
+    # hazard _ensure_live_backend guards the full bench against)
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    # env alone is too late when jax was already imported via the
+    # package: pin the backend through the config knob as well
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # older jaxlib spelling; the env pin above covers it
+    jax.config.update("jax_enable_x64", True)
+    from crdt_tpu.models import stream_replay
+
+    R = int(os.environ.get("BENCH_SMOKE_REPLICAS", 48))
+    K = int(os.environ.get("BENCH_SMOKE_OPS", 40))
+    blobs = build_trace(R, K)
+
+    p_n: dict = {}
+    t0 = time.perf_counter()
+    cache_np, snap_np = run_numpy(blobs, p_n)
+    t_np = time.perf_counter() - t0
+
+    p_d: dict = {}
+    t0 = time.perf_counter()
+    cache_dev, snap_dev, *_ = run_device(blobs, p_d)
+    t_dev = time.perf_counter() - t0
+
+    # force the full pipeline shape on the tiny trace: several decode
+    # chunks, a real multi-shard converge/materialize pipeline
+    p_s: dict = {}
+    t0 = time.perf_counter()
+    res = stream_replay(
+        blobs, chunk_blobs=max(1, R // 6), max_shards=3,
+        min_shard_rows=1, phases=p_s,
+    )
+    t_stream = time.perf_counter() - t0
+
+    assert cache_dev == cache_np, "smoke: device vs numpy diverge"
+    assert snap_dev == snap_np, "smoke: snapshots diverge"
+    assert res.cache == cache_dev, "smoke: streamed cache diverges"
+    assert res.snapshot == snap_dev, "smoke: streamed snapshot diverges"
+    # accounting sanity: the overlap fields must exist and the busy
+    # sum must cover every pipeline lane (decode + converge + the
+    # incremental materialize all ran)
+    for key in ("decode", "converge", "materialize",
+                "busy_sum_s", "wall_s", "overlap_efficiency",
+                "wall_vs_phases"):
+        assert key in p_s, f"smoke: missing phase {key}"
+    assert p_s["busy_sum_s"] > 0
+    out = {
+        "metric": "smoke_trace_replay",
+        "ops": R * K,
+        "platform": jax.devices()[0].platform,
+        "numpy_s": round(t_np, 3),
+        "device_s": round(t_dev, 3),
+        "stream_s": round(t_stream, 3),
+        "stream_phases_s": p_s,
+        "phases_device_s": p_d,
+        "phases_numpy_s": p_n,
+        "ok": True,
+    }
+    print(json.dumps(out))
+
+
 def main():
     _ensure_live_backend()
     import jax
+
+    global enable_x64
+    from crdt_tpu.compat import enable_x64
 
     jax.config.update("jax_enable_x64", True)
     # the persistent compile cache is configured by the package itself
@@ -683,7 +772,7 @@ def main():
     for frac in (4, 2, 1):
         nsub = len(cols_w["client"]) // frac
         plan = _pk.stage({k: v[:nsub] for k, v in cols_w.items()})
-        with jax.enable_x64(True):
+        with enable_x64(True):
             dev = jnp.asarray(plan.mat)
             jax.block_until_ready(dev)
             args = dict(num_segments=plan.num_segments,
@@ -1257,47 +1346,75 @@ def main():
       if scale > 1:
         log(f"scale run: {R * scale} replicas x {K} ops")
         blobs_l = build_trace(R * scale, K, seed=1)
-        run_device(blobs_l, {})  # warm new shapes
-        # two recorded runs per contender, interleaved: the judge's
-        # bar is a ratio STABLE across runs, not one lucky session
-        # (VERDICT r3 item 1), and interleaving shares any drift
-        runs_d, runs_n = [], []
-        p_d, p_n = {}, {}
+        run_device(blobs_l, {})  # warm one-shot shapes (the oracle)
+        run_stream(blobs_l, {})  # warm the streaming shard shapes
+        # the DEVICE PATH of the scale replay is the overlapped
+        # streaming executor (on by default; crdt_tpu.models.
+        # streaming); the serial one-shot pipeline stays as the
+        # reference oracle — equality asserted below — and its wall
+        # clock is recorded so the overlap win is itself a published,
+        # reproducible number. Two recorded runs per contender,
+        # interleaved: the judge's bar is a ratio STABLE across runs,
+        # not one lucky session (VERDICT r3 item 1).
+        runs_s, runs_n = [], []
+        p_s, p_n = {}, {}
+        res_s = None
         for _ in range(2):
-            pd = {}
+            ps = {}
             t0 = time.perf_counter()
-            cache_l, snap_l, *_ = run_device(blobs_l, pd)
-            runs_d.append(round(time.perf_counter() - t0, 2))
-            if not p_d or runs_d[-1] <= min(runs_d[:-1]):
-                p_d = pd
+            res_s = run_stream(blobs_l, ps)
+            runs_s.append(round(time.perf_counter() - t0, 2))
+            if not p_s or runs_s[-1] <= min(runs_s[:-1]):
+                p_s = ps
             pn = {}
             t0 = time.perf_counter()
             cache_ln, _ = run_numpy(blobs_l, pn)
             runs_n.append(round(time.perf_counter() - t0, 2))
             if not p_n or runs_n[-1] <= min(runs_n[:-1]):
                 p_n = pn
-        t_dev_l, t_np_l = min(runs_d), min(runs_n)
+        # one-shot oracle: min-of-2 like the streamed side, so the
+        # published overlap win never divides a single noisy run
+        runs_one = []
+        p_d = {}
+        for _ in range(2):
+            pd = {}
+            t0 = time.perf_counter()
+            cache_l, snap_l, *_ = run_device(blobs_l, pd)
+            runs_one.append(round(time.perf_counter() - t0, 2))
+            if not p_d or runs_one[-1] <= min(runs_one[:-1]):
+                p_d = pd
+        t_oneshot = min(runs_one)
+        t_dev_l, t_np_l = min(runs_s), min(runs_n)
+        # the streamed path must be BIT-IDENTICAL to the one-shot
+        # oracle (and both to the numpy contender's shared assembly)
         assert cache_l == cache_ln
+        assert res_s.cache == cache_l, "streamed cache diverges"
+        assert res_s.snapshot == snap_l, "streamed snapshot diverges"
         scale_result = {
             "ops": R * scale * K,
-            "device_s": t_dev_l,
+            "device_s": t_dev_l,           # streaming executor wall
             "numpy_s": t_np_l,
             "vs_baseline": round(t_np_l / t_dev_l, 2),
-            "runs_device_s": runs_d,
+            "runs_device_s": runs_s,
             "runs_numpy_s": runs_n,
             "vs_baseline_per_run": [
-                round(n / d, 2) for n, d in zip(runs_n, runs_d)
+                round(n / d, 2) for n, d in zip(runs_n, runs_s)
             ],
-            "phases_device_s": p_d,
+            "phases_device_s": p_s,        # incl. overlap accounting
             "phases_numpy_s": p_n,
+            "oneshot_device_s": t_oneshot,
+            "oneshot_runs_s": runs_one,
+            "oneshot_phases_s": p_d,
+            "stream_vs_oneshot": round(t_oneshot / t_dev_l, 2),
+            "overlap_efficiency": p_s.get("overlap_efficiency"),
+            "wall_vs_phases": p_s.get("wall_vs_phases"),
         }
-        # the e2e ratio's structural ceiling: decode/columns/
-        # materialize/compact are IDENTICAL host code in both
-        # contenders, so even an instant device merge cannot beat
-        # numpy_total / shared_stages (Amdahl). Recorded so the
-        # headline ratio reads against what this pipeline shape can
-        # express at all; merge_span_ratio isolates the contended span
-        # (numpy merge+gather vs device pack+converge+gather).
+        # the SERIAL pipeline's structural ceiling, kept for the
+        # r05-comparable record: with every phase serialized,
+        # decode/columns/materialize/compact bound the ratio no
+        # matter how fast the merge is. The streaming executor exists
+        # to break exactly this bound — its wall vs busy-sum above is
+        # the measured overlap.
         shared_d = sum(
             p_d.get(k, 0.0)
             for k in ("decode", "columns", "materialize", "compact")
@@ -1309,12 +1426,17 @@ def main():
         )
         scale_result["merge_span_ratio"] = round(span_n / span_d, 2)
         scale_result["amdahl_ceiling"] = round(t_np_l / shared_d, 2)
-        log(f"scale e2e: device {runs_d} vs numpy {runs_n} "
+        log(f"scale e2e: stream {runs_s} (one-shot {t_oneshot}s -> "
+            f"x{scale_result['stream_vs_oneshot']} from overlap, "
+            f"efficiency {p_s.get('overlap_efficiency')}, wall/phases "
+            f"{p_s.get('wall_vs_phases')}) vs numpy {runs_n} "
             f"-> {scale_result['vs_baseline']}x "
             f"(per-run {scale_result['vs_baseline_per_run']}; "
             f"merge-span {scale_result['merge_span_ratio']}x; "
-            f"shared-stage ceiling {scale_result['amdahl_ceiling']}x)")
-        log(f"  device phases {p_d}")
+            f"serial shared-stage ceiling "
+            f"{scale_result['amdahl_ceiling']}x)")
+        log(f"  stream phases {p_s}")
+        log(f"  one-shot phases {p_d}")
         log(f"  numpy phases {p_n}")
 
         # ---- steady-state rounds on the big doc (BENCH_ROUNDS=0 off)
@@ -1522,5 +1644,10 @@ if __name__ == "__main__":
 
     if len(_sys_main.argv) > 1 and _sys_main.argv[1] == "--fleet-mesh-child":
         fleet_mesh_child(_sys_main.argv[2:])
+    elif (
+        "--smoke" in _sys_main.argv[1:]
+        or os.environ.get("BENCH_SMOKE") == "1"
+    ):
+        smoke()
     else:
         main()

@@ -1,0 +1,37 @@
+"""jax API compatibility shims.
+
+The package targets a range of jax releases: top-level ``jax.enable_x64``
+and ``jax.shard_map`` exist on newer trains, while older ones only ship
+the ``jax.experimental`` spellings. Every internal caller imports the
+two names from here so a version bump is a one-file change (and so a
+missing symbol fails at import time with one clear site, not as dozens
+of scattered AttributeErrors mid-kernel).
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:  # pre-top-level releases
+    from jax.experimental import enable_x64  # noqa: F401
+
+if hasattr(jax, "shard_map") and callable(jax.shard_map):
+    _shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f=None, **kw):
+    """``jax.shard_map`` with the replication-checker knob translated
+    across its rename (``check_vma`` on newer trains, ``check_rep``
+    before): callers write the current name, older jax still works."""
+    import inspect
+
+    params = inspect.signature(_shard_map).parameters
+    if "check_vma" in kw and "check_vma" not in params:
+        kw["check_rep"] = kw.pop("check_vma")
+    if f is None:
+        return _shard_map(**kw)
+    return _shard_map(f, **kw)

@@ -30,6 +30,8 @@ into columnar tensors and applied as one vectorized applyUpdate").
 
 from __future__ import annotations
 
+from crdt_tpu.compat import enable_x64
+
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -223,7 +225,7 @@ def rebuild_chains(engine) -> None:
 
     # ---- maps: winner (= chain tail) per (parent, key) segment --------
     if is_map.any():
-        with jax.enable_x64(True):
+        with enable_x64(True):
             order_k, seg_k, winners, _, _, _ = converge_maps(
                 jnp.asarray(_pad(client, pad, 0)),
                 jnp.asarray(_pad(clock.astype(np.int64), pad, 0)),
@@ -339,7 +341,7 @@ def rebuild_chains(engine) -> None:
         )
 
         num_segments = _bucket(len(local_seg_of), floor=3)
-        with jax.enable_x64(True):
+        with enable_x64(True):
             rank, _ = tree_order_ranks(
                 jnp.asarray(_pad(seg, pad, -1)),
                 jnp.asarray(_pad(parent_arr, pad, -1)),

@@ -11,6 +11,7 @@ from crdt_tpu.models.fleet import (
 )
 from crdt_tpu.models.incremental import IncrementalReplay
 from crdt_tpu.models.replay import ReplayResult, replay_trace
+from crdt_tpu.models.streaming import stream_replay
 
 __all__ = [
     "FleetStep",
@@ -25,4 +26,5 @@ __all__ = [
     "load_trace",
     "replay_trace",
     "shard_trace",
+    "stream_replay",
 ]

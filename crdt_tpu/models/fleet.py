@@ -661,6 +661,28 @@ class SegmentedFleet:
             segment_out_sizes,
         )
 
+        # compiled-step reuse is only sound when the trace fits the
+        # bounds this instance compiled with: a larger segment bucket
+        # would overflow the kernel's segment table and a replica or
+        # mesh mismatch would unpack the output vector at wrong
+        # offsets — both return silently wrong winners, the exact
+        # hazard fleet_replay guards against for ReplicaFleet reuse
+        # (advisor finding, round 5).
+        nd_mesh = self.mesh.devices.size
+        if (
+            sharded.n_devices != nd_mesh
+            or sharded.n_replicas != self.n_replicas
+            or sharded.num_segments > self.num_segments
+        ):
+            raise ValueError(
+                f"sharded trace (devices={sharded.n_devices}, "
+                f"replicas={sharded.n_replicas}, "
+                f"segments={sharded.num_segments}) does not fit the "
+                f"compiled SegmentedFleet (devices={nd_mesh}, "
+                f"replicas={self.n_replicas}, "
+                f"segments={self.num_segments})"
+            )
+
         tracer = get_tracer()
         nd, N_d = sharded.row_map.shape
         R = self.n_replicas

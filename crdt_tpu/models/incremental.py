@@ -64,6 +64,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from crdt_tpu.compat import enable_x64
 from crdt_tpu.codec import native
 from crdt_tpu.core.ids import DeleteSet
 from crdt_tpu.ops.device import bucket_pow2
@@ -386,7 +387,7 @@ class IncrementalReplay:
     def _ensure_mat(self):
         if self._mat is None:
             jax, jnp = self._jax, self._jnp
-            with jax.enable_x64(True):
+            with enable_x64(True):
                 m = jnp.zeros(
                     (7, bucket_pow2(self._capacity)), jnp.int64
                 )
@@ -407,7 +408,7 @@ class IncrementalReplay:
             perm = np.zeros(len(old), np.int32)
             for raw, od in old.items():
                 perm[od] = self._dense[raw]
-            with self._jax.enable_x64(True):
+            with enable_x64(True):
                 self._mat = pk._relabel_mat(
                     self._mat, self._jnp.asarray(perm)
                 )
@@ -1520,7 +1521,7 @@ class IncrementalReplay:
             self._ensure_mat()
             need = self.n_dev + kpad
             if need > self._mat.shape[1]:
-                with jax.enable_x64(True):
+                with enable_x64(True):
                     self._mat = pk._grow_mat(
                         self._mat, new_cap=bucket_pow2(need)
                     )
@@ -1529,7 +1530,7 @@ class IncrementalReplay:
                 _octave(n_sel, floor=1 << 13),
                 self._mat.shape[1],
             )
-            with jax.enable_x64(True):
+            with enable_x64(True):
                 self._mat, packed_out = pk._splice_select_converge(
                     self._mat, jnp.asarray(delta),
                     jnp.int32(self.n_dev),

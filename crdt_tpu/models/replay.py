@@ -189,11 +189,18 @@ def segment_bound(cols: Dict[str, np.ndarray]) -> int:
     return len(np.unique(segment_key(cols["parent_a"], cols["key_id"])))
 
 
-def _assemble_packed(dec: Dict, res):
-    """Vectorized host assembly of the packed kernel's one fetch."""
-    win_rows = res.win_rows[res.win_rows >= 0].tolist()
+def _assemble_packed(dec: Dict, res, row_map=None):
+    """Vectorized host assembly of the packed kernel's one fetch.
+    ``row_map`` translates the result's row space into ``dec``'s (the
+    streaming executor stages each chunk's rows separately, so its
+    results come back chunk-local); None means they already agree."""
+    win = res.win_rows[res.win_rows >= 0]
     m = res.stream_row >= 0
     rows, segs = res.stream_row[m], res.stream_seg[m]
+    if row_map is not None:
+        win = row_map[win]
+        rows = row_map[rows]
+    win_rows = win.tolist()
     seq_orders: dict = {}
     if len(rows):
         cuts = np.r_[0, np.flatnonzero(segs[1:] != segs[:-1]) + 1, len(segs)]
@@ -268,20 +275,32 @@ def _host_seq_orders(dec: Dict, specs_needed: set):
     }
 
 
-def _fix_map_chains_with_rights(dec: Dict, win_rows):
+def _fix_map_chains_with_rights(dec: Dict, win_rows, bad_rows=None,
+                                chain_rows=None, union_ids=None):
     """Crafted rights on MAP rows shift chain tails in ways the argmax
     kernel cannot express; recompute exactly those chains' tails via
-    the scalar chain order."""
+    the scalar chain order. The optional subsets are the streaming
+    executor's seams: ``bad_rows`` restricts the repair to a chunk's
+    right-bearing map rows (so one chunk never emits another chunk's
+    tails), ``chain_rows`` restricts the chain-membership scan to the
+    chunk's rows (sound because segments never split across chunks),
+    and ``union_ids`` shares one precomputed whole-union id set across
+    chunks instead of rebuilding it per call. Defaults scan the whole
+    union."""
     from crdt_tpu.core.records import ItemRecord
     from crdt_tpu.ops.yata import order_hard_segment
 
     rc_col, kid_col = dec["right_client"], dec["key_id"]
-    bad = np.flatnonzero((rc_col >= 0) & (kid_col >= 0))
+    if bad_rows is None:
+        bad = np.flatnonzero((rc_col >= 0) & (kid_col >= 0))
+    else:
+        bad = np.asarray(bad_rows, np.int64)
     if not len(bad):
         return win_rows
     affected = {(parent_spec(dec, int(r)), int(kid_col[r])) for r in bad}
     chains: Dict[Tuple, List[int]] = {}
-    for i in range(len(kid_col)):
+    for i in (range(len(kid_col)) if chain_rows is None else chain_rows):
+        i = int(i)
         if kid_col[i] >= 0:
             key = (parent_spec(dec, i), int(kid_col[i]))
             if key in affected:
@@ -291,10 +310,11 @@ def _fix_map_chains_with_rights(dec: Dict, win_rows):
         for rows in chains.values()
         for i in rows
     }
-    union_ids = {
-        (int(dec["client"][i]), int(dec["clock"][i]))
-        for i in range(len(kid_col))
-    }
+    if union_ids is None:
+        union_ids = {
+            (int(dec["client"][i]), int(dec["clock"][i]))
+            for i in range(len(kid_col))
+        }
     patched = dict.fromkeys(affected)
     for key, rows in chains.items():
         recs = [
@@ -382,6 +402,22 @@ def materialize(dec: Dict, ds: DeleteSet, win_rows, win_vis,
     tombstoned sequence members dropped (the engine's visible walk).
     Nested collections (a Y.Array/Y.Map stored under a map key or a
     sequence slot) materialize recursively through their type items."""
+    cache, ix_group = assemble_cache(
+        dec, ds, win_rows, win_vis, seq_orders
+    )
+    finish_cache(cache, dec, ix_group)
+    return cache
+
+
+def assemble_cache(dec: Dict, ds: DeleteSet, win_rows, win_vis,
+                   seq_orders) -> Tuple[dict, Dict[str, int]]:
+    """The per-subset half of :func:`materialize`: builds the cache
+    entries for exactly the root specs present in ``win_rows`` /
+    ``seq_orders``. The streaming executor calls this once per chunk
+    (each chunk owning whole root subtrees, so nested type items
+    resolve within the chunk) and merges the parts; the returned
+    ``ix_group`` is the subset's slice of the reserved ``ix`` index
+    root, consumed by :func:`finish_cache` once every part is in."""
     from crdt_tpu.core.store import K_TYPE, TYPE_MAP
 
     keys = dec["keys"]
@@ -438,10 +474,18 @@ def materialize(dec: Dict, ds: DeleteSet, win_rows, win_vis,
     for spec in seq_orders:
         if spec[0] == "root" and spec[1] not in cache:
             cache[spec[1]] = collection(spec, False, 0)
-    # roots registered in the ix index but with no visible content
-    # (e.g. a map whose every key was tombstoned) still materialize —
-    # empty — exactly like the document cache
-    for name, row in map_groups.get(("root", "ix"), {}).items():
+    return cache, map_groups.get(("root", "ix"), {})
+
+
+def finish_cache(cache: dict, dec: Dict,
+                 ix_group: Dict[str, int]) -> dict:
+    """The cross-subset tail of :func:`materialize`: roots registered
+    in the ix index but with no visible content (e.g. a map whose
+    every key was tombstoned) still materialize — empty — exactly
+    like the document cache. Runs once, after every subset's
+    :func:`assemble_cache` part has merged into ``cache``."""
+    contents = dec["contents"]
+    for name, row in ix_group.items():
         if name not in cache and name != "ix":
             cache[name] = [] if contents[row] == "array" else {}
     return cache
@@ -489,9 +533,18 @@ def replay_trace(
       (:func:`crdt_tpu.models.fleet.fleet_replay` — the reference's
       full-mesh propagate round, crdt.js:385,445, as a collective).
       Requires a causally complete union, like the device route.
+    - ``"stream"`` — the device pipeline, OVERLAPPED: chunked decode,
+      async double-buffered converge dispatches, incremental per-chunk
+      materialization (:func:`crdt_tpu.models.streaming.stream_replay`
+      — the default engine for the scale replay; same outputs as
+      ``"device"``, differential-tested byte-identical).
 
     All engines are differential-tested against each other and the
     scalar oracle; ``ReplayResult.path`` records which one ran."""
+    if route == "stream":
+        from crdt_tpu.models.streaming import stream_replay
+
+        return stream_replay(blobs, clients=clients)
     if route == "fleet":
         from crdt_tpu.models.fleet import fleet_replay
 

@@ -1,0 +1,484 @@
+"""Overlapped streaming replay — pipeline host phases against the
+in-flight device converge.
+
+The one-shot replay (:mod:`crdt_tpu.models.replay`) runs its phases
+strictly in series: decode -> stage -> pack -> converge -> gather ->
+materialize -> compact. On the tunnelled single-chip platform that
+serial shape caps the whole pipeline at an Amdahl ceiling of ~1.6x
+regardless of kernel speed (BENCH_r05: host-serial phases bracket a
+1.4s converge). This module restructures the SAME computation as a
+chunked, double-buffered pipeline — the classic training-stack move
+(input-pipeline prefetch + async dispatch):
+
+1. **decode** — the blob stream splits into fixed-size chunks and a
+   background thread pool decodes them (`codec.native` per chunk, one
+   :func:`crdt_tpu.codec.native.merge_decoded` merge — byte-identical
+   to the one-shot decode of the whole stream);
+2. **partition** — the union's segments group by their TOP-LEVEL root
+   (parent chains climbed host-side, vectorized), so every chunk of
+   work owns whole root subtrees and can converge AND materialize
+   independently;
+3. **converge** — each chunk stages through the packed single-dispatch
+   pipeline and enqueues its fused kernel ASYNCHRONOUSLY
+   (:func:`crdt_tpu.ops.packed.converge_async`): the upload of chunk
+   k+1 rides behind the dispatch of chunk k (double-buffered, bounded
+   queue), and winners are fetched only when the consumer needs them;
+4. **materialize** — the plain-JSON cache builds INCREMENTALLY per
+   chunk (:func:`crdt_tpu.models.replay.assemble_cache`) while later
+   chunks are still on the device, so the old serial materialize tail
+   amortizes into the overlap window. Snapshot compaction (pure
+   decode-side work) runs on the staging lane, inside the same window.
+
+Exactness: every chunk's result is the packed kernel's result for its
+segments, and segments never split across chunks, so the merged
+winners/orders are the one-shot path's outputs re-ordered. Shapes the
+chunked stager cannot prove locally (right-origin segments whose
+origin chains leave the segment) are conservatively routed to the
+exact host machinery — the same fallback the one-shot gather uses.
+Unions the packed layout cannot express at all fall back to the
+one-shot path wholesale. Differential-tested byte-identical against
+the one-shot oracle in tests/test_streaming.py.
+
+Phase accounting: ``phases`` (when passed) receives per-stage BUSY
+seconds summed across lanes, plus ``wall_s``, ``busy_sum_s``, and
+``overlap_efficiency`` = (busy - wall) / (busy - max_stage): 0 means
+fully serial, 1 means the wall clock collapsed onto the single longest
+stage. ``bench.py`` publishes these for the scale run.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from crdt_tpu.codec import native
+from crdt_tpu.models import replay as rp
+from crdt_tpu.models.replay import ReplayResult
+
+# default pipeline depth targets: enough chunks that decode streams,
+# enough convergence shards that fetch/materialize of shard k hides
+# behind the dispatch of shard k+1 — but never so many that fixed
+# per-dispatch latency dominates (each shard pays one upload + one
+# dispatch + one fetch through the tunnel)
+_DECODE_CHUNKS = 8
+_MAX_SHARDS = 4
+_MIN_SHARD_ROWS = 1 << 16
+
+
+class _Phases:
+    """Thread-safe busy-time accumulator (seconds per stage).
+
+    Host stages are charged in per-thread CPU time, not wall time:
+    the pipeline's lanes run concurrently, and a stage's wall span
+    inflated by GIL/core contention would multiply-count the same
+    second into the busy sum (whose contract is to reconstruct the
+    SERIAL pipeline's cost). The device lane's occupancy is the one
+    wall-clock entry, added explicitly by the consumer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.t: Dict[str, float] = {}
+
+    def add(self, name: str, dt: float) -> None:
+        with self._lock:
+            self.t[name] = self.t.get(name, 0.0) + dt
+
+    def timed(self, name: str, fn, *a, **kw):
+        t0 = time.thread_time()
+        out = fn(*a, **kw)
+        self.add(name, time.thread_time() - t0)
+        return out
+
+
+_IDLE_PHASES = ("converge_wait",)  # blocked time, not work: reported
+                                   # as a diagnostic, excluded from
+                                   # the busy sum (the device lane's
+                                   # occupancy is charged as
+                                   # "converge" instead)
+
+
+def overlap_stats(phases: Dict[str, float], wall: float) -> Dict:
+    """Pipeline accounting over per-stage busy seconds: how much of
+    the total work the wall clock actually hid. The sum counts each
+    lane's OCCUPANCY — host stages plus the device lane's
+    non-overlapping converge span — and excludes blocked-wait
+    diagnostics, so it reconstructs what the serial pipeline would
+    cost (cross-checked against the one-shot oracle's wall in
+    bench.py). ``overlap_efficiency`` is (busy - wall) /
+    (busy - max_stage) — the fraction of the maximally-hideable time
+    that WAS hidden (1.0 = wall collapsed to the longest stage, 0.0 =
+    fully serial); ``wall_vs_phases`` is the raw wall / sum-of-phases
+    ratio the acceptance bar reads."""
+    phases = {
+        k: v for k, v in phases.items() if k not in _IDLE_PHASES
+    }
+    busy = sum(v for v in phases.values())
+    longest = max(phases.values(), default=0.0)
+    hideable = busy - longest
+    eff = (busy - wall) / hideable if hideable > 1e-9 else (
+        1.0 if wall <= busy + 1e-9 else 0.0
+    )
+    return {
+        "busy_sum_s": round(busy, 3),
+        "wall_s": round(wall, 3),
+        "wall_vs_phases": round(wall / busy, 3) if busy else 1.0,
+        "overlap_efficiency": round(min(max(eff, 0.0), 1.0), 3),
+        "longest_stage_s": round(longest, 3),
+    }
+
+
+# ---------------------------------------------------------------------------
+# decode lane: chunked, thread-pooled
+# ---------------------------------------------------------------------------
+
+
+def stream_decode(blobs: Sequence[bytes], chunk_blobs: int,
+                  ph: _Phases) -> Dict:
+    """Chunked parallel decode -> the canonical (deduped) union,
+    byte-identical to the one-shot ``replay.decode``. Chunk decodes
+    run on a small thread pool: the native codec holds the GIL for
+    its Python-object work, but chunk k+1's wire parse still overlaps
+    chunk k's numpy merge tail, and on free-threaded builds the
+    chunks parallelize outright."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    blobs = list(blobs)
+    chunks = [
+        blobs[i:i + chunk_blobs]
+        for i in range(0, len(blobs), chunk_blobs)
+    ] or [[]]
+
+    def _one(chunk):
+        return ph.timed(
+            "decode", native.decode_updates_columns_any, chunk
+        )
+
+    if len(chunks) == 1:
+        decs = [_one(chunks[0])]
+    else:
+        import os
+
+        workers = min(4, max(2, (os.cpu_count() or 2)))
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            decs = list(ex.map(_one, chunks))
+    return ph.timed(
+        "merge", lambda: native.dedup_columns(native.merge_decoded(decs))
+    )
+
+
+# ---------------------------------------------------------------------------
+# partition: whole root subtrees per convergence shard
+# ---------------------------------------------------------------------------
+
+
+def partition_shards(cols: Dict[str, np.ndarray], max_shards: int):
+    """Group the union's segments by TOP-LEVEL root and greedy-pack
+    the roots into at most ``max_shards`` row-balanced shards.
+
+    Returns ``(shard_rows, seg, extra_hard_rows)``:
+
+    - ``shard_rows``: list of union-row index arrays (ascending), one
+      per shard, covering every row exactly once. Whole segments — and
+      whole root SUBTREES (nested type items and their collections) —
+      stay co-located, so each shard converges and materializes
+      independently of the others.
+    - ``seg``: dense segment id per row (shared diagnostics).
+    - ``extra_hard_rows``: representative union rows of right-bearing
+      segments whose members' origin chains may LEAVE the segment —
+      the shapes whose hardness the one-shot stager proves with
+      union-wide walks that a shard cannot run. They are routed to the
+      exact host ordering, a conservative superset of the one-shot
+      path's hard set.
+    """
+    n = len(cols["client"])
+    if n == 0:
+        return [], np.empty(0, np.int64), []
+    pir = np.asarray(cols["parent_is_root"], bool)
+    pa = np.asarray(cols["parent_a"], np.int64)
+    pb = np.asarray(cols["parent_b"], np.int64)
+    kid = np.asarray(cols["key_id"], np.int64)
+
+    # dense segment ids over (pir, pa, pb, kid)
+    order = np.lexsort((kid, pb, pa, pir))
+    same = (
+        (pir[order][1:] == pir[order][:-1])
+        & (pa[order][1:] == pa[order][:-1])
+        & (pb[order][1:] == pb[order][:-1])
+        & (kid[order][1:] == kid[order][:-1])
+    )
+    seg_sorted = np.cumsum(np.r_[True, ~same]) - 1
+    seg = np.empty(n, np.int64)
+    seg[order] = seg_sorted
+    S = int(seg_sorted[-1]) + 1 if n else 0
+    rep = np.empty(S, np.int64)
+    rep[seg_sorted] = order  # any member row stands for its segment
+
+    # climb each segment's parent chain to its top-level root (log-S
+    # pointer-doubling rounds, host-vectorized; shared packed-id
+    # index: codec.native.id_index)
+    index = native.id_index(cols["client"], cols["clock"])
+    rep_pir = pir[rep]
+    rep_pa = pa[rep]
+    rep_pb = pb[rep]
+    prow = native.id_lookup(
+        index, np.where(~rep_pir, rep_pa, np.int64(-1)), rep_pb
+    )
+    # seg -> parent seg; terminal segments self-loop
+    terminal = rep_pir | (prow < 0)
+    f = np.where(terminal, np.arange(S), seg[np.clip(prow, 0, max(n - 1, 0))])
+    for _ in range(max(1, (max(S, 2) - 1).bit_length() + 1)):
+        f = f[f]
+    # root id of each segment: the terminal ancestor's root (or -1 for
+    # dangling/cyclic chains — those collect in shard 0; their specs
+    # are non-root and unreachable from any root's nesting)
+    top = f
+    root_of_seg = np.where(
+        rep_pir[top] & terminal[top], rep_pa[top], np.int64(-1)
+    )
+
+    # rows per segment / per root, then greedy-pack roots
+    seg_rows_count = np.bincount(seg, minlength=S)
+    roots_u, root_inv = np.unique(root_of_seg, return_inverse=True)
+    root_load = np.bincount(root_inv, weights=seg_rows_count).astype(
+        np.int64
+    )
+    n_shards = max(1, min(max_shards, len(roots_u)))
+    bins = np.zeros(len(roots_u), np.int64)
+    loads = np.zeros(n_shards, np.int64)
+    for r in np.argsort(-root_load, kind="stable"):
+        b = int(np.argmin(loads))
+        bins[r] = b
+        loads[b] += int(root_load[r])
+    # dangling bucket (-1) pinned to shard 0 for determinism
+    if len(roots_u) and roots_u[0] == -1:
+        bins[0] = 0
+    shard_of_seg = bins[root_inv]
+    shard_of_row = shard_of_seg[seg]
+    shard_rows = [
+        np.flatnonzero(shard_of_row == b) for b in range(n_shards)
+    ]
+    shard_rows = [r for r in shard_rows if len(r)]
+
+    # conservative hard set: right-bearing sequence segments with any
+    # member whose origin resolves OUTSIDE the segment (the one-shot
+    # stager's union-wide subtree walks can cross segments there; a
+    # shard-local walk cannot follow them, so the exact host machinery
+    # takes those segments in every case)
+    extra_hard: List[int] = []
+    rc = np.asarray(cols["right_client"], np.int64)
+    rb = (rc >= 0) & (kid < 0)
+    if rb.any():
+        oc = np.asarray(cols["origin_client"], np.int64)
+        ock = np.asarray(cols["origin_clock"], np.int64)
+        orow = native.id_lookup(index, oc, ock)
+        cross = (oc >= 0) & (orow >= 0) & (
+            seg[np.clip(orow, 0, max(n - 1, 0))] != seg
+        )
+        hard_segs = np.intersect1d(
+            np.unique(seg[rb]), np.unique(seg[cross])
+        )
+        extra_hard = [int(rep[s]) for s in hard_segs.tolist()]
+    return shard_rows, seg, extra_hard
+
+
+# ---------------------------------------------------------------------------
+# the executor
+# ---------------------------------------------------------------------------
+
+
+def stream_replay(
+    blobs: Sequence[bytes],
+    *,
+    chunk_blobs: Optional[int] = None,
+    max_shards: int = _MAX_SHARDS,
+    min_shard_rows: int = _MIN_SHARD_ROWS,
+    clients: Optional[Sequence[int]] = None,
+    phases: Optional[dict] = None,
+) -> ReplayResult:
+    """Chunked, double-buffered streaming replay: blobs in, converged
+    cache + compacted snapshot out — same outputs as
+    ``replay_trace(route="device")``, pipelined (see module doc).
+
+    ``chunk_blobs`` sets the decode chunk size (default: ~8 chunks);
+    ``max_shards`` bounds the convergence/materialize pipeline depth.
+    ``clients`` seeds the resident fallback's client table exactly as
+    on the device route (the packed path interns its own equivalent
+    table). ``phases``, when given, receives per-stage busy seconds
+    plus the overlap accounting of :func:`overlap_stats`."""
+    import jax
+
+    from crdt_tpu.ops import packed
+
+    t_wall0 = time.perf_counter()
+    ph = _Phases()
+    blobs = list(blobs)
+    if chunk_blobs is None:
+        chunk_blobs = max(1, -(-len(blobs) // _DECODE_CHUNKS))
+
+    dec = stream_decode(blobs, chunk_blobs, ph)
+    cols, ds = ph.timed("columns", rp.stage, dec)
+    n = len(cols["client"])
+
+    eff_shards = max(
+        1, min(max_shards, n // max(min_shard_rows, 1) or 1)
+    )
+    shard_rows, _seg, extra_hard = ph.timed(
+        "partition", partition_shards, cols, eff_shards
+    )
+
+    # crafted rights on MAP rows shift chain tails; repaired per shard
+    # so every shard emits only its own segments' tails. The whole-
+    # union id set the repair consults is built ONCE here (not per
+    # shard) when any such rows exist at all.
+    map_bad = np.flatnonzero(
+        (np.asarray(cols["right_client"]) >= 0)
+        & (np.asarray(cols["key_id"]) >= 0)
+    )
+    union_ids = None
+    if len(map_bad):
+        union_ids = set(
+            zip(
+                np.asarray(cols["client"]).tolist(),
+                np.asarray(cols["clock"]).tolist(),
+            )
+        )
+
+    # ---- staging/dispatch lane (background thread) -------------------
+    # bounded queue = the double buffer: at most two plans in flight
+    # behind the consumer, so uploads of shard k+1 overlap the dispatch
+    # of shard k without unbounded device-memory growth
+    q: queue.Queue = queue.Queue(maxsize=2)
+    snap_box: dict = {}
+
+    def stager():
+        try:
+            for g, rows_g in enumerate(shard_rows):
+                sub = {k: v[rows_g] for k, v in cols.items()}
+                # eager per-row shipping is gated on THIS shard's row
+                # count: a sub-threshold shard's extra per-put fixed
+                # latencies outweigh any staging/transfer overlap
+                # (same rationale as replay.converge's gate)
+                eager = len(rows_g) >= packed.EAGER_PUT_MIN_ROWS
+                plan = ph.timed(
+                    "pack", packed.stage, sub,
+                    put=jax.device_put if eager else None,
+                )
+                if plan is None:
+                    q.put(("unstageable", None, None))
+                    return
+                handle = packed.converge_async(plan)  # enqueue, no block
+                q.put(("shard", (handle, time.perf_counter()), rows_g))
+            # compact is pure decode-side work: it runs here, inside
+            # the window where the consumer is fetching/materializing
+            snap_box["snap"] = ph.timed("compact", rp.compact, dec, ds)
+            q.put(("done", None, None))
+        except BaseException as exc:  # surface in the consumer
+            q.put(("error", exc, None))
+
+    worker = threading.Thread(target=stager, daemon=True)
+    worker.start()
+
+    # ---- consumer: fetch -> gather -> incremental materialize --------
+    cache: dict = {}
+    ix_group: Dict[str, int] = {}
+    failed: Optional[BaseException] = None
+    unstageable = False
+    extra_hard_left = list(extra_hard)
+    last_fetch_done = 0.0
+    try:
+        while True:
+            kind, payload, rows_g = q.get()
+            if kind == "done":
+                break
+            if kind == "error":
+                failed = payload
+                break
+            if kind == "unstageable":
+                unstageable = True
+                break
+            handle, t_enq = payload
+            t0 = time.perf_counter()
+            res = packed.converge_fetch(handle)  # the shard's ONE sync
+            t1 = time.perf_counter()
+            ph.add("converge_wait", t1 - t0)
+            # device-lane occupancy: this shard's span, net of any
+            # part that overlapped the previous shard's execution
+            ph.add("converge", t1 - max(t_enq, last_fetch_done))
+            last_fetch_done = t1
+
+            t0 = time.thread_time()
+            win_rows, seq_orders = rp._assemble_packed(
+                dec, res, row_map=rows_g
+            )
+            # hard/right shapes are the exception path: each affected
+            # shard pays one full-union host pass (the same machinery
+            # the one-shot gather uses once); benign firehose unions
+            # skip all of this
+            hard = [int(rows_g[int(r)]) for r in res.hard_rows]
+            if extra_hard_left:
+                in_shard = set(rows_g.tolist())
+                mine = [r for r in extra_hard_left if r in in_shard]
+                extra_hard_left = [
+                    r for r in extra_hard_left if r not in in_shard
+                ]
+                hard.extend(mine)
+            if hard:
+                affected = {rp.parent_spec(dec, r) for r in hard}
+                seq_orders.update(rp._host_seq_orders(dec, affected))
+            if len(map_bad):
+                shard_bad = map_bad[np.isin(map_bad, rows_g)]
+                win_rows = rp._fix_map_chains_with_rights(
+                    dec, win_rows, bad_rows=shard_bad,
+                    chain_rows=rows_g, union_ids=union_ids,
+                )
+            win_vis = rp.visible_mask(dec, win_rows, ds)
+            ph.add("gather", time.thread_time() - t0)
+
+            part, ix_part = ph.timed(
+                "materialize", rp.assemble_cache,
+                dec, ds, win_rows, win_vis, seq_orders,
+            )
+            cache.update(part)
+            ix_group.update(ix_part)
+    finally:
+        # never leave the stager blocked on a full queue (e.g. when
+        # the consumer raised mid-shard): drain until it exits
+        while worker.is_alive():
+            try:
+                q.get(timeout=0.05)
+            except queue.Empty:
+                pass
+        worker.join()
+    if failed is not None:
+        raise failed
+    if unstageable:
+        # the union exceeds the packed layout's bounds: one-shot
+        # fallback through the general path (exact, unpipelined;
+        # ``clients`` seeds the resident table exactly as on the
+        # device route)
+        handle = rp.converge(cols, clients=clients)
+        win_rows, win_vis, seq_orders = rp.gather(dec, ds, handle)
+        cache = rp.materialize(dec, ds, win_rows, win_vis, seq_orders)
+        snap = rp.compact(dec, ds)
+        if phases is not None:  # the contract holds on every exit
+            wall = time.perf_counter() - t_wall0
+            phases.update({k: round(v, 4) for k, v in ph.t.items()})
+            phases.update(overlap_stats(ph.t, wall))
+            phases["fallback"] = True
+        return ReplayResult(
+            cache=cache, snapshot=snap, n_ops=n,
+            path="stream-fallback",
+        )
+    ph.timed("materialize", rp.finish_cache, cache, dec, ix_group)
+
+    wall = time.perf_counter() - t_wall0
+    if phases is not None:
+        phases.update({k: round(v, 4) for k, v in ph.t.items()})
+        phases.update(overlap_stats(ph.t, wall))
+    return ReplayResult(
+        cache=cache, snapshot=snap_box["snap"], n_ops=n, path="stream"
+    )

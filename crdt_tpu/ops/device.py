@@ -123,21 +123,52 @@ _CLOCK_BITS = 40
 _LOCAL_CPU_COMPILED: set = set()
 
 
+_RESET_HOOK_WARNED = False
+
+
+def _warn_no_reset_hook() -> None:
+    """One-time loud signal that persistent-cache suppression is
+    DEGRADED: jax's private ``compilation_cache.reset_cache`` hook is
+    gone, so XLA:CPU AOT artifacts from an accelerator-backed process
+    may persist and feature-mismatch a later loader (the documented
+    SIGILL hazard). Silent no-op was the advisor's round-5 finding;
+    tests/test_device_merge.py pins the hook so a jax upgrade that
+    removes it fails loudly instead of landing here in production."""
+    global _RESET_HOOK_WARNED
+    if not _RESET_HOOK_WARNED:
+        _RESET_HOOK_WARNED = True
+        import warnings
+
+        warnings.warn(
+            "jax._src.compilation_cache.reset_cache is unavailable: "
+            "persistent-cache suppression around local-CPU compiles "
+            "is a no-op (SIGILL hazard for cross-backend cached "
+            "artifacts). Pin CRDT_TPU_COMPILE_CACHE=\"\" to disable "
+            "the cache, or update crdt_tpu for this jax version.",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
 def _cache_singleton_reset(cache_dir) -> bool:
     """Point the persistent-cache config at ``cache_dir`` AND drop the
     initialized singleton so the new value actually takes effect
     (flipping the flag alone is a no-op against jax's process-wide
-    cache singleton). Returns False when the private reset hook is
-    unavailable (callers must then not assume suppression worked)."""
+    cache singleton). Returns False — after a one-time warning — when
+    the private reset hook is unavailable (callers must then not
+    assume suppression worked)."""
     import jax as _jax
 
     try:
         from jax._src import compilation_cache as _cc
+
+        _reset = _cc.reset_cache
     except Exception:
+        _warn_no_reset_hook()
         return False  # no reset hook: leave the config untouched
     _jax.config.update("jax_compilation_cache_dir", cache_dir)
     try:
-        _cc.reset_cache()
+        _reset()
     except Exception:
         pass  # config did change; restoring it is still required
     return True

@@ -23,6 +23,8 @@ from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
+
+from crdt_tpu.compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 
@@ -263,7 +265,7 @@ def merge_records(
     d_client = list(d_client) + [-1] * (dpad - len(d_client))
     d_start = list(d_start) + [-1] * (dpad - len(d_start))
     d_end = list(d_end) + [-1] * (dpad - len(d_end))
-    with jax.enable_x64(True):
+    with enable_x64(True):
         order, seg, winners, visible, _, _ = converge_maps(
             jnp.asarray(cols["client"]),
             jnp.asarray(cols["clock"]),

@@ -37,6 +37,8 @@ from functools import partial
 from typing import Dict, NamedTuple, Optional
 
 import jax
+
+from crdt_tpu.compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 
@@ -894,6 +896,32 @@ def _assemble_result(plan: PackedPlan, h: np.ndarray) -> PackedResult:
     )
 
 
+def converge_async(plan: PackedPlan):
+    """ENQUEUE the fused convergence and return immediately — no
+    blocking fetch. The returned handle is the streaming executor's
+    overlap seam: while the dispatch is in flight the host stages,
+    uploads, and dispatches the NEXT chunk (and materializes the
+    previous one); :func:`converge_fetch` blocks only when the
+    consumer actually needs the winners. ``jnp.asarray``/``device_put``
+    and jitted calls are all asynchronous, so the only synchronization
+    point in the whole (stage -> upload -> dispatch) chain is the
+    fetch."""
+    args = _plan_args(plan)
+    with enable_x64(True):
+        if plan.dev:
+            out = _converge_rows(*plan.dev, **args)
+        else:
+            out = _converge_packed(jnp.asarray(plan.mat), **args)
+    return plan, out
+
+
+def converge_fetch(handle) -> PackedResult:
+    """Block on an in-flight :func:`converge_async` dispatch and
+    assemble its one packed fetch into caller row space."""
+    plan, out = handle
+    return _assemble_result(plan, np.asarray(out))
+
+
 def converge(plan: PackedPlan,
              phases: Optional[dict] = None) -> PackedResult:
     """Stage -> single dispatch -> single fetch. Device outputs are in
@@ -908,38 +936,38 @@ def converge(plan: PackedPlan,
     of reporting one opaque "converge"."""
     import time as _t
 
+    if phases is None:
+        # production shape: enqueue + one blocking fetch (the same
+        # two-step seam the streaming executor drives directly)
+        return converge_fetch(converge_async(plan))
+
     args = _plan_args(plan)
 
     def mark(name, t0):
-        if phases is not None:
-            phases[name] = round(_t.perf_counter() - t0, 4)
+        phases[name] = round(_t.perf_counter() - t0, 4)
 
-    # the sync barriers below exist ONLY for instrumentation: the
-    # production call (phases=None) must keep its original single-sync
-    # shape, where the dispatch enqueue overlaps the eager-upload tail
-    # and np.asarray is the one blocking point
-    with jax.enable_x64(True):
+    # from here on phases is non-None: this is the INSTRUMENTED shape
+    # only — its sync barriers exist to itemize upload/dispatch/fetch
+    # against the floor derivation (ROOFLINE.md), and would serialize
+    # the production path, which took the async early return above
+    with enable_x64(True):
         if plan.dev:
-            if phases is not None:
-                t0 = _t.perf_counter()
-                jax.block_until_ready(plan.dev)  # eager uploads land
-                mark("upload_wait", t0)
+            t0 = _t.perf_counter()
+            jax.block_until_ready(plan.dev)  # eager uploads land
+            mark("upload_wait", t0)
             t0 = _t.perf_counter()
             out = _converge_rows(*plan.dev, **args)          # 1 dispatch
-            if phases is not None:
-                jax.block_until_ready(out)
-                mark("dispatch", t0)
+            jax.block_until_ready(out)
+            mark("dispatch", t0)
         else:
             t0 = _t.perf_counter()
             dev_mat = jnp.asarray(plan.mat)                  # 1 transfer
-            if phases is not None:
-                jax.block_until_ready(dev_mat)
-                mark("upload_wait", t0)
-                t0 = _t.perf_counter()
+            jax.block_until_ready(dev_mat)
+            mark("upload_wait", t0)
+            t0 = _t.perf_counter()
             out = _converge_packed(dev_mat, **args)          # 1 dispatch
-            if phases is not None:
-                jax.block_until_ready(out)
-                mark("dispatch", t0)
+            jax.block_until_ready(out)
+            mark("dispatch", t0)
         t0 = _t.perf_counter()
         h = np.asarray(out)                                  # 1 fetch
         mark("fetch", t0)
@@ -970,7 +998,7 @@ def converge_host(plan: PackedPlan) -> PackedResult:
 
     args = _plan_args(plan)
     key = ("converge_host", plan.mat.shape, tuple(sorted(args.items())))
-    with on_local_cpu(cache_key=key), _jax.enable_x64(True):
+    with on_local_cpu(cache_key=key), enable_x64(True):
         h = np.asarray(
             _converge_packed(jnp.asarray(plan.mat), **args)
         )

@@ -43,6 +43,8 @@ import functools
 import os
 
 import jax
+
+from crdt_tpu.compat import enable_x64
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -148,7 +150,7 @@ def _ds_mask_call(cl2, ckh2, ckl2, dcl, dsh, dsl, deh, delo, interpret):
     # trace with x64 off: the framework traces under x64 and the
     # promoted i64 literals (index maps, reductions) fail Mosaic
     # legalization; every input here is already explicit int32
-    with jax.enable_x64(False):
+    with enable_x64(False):
         return pl.pallas_call(
             _ds_mask_kernel,
             out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
@@ -243,7 +245,7 @@ def _sv_deficit_kernel(svi_ref, svj_ref, out_ref):
 def _sv_deficit_call(svs, interpret):
     r, c = svs.shape
     grid = (r // _DEF_TI, r // _DEF_TJ, c // _DEF_TC)
-    with jax.enable_x64(False):  # see _ds_mask_call
+    with enable_x64(False):  # see _ds_mask_call
         return pl.pallas_call(
             _sv_deficit_kernel,
             out_shape=jax.ShapeDtypeStruct((r, r), jnp.int32),

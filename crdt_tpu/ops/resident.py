@@ -35,6 +35,8 @@ from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+
+from crdt_tpu.compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 
@@ -134,7 +136,7 @@ class ResidentColumns:
         self._dense: Dict[int, int] = {}  # raw -> rank among seen
         if clients is not None and len(clients) > 0:
             self._intern(np.asarray(sorted(set(int(c) for c in clients))))
-        with jax.enable_x64(True):
+        with enable_x64(True):
             self._bufs: Tuple[jnp.ndarray, ...] = tuple(
                 jnp.full(cap, _FILL[name], dtype=dt) for name, dt in COLUMNS
             )
@@ -210,7 +212,7 @@ class ResidentColumns:
         k = len(cols["client"])
         if k == 0:
             return
-        with jax.enable_x64(True):
+        with enable_x64(True):
             delta = self._prepare_delta(cols, k)
             self._bufs = _splice(self._bufs, delta, jnp.int32(self.n))
         self.n += k
@@ -232,7 +234,7 @@ class ResidentColumns:
                 num_segments=num_segments, d_client=d_client,
                 d_start=d_start, d_end=d_end,
             )
-        with jax.enable_x64(True):
+        with enable_x64(True):
             delta = self._prepare_delta(cols, k)
             # default segments AFTER _prepare_delta: it may grow the
             # capacity, and a pre-growth default would alias segment
@@ -277,7 +279,7 @@ class ResidentColumns:
         (:meth:`dense_client`).
         """
         segs = num_segments or self.capacity
-        with jax.enable_x64(True):
+        with enable_x64(True):
             if d_client is None:
                 d_client = jnp.full(16, -1, jnp.int32)
                 d_start = jnp.full(16, -1, jnp.int64)

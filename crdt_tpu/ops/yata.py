@@ -49,6 +49,8 @@ from functools import partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
+
+from crdt_tpu.compat import enable_x64
 import jax.numpy as jnp
 
 from crdt_tpu.ops.device import (
@@ -497,7 +499,7 @@ def order_sequences(records):
 
     with on_local_cpu(
         cache_key=("order_sequences", pad, num_segments)
-    ), jax.enable_x64(True):
+    ), enable_x64(True):
         rank, _ = tree_order_ranks(
             jnp.asarray(_pad_to(seg, pad, -1)),
             jnp.asarray(_pad_to(parent_idx, pad, -1)),

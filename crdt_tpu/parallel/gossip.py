@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from crdt_tpu.compat import shard_map
 
 from crdt_tpu.ops import statevec
 from crdt_tpu.ops.merge import converge_maps

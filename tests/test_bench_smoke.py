@@ -1,0 +1,40 @@
+"""Tier-1 guard over the bench pipeline accounting.
+
+``bench.py --smoke`` replays a tiny trace through all three contenders
+(numpy baseline, one-shot device pipeline, streaming executor) on the
+CPU backend, asserts equality, and prints one JSON line with the
+per-phase + overlap accounting. Running it here catches accounting
+regressions — a phase silently re-serializing, a lane dropping out of
+the busy sum, the streamed path diverging — without a full scale run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_smoke_mode():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial a tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--smoke"],
+        env=env, capture_output=True, text=True, timeout=240, cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["ok"] is True
+    assert out["platform"] == "cpu"
+    ph = out["stream_phases_s"]
+    # every pipeline lane accounted, overlap metrics present
+    for key in ("decode", "converge", "materialize", "busy_sum_s",
+                "wall_s", "wall_vs_phases", "overlap_efficiency"):
+        assert key in ph, key
+    assert ph["busy_sum_s"] > 0
+    assert 0.0 <= ph["overlap_efficiency"] <= 1.0
+    # the serial contenders' phase dicts stay r05-shaped
+    for key in ("decode", "pack", "converge", "materialize", "compact"):
+        assert key in out["phases_device_s"], key

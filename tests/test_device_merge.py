@@ -380,3 +380,42 @@ class TestDeviceModePlumbing:
         device.apply_update(blob)
         _assert_same_state(scalar, device)
         assert scalar.c["m"]["k"] == "A"
+
+
+class TestCompilationCacheHook:
+    """The local-CPU escape hatch suppresses the persistent compile
+    cache through jax's PRIVATE reset hook. If a jax upgrade removes
+    it, suppression silently no-ops and the SIGILL hazard (XLA:CPU AOT
+    artifacts persisted from an accelerator-backed process) returns —
+    so the hook's presence is pinned here, and its absence must warn
+    loudly instead of degrading in silence (ADVICE r5)."""
+
+    def test_reset_hook_present(self):
+        """Fails loudly when a jax upgrade removes the private hook
+        crdt_tpu.ops.device._cache_singleton_reset depends on."""
+        from jax._src import compilation_cache as cc
+
+        assert callable(getattr(cc, "reset_cache", None)), (
+            "jax._src.compilation_cache.reset_cache is gone: update "
+            "crdt_tpu.ops.device's cache suppression for this jax "
+            "version (silent no-op = SIGILL hazard)"
+        )
+
+    def test_missing_hook_warns_once_and_reports_failure(self, monkeypatch):
+        """With the hook absent, _cache_singleton_reset must return
+        False (callers then skip suppression) and emit its one-time
+        RuntimeWarning instead of pretending the reset happened."""
+        import warnings
+
+        from jax._src import compilation_cache as cc
+
+        from crdt_tpu.ops import device
+
+        monkeypatch.delattr(cc, "reset_cache")
+        monkeypatch.setattr(device, "_RESET_HOOK_WARNED", False)
+        with pytest.warns(RuntimeWarning, match="reset_cache"):
+            assert device._cache_singleton_reset(None) is False
+        # second call: degraded mode already announced, no new warning
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert device._cache_singleton_reset(None) is False

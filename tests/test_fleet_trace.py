@@ -246,6 +246,39 @@ class TestFleetReplay:
         out = fleet_replay(blobs, mesh=make_mesh(1), shard="segments")
         assert out.cache == oracle_cache(blobs)
 
+    def test_segmented_step_rejects_out_of_bounds_trace(self, mesh8):
+        """A reused SegmentedFleet fed a trace exceeding its compiled
+        bounds (segment bucket, replica count, device count) must
+        raise, not unpack wrong offsets into silently wrong winners —
+        the mirror of the ReplicaFleet reuse guard (ADVICE r5)."""
+        from crdt_tpu.models.fleet import (
+            SegmentedFleet,
+            load_trace,
+            shard_trace,
+        )
+        from crdt_tpu.parallel.gossip import make_mesh
+
+        blobs = build_round_blobs(4, 4, seed=50)
+        tr = load_trace(blobs, replicas_multiple=1)
+        sh = shard_trace(tr, 8)
+        sf = SegmentedFleet(sh, mesh=mesh8)
+
+        # bigger segment bucket than compiled
+        big = sh._replace(num_segments=sh.num_segments * 2)
+        with pytest.raises(ValueError, match="does not fit"):
+            sf.step(big)
+        # replica-count mismatch (deficit block unpack would shear)
+        wrong_r = sh._replace(n_replicas=sh.n_replicas + 1)
+        with pytest.raises(ValueError, match="does not fit"):
+            sf.step(wrong_r)
+        # sharded for a different mesh width
+        sh2 = shard_trace(tr, 2)
+        with pytest.raises(ValueError, match="does not fit"):
+            sf.step(sh2)
+        # the matching trace still steps after the rejections
+        out = sf.step(sh)
+        assert out.winners.shape[0] == 8
+
     def test_snapshot_replays_to_same_cache(self, mesh8):
         """The compacted snapshot a fleet round emits is a valid v1
         blob that cold-replays to the identical document."""

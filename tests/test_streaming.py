@@ -1,0 +1,261 @@
+"""Streamed/chunked executor vs the one-shot oracle — byte-identical.
+
+The overlapped streaming replay (crdt_tpu.models.streaming) must
+produce EXACTLY the one-shot device pipeline's final state — winners,
+sequence orders, materialized cache, and the encoded snapshot bytes —
+for every chunking of the blob stream (single-blob chunks, odd sizes,
+the whole stream at once) and every convergence-shard count, including
+delete-set-only chunks, right-origin mid-inserts, and nested
+collections. The one-shot path is itself oracle-pinned elsewhere
+(tests/test_models.py, tests/test_grand_differential.py), so equality
+here chains the streamed path to the scalar reference.
+"""
+
+import numpy as np
+import pytest
+
+from crdt_tpu.codec import native, v1
+from crdt_tpu.core.engine import Engine
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.models import replay_trace, stream_replay
+from crdt_tpu.models import replay as rp
+from crdt_tpu.models import streaming as sm
+
+
+def mixed_blobs(R=12, K=18, seed=0):
+    """Per-replica blobs: chained map sets over two maps, own-chain
+    list appends over two lists, shared-anchor attaches (cross-blob
+    origin chains — the shape that forces cross-chunk parent
+    resolution), and per-replica delete ranges."""
+    rng = np.random.default_rng(seed)
+    blobs = []
+    for r in range(R):
+        client = r + 1
+        recs = []
+        last = {}
+        for k in range(K):
+            kind = int(rng.integers(0, 3))
+            if kind == 0:
+                recs.append(ItemRecord(
+                    client=client, clock=k, parent_root=f"m{k % 2}",
+                    key=f"k{int(rng.integers(0, 6))}", content=[r, k],
+                ))
+            elif kind == 1 and r > 0:
+                # attach to replica 1's own chain head (cross-blob)
+                recs.append(ItemRecord(
+                    client=client, clock=k, origin=(1, 0),
+                    content=f"x{r}-{k}",
+                ))
+            else:
+                lst = int(rng.integers(0, 2))
+                prev = last.get(lst)
+                recs.append(ItemRecord(
+                    client=client, clock=k, parent_root=f"l{lst}",
+                    origin=(client, prev) if prev is not None else None,
+                    content=k,
+                ))
+                last[lst] = k
+        ds = DeleteSet()
+        ds.add(client, int(rng.integers(0, K)))
+        blobs.append(v1.encode_update(recs, ds))
+    # ensure replica 1's clock-0 op exists as a list head
+    return blobs
+
+
+def text_blobs(R=8, K=16, seed=3):
+    """Right-origin mid-inserts into one shared sequence."""
+    rng = np.random.default_rng(seed)
+    blobs = []
+    for r in range(R):
+        client = r + 1
+        chain = []
+        recs = []
+        for k in range(K):
+            if chain and rng.random() < 0.3:
+                j = int(rng.integers(0, len(chain)))
+                recs.append(ItemRecord(
+                    client=client, clock=k, parent_root="text",
+                    origin=chain[j - 1] if j > 0 else None,
+                    right=chain[j], content=k))
+                chain.insert(j, (client, k))
+            else:
+                recs.append(ItemRecord(
+                    client=client, clock=k, parent_root="text",
+                    origin=chain[-1] if chain else None, content=k))
+                chain.append((client, k))
+        blobs.append(v1.encode_update(recs, DeleteSet()))
+    return blobs
+
+
+def nested_blobs():
+    """Nested collections (array-in-map, map-in-array) via the scalar
+    engine — the root-subtree co-location case: a chunk must own a
+    type item together with its child segments."""
+    blobs = []
+    for cid in (5, 9, 13):
+        eng = Engine(cid)
+        eng.map_set("cfg", f"plain{cid}", [1, cid])
+        t = eng.map_set_type("cfg", f"narr{cid}")  # array-in-map
+        eng.seq_insert(
+            "", 0, [cid * 10, cid * 11], parent=("item",) + t.id
+        )
+        for i in range(4):
+            eng.seq_insert("log", i, [[cid, i]])
+        blobs.append(v1.encode_state_as_update(eng))
+    return blobs
+
+
+def _one_shot(blobs):
+    return replay_trace(blobs, route="device")
+
+
+def _assert_identical(blobs, chunk_blobs, max_shards):
+    one = _one_shot(blobs)
+    ph = {}
+    st = stream_replay(
+        blobs, chunk_blobs=chunk_blobs, max_shards=max_shards,
+        min_shard_rows=1, phases=ph,
+    )
+    assert st.cache == one.cache, (chunk_blobs, max_shards)
+    assert st.snapshot == one.snapshot, (chunk_blobs, max_shards)
+    assert st.n_ops == one.n_ops
+    return ph
+
+
+class TestStreamedDifferential:
+    def test_chunk_size_matrix(self):
+        """{1 blob, odd sizes, whole-stream} x shard counts."""
+        blobs = mixed_blobs()
+        for chunk in (1, 3, len(blobs)):
+            for shards in (1, 2, 3):
+                _assert_identical(blobs, chunk, shards)
+
+    def test_delete_set_only_chunks(self):
+        """Blobs carrying ONLY delete ranges (no structs) must merge
+        through the chunked decode — including as single-blob chunks
+        where a whole chunk is delete-set-only."""
+        blobs = mixed_blobs(R=8, K=12, seed=4)
+        ds1, ds2 = DeleteSet(), DeleteSet()
+        ds1.add(1, 2, 3)
+        ds2.add(3, 0, 2)
+        ds2.add(5, 1, 4)
+        blobs = (
+            blobs[:3]
+            + [v1.encode_update([], ds1)]
+            + blobs[3:]
+            + [v1.encode_update([], ds2)]
+        )
+        for chunk in (1, 2, len(blobs)):
+            _assert_identical(blobs, chunk, 2)
+
+    def test_right_origin_mid_inserts(self):
+        """Attachment groups (rights) route through the exact host
+        machinery in both paths; results stay byte-identical."""
+        blobs = text_blobs()
+        for chunk in (1, 5, len(blobs)):
+            _assert_identical(blobs, chunk, 2)
+
+    def test_nested_collections_stay_co_located(self):
+        blobs = nested_blobs()
+        for chunk in (1, 2):
+            _assert_identical(blobs, chunk, 3)
+
+    def test_cross_chunk_origin_chains_resolve(self):
+        """Rows whose implicit parents resolve through ANOTHER chunk's
+        rows: the merged decode must equal the one-shot decode
+        column-for-column (the r6 cross-chunk resolution pass)."""
+        blobs = mixed_blobs(R=10, K=14, seed=7)
+        one = rp.decode(blobs)
+        chunks = [blobs[i:i + 1] for i in range(len(blobs))]
+        decs = [native.decode_updates_columns_any(c) for c in chunks]
+        merged = native.dedup_columns(native.merge_decoded(decs))
+        for k in native._COLUMN_KEYS:
+            np.testing.assert_array_equal(merged[k], one[k], err_msg=k)
+        assert merged["roots"] == one["roots"]
+        assert merged["keys"] == one["keys"]
+        assert merged["contents"] == one["contents"]
+        np.testing.assert_array_equal(
+            np.asarray(merged["ds"]), np.asarray(one["ds"])
+        )
+
+    def test_phase_accounting_shape(self):
+        """Every pipeline lane must report busy time: a phase silently
+        re-serializing (or dropping out of the accounting) fails here
+        without a scale run."""
+        blobs = mixed_blobs(R=10, K=16, seed=9)
+        ph = _assert_identical(blobs, 3, 3)
+        for key in ("decode", "merge", "columns", "partition", "pack",
+                    "converge", "gather", "materialize", "compact",
+                    "busy_sum_s", "wall_s", "wall_vs_phases",
+                    "overlap_efficiency"):
+            assert key in ph, key
+        assert ph["busy_sum_s"] > 0
+        assert 0.0 <= ph["overlap_efficiency"] <= 1.0
+
+    def test_redelivered_blobs_dedup(self):
+        """Duplicate blob delivery (at-least-once transport) across
+        DIFFERENT chunks must dedup exactly like the one-shot path."""
+        blobs = mixed_blobs(R=6, K=10, seed=11)
+        dup = blobs + blobs[:3]
+        for chunk in (1, 4):
+            _assert_identical(dup, chunk, 2)
+
+    def test_crafted_map_rights_repair_per_chunk(self):
+        """Hostile rights on MAP rows (the chain-tail repair path):
+        the per-chunk repair with its shared union-id set must match
+        the one-shot path's whole-union repair."""
+        blobs = mixed_blobs(R=6, K=10, seed=21)
+        recs = [
+            ItemRecord(client=101, clock=0, parent_root="m0", key="kx",
+                       content="A"),
+            # right = A stops the scan at the head: B lands BEFORE A
+            ItemRecord(client=102, clock=0, parent_root="m0", key="kx",
+                       right=(101, 0), content="B"),
+        ]
+        blobs = blobs + [v1.encode_update(recs, DeleteSet())]
+        for chunk in (1, 4):
+            _assert_identical(blobs, chunk, 3)
+
+    def test_empty_and_deletes_only_streams(self):
+        """A cold start ([] blobs) and a stream of ONLY delete-set
+        blobs must return the same empty-union results as the other
+        routes instead of crashing the partitioner."""
+        one = replay_trace([], route="device")
+        st = stream_replay([], phases={})
+        assert st.cache == one.cache == {}
+        assert st.snapshot == one.snapshot
+        ds = DeleteSet()
+        ds.add(2, 0, 5)
+        only = [v1.encode_update([], ds)] * 2
+        one = replay_trace(only, route="device")
+        st = stream_replay(only, chunk_blobs=1, min_shard_rows=1)
+        assert st.cache == one.cache
+        assert st.snapshot == one.snapshot
+
+    def test_route_stream_through_replay_trace(self):
+        blobs = mixed_blobs(R=6, K=10, seed=12)
+        one = replay_trace(blobs, route="device")
+        st = replay_trace(blobs, route="stream")
+        assert st.cache == one.cache
+        assert st.snapshot == one.snapshot
+        assert st.path == "stream"
+
+
+class TestPartition:
+    def test_whole_segments_and_roots_per_shard(self):
+        """No segment — and no root subtree — may split across
+        shards (the executor's exactness precondition)."""
+        blobs = mixed_blobs(R=10, K=16, seed=13)
+        dec = rp.decode(blobs)
+        cols, _ = rp.stage(dec)
+        shard_rows, seg, _ = sm.partition_shards(cols, 3)
+        n = len(cols["client"])
+        owner = np.full(n, -1)
+        for g, rows in enumerate(shard_rows):
+            assert (owner[rows] == -1).all()
+            owner[rows] = g
+        assert (owner >= 0).all()
+        # each segment wholly in one shard
+        for s in np.unique(seg):
+            assert len(np.unique(owner[seg == s])) == 1

@@ -18,6 +18,8 @@ import numpy as np
 sys.path.insert(0, ".")
 
 import jax
+
+from crdt_tpu.compat import enable_x64
 import jax.numpy as jnp
 
 jax.config.update("jax_enable_x64", True)
@@ -196,7 +198,7 @@ def main():
     # force sync mode (lazy-exec trap)
     np.asarray(jnp.arange(8) + 1)
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         dev = jnp.asarray(plan.mat)
         jax.block_until_ready(dev)
         kw = dict(num_segments=plan.num_segments, seq_bucket=plan.seq_bucket)
