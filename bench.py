@@ -60,6 +60,77 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+BENCH_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "BENCH_OUT.json")
+
+
+def emit_result(out: dict, *, path: str = BENCH_OUT,
+                summary_keys=None) -> None:
+    """Durable bench evidence (VERDICT r5 Next #1): the FULL result
+    object is written to BENCH_OUT.json at the end of every full run,
+    and stdout gets one line guaranteed to fit the driver's 2000-byte
+    tail window (<=1500 bytes), so the tail always parses. When the
+    full object already fits, it IS the stdout line; otherwise a
+    scalar summary (headline metrics + per-section digests, pointing
+    at the artifact for the rest) goes out instead.
+
+    ``path=None`` skips the artifact — the smoke mode uses it so a
+    tier-1 test run can never overwrite a real run's committed
+    evidence with toy numbers."""
+    if path is not None:
+        try:
+            with open(path, "w") as f:
+                json.dump(out, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError as exc:  # read-only checkout: stdout still works
+            log(f"{path} not written: {exc}")
+    line = json.dumps(out)
+    if len(line) <= 1500:
+        print(line)
+        return
+    summary = {"full_results": "BENCH_OUT.json"}
+    keys = summary_keys or (
+        "metric", "value", "unit", "vs_baseline", "vs_python_oracle",
+        "kernel_dispatch_ops_per_s", "platform", "dispatch_floor_ms",
+    )
+    for k in keys:
+        if k in out:
+            summary[k] = out[k]
+    # per-section one-number digests, added while they fit
+    digests = []
+    scale = out.get("scale_run") or {}
+    if "vs_baseline" in scale:
+        digests.append(("scale_vs_baseline", scale["vs_baseline"]))
+    if "stream_vs_oneshot" in scale:
+        digests.append(("stream_vs_oneshot", scale["stream_vs_oneshot"]))
+    rounds = scale.get("rounds") or {}
+    if "vs_cold_replay" in rounds:
+        digests.append(("rounds_vs_cold_replay", rounds["vs_cold_replay"]))
+    fleet = out.get("fleet_run") or {}
+    if "fleet_vs_swarm_equiv" in fleet:
+        eq = dict(fleet["fleet_vs_swarm_equiv"])
+        digests.append(("fleet_vs_swarm_equiv_est",
+                        eq.get("replicated")))
+    for sec in ("conflict_run", "text_run", "swarm_run"):
+        if out.get(sec):
+            digests.append((f"{sec}_ok", "error" not in out[sec]))
+    for k, v in digests:
+        trial = dict(summary)
+        trial[k] = v
+        if len(json.dumps(trial)) > 1500:
+            break
+        summary[k] = v
+    line = json.dumps(summary)
+    if len(line) > 1500:  # hard guarantee, whatever the values held
+        line = json.dumps({
+            "metric": out.get("metric"),
+            "value": out.get("value"),
+            "unit": out.get("unit"),
+            "full_results": "BENCH_OUT.json",
+        })
+    print(line)
+
+
 # ---------------------------------------------------------------------------
 # trace generation (not timed: this manufactures the wire input)
 # ---------------------------------------------------------------------------
@@ -708,7 +779,7 @@ def smoke():
         "phases_numpy_s": p_n,
         "ok": True,
     }
-    print(json.dumps(out))
+    emit_result(out, path=None)  # smoke never overwrites run evidence
 
 
 def main():
@@ -1284,6 +1355,17 @@ def main():
                 "segmented_merge_only": round(
                     t_swarm / r64["segmented_round_s"], 1
                 ),
+                # VERDICT r5 Next #6: this is an EXTRAPOLATION, not a
+                # measured swarm — one engine applyUpdate pass over
+                # the round, times R, on the model that the
+                # reference's full-mesh swarm repeats the same merge
+                # at every peer. No R-peer swarm was actually run.
+                "estimated": True,
+                "formula": (
+                    f"swarm_equiv_total_merge_s = R({R_d}) x "
+                    "engine_one_peer_apply_s (one measured apply, "
+                    "extrapolated); ratio = that / fleet round_s"
+                ),
             }
             ratios = fleet_result["fleet_vs_swarm_equiv"]
             log(f"fleet differential: exact; engine one-peer apply "
@@ -1636,7 +1718,7 @@ def main():
         out["fleet_run"] = fleet_result
     if scale_result:
         out["scale_run"] = scale_result
-    print(json.dumps(out))
+    emit_result(out)
 
 
 if __name__ == "__main__":

@@ -1,13 +1,33 @@
 from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
 from crdt_tpu.net.replica import MemoryPersistence, Replica, ypear_crdt
 from crdt_tpu.net.udp_router import UdpRouter, pump
+from crdt_tpu.net.faults import (
+    ConeNat,
+    FaultSchedule,
+    FaultyEndpoint,
+    NatFabric,
+    Partition,
+    SymmetricNat,
+    install_faults,
+    install_nat,
+    pump_until,
+)
 
 __all__ = [
+    "ConeNat",
+    "FaultSchedule",
+    "FaultyEndpoint",
     "LoopbackNetwork",
     "LoopbackRouter",
     "MemoryPersistence",
+    "NatFabric",
+    "Partition",
     "Replica",
+    "SymmetricNat",
     "UdpRouter",
+    "install_faults",
+    "install_nat",
     "pump",
+    "pump_until",
     "ypear_crdt",
 ]

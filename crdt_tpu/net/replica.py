@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional
 from crdt_tpu.api.doc import Crdt
 from crdt_tpu.codec import v1
 from crdt_tpu.core.ids import StateVector
+from crdt_tpu.utils.backoff import jitter
 from crdt_tpu.utils.trace import get_tracer
 
 
@@ -117,6 +118,11 @@ class Replica:
         batch_incoming: Optional[bool] = None,
         merge_mode: Optional[str] = None,
         device_min_rows: Optional[int] = None,
+        probe_retry_s: float = 0.5,
+        probe_retry_max_s: float = 8.0,
+        probe_max_retries: int = 10,
+        anti_entropy_s: Optional[float] = None,
+        anti_entropy_max_s: Optional[float] = None,
     ):
         if not getattr(router, "is_ypear_router", False):
             raise TypeError("router is not a ypear router")  # crdt.js:172
@@ -128,6 +134,31 @@ class Replica:
         self.synced = False
         self.closed = False
         self.peer_state_vectors: Dict[str, StateVector] = {}
+
+        # partition tolerance: ready probes were historically fired
+        # ONCE and lost probes were only repaired by topology changes.
+        # Now un-synced replicas re-probe on a jittered exponential
+        # backoff (bounded — a dead topic must not broadcast forever;
+        # any topology change re-arms the schedule), and an optional
+        # periodic anti-entropy cadence re-runs the two-way SV
+        # exchange so updates lost AFTER sync (where the optimistic
+        # SV advancement lies about delivery) are repaired too.
+        self.probe_retry_s = probe_retry_s
+        self.probe_retry_max_s = probe_retry_max_s
+        self.probe_max_retries = probe_max_retries
+        self.anti_entropy_s = anti_entropy_s
+        self.anti_entropy_max_s = (
+            anti_entropy_max_s
+            if anti_entropy_max_s is not None
+            else (anti_entropy_s or 0.0) * 16
+        )
+        self._probe_interval = probe_retry_s
+        self._probe_retries = 0
+        self._next_probe_at: Optional[float] = None
+        self._ae_interval = anti_entropy_s or 0.0
+        self._next_ae_at: Optional[float] = (
+            time.monotonic() + anti_entropy_s if anti_entropy_s else None
+        )
 
         # merge_mode selects the document backend:
         #   "scalar"   — Engine-backed, host integrate loop
@@ -233,6 +264,11 @@ class Replica:
                     # routers call this after each poll/delivery round
                     # so buffered inbound updates land as one merge
                     "flush": self.flush_incoming,
+                    # ... and this afterwards: the replica's timer
+                    # pump (probe retry/backoff, periodic
+                    # anti-entropy) — a lost sync message is now a
+                    # delay, not a permanent divergence
+                    "tick": self.tick,
                     # async-transport hook (e.g. the UDP router): a
                     # peer subscribing to our topic AFTER construction
                     # triggers a directed anti-entropy probe even when
@@ -269,7 +305,12 @@ class Replica:
     def probe(self, public_key: Optional[str] = None) -> None:
         """Unconditional ready probe (unlike :meth:`sync`, which is a
         no-op once synced): ask one peer — or everyone — for whatever
-        we lack. The two-way handshake then reconciles both sides."""
+        we lack. The two-way handshake then reconciles both sides.
+
+        A topology-triggered probe (``public_key`` set: someone
+        joined) re-arms the retry schedule from its base interval —
+        new peers are new chances to sync, whatever the retry budget
+        said before."""
         if self.closed:
             return
         self.flush_incoming()  # advertise the SV incl. buffered updates
@@ -279,12 +320,82 @@ class Replica:
             "state_vector": self.doc.encode_state_vector(),
         }
         if public_key is not None:
+            self._probe_retries = 0
+            self._probe_interval = self.probe_retry_s
+            if not self.synced:
+                # re-arm from the BASE interval even when a (backed-
+                # off) deadline is already pending: the new peer is a
+                # fresh chance to sync and must be retried promptly
+                self._next_probe_at = (
+                    time.monotonic() + self._probe_interval * jitter()
+                )
             self._to_peer(public_key, msg)
         else:
             self._broadcast(msg)
+        if not self.synced and self._next_probe_at is None:
+            self._next_probe_at = (
+                time.monotonic() + self._probe_interval * jitter()
+            )
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Timer pump, called by routers once per poll/delivery round:
+        retries un-synced ready probes (jittered exponential backoff,
+        bounded by ``probe_max_retries``) and runs the periodic
+        anti-entropy cadence when ``anti_entropy_s`` is set (interval
+        backs off while rounds stay idle, resets on any activity)."""
+        if self.closed:
+            return
+        if now is None:
+            now = time.monotonic()
+        if (
+            not self.synced
+            and self._next_probe_at is not None
+            and now >= self._next_probe_at
+        ):
+            if self._probe_retries >= self.probe_max_retries:
+                self._next_probe_at = None  # bounded; re-armed on join
+            else:
+                self._probe_retries += 1
+                get_tracer().count("replica.probe_retries")
+                self._probe_interval = min(
+                    self._probe_interval * 2, self.probe_retry_max_s
+                )
+                self._next_probe_at = (
+                    now + self._probe_interval * jitter()
+                )
+                self.probe()
+        if self._next_ae_at is not None and now >= self._next_ae_at:
+            get_tracer().count("replica.anti_entropy_rounds")
+            sent = self.anti_entropy()
+            # the SV-records-driven delta above repairs known
+            # deficits; the periodic probe below re-exchanges REAL
+            # state vectors, repairing deficits the optimistic
+            # advancement mis-recorded (a dropped broadcast)
+            self.probe()
+            if sent:
+                self._ae_interval = self.anti_entropy_s
+            else:
+                self._ae_interval = min(
+                    self._ae_interval * 2, self.anti_entropy_max_s
+                )
+            self._next_ae_at = now + self._ae_interval * jitter()
+
+    def _reset_ae_backoff(self) -> None:
+        if self.anti_entropy_s is not None:
+            was = self._ae_interval
+            self._ae_interval = self.anti_entropy_s
+            if was != self._ae_interval and self._next_ae_at is not None:
+                self._next_ae_at = min(
+                    self._next_ae_at,
+                    time.monotonic() + self._ae_interval * jitter(),
+                )
 
     def _set_synced(self, value: bool) -> None:
         self.synced = value
+        if value:
+            self._next_probe_at = None
+            self._probe_retries = 0
+            self._probe_interval = self.probe_retry_s
         self.router.options["cache"].setdefault(self.topic, {})["synced"] = value
 
     def _update_own_sv(self) -> bytes:
@@ -349,6 +460,7 @@ class Replica:
         if not self.closed:
             self._propagate({"update": update, **meta})
             self._advance_topic_peer_svs()
+            self._reset_ae_backoff()  # fresh writes: stay chatty
 
     def _advance_topic_peer_svs(self) -> None:
         """Optimistically advance recorded SVs of peers CURRENTLY on
@@ -482,6 +594,8 @@ class Replica:
             for item in items:
                 self._apply_incoming([item])
             return
+        if updates:
+            self._reset_ae_backoff()  # remote activity: stay chatty
         for u in updates:
             tracer.count("replica.updates_applied")
             tracer.count("replica.bytes_received", len(u))

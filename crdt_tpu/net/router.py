@@ -94,14 +94,20 @@ class LoopbackNetwork:
                     handler(msg, frm)
                     self.delivered += 1
             # end of delivery round: replicas buffering inbound updates
-            # (batch_incoming) merge the round's worth in one txn
+            # (batch_incoming) merge the round's worth in one txn,
+            # then get their timer tick (probe retry / anti-entropy —
+            # mostly a no-op on this reliable fabric, but the contract
+            # matches the UDP router so protocol tests can drive the
+            # retry machinery through either transport)
             for topic, subs in list(self.topics.items()):
                 for r, _ in subs:
-                    flush = r.options.get("cache", {}).get(topic, {}).get(
-                        "flush"
-                    )
+                    contract = r.options.get("cache", {}).get(topic, {})
+                    flush = contract.get("flush")
                     if flush is not None:
                         flush()
+                    tick = contract.get("tick")
+                    if tick is not None:
+                        tick()
         if self.queue:
             raise RuntimeError(f"network did not quiesce in {max_rounds} rounds")
         return self.delivered - n0

@@ -99,6 +99,8 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, _u8p,
             ctypes.c_uint32,
         ]
+        lib.udp_send_unreliable.restype = ctypes.c_long
+        lib.udp_send_unreliable.argtypes = lib.udp_send.argtypes
         lib.udp_poll.restype = ctypes.c_int
         lib.udp_poll.argtypes = [ctypes.c_void_p]
         lib.udp_recv.restype = ctypes.c_int
@@ -276,6 +278,18 @@ class UdpEndpoint:
         )
         if mid < 0:
             raise OSError(f"udp_send to {ip}:{port} failed")
+        return int(mid)
+
+    def send_unreliable(self, ip: str, port: int, data: bytes) -> int:
+        """Fire-and-forget send: framed like :meth:`send` (receivers
+        reassemble/dedup identically) but never retransmitted, never
+        counted in ``pending``/``failed``. The NAT-traversal probe
+        path — callers that need delivery retry at their own layer."""
+        mid = self._lib.udp_send_unreliable(
+            self._handle, ip.encode(), port, _as_u8p(data), len(data)
+        )
+        if mid < 0:
+            raise OSError(f"udp_send_unreliable to {ip}:{port} failed")
         return int(mid)
 
     def poll(self) -> int:

@@ -39,12 +39,32 @@ holepuncher runs, minus its relay fallback:
   first packet loses the race against the other NAT's mapping
   creation is retransmitted straight through once it exists.
 
-Full-cone and (address-)restricted-cone NATs traverse; symmetric
-NATs (per-destination port mappings) need port prediction or a relay
-and are OUT of scope — the documented delta against Hyperswarm,
-whose DHT-assisted relaying covers that tail. The mechanism
-properties are pinned by tests/test_transport.py
-(TestIntroductionPunch).
+Full-cone and (address-)restricted-cone NATs traverse by the intro
+mechanics alone. Symmetric NATs (per-destination port mappings) get
+the two remaining Hyperswarm capabilities:
+
+- **port prediction**: an introduced dial that does not complete is
+  retried on a jittered exponential backoff, and after a few rounds
+  the retry sprays unreliable hellos at the advertised port ±
+  ``predict_window`` — sequential-allocation symmetric NATs put the
+  mapping toward us within a few ports of the mapping the rendezvous
+  observed, so a predicted probe (or the peer's probe toward our
+  predicted port) lands and the ordinary handshake completes. Probes
+  ride :meth:`UdpEndpoint.send_unreliable` (no retransmit state, no
+  ``failed`` accounting — most probes are EXPECTED to die).
+- **peer relay**: past ``relay_after_s`` the dialer falls back to
+  forwarding end-to-end encrypted frames through a mutually reachable
+  peer (the introducer first, then other proven peers — deterministic
+  election order, rotated on NAK or death). Relays enforce per-source
+  byte budgets (token bucket); a saturated or dead relay answers with
+  a NAK / goes silent and the sender re-elects or sheds to the sync
+  protocol's own retry/anti-entropy cadence. A direct path proven
+  LATER (a predicted probe finally landing) upgrades the peer in
+  place and the relay leg is dropped.
+
+The mechanism properties are pinned by tests/test_transport.py
+(TestIntroductionPunch, TestSymmetricNatTraversal, TestRelayFallback)
+over the simulated-NAT loopback fabric in :mod:`crdt_tpu.net.faults`.
 
 Wire protocol (each transport message, after reassembly):
   kind 0x00  plaintext hello       {pk: hex, ack: bool}
@@ -66,6 +86,8 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from crdt_tpu.codec.lib0 import Decoder, Encoder
 from crdt_tpu.net.transport import SecureBox, UdpEndpoint, keypair
+from crdt_tpu.utils.backoff import jitter
+from crdt_tpu.utils.trace import get_tracer
 
 _HELLO = 0
 _ENVELOPE = 1
@@ -129,10 +151,11 @@ def _unpack_any(data: bytes) -> Any:
 
 class _Peer:
     __slots__ = ("pk_hex", "addr", "topics", "topics_v", "inst", "box",
-                 "last_seen", "announce_ttl")
+                 "last_seen", "announce_ttl", "direct", "relay",
+                 "relay_idx", "relay_paused_until", "introducer")
 
     def __init__(self, pk_hex: str, addr: Tuple[str, int], inst: str,
-                 box: SecureBox):
+                 box: SecureBox, *, direct: bool = True):
         self.pk_hex = pk_hex
         self.addr = addr
         self.topics: Set[str] = set()
@@ -141,6 +164,15 @@ class _Peer:
         self.box = box
         self.last_seen = time.monotonic()  # last AUTHENTICATED traffic
         self.announce_ttl = 0.0  # the peer's own wire-declared TTL
+        # `direct`: addr is a real datagram source / a proven rebind —
+        # usable for direct sends. False for peers seeded from an intro
+        # hint or met through a relay: their addr is at best a guess,
+        # and traffic routes via `relay` until a probe proves a path
+        self.direct = direct
+        self.relay: Optional[str] = None  # forwarding peer's pk
+        self.relay_idx = 0  # election cursor (rotated on NAK/death)
+        self.relay_paused_until = 0.0  # budget-shed cooldown
+        self.introducer: Optional[str] = None  # who told us about them
 
     def new_incarnation(self, inst: str) -> None:
         """A restarted process announces from version 1 again; carrying
@@ -149,6 +181,30 @@ class _Peer:
         self.inst = inst
         self.topics_v = -1
         self.topics = set()
+
+
+class _Dial:
+    """One in-progress introduction dial: retried on a jittered
+    exponential backoff, escalating through port prediction to the
+    relay fallback, until the peer proves a direct path or the dial
+    expires (bounded — a gone-forever peer must not probe forever)."""
+
+    __slots__ = ("pk_hex", "addr", "introducer", "created", "attempts",
+                 "interval", "next_due", "give_up_at", "relay_on")
+
+    def __init__(self, pk_hex: str, addr: Tuple[str, int],
+                 introducer: Optional[str], *, base_s: float,
+                 give_up_s: float):
+        self.pk_hex = pk_hex
+        self.addr = addr
+        self.introducer = introducer
+        now = time.monotonic()
+        self.created = now
+        self.attempts = 0
+        self.interval = base_s
+        self.next_due = now + base_s
+        self.give_up_at = now + give_up_s
+        self.relay_on = False
 
 
 class UdpRouter:
@@ -166,6 +222,17 @@ class UdpRouter:
         rendezvous: bool = False,
         bootstrap: Optional[List[Tuple[str, int]]] = None,
         announce_ttl: float = 60.0,
+        dial_retry_s: float = 0.5,
+        dial_retry_max_s: float = 8.0,
+        dial_give_up_s: float = 60.0,
+        port_prediction: bool = True,
+        predict_after: int = 2,
+        predict_window: int = 8,
+        relay_after_s: float = 3.0,
+        relay_stale_s: float = 30.0,
+        relay_budget_bytes: int = 256 * 1024,
+        relay_refill_bps: int = 64 * 1024,
+        relay_shed_pause_s: float = 1.0,
     ):
         self.endpoint = UdpEndpoint(bind_ip, port)
         pub, sec = keypair(seed)
@@ -227,6 +294,23 @@ class UdpRouter:
         }
         self._announce_ttl = announce_ttl
         self._last_announce = 0.0
+        # NAT traversal / partition tolerance (module docstring):
+        # dial retry schedule, port prediction, relay fallback
+        self._dial_retry_s = dial_retry_s
+        self._dial_retry_max_s = dial_retry_max_s
+        self._dial_give_up_s = dial_give_up_s
+        self._port_prediction = port_prediction
+        self._predict_after = predict_after
+        self._predict_window = predict_window
+        self._relay_after_s = relay_after_s
+        self._relay_stale_s = relay_stale_s
+        self._relay_budget_bytes = relay_budget_bytes
+        self._relay_refill_bps = relay_refill_bps
+        self._relay_shed_pause_s = relay_shed_pause_s
+        self._dials: Dict[str, _Dial] = {}  # pk_hex -> in-progress dial
+        # token buckets for frames WE forward, keyed by source pk
+        self._relay_budget: Dict[str, Tuple[float, float]] = {}
+        self._last_ping: Dict[str, float] = {}  # keepalive rate limit
         # discovery diagnostics: a wedged swarm (intros never applied,
         # claimants never proving) must be visible, not silent
         self.stats: Dict[str, int] = {
@@ -234,6 +318,17 @@ class UdpRouter:
             "intros_buffered": 0,
             "intros_dropped": 0,
             "intros_refused": 0,
+            "dial_retries": 0,
+            "dials_expired": 0,
+            "predict_probes": 0,
+            "relay_sends": 0,
+            "relay_frames_forwarded": 0,
+            "relay_bytes_forwarded": 0,
+            "relay_naks": 0,
+            "relay_sheds": 0,
+            "relay_elections": 0,
+            "relay_upgrades": 0,
+            "relay_unroutable": 0,
         }
         # introducer trust is granted ONLY by proven key possession at
         # a configured bootstrap address (nonce challenge/pong, the
@@ -294,10 +389,19 @@ class UdpRouter:
         the reply completes the key exchange."""
         self._send_hello(ip, port, ack=False)
 
-    def _send_hello(self, ip: str, port: int, *, ack: bool) -> None:
+    def _send_hello(self, ip: str, port: int, *, ack: bool,
+                    unreliable: bool = False) -> None:
         payload = bytes([_HELLO]) + _pack_any(
             {"pk": self.public_key, "ack": ack, "inst": self._inst}
         )
+        if unreliable:
+            # dial retries and prediction probes: most are EXPECTED to
+            # die at a closed NAT mapping — no retransmit state, no
+            # `failed` accounting; the dial schedule is the retry layer
+            send = getattr(self.endpoint, "send_unreliable", None)
+            if send is not None:
+                send(ip, port, payload)
+                return
         self.endpoint.send(ip, port, payload)
 
     # -- peer/topic views ------------------------------------------------
@@ -346,8 +450,101 @@ class UdpRouter:
     ) -> None:
         me = bytes.fromhex(self.public_key)
         body = peer.box.encrypt(_pack_any(payload), aad=me)
+        if addr is None and not peer.direct:
+            # no proven direct path: forward the sealed frame through
+            # the elected relay (addr=None in _rebind_nonce marks the
+            # relay-routed challenges this peer may owe us)
+            self._send_via_relay(peer, me + body)
+            return
         ip, port = addr if addr is not None else peer.addr
         self.endpoint.send(ip, port, bytes([_ENVELOPE]) + me + body)
+
+    # -- peer relay (module docstring: the Hyperswarm relay reduction) ---
+    def _relay_candidates(self, peer: _Peer) -> List[str]:
+        """Deterministic election order: the introducer (connected to
+        both sides at introduction time by construction), then proven
+        rendezvous peers, then every other direct peer."""
+        order: List[str] = []
+        seen: Set[str] = set()
+        cands: List[str] = []
+        if peer.introducer:
+            cands.append(peer.introducer)
+        cands += sorted(self._rendezvous_pks)
+        cands += sorted(self._peers)
+        for pk in cands:
+            if pk in seen or pk == peer.pk_hex or pk == self.public_key:
+                continue
+            seen.add(pk)
+            p = self._peers.get(pk)
+            if p is not None and p.direct:
+                order.append(pk)
+        return order
+
+    def _relay_for(self, peer: _Peer) -> Optional[_Peer]:
+        """Resolve (electing / re-electing as needed) the relay to
+        route `peer`'s traffic through. A silent candidate is pinged
+        (rate-limited) and skipped while any fresh one exists — a dead
+        relay therefore triggers re-election, never a wedge."""
+        order = self._relay_candidates(peer)
+        if not order:
+            return None
+        now = time.monotonic()
+        fresh = []
+        for pk in order:
+            p = self._peers[pk]
+            if now - p.last_seen <= self._relay_stale_s:
+                fresh.append(pk)
+            else:
+                # nudge: an alive-but-quiet relay pongs, refreshes
+                # last_seen, and rejoins the fresh pool
+                last = self._last_ping.get(pk, 0.0)
+                if now - last > max(self._relay_stale_s / 4, 0.05):
+                    self._last_ping[pk] = now
+                    self._challenge_liveness(p, p.addr)
+        pool = fresh or order
+        pk = pool[peer.relay_idx % len(pool)]
+        if pk != peer.relay:
+            peer.relay = pk
+            self.stats["relay_elections"] += 1
+            get_tracer().count("router.relay_elections")
+        return self._peers[pk]
+
+    def _send_via_relay(self, peer: _Peer, frame: bytes) -> None:
+        now = time.monotonic()
+        if peer.relay_paused_until > now:
+            # relay shed our traffic (budget): drop — the sync layer's
+            # retry/anti-entropy cadence recovers the payload later
+            self.stats["relay_sheds"] += 1
+            get_tracer().count("router.relay_sheds")
+            return
+        relay = self._relay_for(peer)
+        if relay is None:
+            self.stats["relay_unroutable"] += 1
+            return
+        self.stats["relay_sends"] += 1
+        tracer = get_tracer()
+        tracer.count("router.relay_sends")
+        tracer.count("router.relay_send_bytes", len(frame))
+        self._send_envelope(
+            relay, {"t": "relay", "dst": peer.pk_hex, "f": frame}
+        )
+
+    def _relay_allow(self, src_pk: str, nbytes: int) -> bool:
+        """Token bucket per forwarded-for source: a chatty pair cannot
+        monopolize this node's forwarding capacity."""
+        now = time.monotonic()
+        tokens, last = self._relay_budget.get(
+            src_pk, (float(self._relay_budget_bytes), now)
+        )
+        tokens = min(
+            float(self._relay_budget_bytes),
+            tokens + (now - last) * self._relay_refill_bps,
+        )
+        if nbytes > tokens:
+            self._relay_budget[src_pk] = (tokens, now)
+            return False
+        self._relay_budget[src_pk] = (tokens - nbytes, now)
+        return True
 
     def _announce_topics(
         self,
@@ -376,7 +573,8 @@ class UdpRouter:
             self._last_announce = time.monotonic()
 
     def _register_peer(
-        self, pk_hex: str, addr: Tuple[str, int], inst: str
+        self, pk_hex: str, addr: Tuple[str, int], inst: str,
+        *, direct: bool = True,
     ) -> Optional[_Peer]:
         """Create a peer entry for a previously unknown identity.
         Returns None for keys no secure channel can be built with."""
@@ -384,12 +582,12 @@ class UdpRouter:
             box = SecureBox(self._secret, bytes.fromhex(pk_hex))
         except ValueError:
             return None  # low-order key
-        p = _Peer(pk_hex, addr, inst, box)
+        p = _Peer(pk_hex, addr, inst, box, direct=direct)
         self._peers[pk_hex] = p
         return p
 
     def _challenge_liveness(
-        self, peer: _Peer, addr: Tuple[str, int]
+        self, peer: _Peer, addr: Optional[Tuple[str, int]]
     ) -> None:
         """A hello is unauthenticated: before rerouting a KNOWN peer's
         traffic to a new address, or believing its incarnation
@@ -397,7 +595,12 @@ class UdpRouter:
         real key holder can echo the nonce back, and only from the
         challenged address (the pong's source is checked, so a copied
         pong from elsewhere proves nothing). The pong also reports the
-        responder's live inst."""
+        responder's live inst.
+
+        ``addr=None`` challenges over the RELAY path instead: there is
+        no address claim to verify, but the fresh nonce still proves
+        the far end holds the key NOW (relayed frames are end-to-end
+        sealed), which is what inst adoption needs."""
         import os as _os
 
         nonce = _os.urandom(16).hex()
@@ -423,6 +626,7 @@ class UdpRouter:
                 p for pk, p in self._peers.items()
                 if pk in self._rendezvous_pks
             ])
+        self._service_dials()
         self.endpoint.poll()
         handled = 0
         for src_ip, src_port, data in self.endpoint.recv_all():
@@ -436,12 +640,79 @@ class UdpRouter:
                 if self._on_envelope(body, (src_ip, src_port)):
                     handled += 1
         # end of poll round: replicas buffering inbound updates
-        # (batch_incoming) merge this round's worth in one txn
+        # (batch_incoming) merge this round's worth in one txn, then
+        # get a timer tick (probe retry/backoff, periodic anti-entropy)
         for contract in list(self.options["cache"].values()):
             flush = contract.get("flush")
             if flush is not None:
                 flush()
+        for contract in list(self.options["cache"].values()):
+            tick = contract.get("tick")
+            if tick is not None:
+                tick()
         return handled
+
+    def _service_dials(self) -> None:
+        """Drive every in-progress introduction dial: retry hellos on
+        a jittered exponential backoff, escalate to port-prediction
+        probes, fall back to a relay, expire bounded."""
+        if not self._dials:
+            return
+        now = time.monotonic()
+        tracer = get_tracer()
+        for pk, d in list(self._dials.items()):
+            peer = self._peers.get(pk)
+            if peer is not None and peer.direct:
+                del self._dials[pk]  # proven direct path: dial done
+                continue
+            if now >= d.give_up_at:
+                # bounded: stop probing a peer that never answered.
+                # An established relay route (peer entry) stays.
+                del self._dials[pk]
+                self.stats["dials_expired"] += 1
+                continue
+            if now >= d.next_due:
+                d.attempts += 1
+                self.stats["dial_retries"] += 1
+                tracer.count("router.dial_retries")
+                ip, port = d.addr
+                self._send_hello(ip, port, ack=False, unreliable=True)
+                if self._port_prediction and d.attempts >= self._predict_after:
+                    # sequential-allocation NATs put the real mapping
+                    # near the observed one: spray the neighborhood
+                    sent = 0
+                    for delta in range(1, self._predict_window + 1):
+                        for p in (port + delta, port - delta):
+                            if 0 < p < 65536:
+                                self._send_hello(
+                                    ip, p, ack=False, unreliable=True
+                                )
+                                sent += 1
+                    self.stats["predict_probes"] += sent
+                    tracer.count("router.predict_probes", sent)
+                d.interval = min(d.interval * 2, self._dial_retry_max_s)
+                d.next_due = now + d.interval * jitter()
+            if not d.relay_on and now - d.created >= self._relay_after_s:
+                if self._activate_relay(d):
+                    d.relay_on = True
+
+    def _activate_relay(self, d: _Dial) -> bool:
+        """Relay fallback for a dial that direct probing has not
+        completed: register the peer (we hold its pk from the intro)
+        routed via an elected relay and open the handshake by
+        announcing our topics through it."""
+        peer = self._peers.get(d.pk_hex)
+        if peer is None:
+            peer = self._register_peer(d.pk_hex, d.addr, "", direct=False)
+            if peer is None:
+                return True  # unusable key: stop trying
+        if peer.introducer is None:
+            peer.introducer = d.introducer
+        if self._relay_for(peer) is None:
+            return False  # no candidate yet; retry next pass
+        get_tracer().count("router.relay_activations")
+        self._announce_topics(peer)
+        return True
 
     def _on_hello(self, body: bytes, addr: Tuple[str, int]) -> None:
         try:
@@ -466,9 +737,11 @@ class UdpRouter:
         # below could never be decrypted
         if not info.get("ack"):
             self._send_hello(addr[0], addr[1], ack=True)
-        if peer.addr != addr:
-            # identity known but source moved: don't reroute until the
-            # new address proves key possession
+        if peer.addr != addr or not peer.direct:
+            # identity known but source moved — or known only through
+            # a relay / an intro hint (no proven direct path at all):
+            # don't reroute (or upgrade) until this address proves key
+            # possession
             self._challenge_liveness(peer, addr)
             return
         if inst != peer.inst:
@@ -507,21 +780,74 @@ class UdpRouter:
         except ValueError:
             return False  # forged or corrupted
         peer.last_seen = time.monotonic()
+        return self._dispatch(peer, payload, addr, via=None)
+
+    def _on_relayed_frame(self, frame: bytes, via: str) -> bool:
+        """A frame forwarded to us by a relay: `frame` is the same
+        sealed wire body a direct envelope carries (sender pk || box).
+        The relay authenticated nothing about the CONTENT — end-to-end
+        AEAD under the sender's static key does. An unknown sender
+        reached this way is registered route-via-relay (its address is
+        unknown by definition) and greeted with our topic set, which
+        is the relayed half of the hello handshake."""
+        sender_raw, sealed = frame[:32], frame[32:]
+        pk_hex = sender_raw.hex()
+        if pk_hex == self.public_key:
+            return False
+        peer = self._peers.get(pk_hex)
+        announce_back = False
+        if peer is None:
+            peer = self._register_peer(
+                pk_hex, ("0.0.0.0", 0), "", direct=False
+            )
+            if peer is None:
+                return False
+            peer.relay = via
+            peer.introducer = via
+            announce_back = True
+        try:
+            payload = _unpack_any(peer.box.decrypt(sealed, aad=sender_raw))
+        except ValueError:
+            return False
+        peer.last_seen = time.monotonic()
+        if announce_back:
+            self._announce_topics(peer)
+        return self._dispatch(peer, payload, None, via=via)
+
+    def _dispatch(
+        self, peer: _Peer, payload: Any,
+        addr: Optional[Tuple[str, int]], via: Optional[str],
+    ) -> bool:
+        pk_hex = peer.pk_hex
         t = payload.get("t") if isinstance(payload, dict) else None
         if t == "topics":
             if payload.get("inst") != peer.inst:
-                # replayed from a dead incarnation — or our recorded
-                # inst is the stale one (bootstrap raced a restart, or
-                # a spoofed hello poisoned it). Never adopt an inst
-                # from a replayable envelope; challenge instead: the
-                # fresh-nonce pong reports the live inst, after which
-                # the peer's re-announce applies. Self-healing either
-                # way, wedge-proof both ways. Challenged at the
-                # envelope's source (peer.addr may be a dead pre-
-                # restart socket; the pong's source-binding keeps a
-                # spoofed source harmless).
-                self._challenge_liveness(peer, addr)
-                return True
+                if peer.inst == "" and via is not None and isinstance(
+                    payload.get("inst"), str
+                ):
+                    # relay-met peer announcing for the first time: no
+                    # recorded incarnation to protect yet — adopt. (A
+                    # replayed DEAD-incarnation first announce heals
+                    # through the relay-routed nonce challenge the
+                    # genuine announce then triggers below.)
+                    peer.inst = payload["inst"]
+                else:
+                    # replayed from a dead incarnation — or our
+                    # recorded inst is the stale one (bootstrap raced
+                    # a restart, or a spoofed hello poisoned it).
+                    # Never adopt an inst from a replayable envelope;
+                    # challenge instead: the fresh-nonce pong reports
+                    # the live inst, after which the peer's
+                    # re-announce applies. Self-healing either way,
+                    # wedge-proof both ways. Challenged at the
+                    # envelope's source (peer.addr may be a dead pre-
+                    # restart socket; the pong's source-binding keeps
+                    # a spoofed source harmless) — or, for a
+                    # relay-met peer (addr=None), over the relay: no
+                    # address claim to verify, but the nonce still
+                    # proves key possession NOW.
+                    self._challenge_liveness(peer, addr)
+                    return True
             v = payload.get("v", 0)
             if v < peer.topics_v:
                 return True  # stale retransmit must not regress the set
@@ -586,11 +912,67 @@ class UdpRouter:
                     )
                 return True
             self.stats["intros_applied"] += 1
-            self._apply_intro(payload)
+            self._apply_intro(payload, introducer=pk_hex)
+        elif t == "relay" and via is None:
+            # forward a sealed frame between two peers that cannot
+            # reach each other. Accepted on DIRECT links only (no
+            # multi-hop chains, no forwarding loops), forwarded only
+            # to DIRECT peers, and metered per source (token bucket) —
+            # a saturated pair is NAK'd and sheds to its own
+            # anti-entropy cadence rather than starving the relay.
+            dst_pk = payload.get("dst")
+            frame = payload.get("f")
+            if not isinstance(dst_pk, str) or not isinstance(
+                frame, (bytes, bytearray)
+            ) or len(frame) <= 32:
+                return True
+            dstp = self._peers.get(dst_pk)
+            if dstp is None or not dstp.direct:
+                self.stats["relay_naks"] += 1
+                self._send_envelope(
+                    peer, {"t": "relay_nak", "dst": dst_pk, "why": "unknown"}
+                )
+            elif not self._relay_allow(pk_hex, len(frame)):
+                self.stats["relay_sheds"] += 1
+                get_tracer().count("router.relay_sheds")
+                self._send_envelope(
+                    peer, {"t": "relay_nak", "dst": dst_pk, "why": "budget"}
+                )
+            else:
+                self.stats["relay_frames_forwarded"] += 1
+                self.stats["relay_bytes_forwarded"] += len(frame)
+                tracer = get_tracer()
+                tracer.count("router.relay_frames_forwarded")
+                tracer.count("router.relay_bytes_forwarded", len(frame))
+                self._send_envelope(
+                    dstp,
+                    {"t": "relayed", "src": pk_hex, "f": bytes(frame)},
+                )
+        elif t == "relayed" and via is None:
+            frame = payload.get("f")
+            if isinstance(frame, (bytes, bytearray)) and len(frame) > 32:
+                self._on_relayed_frame(bytes(frame), via=pk_hex)
+        elif t == "relay_nak":
+            dst_pk = payload.get("dst")
+            dstp = self._peers.get(dst_pk) if isinstance(dst_pk, str) else None
+            if dstp is not None and dstp.relay == pk_hex:
+                if payload.get("why") == "budget":
+                    # saturation: pause relayed traffic toward this
+                    # peer; the sync layer's retry/anti-entropy picks
+                    # the payload back up after the pause
+                    dstp.relay_paused_until = (
+                        time.monotonic() + self._relay_shed_pause_s
+                    )
+                else:
+                    # this relay cannot see the peer: rotate the
+                    # election cursor; the next send re-elects
+                    dstp.relay_idx += 1
+                    dstp.relay = None
         elif t == "ping":
             # liveness challenge: echo the nonce (proving this address
-            # holds our key, NOW — the nonce is fresh) and report our
-            # current incarnation, the only trusted source for it
+            # — or, relay-routed, this KEY — holds the secret NOW; the
+            # nonce is fresh) and report our current incarnation, the
+            # only trusted source for it
             self._send_envelope(
                 peer,
                 {"t": "pong", "n": payload.get("n"), "inst": self._inst},
@@ -598,52 +980,100 @@ class UdpRouter:
             )
         elif t == "pong":
             pending = self._rebind_nonce.get(pk_hex)
-            if (
-                pending is not None
-                and payload.get("n") == pending[0]
-                and addr == pending[1]  # nonce is bound to the
-                # challenged address: a pong copied and re-sent from
-                # elsewhere must not redirect traffic there
-            ):
+            if pending is None or payload.get("n") != pending[0]:
+                return True
+            if pending[1] is None:
+                # relay-routed challenge: no address was claimed, so
+                # none is proven — adopt only the (fresh-nonce-bound)
+                # incarnation; routing is untouched
                 del self._rebind_nonce[pk_hex]
-                peer.addr = addr  # proven: reroute to the new address
-                if addr in self._bootstrap_canon:
-                    # key possession proven AT a bootstrap address:
-                    # grant introducer trust and replay any intro that
-                    # arrived while the proof was in flight
-                    self._rendezvous_pks.add(pk_hex)
-                    held = self._pending_intros.pop(pk_hex, None)
-                    if held is not None:
-                        self.stats["intros_applied"] += 1
-                        self._apply_intro(held)
                 live_inst = payload.get("inst", peer.inst)
                 if live_inst != peer.inst:
-                    # fresh-nonce-proven incarnation change: reset the
-                    # announcement watermark and prompt the new
-                    # incarnation to (re)announce its topics to us;
-                    # ours go out right below
                     peer.new_incarnation(live_inst)
-                    self._send_hello(addr[0], addr[1], ack=True)
                 self._announce_topics(peer)
+                return True
+            if addr != pending[1]:  # nonce is bound to the challenged
+                # address: a pong copied and re-sent from elsewhere
+                # must not redirect traffic there
+                return True
+            del self._rebind_nonce[pk_hex]
+            peer.addr = addr  # proven: reroute to the new address
+            if not peer.direct or peer.relay is not None:
+                # a direct path just beat the relay route (a predicted
+                # probe landed, or the peer dialed us): upgrade in
+                # place and drop the relay leg
+                if peer.relay is not None:
+                    self.stats["relay_upgrades"] += 1
+                    get_tracer().count("router.relay_upgrades")
+                peer.relay = None
+            peer.direct = True
+            self._dials.pop(pk_hex, None)
+            if addr in self._bootstrap_canon:
+                # key possession proven AT a bootstrap address:
+                # grant introducer trust and replay any intro that
+                # arrived while the proof was in flight
+                self._rendezvous_pks.add(pk_hex)
+                held = self._pending_intros.pop(pk_hex, None)
+                if held is not None:
+                    self.stats["intros_applied"] += 1
+                    self._apply_intro(held, introducer=pk_hex)
+            live_inst = payload.get("inst", peer.inst)
+            if live_inst != peer.inst:
+                # fresh-nonce-proven incarnation change: reset the
+                # announcement watermark and prompt the new
+                # incarnation to (re)announce its topics to us;
+                # ours go out right below
+                peer.new_incarnation(live_inst)
+                self._send_hello(addr[0], addr[1], ack=True)
+            self._announce_topics(peer)
         return True
 
-    def _apply_intro(self, payload: Any) -> None:
+    def _apply_intro(self, payload: Any,
+                     introducer: Optional[str] = None) -> None:
         """Dial every listed peer we do not already know. The address
         is only a hint — the hello/key-exchange (and, for known
         identities, the liveness challenge) authenticates; a malformed
         or bogus entry must never escape this loop (it would kill the
         router's event loop), so every per-entry failure — wrong-typed
-        fields included — just skips the entry."""
+        fields included — just skips the entry. Each dial is tracked
+        in ``_dials`` so an unanswered hello escalates through retry /
+        prediction / relay instead of being fired once and forgotten
+        (the cone-NAT-only gap this closes)."""
         peers_list = payload.get("peers", ())
         if not isinstance(peers_list, (list, tuple)):
             return
+        now = time.monotonic()
         for entry in peers_list:
             try:
                 pk = entry["pk"].lower()
                 ip, port = entry["ip"], int(entry["port"])
                 if not isinstance(ip, str):
                     continue
-                if pk != self.public_key and pk not in self._peers:
+                if pk == self.public_key:
+                    continue
+                peer = self._peers.get(pk)
+                if peer is not None and peer.direct:
+                    continue  # already have a proven path
+                # unknown peer OR one we only reach via relay (or whose
+                # earlier dial expired): a fresh introduction carries a
+                # fresh observed address — (re)open the dial so the
+                # retry/prediction escalation gets its shot at
+                # upgrading the pair to a direct path
+                if len(bytes.fromhex(pk)) != 32:
+                    continue
+                d = self._dials.get(pk)
+                if d is None:
+                    self._dials[pk] = _Dial(
+                        pk, (ip, port), introducer,
+                        base_s=self._dial_retry_s,
+                        give_up_s=self._dial_give_up_s,
+                    )
+                else:
+                    # refresh the hint and extend the window: the
+                    # introducer just vouched the peer is alive
+                    d.addr = (ip, port)
+                    d.give_up_at = now + self._dial_give_up_s
+                if peer is None:
                     self.add_peer(ip, port)
             except (KeyError, TypeError, ValueError,
                     AttributeError, OSError):
@@ -658,10 +1088,13 @@ class UdpRouter:
         announcement introducing against the then-current holder set.
         Holders silent past their own wire-declared announce TTL are
         aged out (they are expected to refresh; see __init__)."""
+        if not newcomer.direct:
+            return  # a relay-met peer has no dialable address to share
         now = time.monotonic()
         holders = {
             pk: p for pk, p in self._peers.items()
             if pk != newcomer.pk_hex
+            and p.direct  # never hand out unproven hint addresses
             and now - p.last_seen <= (p.announce_ttl or self._announce_ttl)
             and p.topics & new_topics
         }

@@ -377,6 +377,69 @@ def segment_out_sizes(blk: int, R: int, N_d: int, S: int):
     )
 
 
+class GossipFaultPlan:
+    """Deterministic fault plan for FLEET gossip rounds — the
+    device-mesh analogue of the router-seam fault fabric
+    (:mod:`crdt_tpu.net.faults`). A replica "dropped" in a round has
+    its contribution withheld from the all-gather (its valid column
+    zeroed — exactly what a lost propagate broadcast looks like to
+    everyone else); a partition splits the replica axis into groups
+    that gossip separately (each group's union excludes the other's
+    ops). Because the converge kernels are merges over op unions, a
+    later heal round over the full columns lands on EXACTLY the
+    fault-free output — CRDT idempotence on device, which
+    tests/test_faults.py pins.
+
+    Decisions hash ``(seed, round, replica)`` — no RNG state, so any
+    round can be replayed in isolation.
+    """
+
+    def __init__(self, seed: int = 0, *, drop: float = 0.0,
+                 partition_every: int = 0, groups: int = 2):
+        self.seed = seed
+        self.drop = drop
+        self.partition_every = partition_every
+        self.groups = groups
+
+    def _h(self, *key) -> float:
+        import zlib
+
+        return zlib.crc32(repr((self.seed,) + key).encode()) / 2**32
+
+    def delivered_mask(self, round_idx: int, n_replicas: int) -> np.ndarray:
+        """[R] bool: False = this replica's batch is lost this round."""
+        return np.array(
+            [self._h("drop", round_idx, r) >= self.drop
+             for r in range(n_replicas)],
+            dtype=bool,
+        )
+
+    def partition_masks(self, round_idx: int,
+                        n_replicas: int) -> Optional[list]:
+        """List of [R] bool group masks when this round is partitioned
+        (round index divisible by ``partition_every``), else None.
+        Group assignment is hashed per (round, replica), so healing
+        and re-partitioning replay deterministically."""
+        if not self.partition_every or round_idx % self.partition_every:
+            return None
+        assign = np.array(
+            [int(self._h("part", round_idx, r) * self.groups)
+             for r in range(n_replicas)]
+        )
+        return [assign == g for g in range(self.groups)]
+
+
+def mask_packed(packed: np.ndarray, keep: np.ndarray) -> np.ndarray:
+    """Withhold replicas' contributions from one packed [9, R, N]
+    gossip input: rows where ``keep`` is False get their valid column
+    (pack index 8) zeroed, so the gathered union treats every one of
+    their ops as padding. The original block is untouched — a heal
+    round re-presents it in full."""
+    out = np.array(packed, copy=True)
+    out[8, ~np.asarray(keep, dtype=bool), :] = 0
+    return out
+
+
 def synth_columns(
     n_replicas: int,
     ops_per_replica: int,

@@ -77,6 +77,18 @@ class Tracer:
         if self.enabled:
             self._gauges[name] = value
 
+    def counters(self, prefix: str = "") -> Dict[str, int]:
+        """Counter snapshot, optionally filtered by name prefix —
+        e.g. ``counters("router.relay")`` for the relay path or
+        ``counters("replica.probe")`` for the retry schedule (the
+        partition-tolerance counters: ``router.dial_retries``,
+        ``router.predict_probes``, ``router.relay_*``,
+        ``replica.probe_retries``, ``replica.anti_entropy_rounds``)."""
+        return {
+            k: v for k, v in sorted(self._counters.items())
+            if k.startswith(prefix)
+        }
+
     # -- reporting -------------------------------------------------------
     def report(self) -> Dict[str, Any]:
         return {

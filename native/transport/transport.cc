@@ -777,6 +777,47 @@ long udp_send(void* h, const char* ip, int port, const uint8_t* buf,
   return (long)id;
 }
 
+// Fire-and-forget variant: the message is framed exactly like
+// udp_send (receivers reassemble / ack / dedup identically) but no
+// retransmit state is kept — no pending entry, no retries, and a lost
+// datagram never counts toward `failed`. This is the dial-probe path:
+// NAT hole punching sprays hellos at addresses that are EXPECTED to
+// blackhole (wrong ports, unopened mappings), and a reliable send to
+// each would burn MAX_RETRIES of traffic and report hard failures for
+// behavior that is routine. Callers retry at their own layer.
+long udp_send_unreliable(void* h, const char* ip, int port,
+                         const uint8_t* buf, uint32_t len) {
+  Endpoint* ep = (Endpoint*)h;
+  Addr to;
+  struct in_addr ia;
+  if (inet_pton(AF_INET, ip, &ia) != 1) return -1;
+  to.ip = ia.s_addr;
+  to.port = (uint16_t)port;
+
+  uint32_t id = ep->next_msg_id++;
+  size_t n_frags = len == 0 ? 1 : (len + FRAG_PAYLOAD - 1) / FRAG_PAYLOAD;
+  if (n_frags > 0xffff) return -1;
+  uint32_t token = 0;
+  ct_randombytes((uint8_t*)&token, sizeof(token));
+  for (size_t i = 0; i < n_frags; i++) {
+    size_t off = i * FRAG_PAYLOAD;
+    size_t n = len - off < FRAG_PAYLOAD ? len - off : FRAG_PAYLOAD;
+    std::string d;
+    d.reserve(HDR + n);
+    d.push_back((char)WIRE_MAGIC);
+    d.push_back((char)T_DATA);
+    uint8_t hdr[12];
+    store32le(hdr, id);
+    hdr[4] = i & 0xff; hdr[5] = (i >> 8) & 0xff;
+    hdr[6] = n_frags & 0xff; hdr[7] = (n_frags >> 8) & 0xff;
+    store32le(hdr + 8, token);
+    d.append((const char*)hdr, 12);
+    d.append((const char*)buf + off, n);
+    raw_send(ep, to, d);
+  }
+  return (long)id;
+}
+
 static void send_ack(Endpoint* ep, const Addr& to, uint32_t msg_id,
                      uint16_t idx, uint32_t token) {
   std::string d;

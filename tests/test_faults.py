@@ -1,0 +1,290 @@
+"""Deterministic fault-injection fabric: the tier-1 chaos smoke.
+
+One seeded schedule (drop + duplicate + delay/reorder + corrupt, plus
+a partition leg) runs the sync protocol across all three merge modes
+— scalar, device, resident — and must converge BYTE-IDENTICALLY to
+the fault-free run: same per-replica snapshot bytes, same state
+vectors. Recovery is driven entirely by the protocol's own machinery
+(ready-probe retry/backoff, periodic anti-entropy), pinned by tracer
+counters — no test-side resend plumbing. Heavier schedules live
+behind ``-m slow``.
+
+The fleet half (parallel/gossip.py hooks) pins the device-mesh
+analogue: a round with withheld/partitioned replica batches, followed
+by a heal round, lands on exactly the fault-free gossip output.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from crdt_tpu.net.faults import (
+    FaultSchedule,
+    FaultyEndpoint,
+    Partition,
+    install_faults,
+    pump_until,
+)
+from crdt_tpu.net.replica import Replica
+from crdt_tpu.net.udp_router import UdpRouter
+from crdt_tpu.utils.trace import Tracer, get_tracer, set_tracer
+
+SEED = 7
+CHAOS = dict(drop=0.12, duplicate=0.1, delay=0.1, delay_polls=(1, 6),
+             corrupt=0.05)
+
+
+# ---------------------------------------------------------------------------
+# schedule determinism (the replayability claim)
+# ---------------------------------------------------------------------------
+
+
+def test_schedule_is_deterministic_per_flow():
+    a = FaultSchedule(SEED, **CHAOS)
+    b = FaultSchedule(SEED, **CHAOS)
+    flows = [(1000, 2000), (2000, 1000), (1000, 3000)]
+    seq_a = [a.decide(s, d, n) for s, d in flows for n in range(200)]
+    seq_b = [b.decide(s, d, n) for s, d in flows for n in range(200)]
+    assert seq_a == seq_b
+    # a different seed is a different schedule
+    c = FaultSchedule(SEED + 1, **CHAOS)
+    seq_c = [c.decide(s, d, n) for s, d in flows for n in range(200)]
+    assert seq_c != seq_a
+    # and the rates are in the ballpark they claim
+    drops = sum(d["drop"] for d in seq_a)
+    assert 0.04 * len(seq_a) < drops < 0.25 * len(seq_a)
+
+
+def test_partition_blocks_cross_group_then_heals():
+    p = Partition({1000}, {2000}, max_blocked=3)
+    assert p.blocks(1000, 2000)
+    assert p.blocks(2000, 1000)
+    assert not p.blocks(1000, 3000)  # third parties unaffected
+    assert p.blocks(1000, 2000)  # third blocked message -> auto-heal
+    assert p.healed
+    assert not p.blocks(1000, 2000)
+
+
+# ---------------------------------------------------------------------------
+# the chaos smoke: one seeded schedule x all three merge modes
+# ---------------------------------------------------------------------------
+
+
+def _chaos_run(merge_mode, schedule_seed=None, *, n_ops=12,
+               partition=False, timeout_s=60.0):
+    """Three replicas over real UDP routers; returns (snapshots, svs,
+    cache, fault stats). ``schedule_seed=None`` = the fault-free
+    reference run. Faults are injected AFTER membership settles and
+    every write happens under them: the schedule attacks the sync /
+    update traffic, whose loss only the protocol's own retry,
+    anti-entropy, and partition-heal machinery may repair."""
+    routers = [UdpRouter() for _ in range(3)]
+    for i, r in enumerate(routers):
+        for other in routers[:i]:
+            r.add_peer(*other.addr)
+    pump_until(
+        routers,
+        lambda: all(len(r.peers) == 2 for r in routers),
+        timeout_s=timeout_s,
+    )
+    reps = [
+        Replica(r, topic="room", client_id=i + 1, merge_mode=merge_mode,
+                probe_retry_s=0.1, anti_entropy_s=0.15)
+        for i, r in enumerate(routers)
+    ]
+    pump_until(
+        routers,
+        lambda: all(len(r.peers_on("room")) == 2 for r in routers),
+        timeout_s=timeout_s,
+    )
+    eps = []
+    part = None
+    if schedule_seed is not None:
+        ports = [r.endpoint.port for r in routers]
+        if partition:
+            # replica 2 partitioned away from 0 and 1 until the
+            # partition has eaten a fixed number of messages (a
+            # count, not a timer: the schedule replays)
+            part = Partition(set(ports[:2]), {ports[2]}, max_blocked=25)
+        for r in routers:
+            sched = FaultSchedule(schedule_seed, partition=part, **CHAOS)
+            eps.append(install_faults(r, sched))
+    for i in range(n_ops):
+        reps[i % 3].set("kv", f"k{i}", [i, "v"])
+        reps[i % 3].push(f"log{i % 2}", f"e{i}")
+
+    def converged():
+        cs = [dict(r.c) for r in reps]
+        return cs[0] == cs[1] == cs[2] and len(cs[0].get("kv", {})) == n_ops
+
+    pump_until(routers, converged, timeout_s=timeout_s)
+    # pump past convergence so the periodic anti-entropy cadence
+    # provably fires at least once (its counters are asserted below;
+    # post-convergence rounds find no deficit and change nothing)
+    end = time.monotonic() + 0.4
+    while time.monotonic() < end:
+        for r in routers:
+            r.poll()
+        time.sleep(0.002)
+    snaps = [r.doc.encode_state_as_update() for r in reps]
+    svs = [r.doc.encode_state_vector() for r in reps]
+    cache = dict(reps[0].c)
+    stats = {}
+    for ep in eps:
+        for k, v in ep.stats.items():
+            stats[k] = stats.get(k, 0) + v
+    if part is not None:
+        stats["partition_healed"] = part.healed
+    for r in routers:
+        r.close()
+    return snaps, svs, cache, stats
+
+
+@pytest.mark.parametrize("merge_mode", ["scalar", "device", "resident"])
+def test_chaos_schedule_converges_byte_identical(merge_mode):
+    tracer = set_tracer(Tracer(enabled=True))
+    try:
+        clean = _chaos_run(merge_mode, None)
+        faulted = _chaos_run(merge_mode, SEED, partition=True)
+    finally:
+        set_tracer(Tracer(enabled=False))
+    # the adversary actually showed up...
+    stats = faulted[3]
+    assert stats["dropped"] > 0, stats
+    assert stats["corrupted"] + stats["duplicated"] + stats["delayed"] > 0
+    assert stats["partitioned"] > 0 and stats["partition_healed"]
+    # ...and the retry machinery (not test plumbing) recovered it,
+    # visibly in the tracer
+    counters = tracer.counters()
+    assert (
+        counters.get("replica.probe_retries", 0)
+        + counters.get("replica.anti_entropy_rounds", 0)
+    ) > 0, counters
+    # byte-identical convergence: every replica, both runs
+    clean_snaps, clean_svs, clean_cache, _ = clean
+    f_snaps, f_svs, f_cache, _ = faulted
+    assert clean_snaps[0] == clean_snaps[1] == clean_snaps[2]
+    assert f_snaps[0] == f_snaps[1] == f_snaps[2]
+    assert f_snaps == clean_snaps
+    assert f_svs == clean_svs
+    assert f_cache == clean_cache
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("merge_mode", ["scalar", "device", "resident"])
+def test_heavy_chaos_schedule(merge_mode):
+    clean = _chaos_run(merge_mode, None, n_ops=45, timeout_s=120.0)
+    faulted = _chaos_run(
+        merge_mode, SEED + 1, n_ops=45, partition=True, timeout_s=120.0
+    )
+    assert faulted[0] == clean[0]
+    assert faulted[1] == clean[1]
+    assert faulted[2] == clean[2]
+
+
+# ---------------------------------------------------------------------------
+# fault wrapper mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_delayed_messages_count_as_pending_and_release():
+    from crdt_tpu.net import transport as t
+
+    a, b = t.UdpEndpoint(), t.UdpEndpoint()
+    try:
+        ep = FaultyEndpoint(a, FaultSchedule(0, delay=1.0, delay_polls=(3, 3)))
+        ep.send("127.0.0.1", b.port, b"held")
+        assert ep.stats["delayed"] == 1
+        assert ep.pending >= 1  # held message visible to quiescence checks
+        got = []
+        for _ in range(200):
+            ep.poll()
+            b.poll()
+            got.extend(b.recv_all())
+            if got:
+                break
+        assert got and got[0][2] == b"held"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_corrupted_envelope_is_rejected_not_fatal():
+    """A corrupted encrypted envelope must fail authentication and be
+    discarded — never poison peer state or kill the poll loop."""
+    routers = [UdpRouter() for _ in range(2)]
+    try:
+        routers[1].add_peer(*routers[0].addr)
+        pump_until(
+            routers,
+            lambda: all(len(r.peers) == 1 for r in routers),
+            timeout_s=20.0,
+        )
+        # corrupt EVERY outbound message from router 1 for a while
+        ep = install_faults(routers[0], FaultSchedule(0, corrupt=1.0))
+        routers[0].alow("room", lambda m, pk: None)
+        for _ in range(100):
+            for r in routers:
+                r.poll()
+        assert ep.stats["corrupted"] > 0
+        # fabric still alive; clearing the faults heals the topic
+        routers[0].endpoint = ep._inner
+        routers[1].alow("room", lambda m, pk: None)
+        routers[0]._announce_topics()
+        pump_until(
+            routers,
+            lambda: routers[1].peers_on("room") == [routers[0].public_key],
+            timeout_s=20.0,
+        )
+    finally:
+        for r in routers:
+            r.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet gossip fault hooks (parallel/gossip.py)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_gossip_drop_and_partition_heal_to_fault_free():
+    jax = pytest.importorskip("jax")
+    from crdt_tpu.parallel.gossip import (
+        GossipFaultPlan,
+        make_gossip_step,
+        make_mesh,
+        mask_packed,
+        pack_cols,
+        pack_dels,
+        synth_columns,
+    )
+
+    del jax
+    R, N = 8, 16
+    cols, dels = synth_columns(R, N, num_maps=2, keys_per_map=8,
+                               num_lists=2, seed=3)
+    packed, dels_p = pack_cols(cols), pack_dels(dels)
+    mesh = make_mesh(1)
+    step = make_gossip_step(mesh, num_segments=R * N, num_clients=R + 1)
+    reference = np.asarray(step(packed, dels_p))
+
+    plan = GossipFaultPlan(seed=5, drop=0.4, partition_every=2, groups=2)
+    keep = plan.delivered_mask(0, R)
+    assert 0 < keep.sum() < R  # the plan actually dropped someone
+    lossy = np.asarray(step(mask_packed(packed, keep), dels_p))
+    assert not np.array_equal(lossy, reference)  # loss is observable
+
+    masks = plan.partition_masks(2, R)
+    assert masks is not None and sum(m.sum() for m in masks) == R
+    for m in masks:
+        np.asarray(step(mask_packed(packed, m), dels_p))  # group round
+
+    # heal: the full columns re-presented -> exactly the fault-free
+    # round (CRDT idempotence on device)
+    healed = np.asarray(step(packed, dels_p))
+    assert np.array_equal(healed, reference)
+
+    # determinism: same plan, same decisions
+    plan2 = GossipFaultPlan(seed=5, drop=0.4, partition_every=2, groups=2)
+    assert np.array_equal(plan2.delivered_mask(0, R), keep)
+    assert plan.partition_masks(1, R) is None  # off-cycle rounds clean

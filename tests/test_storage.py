@@ -305,3 +305,92 @@ def test_meta_is_json(path):
     finally:
         raw.close()
         p.close()
+
+
+def test_crash_consistent_recovery_through_persistence(path):
+    """The satellite crash contract, end to end through the replica
+    persistence layer: a kvlog whose tail record is torn mid-write
+    (and, separately, CRC-corrupted) must reopen replaying every
+    intact update, drop ONLY the tail, and accept the next
+    store_update as if nothing happened — so a replica restarting
+    after a crash resumes from its last durable update and
+    anti-entropy refills the lost one."""
+    updates = [_mk_update(c) for c in range(1, 6)]
+    p = LogPersistence(path)
+    for u in updates:
+        p.store_update("doc", u, sv=b"sv-%d" % len(u))
+    p.close()
+
+    # torn tail: the crash hit mid-append of the LAST batch record
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 11)
+
+    p = LogPersistence(path)
+    assert p.get_all_updates("doc") == updates[:-1]  # only the tail gone
+    meta = p.get_meta("doc")
+    assert meta is not None and meta["count"] == 4
+    # the store stays writable: the next update lands on a clean
+    # record boundary and persists durably
+    recovered = _mk_update(9)
+    p.store_update("doc", recovered, sv=b"sv-after")
+    p.close()
+    p = LogPersistence(path)
+    assert p.get_all_updates("doc") == updates[:-1] + [recovered]
+    assert p.get_state_vector("doc") == b"sv-after"
+    p.close()
+
+    # corrupt tail: a bit flip inside the last record's payload — the
+    # CRC guard must drop that whole record, nothing before it
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size - 3)
+        byte = f.read(1)
+        f.seek(size - 3)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    p = LogPersistence(path)
+    assert p.get_all_updates("doc") == updates[:-1]
+    p.store_update("doc", recovered)  # and writes still succeed
+    assert p.get_all_updates("doc") == updates[:-1] + [recovered]
+    p.close()
+
+
+def test_replica_restart_after_torn_tail_resyncs(path):
+    """A replica whose log lost its torn tail restarts on the intact
+    prefix and the sync protocol refills the difference."""
+    net = LoopbackNetwork()
+    r1 = Replica(
+        LoopbackRouter(net, "pk1"), "room",
+        client_id=1, persistence=LogPersistence(path),
+    )
+    # a peer that will survive the crash holding the full document
+    survivor = Replica(LoopbackRouter(net, "pk2"), "room", client_id=2)
+    for i in range(6):
+        r1.set("m", f"k{i}", i)
+    net.run()
+    assert survivor.c["m"] == {f"k{i}": i for i in range(6)}
+    r1.self_close()
+    # crash tears the log tail. The file's trailing records include
+    # handshake diffs r1 persisted, so walk the truncation point back
+    # until exactly one UPDATE record (k5's) is torn away — the CRC
+    # guard drops whole records, never prefixes
+    size = os.path.getsize(path)
+    while True:
+        size -= 7
+        with open(path, "r+b") as f:
+            f.truncate(size)
+        probe = LogPersistence(path)
+        n = len(probe.get_all_updates("room"))
+        probe.close()
+        if n <= 5:
+            break
+    assert n == 5
+
+    restarted = Replica(
+        LoopbackRouter(net, "pk3"), "room",
+        client_id=3, persistence=LogPersistence(path),
+    )
+    # the torn update is absent from the replayed log...
+    assert len(restarted.c.get("m", {})) < 6
+    net.run()  # ...until the ready/sync handshake refills it
+    assert restarted.c["m"] == {f"k{i}": i for i in range(6)}

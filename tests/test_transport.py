@@ -1135,3 +1135,320 @@ class TestIntroductionPunch:
         finally:
             a.close()
             b.close()
+
+
+# ---------------------------------------------------------------------------
+# symmetric-NAT traversal: port prediction + relay fallback
+# ---------------------------------------------------------------------------
+
+from crdt_tpu.net.faults import (  # noqa: E402
+    ConeNat,
+    NatFabric,
+    SymmetricNat,
+    install_nat,
+    pump_until,
+)
+
+
+def _nat_pair(nat_a, nat_b, **router_kw):
+    """Rendezvous + two members behind simulated NATs on one virtual
+    fabric. Returns (routers, a, b)."""
+    fabric = NatFabric()
+    boot = UdpRouter(rendezvous=True)
+    install_nat(boot, fabric)
+    router_kw.setdefault("dial_retry_s", 0.05)
+    a = UdpRouter(bootstrap=[boot.addr], **router_kw)
+    install_nat(a, fabric, nat_a)
+    b = UdpRouter(bootstrap=[boot.addr], **router_kw)
+    install_nat(b, fabric, nat_b)
+    return [boot, a, b], a, b
+
+
+class TestSymmetricNatTraversal:
+    """A symmetric NAT allocates a NEW external port per destination,
+    so the address the rendezvous observed is a dead letter to every
+    introduced stranger — the introduction alone (TestIntroductionPunch)
+    can no longer traverse. Sequential allocation makes the live
+    mapping predictable, and the dial scheduler's probe spray finds
+    it."""
+
+    def test_introduction_alone_is_filtered(self):
+        """Ground truth for the scenario: with retries/prediction OFF,
+        the introduced members stay strangers — their dials at the
+        observed addresses die at each other's NAT filters."""
+        routers, a, b = _nat_pair(
+            SymmetricNat(21000), SymmetricNat(23000),
+            port_prediction=False, relay_after_s=3600.0,
+            dial_retry_s=3600.0,
+        )
+        try:
+            ra = Replica(a, topic="room", client_id=1)
+            rb = Replica(b, topic="room", client_id=2)
+            del ra, rb
+            deadline = time.monotonic() + 1.5
+            while time.monotonic() < deadline:
+                for r in routers:
+                    r.poll()
+                time.sleep(0.002)
+            assert b.public_key not in a.peers
+            assert a.public_key not in b.peers
+            # the dials really happened and really were filtered
+            assert a.endpoint.stats["filtered"] > 0
+            assert b.endpoint.stats["filtered"] > 0
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_converges_via_port_prediction(self):
+        """Symmetric vs (port-restricted) cone: the cone side's probe
+        at observed+1 lands in the symmetric side's sequentially
+        allocated mapping, each side's spray opens its own filter, and
+        the ordinary hello/challenge handshake completes a DIRECT
+        path. Replicas then converge over it."""
+        from crdt_tpu.utils.trace import Tracer, set_tracer
+
+        tracer = set_tracer(Tracer(enabled=True))
+        routers, a, b = _nat_pair(
+            SymmetricNat(21000), ConeNat(22000),
+            predict_after=1, relay_after_s=3600.0,  # no relay: punch or bust
+        )
+        try:
+            ra = Replica(a, topic="room", client_id=1)
+            rb = Replica(b, topic="room", client_id=2)
+            pump_until(
+                routers,
+                lambda: (
+                    b.public_key in a._peers and a._peers[b.public_key].direct
+                    and a.public_key in b._peers
+                    and b._peers[a.public_key].direct
+                ),
+                timeout_s=30.0,
+            )
+            assert a.stats["predict_probes"] > 0
+            assert tracer.counters("router.")["router.dial_retries"] > 0
+            ra.set("m", "ka", 1)
+            rb.set("m", "kb", 2)
+            pump_until(
+                routers,
+                lambda: dict(ra.c) == dict(rb.c)
+                and ra.c.get("m", {}).get("kb") == 2,
+                timeout_s=30.0,
+            )
+            # the punched mapping, not the advertised one, carries it:
+            # b appears to a at its NAT address
+            assert a._peers[b.public_key].addr[1] >= 22000
+        finally:
+            set_tracer(Tracer(enabled=False))
+            for r in routers:
+                r.close()
+
+
+class TestRelayFallback:
+    """Symmetric vs symmetric with sequentially interleaved probes
+    never self-punches; the dial deadline falls back to forwarding
+    end-to-end sealed frames through the introducer."""
+
+    def test_converges_via_relay_with_prediction_disabled(self):
+        from crdt_tpu.utils.trace import Tracer, set_tracer
+
+        tracer = set_tracer(Tracer(enabled=True))
+        routers, a, b = _nat_pair(
+            SymmetricNat(21000), SymmetricNat(23000),
+            port_prediction=False, relay_after_s=0.3,
+        )
+        boot = routers[0]
+        try:
+            ra = Replica(a, topic="room", client_id=1,
+                         probe_retry_s=0.1, anti_entropy_s=0.2)
+            rb = Replica(b, topic="room", client_id=2,
+                         probe_retry_s=0.1, anti_entropy_s=0.2)
+            ra.set("m", "ka", 1)
+            rb.set("m", "kb", 2)
+            pump_until(
+                routers,
+                lambda: dict(ra.c) == dict(rb.c)
+                and ra.c.get("m", {}).get("kb") == 2
+                and ra.c.get("m", {}).get("ka") == 1,
+                timeout_s=30.0,
+            )
+            # converged WITHOUT a direct path, through the rendezvous
+            pa = a._peers[b.public_key]
+            assert not pa.direct and pa.relay == boot.public_key
+            assert boot.stats["relay_frames_forwarded"] > 0
+            assert boot.stats["relay_bytes_forwarded"] > 0
+            assert a.stats["relay_sends"] > 0
+            counters = tracer.counters("router.relay")
+            assert counters["router.relay_frames_forwarded"] > 0
+            assert counters["router.relay_elections"] > 0
+        finally:
+            set_tracer(Tracer(enabled=False))
+            for r in routers:
+                r.close()
+
+    def test_later_probe_success_upgrades_relay_to_direct(self):
+        """The relay is a bridge, not a destination: once prediction
+        is allowed to run and a probe lands, the proven direct path
+        replaces the relay leg in place."""
+        routers, a, b = _nat_pair(
+            SymmetricNat(21000), ConeNat(22000),
+            port_prediction=False, relay_after_s=0.2, predict_after=1,
+        )
+        try:
+            ra = Replica(a, topic="room", client_id=1)
+            rb = Replica(b, topic="room", client_id=2)
+            ra.set("m", "early", 7)
+            pump_until(
+                routers,
+                lambda: rb.c.get("m", {}).get("early") == 7,
+                timeout_s=30.0,
+            )
+            assert not a._peers[b.public_key].direct  # relayed so far
+            a._port_prediction = True
+            b._port_prediction = True
+            pump_until(
+                routers,
+                lambda: a._peers[b.public_key].direct
+                and b._peers[a.public_key].direct,
+                timeout_s=30.0,
+            )
+            assert a._peers[b.public_key].relay is None
+            assert a.stats["relay_upgrades"] + b.stats["relay_upgrades"] > 0
+            ra.set("m", "late", 8)  # post-upgrade traffic rides direct
+            pump_until(
+                routers,
+                lambda: rb.c.get("m", {}).get("late") == 8,
+                timeout_s=15.0,
+            )
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_fresh_intro_reopens_expired_dial_for_relayed_peer(self):
+        """A relay-routed pair whose dial window expired must not be
+        stuck relayed forever: a later introduction carries a fresh
+        observed address, re-opens the dial, and the prediction
+        escalation upgrades the pair to direct."""
+        routers, a, b = _nat_pair(
+            SymmetricNat(21000), ConeNat(22000),
+            port_prediction=False, relay_after_s=0.2, predict_after=1,
+            dial_give_up_s=0.5,
+        )
+        boot = routers[0]
+        try:
+            ra = Replica(a, topic="room", client_id=1)
+            rb = Replica(b, topic="room", client_id=2)
+            ra.set("m", "x", 1)
+            pump_until(
+                routers,
+                lambda: rb.c.get("m", {}).get("x") == 1,
+                timeout_s=30.0,
+            )
+            end = time.monotonic() + 0.8  # let the 0.5s dials expire
+            while time.monotonic() < end:
+                for r in routers:
+                    r.poll()
+                time.sleep(0.002)
+            assert not a._dials and not b._dials
+            assert not a._peers[b.public_key].direct  # still relayed
+            a._port_prediction = True
+            b._port_prediction = True
+            bs = boot._peers
+            for src, dst in ((a, b), (b, a)):
+                src._apply_intro(
+                    {"peers": [{
+                        "pk": dst.public_key,
+                        "ip": bs[dst.public_key].addr[0],
+                        "port": bs[dst.public_key].addr[1],
+                    }]},
+                    introducer=boot.public_key,
+                )
+            assert b.public_key in a._dials  # dial re-opened
+            pump_until(
+                routers,
+                lambda: a._peers[b.public_key].direct
+                and b._peers[a.public_key].direct,
+                timeout_s=30.0,
+            )
+            assert a._peers[b.public_key].relay is None
+        finally:
+            for r in routers:
+                r.close()
+
+    def test_dead_relay_triggers_reelection_not_a_wedge(self):
+        fabric = NatFabric()
+        b1 = UdpRouter(rendezvous=True)
+        install_nat(b1, fabric)
+        b2 = UdpRouter(rendezvous=True)
+        install_nat(b2, fabric)
+        boots = [b1.addr, b2.addr]
+        kw = dict(bootstrap=boots, dial_retry_s=0.05,
+                  port_prediction=False, relay_after_s=0.2,
+                  relay_stale_s=0.4)
+        a = UdpRouter(**kw)
+        install_nat(a, fabric, SymmetricNat(31000))
+        b = UdpRouter(**kw)
+        install_nat(b, fabric, SymmetricNat(33000))
+        routers = [b1, b2, a, b]
+        try:
+            ra = Replica(a, topic="room", client_id=1,
+                         probe_retry_s=0.1, anti_entropy_s=0.2)
+            rb = Replica(b, topic="room", client_id=2,
+                         probe_retry_s=0.1, anti_entropy_s=0.2)
+            ra.set("m", "pre", 1)
+            pump_until(
+                routers,
+                lambda: rb.c.get("m", {}).get("pre") == 1,
+                timeout_s=30.0,
+            )
+            relay0 = a._peers[b.public_key].relay
+            dead = b1 if relay0 == b1.public_key else b2
+            survivor = b2 if dead is b1 else b1
+            elections0 = a.stats["relay_elections"]
+            dead.close()
+            live = [r for r in routers if r is not dead]
+            ra.set("m", "after-death", 42)
+            pump_until(
+                live,
+                lambda: rb.c.get("m", {}).get("after-death") == 42,
+                timeout_s=40.0,
+            )
+            assert a.stats["relay_elections"] > elections0
+            assert a._peers[b.public_key].relay == survivor.public_key
+        finally:
+            for r in routers:
+                r.close()  # idempotent: the dead relay closed earlier
+
+    def test_saturated_relay_sheds_and_recovers(self):
+        """Per-source byte budgets: a relay over budget NAKs, the
+        sender pauses its relay leg (sheds to its own retry cadence),
+        and the refill lets the pair converge anyway."""
+        fabric = NatFabric()
+        # budget below ONE side's handshake+sync footprint: the bucket
+        # must bind during the initial exchange, whatever the timing
+        boot = UdpRouter(rendezvous=True, relay_budget_bytes=400,
+                         relay_refill_bps=1500)
+        install_nat(boot, fabric)
+        kw = dict(bootstrap=[boot.addr], dial_retry_s=0.05,
+                  port_prediction=False, relay_after_s=0.2)
+        a = UdpRouter(**kw)
+        install_nat(a, fabric, SymmetricNat(21000))
+        b = UdpRouter(**kw)
+        install_nat(b, fabric, SymmetricNat(23000))
+        routers = [boot, a, b]
+        try:
+            ra = Replica(a, topic="room", client_id=1,
+                         probe_retry_s=0.1, anti_entropy_s=0.15)
+            rb = Replica(b, topic="room", client_id=2,
+                         probe_retry_s=0.1, anti_entropy_s=0.15)
+            for i in range(8):
+                (ra if i % 2 else rb).set("m", f"k{i}", i)
+            pump_until(
+                routers,
+                lambda: dict(ra.c) == dict(rb.c)
+                and len(ra.c.get("m", {})) == 8,
+                timeout_s=40.0,
+            )
+            assert boot.stats["relay_sheds"] > 0  # budget really bound
+        finally:
+            for r in routers:
+                r.close()
