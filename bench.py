@@ -731,6 +731,15 @@ def smoke():
     jax.config.update("jax_enable_x64", True)
     from crdt_tpu.models import stream_replay
 
+    # tracing ON by default in smoke: a tier-1 test asserts the
+    # hot-path spans exist (instrumentation cannot silently rot).
+    # BENCH_TRACE=0 measures the off-path cost instead.
+    from crdt_tpu.obs import Tracer, set_tracer
+
+    tracer = None
+    if os.environ.get("BENCH_TRACE", "1") != "0":
+        tracer = set_tracer(Tracer(enabled=True))
+
     R = int(os.environ.get("BENCH_SMOKE_REPLICAS", 48))
     K = int(os.environ.get("BENCH_SMOKE_OPS", 40))
     blobs = build_trace(R, K)
@@ -779,6 +788,39 @@ def smoke():
         "phases_numpy_s": p_n,
         "ok": True,
     }
+    report = None
+    if tracer is not None:
+        # the persistence leg (WAL append/compact spans), then the
+        # hot-path span contract: these names are the documented
+        # registry (README "Observability") and tier-1 pins them
+        import tempfile
+
+        from crdt_tpu.storage.persistence import LogPersistence
+
+        with tempfile.TemporaryDirectory() as td:
+            lp = LogPersistence(os.path.join(td, "smoke.kvlog"))
+            for blob in blobs[:8]:
+                lp.store_update("smoke", blob)
+            lp.compact("smoke", snap_dev)
+            lp.close()
+        report = tracer.report()
+        for name in ("decode", "pack", "converge.dispatch",
+                     "converge.fetch", "materialize", "gather",
+                     "compact", "persist", "persist.compact"):
+            sp = report["spans"].get(name)
+            assert sp and sp["count"] > 0, \
+                f"smoke: hot-path span {name!r} missing from tracer"
+            assert "p50_s" in sp and "p99_s" in sp, name
+        out["tracer_spans_ok"] = True
+    smoke_out = os.environ.get("BENCH_SMOKE_OUT")
+    if smoke_out and report is not None:
+        # the BENCH_OUT-shaped artifact WITH the embedded report, at
+        # a caller-chosen path (never the committed BENCH_OUT.json:
+        # smoke must not overwrite real run evidence with toy numbers)
+        with open(smoke_out, "w") as f:
+            json.dump({**out, "tracer": report}, f, indent=1,
+                      sort_keys=True)
+            f.write("\n")
     emit_result(out, path=None)  # smoke never overwrites run evidence
 
 
@@ -790,6 +832,18 @@ def main():
     from crdt_tpu.compat import enable_x64
 
     jax.config.update("jax_enable_x64", True)
+
+    # phase evidence rides the artifact: the full tracer report
+    # (p50/p99 histograms for decode/pack/converge.dispatch/
+    # converge.fetch/materialize/persist + counters) is embedded in
+    # BENCH_OUT.json at the end, so every committed bench run carries
+    # its own per-phase breakdown. BENCH_TRACE=0 disables (hooks cost
+    # one attribute check when off).
+    from crdt_tpu.obs import Tracer, set_tracer
+
+    bench_tracer = None
+    if os.environ.get("BENCH_TRACE", "1") != "0":
+        bench_tracer = set_tracer(Tracer(enabled=True))
     # the persistent compile cache is configured by the package itself
     # (crdt_tpu/ops/device.py, per-user path): the untimed warmup
     # costs real compile only on a cold machine
@@ -890,6 +944,20 @@ def main():
     # the two contenders must agree before any ratio is meaningful
     assert cache_dev == cache_np, "device and numpy contenders diverge"
     assert snap_dev == snap_np
+
+    # ---- WAL evidence (untimed): run the persistence layer so the
+    # embedded tracer report carries real persist append/compact spans
+    if bench_tracer is not None:
+        import tempfile
+
+        from crdt_tpu.storage.persistence import LogPersistence
+
+        with tempfile.TemporaryDirectory() as td:
+            lp = LogPersistence(os.path.join(td, "bench.kvlog"))
+            for blob in blobs[: min(64, len(blobs))]:
+                lp.store_update("bench", blob)
+            lp.compact("bench", snap_dev)
+            lp.close()
 
     # ---- python oracle (BASELINE.md's named baseline) ----------------
     skip_oracle = os.environ.get("BENCH_SKIP_ORACLE", "0") == "1"
@@ -1718,6 +1786,11 @@ def main():
         out["fleet_run"] = fleet_result
     if scale_result:
         out["scale_run"] = scale_result
+    if bench_tracer is not None:
+        # the full observability report (shared Tracer.report schema):
+        # per-span p50/p90/p99/max histograms + counters + gauges —
+        # committed phase evidence, not session-log folklore
+        out["tracer"] = bench_tracer.report()
     emit_result(out)
 
 

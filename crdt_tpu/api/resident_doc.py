@@ -74,6 +74,11 @@ class _ResidentEngineShim:
     def pending(self):
         return self._replay._pending
 
+    def delete_set(self) -> DeleteSet:
+        # the divergence sentinel's tombstone guard reads the full
+        # recorded delete set (resident state records it immediately)
+        return self._replay.ds
+
 
 class ResidentCrdt(DocOpsMixin):
     """Drop-in :class:`crdt_tpu.api.doc.Crdt` replacement backed by

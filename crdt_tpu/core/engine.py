@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from crdt_tpu.core.ids import DeleteSet, StateVector
 from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.obs.tracer import get_tracer
 from crdt_tpu.core.store import (
     K_ANY,
     K_DELETED,
@@ -327,12 +328,15 @@ class Engine:
         queue = deque(
             sorted(records + self.pending, key=lambda r: (r.client, r.clock))
         )
+        n_prior_pending = len(self.pending)
         self.pending = []
         waiting: Dict[Tuple[int, int], List[ItemRecord]] = {}
+        n_integrated = 0
         try:
             while queue:
                 rec = queue.popleft()
                 if step(rec):
+                    n_integrated += 1
                     # anything parked on this id (contiguity waiters key
                     # on (client, clock); dep waiters on the dep id)
                     woken = waiting.pop(rec.id, None)
@@ -365,6 +369,22 @@ class Engine:
         if delete_set is not None:
             self._apply_delete_set(delete_set)
         self._retry_pending_deletes()
+        tracer = get_tracer()
+        if tracer.enabled:
+            # one counter flush per batch, never per record: the
+            # admission loop itself stays tracer-free. Stashed counts
+            # only the NET NEW parked records (prior pending re-rides
+            # every batch and must not re-count); the gauge carries
+            # the current stash depth
+            newly_stashed = len(self.pending) - n_prior_pending
+            tracer.count("engine.records_integrated", n_integrated)
+            if newly_stashed > 0:
+                tracer.count("engine.records_stashed", newly_stashed)
+            tracer.gauge("engine.pending", len(self.pending))
+            tracer.gauge(
+                "engine.pending_delete_ranges",
+                sum(len(v) for v in self.pending_deletes.ranges.values()),
+            )
 
     def _blocker_of(self, rec: ItemRecord) -> Optional[Tuple[int, int]]:
         """The first id this record is waiting on: the previous clock

@@ -27,6 +27,7 @@ import numpy as np
 
 from crdt_tpu.codec import native
 from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.obs.tracer import get_tracer
 
 
 class ReplayResult(NamedTuple):
@@ -39,7 +40,10 @@ class ReplayResult(NamedTuple):
 def decode(blobs: Sequence[bytes]) -> Dict:
     """Wire -> canonical columnar union (native C codec when built;
     duplicate ids from redelivered blobs are dropped, first wins)."""
-    return native.dedup_columns(native.decode_updates_columns_any(blobs))
+    with get_tracer().span("decode"):
+        return native.dedup_columns(
+            native.decode_updates_columns_any(blobs)
+        )
 
 
 def stage(dec: Dict) -> Tuple[Dict[str, np.ndarray], DeleteSet]:
@@ -136,6 +140,11 @@ def gather(dec: Dict, ds: DeleteSet, handle):
     rights into a member's subtree, orphan subtrees: the plan's
     ``hard_rows``) re-order on the host. The resident fallback keeps
     the blanket host detour for every right-bearing parent."""
+    with get_tracer().span("gather"):
+        return _gather(dec, ds, handle)
+
+
+def _gather(dec: Dict, ds: DeleteSet, handle):
     if handle[0] == "packed":
         win_rows, seq_orders = _assemble_packed(dec, handle[1])
         hard = getattr(handle[1], "hard_rows", ())
@@ -418,6 +427,12 @@ def assemble_cache(dec: Dict, ds: DeleteSet, win_rows, win_vis,
     resolve within the chunk) and merges the parts; the returned
     ``ix_group`` is the subset's slice of the reserved ``ix`` index
     root, consumed by :func:`finish_cache` once every part is in."""
+    with get_tracer().span("materialize"):
+        return _assemble_cache(dec, ds, win_rows, win_vis, seq_orders)
+
+
+def _assemble_cache(dec: Dict, ds: DeleteSet, win_rows, win_vis,
+                    seq_orders) -> Tuple[dict, Dict[str, int]]:
     from crdt_tpu.core.store import K_TYPE, TYPE_MAP
 
     keys = dec["keys"]
@@ -493,7 +508,8 @@ def finish_cache(cache: dict, dec: Dict,
 
 def compact(dec: Dict, ds: DeleteSet) -> bytes:
     """Snapshot compaction: the whole replayed union as one blob."""
-    return native.encode_from_columns_any(dec, ds)
+    with get_tracer().span("compact"):
+        return native.encode_from_columns_any(dec, ds)
 
 
 def replay_trace(

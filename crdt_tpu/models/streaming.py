@@ -58,6 +58,8 @@ import numpy as np
 from crdt_tpu.codec import native
 from crdt_tpu.models import replay as rp
 from crdt_tpu.models.replay import ReplayResult
+from crdt_tpu.obs.profiling import device_annotation
+from crdt_tpu.obs.tracer import get_tracer
 
 # default pipeline depth targets: enough chunks that decode streams,
 # enough convergence shards that fetch/materialize of shard k hides
@@ -153,9 +155,12 @@ def stream_decode(blobs: Sequence[bytes], chunk_blobs: int,
     ] or [[]]
 
     def _one(chunk):
-        return ph.timed(
-            "decode", native.decode_updates_columns_any, chunk
-        )
+        # runs on the pool: the global tracer span here is exactly the
+        # concurrent-use case the thread-safe tracer exists for
+        with get_tracer().span("decode"):
+            return ph.timed(
+                "decode", native.decode_updates_columns_any, chunk
+            )
 
     if len(chunks) == 1:
         decs = [_one(chunks[0])]
@@ -370,7 +375,11 @@ def stream_replay(
                 if plan is None:
                     q.put(("unstageable", None, None))
                     return
-                handle = packed.converge_async(plan)  # enqueue, no block
+                # per-shard XProf annotation: converge_async's own
+                # dispatch annotation nests inside, so device captures
+                # attribute each fused kernel to its pipeline shard
+                with device_annotation(f"crdt.stream.shard{g}"):
+                    handle = packed.converge_async(plan)  # enqueue, no block
                 q.put(("shard", (handle, time.perf_counter()), rows_g))
             # compact is pure decode-side work: it runs here, inside
             # the window where the consumer is fetching/materializing

@@ -5,6 +5,7 @@ from crdt_tpu.net.faults import (
     ConeNat,
     FaultSchedule,
     FaultyEndpoint,
+    ForkFault,
     NatFabric,
     Partition,
     SymmetricNat,
@@ -17,6 +18,7 @@ __all__ = [
     "ConeNat",
     "FaultSchedule",
     "FaultyEndpoint",
+    "ForkFault",
     "LoopbackNetwork",
     "LoopbackRouter",
     "MemoryPersistence",

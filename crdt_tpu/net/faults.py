@@ -48,6 +48,8 @@ import time
 import zlib
 from typing import Dict, List, Optional, Set, Tuple
 
+from crdt_tpu.obs.recorder import get_recorder, update_digest
+
 Addr = Tuple[str, int]
 
 
@@ -171,6 +173,28 @@ class FaultyEndpoint:
         n = self._flow_seq.get(flow, 0)
         self._flow_seq[flow] = n + 1
         d = self.schedule.decide(flow[0], flow[1], n)
+        rec = get_recorder()
+        if rec.enabled:
+            # one event PER fault kind APPLIED: corrupt co-occurs with
+            # delay or dup on one message (drop/partition are
+            # exclusive early-outs; a held message is sent once on
+            # release, so its dup decision is never applied), and the
+            # recorder must agree with the stats counters
+            kinds = []
+            if d["drop"]:
+                kinds = ["partition" if d.get("partitioned") else "drop"]
+            else:
+                if d["corrupt"]:
+                    kinds.append("corrupt")
+                if d["delay"]:
+                    kinds.append("delay")
+                elif d["dup"]:
+                    kinds.append("dup")
+            for kind in kinds:
+                rec.record(
+                    f"fault.{kind}", src=flow[0], dst=flow[1], seq=n,
+                    size=len(data), digest=update_digest(data),
+                )
         if d["drop"]:
             self.stats["partitioned" if d.get("partitioned") else "dropped"] += 1
             return 0
@@ -444,6 +468,70 @@ def install_nat(router, fabric: NatFabric,
     ep = NattedEndpoint(router.endpoint, fabric, nat)
     router.endpoint = ep
     return ep
+
+
+# ---------------------------------------------------------------------------
+# seeded state-fork fault (the divergence sentinel's adversary)
+# ---------------------------------------------------------------------------
+
+
+class ForkFault:
+    """Seeded fault that FORKS replica state silently — the failure
+    class the drop/dup/delay/corrupt schedule above cannot produce
+    (those are all eventually repaired by the protocol; CRDT
+    convergence guarantees it). A fork models the guarantees-void
+    cases: storage bitrot surviving validation, a buggy merge, a
+    byzantine peer emitting two different ops under ONE (client,
+    clock) id.
+
+    :meth:`inject` applies a conflicting record with the SAME id but
+    seed-derived DIFFERENT content to each given replica, bypassing
+    the network (nothing is broadcast — the fork is silent). Every
+    replica's state vector advances identically, so the sync
+    protocol sees two healthy, "converged" peers whose states will
+    never agree: later anti-entropy diffs carry each side's forked
+    record, and the receiver drops it as an already-known id
+    (first-wins dedup). Exactly the condition the divergence
+    sentinel's snapshot-hash beacon exists to expose — pinned in
+    tests/test_obs.py.
+    """
+
+    def __init__(self, seed: int = 0, *, root: str = "kv",
+                 key: Optional[str] = None):
+        self.seed = seed
+        self.root = root
+        self.key = key if key is not None else f"fork{seed}"
+        # fake origin client well above test/client-id ranges but
+        # inside the 31-bit random-id space
+        self.client = (1 << 29) + (seed % (1 << 16))
+
+    def inject(self, replicas) -> List[bytes]:
+        """Fork the given replicas' states; returns the per-replica
+        conflicting blobs (for assertions/postmortems)."""
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+
+        rec = get_recorder()
+        blobs = []
+        for i, rep in enumerate(replicas):
+            content = f"fork-{self.seed}-{i}-" \
+                      f"{int(_hash01(self.seed, 'fork', i) * 1e9)}"
+            blob = v1.encode_update(
+                [ItemRecord(client=self.client, clock=0,
+                            parent_root=self.root, key=self.key,
+                            content=content)],
+                DeleteSet(),
+            )
+            rep.doc.apply_updates([blob], origin="fork")
+            if rec.enabled:
+                rec.record(
+                    "fault.fork", replica=rep.router.public_key,
+                    topic=rep.topic, digest=update_digest(blob),
+                    size=len(blob),
+                )
+            blobs.append(blob)
+        return blobs
 
 
 # ---------------------------------------------------------------------------

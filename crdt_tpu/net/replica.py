@@ -32,6 +32,8 @@ from typing import Any, Callable, Dict, List, Optional
 from crdt_tpu.api.doc import Crdt
 from crdt_tpu.codec import v1
 from crdt_tpu.core.ids import StateVector
+from crdt_tpu.obs.recorder import get_recorder, update_digest
+from crdt_tpu.obs.sentinel import DivergenceSentinel
 from crdt_tpu.utils.backoff import jitter
 from crdt_tpu.utils.trace import get_tracer
 
@@ -123,6 +125,8 @@ class Replica:
         probe_max_retries: int = 10,
         anti_entropy_s: Optional[float] = None,
         anti_entropy_max_s: Optional[float] = None,
+        sentinel: Optional[bool] = None,
+        on_divergence: Optional[Callable[[dict], None]] = None,
     ):
         if not getattr(router, "is_ypear_router", False):
             raise TypeError("router is not a ypear router")  # crdt.js:172
@@ -229,6 +233,23 @@ class Replica:
         self.batch_incoming = batch_incoming
         self._inbox: List[tuple] = []  # (update bytes, meta dict)
 
+        # divergence sentinel (obs.sentinel): snapshot-hash beacons
+        # ride the anti-entropy cadence (``sentinel=None`` => beacons
+        # enabled exactly when ``anti_entropy_s`` is set). Inbound
+        # beacons are ALWAYS checked — a beaconing peer gets fork
+        # coverage even from replicas that never beacon themselves.
+        self._sentinel_beacons = (
+            sentinel if sentinel is not None else anti_entropy_s is not None
+        )
+        self.sentinel = DivergenceSentinel(
+            self.doc, topic=topic, replica=router.public_key,
+            on_divergence=on_divergence,
+        )
+        # per-origin trace-id sequence: sync frames are stamped with
+        # (client, seq, monotonic ts) so per-peer propagation and
+        # convergence lag become measurable gauges downstream
+        self._tid_seq = 0
+
         # load from the update log (crdt.js:193-217): the whole log
         # replays as ONE batched merge (one observer flush; in device
         # mode, one kernel dispatch instead of one per logged update)
@@ -319,6 +340,12 @@ class Replica:
             "public_key": self.router.public_key,
             "state_vector": self.doc.encode_state_vector(),
         }
+        rec = get_recorder()
+        if rec.enabled:
+            rec.record(
+                "probe.send", topic=self.topic,
+                replica=self.router.public_key, peer=public_key,
+            )
         if public_key is not None:
             self._probe_retries = 0
             self._probe_interval = self.probe_retry_s
@@ -372,6 +399,11 @@ class Replica:
             # state vectors, repairing deficits the optimistic
             # advancement mis-recorded (a dropped broadcast)
             self.probe()
+            if self._sentinel_beacons:
+                # the sentinel's snapshot-hash beacon rides the same
+                # cadence: silent divergence (equal SVs, unequal
+                # state) becomes an observable event at the receivers
+                self.beacon()
             if sent:
                 self._ae_interval = self.anti_entropy_s
             else:
@@ -379,6 +411,22 @@ class Replica:
                     self._ae_interval * 2, self.anti_entropy_max_s
                 )
             self._next_ae_at = now + self._ae_interval * jitter()
+
+    def beacon(self) -> None:
+        """Broadcast one divergence-sentinel beacon: our state vector
+        plus snapshot/delete-set digests. Receivers whose SV equals
+        ours compare digests; a mismatch with equal delete sets is
+        silent divergence and raises an observable event (with a
+        flight-recorder dump) at the receiver."""
+        if self.closed or not self.router.peers_on(self.topic):
+            return
+        self.flush_incoming()  # digest the state the SV advertises
+        self._broadcast({
+            "meta": "beacon",
+            "public_key": self.router.public_key,
+            "state_vector": self.doc.encode_state_vector(),
+            **self.sentinel.beacon_payload(),
+        })
 
     def _reset_ae_backoff(self) -> None:
         if self.anti_entropy_s is not None:
@@ -440,12 +488,19 @@ class Replica:
             return sent
         self.flush_incoming()  # deficits computed on current state
         mine = self.doc.state_vector()
+        rec = get_recorder()
         for pk, sv in list(self.peer_state_vectors.items()):
             if sv.diff_dominates(mine):
                 continue  # no record deficit
             update = self.doc.encode_state_as_update(sv)
             self._to_peer(pk, {"update": update})
             sent[pk] = len(update)
+            if rec.enabled:
+                rec.record(
+                    "ae.delta", topic=self.topic,
+                    replica=self.router.public_key, peer=pk,
+                    size=len(update), digest=update_digest(update),
+                )
             self.peer_state_vectors[pk] = sv.merge(mine)
         if sent:
             tracer = get_tracer()
@@ -458,7 +513,21 @@ class Replica:
     def _on_local_update(self, update: bytes, meta: dict) -> None:
         self._persist(update)
         if not self.closed:
-            self._propagate({"update": update, **meta})
+            # origin trace id: (client, per-origin seq, monotonic ts).
+            # Receivers subtract the stamp from their clock to gauge
+            # propagation/convergence lag (exact in-process and on a
+            # shared clock; cross-host offsets shift it uniformly).
+            self._tid_seq += 1
+            tid = [self.doc.engine.client_id, self._tid_seq,
+                   time.monotonic()]
+            rec = get_recorder()
+            if rec.enabled:
+                rec.record(
+                    "update.send", topic=self.topic,
+                    replica=self.router.public_key, size=len(update),
+                    digest=update_digest(update), tid=tid,
+                )
+            self._propagate({"update": update, "tid": tid, **meta})
             self._advance_topic_peer_svs()
             self._reset_ae_backoff()  # fresh writes: stay chatty
 
@@ -524,6 +593,26 @@ class Replica:
         if meta == "cleanup":
             self.peer_close(msg.get("public_key", from_pk))
             return
+        if meta == "beacon":
+            # sentinel check against OUR settled state: buffered
+            # updates land first, or a batching window would read as
+            # SV lag / a false digest mismatch
+            self.flush_incoming()
+            rec = get_recorder()
+            if rec.enabled:
+                rec.record(
+                    "beacon.recv", topic=self.topic,
+                    replica=self.router.public_key,
+                    peer=msg.get("public_key", from_pk),
+                    digest=msg.get("digest"),
+                )
+            self.sentinel.check(
+                msg.get("public_key", from_pk),
+                v1.decode_state_vector(msg["state_vector"]),
+                msg.get("digest", ""),
+                msg.get("ds_digest", ""),
+            )
+            return
         if meta == "ready":
             # answer with everything we hold: buffered updates must
             # land first or the diff would silently omit them
@@ -539,6 +628,13 @@ class Replica:
             requester = msg["public_key"]
             sv = v1.decode_state_vector(msg["state_vector"])
             diff = self.doc.encode_state_as_update(sv)
+            rec = get_recorder()
+            if rec.enabled:
+                rec.record(
+                    "sync.answer", topic=self.topic,
+                    replica=self.router.public_key, peer=requester,
+                    size=len(diff), digest=update_digest(diff),
+                )
             self._to_peer(
                 requester,
                 {
@@ -572,6 +668,9 @@ class Replica:
 
     def _apply_incoming(self, items) -> None:
         tracer = get_tracer()
+        rec = get_recorder()
+        obs_on = tracer.enabled or rec.enabled
+        t_apply = time.monotonic() if obs_on else 0.0
         updates = [u for u, _, _ in items]
         try:
             with tracer.span("replica.apply_update"):
@@ -590,12 +689,44 @@ class Replica:
             # is idempotent, so re-applying survivors is safe)
             if len(items) == 1:
                 tracer.count("replica.malformed_updates")
+                if rec.enabled:
+                    rec.record(
+                        "update.malformed", topic=self.topic,
+                        replica=self.router.public_key,
+                        peer=items[0][2], size=len(items[0][0]),
+                        digest=update_digest(items[0][0]),
+                    )
                 return
             for item in items:
                 self._apply_incoming([item])
             return
         if updates:
             self._reset_ae_backoff()  # remote activity: stay chatty
+        if obs_on:
+            # observability tail AFTER a successful merge (so the
+            # malformed-batch per-item retry above records each
+            # surviving item exactly once, and the disabled path
+            # pays nothing beyond the two attribute checks):
+            # propagation lag = origin stamp -> merge entry,
+            # convergence lag = origin stamp -> integrated here
+            t_done = time.monotonic()
+            for u, m, from_pk in items:
+                tid = m.get("tid")
+                if tracer.enabled and isinstance(tid, (list, tuple)) \
+                        and len(tid) == 3:
+                    t0 = float(tid[2])
+                    lag = t_apply - t0
+                    tracer.observe("replica.propagation_lag", lag)
+                    tracer.gauge("replica.propagation_lag_s", lag)
+                    clag = t_done - t0
+                    tracer.observe("replica.convergence_lag", clag)
+                    tracer.gauge("replica.convergence_lag_s", clag)
+                if rec.enabled:
+                    rec.record(
+                        "update.recv", topic=self.topic,
+                        replica=self.router.public_key, peer=from_pk,
+                        size=len(u), digest=update_digest(u), tid=tid,
+                    )
         for u in updates:
             tracer.count("replica.updates_applied")
             tracer.count("replica.bytes_received", len(u))

@@ -87,6 +87,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 from crdt_tpu.codec.lib0 import Decoder, Encoder
 from crdt_tpu.net.transport import SecureBox, UdpEndpoint, keypair
 from crdt_tpu.utils.backoff import jitter
+from crdt_tpu.obs.recorder import get_recorder
 from crdt_tpu.utils.trace import get_tracer
 
 _HELLO = 0
@@ -525,6 +526,12 @@ class UdpRouter:
         tracer = get_tracer()
         tracer.count("router.relay_sends")
         tracer.count("router.relay_send_bytes", len(frame))
+        rec = get_recorder()
+        if rec.enabled:
+            rec.record(
+                "relay.send", replica=self.public_key,
+                peer=peer.pk_hex, via=relay.pk_hex, size=len(frame),
+            )
         self._send_envelope(
             relay, {"t": "relay", "dst": peer.pk_hex, "f": frame}
         )
@@ -675,6 +682,12 @@ class UdpRouter:
                 d.attempts += 1
                 self.stats["dial_retries"] += 1
                 tracer.count("router.dial_retries")
+                rec = get_recorder()
+                if rec.enabled:
+                    rec.record(
+                        "dial.retry", replica=self.public_key, peer=pk,
+                        attempt=d.attempts,
+                    )
                 ip, port = d.addr
                 self._send_hello(ip, port, ack=False, unreliable=True)
                 if self._port_prediction and d.attempts >= self._predict_after:
@@ -778,6 +791,13 @@ class UdpRouter:
         try:
             payload = _unpack_any(peer.box.decrypt(sealed, aad=sender_raw))
         except ValueError:
+            get_tracer().count("router.envelopes_rejected")
+            rec = get_recorder()
+            if rec.enabled:
+                rec.record(
+                    "envelope.reject", replica=self.public_key,
+                    peer=pk_hex, size=len(sealed),
+                )
             return False  # forged or corrupted
         peer.last_seen = time.monotonic()
         return self._dispatch(peer, payload, addr, via=None)
@@ -944,6 +964,12 @@ class UdpRouter:
                 tracer = get_tracer()
                 tracer.count("router.relay_frames_forwarded")
                 tracer.count("router.relay_bytes_forwarded", len(frame))
+                rec = get_recorder()
+                if rec.enabled:
+                    rec.record(
+                        "relay.forward", replica=self.public_key,
+                        peer=dst_pk, src=pk_hex, size=len(frame),
+                    )
                 self._send_envelope(
                     dstp,
                     {"t": "relayed", "src": pk_hex, "f": bytes(frame)},

@@ -1,0 +1,54 @@
+"""Observability subsystem: tracer, flight recorder, sentinel, export.
+
+The framework's evidence layer (ROADMAP north star: converging 1k
+replicas x 100k ops needs to be *seen*, not just claimed):
+
+- :mod:`crdt_tpu.obs.tracer` — thread-safe phase tracer with
+  log-bucketed latency histograms (p50/p90/p99/max per span), labeled
+  counters and gauges. One process-global instance, disabled by
+  default; every hot-path hook is a single attribute check when off.
+- :mod:`crdt_tpu.obs.recorder` — bounded ring buffer of structured
+  sync events (monotonic ts, kind, replica/topic, update digest, byte
+  size), dumpable as JSONL on demand or automatically on divergence.
+- :mod:`crdt_tpu.obs.sentinel` — the divergence sentinel: periodic
+  snapshot-hash beacons riding the anti-entropy cadence turn silent
+  divergence (equal state vectors, unequal state) into an observable
+  event carrying a flight-recorder dump.
+- :mod:`crdt_tpu.obs.export` — Prometheus text-format exposition and
+  the JSON snapshot (the same schema as ``Tracer.report()``).
+- :mod:`crdt_tpu.obs.profiling` — ``jax_profile`` (device trace
+  capture that cannot leak a running profiler) and per-dispatch
+  ``device_annotation`` XProf annotations.
+
+See README "Observability" for the metric/span/event name registry.
+"""
+
+from crdt_tpu.obs.export import snapshot_json, to_prometheus
+from crdt_tpu.obs.profiling import device_annotation, jax_profile
+from crdt_tpu.obs.recorder import (
+    FlightRecorder,
+    get_recorder,
+    set_recorder,
+)
+from crdt_tpu.obs.sentinel import (
+    DivergenceSentinel,
+    delete_set_digest,
+    state_digest,
+)
+from crdt_tpu.obs.tracer import Tracer, get_tracer, set_tracer
+
+__all__ = [
+    "DivergenceSentinel",
+    "FlightRecorder",
+    "Tracer",
+    "delete_set_digest",
+    "device_annotation",
+    "get_recorder",
+    "get_tracer",
+    "jax_profile",
+    "set_recorder",
+    "set_tracer",
+    "snapshot_json",
+    "state_digest",
+    "to_prometheus",
+]

@@ -1,0 +1,92 @@
+"""Metrics export: Prometheus text exposition + JSON snapshot.
+
+Both read the ONE shared schema — ``Tracer.report()`` — so a scrape,
+a committed ``BENCH_OUT.json``, and an interactive ``report()`` all
+describe the same numbers with the same names:
+
+- counters  -> ``# TYPE <ns>_<name> counter`` (labels preserved:
+  a tracer key ``name{k="v"}`` exposes as-is after sanitization)
+- gauges    -> ``# TYPE <ns>_<name> gauge``
+- spans     -> ``# TYPE <ns>_<name>_seconds histogram`` with the
+  tracer's log-2 bucket edges as cumulative ``_bucket{le="..."}``
+  series plus ``_sum`` / ``_count``
+
+Metric names are sanitized to the Prometheus charset
+(``[a-zA-Z_:][a-zA-Z0-9_:]*``): every other character becomes ``_``,
+a leading digit gets a ``_`` prefix. Dots in span names (the
+``converge.dispatch`` registry convention) therefore export as
+``converge_dispatch``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, Optional, Tuple
+
+_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Sanitize one metric name to the Prometheus charset."""
+    out = _INVALID.sub("_", name)
+    if not out or out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _split_labels(key: str) -> Tuple[str, str]:
+    """``name{k="v"}`` -> (name, '{k="v"}'); plain names pass through."""
+    if key.endswith("}") and "{" in key:
+        name, _, rest = key.partition("{")
+        return name, "{" + rest
+    return key, ""
+
+
+def to_prometheus(report: Optional[Dict[str, Any]] = None,
+                  *, namespace: str = "crdt") -> str:
+    """Render a ``Tracer.report()`` dict (default: the process-global
+    tracer's) in Prometheus text exposition format 0.0.4."""
+    if report is None:
+        from crdt_tpu.obs.tracer import get_tracer
+
+        report = get_tracer().report()
+    ns = sanitize_metric_name(namespace)
+    lines = []
+    for section, mtype in (("counters", "counter"), ("gauges", "gauge")):
+        # ONE TYPE line per base metric name, all label sets grouped
+        # under it (a duplicate TYPE line is a fatal exposition parse
+        # error, and sorted report keys put label variants adjacent)
+        last_name = None
+        for key, value in report.get(section, {}).items():
+            raw, labels = _split_labels(key)
+            name = f"{ns}_{sanitize_metric_name(raw)}"
+            if name != last_name:
+                lines.append(f"# TYPE {name} {mtype}")
+                last_name = name
+            lines.append(f"{name}{labels} {value}")
+    for key, span in report.get("spans", {}).items():
+        name = f"{ns}_{sanitize_metric_name(key)}_seconds"
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        finite = {
+            le: n for le, n in span.get("buckets", {}).items()
+            if le != "+Inf"
+        }
+        for le in sorted(finite, key=float):
+            cum += finite[le]
+            lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {span["count"]}')
+        lines.append(f"{name}_sum {span['total_s']}")
+        lines.append(f"{name}_count {span['count']}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def snapshot_json(report: Optional[Dict[str, Any]] = None) -> str:
+    """The JSON snapshot: ``Tracer.report()`` serialized verbatim (the
+    same object ``bench.py`` embeds under ``"tracer"``)."""
+    if report is None:
+        from crdt_tpu.obs.tracer import get_tracer
+
+        report = get_tracer().report()
+    return json.dumps(report, sort_keys=True)

@@ -55,6 +55,8 @@ from crdt_tpu.ops.device import (
     searchsorted_ids,
 )
 from crdt_tpu.ops.lww import map_winners
+from crdt_tpu.obs.profiling import device_annotation
+from crdt_tpu.obs.tracer import get_tracer
 
 # host-side packing limits for the composite segment key:
 # (is_map:1 | pref:25 bits | kid:21 bits) must fit non-negative int64
@@ -282,6 +284,16 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
 
 def stage(cols: Dict[str, np.ndarray],
           put=None) -> Optional[PackedPlan]:
+    """Pack kernel columns into the single-transfer matrix (the
+    tracer's ``pack`` span — one per staged union/shard).
+
+    See :func:`_stage` for the layout contract."""
+    with get_tracer().span("pack"):
+        return _stage(cols, put)
+
+
+def _stage(cols: Dict[str, np.ndarray],
+           put=None) -> Optional[PackedPlan]:
     """Pack kernel columns into the single-transfer matrix.
 
     Returns None when the batch exceeds the packed path's bounds
@@ -907,7 +919,12 @@ def converge_async(plan: PackedPlan):
     point in the whole (stage -> upload -> dispatch) chain is the
     fetch."""
     args = _plan_args(plan)
-    with enable_x64(True):
+    # span = enqueue cost (the dispatch is async); the XProf
+    # annotation brackets the jitted call so device timelines
+    # attribute the fused kernel to the converge phase
+    with get_tracer().span("converge.dispatch"), \
+            device_annotation("crdt.converge.dispatch"), \
+            enable_x64(True):
         if plan.dev:
             out = _converge_rows(*plan.dev, **args)
         else:
@@ -917,9 +934,12 @@ def converge_async(plan: PackedPlan):
 
 def converge_fetch(handle) -> PackedResult:
     """Block on an in-flight :func:`converge_async` dispatch and
-    assemble its one packed fetch into caller row space."""
+    assemble its one packed fetch into caller row space (the tracer's
+    ``converge.fetch`` span: wait + transfer + assembly)."""
     plan, out = handle
-    return _assemble_result(plan, np.asarray(out))
+    with get_tracer().span("converge.fetch"), \
+            device_annotation("crdt.converge.fetch"):
+        return _assemble_result(plan, np.asarray(out))
 
 
 def converge(plan: PackedPlan,
@@ -971,6 +991,12 @@ def converge(plan: PackedPlan,
         t0 = _t.perf_counter()
         h = np.asarray(out)                                  # 1 fetch
         mark("fetch", t0)
+    # mirror the async seam's tracer spans so instrumented runs (the
+    # bench's per-phase detail path) still feed the same histograms
+    tracer = get_tracer()
+    if tracer.enabled:
+        tracer.observe("converge.dispatch", phases["dispatch"])
+        tracer.observe("converge.fetch", phases["fetch"])
     return _assemble_result(plan, h)
 
 
@@ -998,8 +1024,10 @@ def converge_host(plan: PackedPlan) -> PackedResult:
 
     args = _plan_args(plan)
     key = ("converge_host", plan.mat.shape, tuple(sorted(args.items())))
-    with on_local_cpu(cache_key=key), enable_x64(True):
+    with get_tracer().span("converge.dispatch"), \
+            on_local_cpu(cache_key=key), enable_x64(True):
         h = np.asarray(
             _converge_packed(jnp.asarray(plan.mat), **args)
         )
-    return _assemble_result(plan, h)
+    with get_tracer().span("converge.fetch"):
+        return _assemble_result(plan, h)

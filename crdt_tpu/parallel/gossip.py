@@ -212,15 +212,20 @@ def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
                       key_id, origin_client, origin_clock, valid)
         ]
 
-        # every replica merges the same union -> replicated converge
-        map_order, _, winners, winner_visible, _, _ = converge_maps(
-            *union, d_client, d_start, d_end, num_segments=num_segments
-        )
+        # every replica merges the same union -> replicated converge.
+        # named_scope (works under jit, unlike host-side trace
+        # annotations): XProf timelines attribute the fused kernels
+        with jax.named_scope("crdt.gossip.converge_maps"):
+            map_order, _, winners, winner_visible, _, _ = converge_maps(
+                *union, d_client, d_start, d_end,
+                num_segments=num_segments
+            )
         # ... and orders every sequence in the same union (the YATA
         # half of applyUpdate; same id-sort, XLA CSEs the shared work)
-        seq_order, seq_seg, seq_rank, seq_len = converge_sequences(
-            *union, num_segments=num_segments
-        )
+        with jax.named_scope("crdt.gossip.converge_sequences"):
+            seq_order, seq_seg, seq_rank, seq_len = converge_sequences(
+                *union, num_segments=num_segments
+            )
         return jnp.concatenate([
             x.reshape(-1).astype(jnp.int64)
             for x in (svs, global_sv, deficit, winners, winner_visible,
@@ -408,11 +413,20 @@ class GossipFaultPlan:
 
     def delivered_mask(self, round_idx: int, n_replicas: int) -> np.ndarray:
         """[R] bool: False = this replica's batch is lost this round."""
-        return np.array(
+        mask = np.array(
             [self._h("drop", round_idx, r) >= self.drop
              for r in range(n_replicas)],
             dtype=bool,
         )
+        from crdt_tpu.obs.recorder import get_recorder
+
+        rec = get_recorder()
+        if rec.enabled and not mask.all():
+            rec.record(
+                "gossip.drop", round=round_idx,
+                replicas=np.flatnonzero(~mask).tolist(),
+            )
+        return mask
 
     def partition_masks(self, round_idx: int,
                         n_replicas: int) -> Optional[list]:
@@ -426,6 +440,14 @@ class GossipFaultPlan:
             [int(self._h("part", round_idx, r) * self.groups)
              for r in range(n_replicas)]
         )
+        from crdt_tpu.obs.recorder import get_recorder
+
+        rec = get_recorder()
+        if rec.enabled:
+            rec.record(
+                "gossip.partition", round=round_idx,
+                groups=assign.tolist(),
+            )
         return [assign == g for g in range(self.groups)]
 
 

@@ -31,6 +31,7 @@ import json
 import time
 from typing import List, Optional
 
+from crdt_tpu.obs.tracer import get_tracer
 from crdt_tpu.storage.kv import Batch, KvLog
 
 
@@ -115,23 +116,27 @@ class LogPersistence:
 
             v1.decode_update(update)  # raises on malformed input
         kv = self._require()
-        seq = self._seq_for(doc_name)
-        batch = Batch()
-        batch.put(_update_key(doc_name, seq), update)
-        if sv is not None:
-            batch.put(_sv_key(doc_name), bytes(sv))
-        meta = self.get_meta(doc_name) or {"size": 0, "count": 0}
-        batch.put(
-            _meta_key(doc_name),
-            json.dumps(
-                {
-                    "last_updated": time.time(),
-                    "size": meta["size"] + len(update),
-                    "count": meta["count"] + 1,
-                }
-            ).encode(),
-        )
-        kv.write(batch)
+        tracer = get_tracer()
+        with tracer.span("persist"):
+            seq = self._seq_for(doc_name)
+            batch = Batch()
+            batch.put(_update_key(doc_name, seq), update)
+            if sv is not None:
+                batch.put(_sv_key(doc_name), bytes(sv))
+            meta = self.get_meta(doc_name) or {"size": 0, "count": 0}
+            batch.put(
+                _meta_key(doc_name),
+                json.dumps(
+                    {
+                        "last_updated": time.time(),
+                        "size": meta["size"] + len(update),
+                        "count": meta["count"] + 1,
+                    }
+                ).encode(),
+            )
+            kv.write(batch)
+        tracer.count("persist.appends")
+        tracer.count("persist.bytes_appended", len(update))
 
     def get_all_updates(self, doc_name: str) -> List[bytes]:
         return [v for _, v in self._require().scan_prefix(_update_prefix(doc_name))]
@@ -147,27 +152,31 @@ class LogPersistence:
         """Replace the doc's update log with one snapshot update, then
         drop dead log history from disk."""
         kv = self._require()
-        batch = Batch()
-        for k in kv.keys(_update_prefix(doc_name)):
-            batch.delete(k)
-        batch.put(_update_key(doc_name, 0), bytes(snapshot))
-        if sv is not None:
-            batch.put(_sv_key(doc_name), bytes(sv))
-        batch.put(
-            _meta_key(doc_name),
-            json.dumps(
-                {"last_updated": time.time(), "size": len(snapshot), "count": 1}
-            ).encode(),
-        )
-        kv.write(batch)
-        self._next_seq[doc_name] = 1
-        # reclaim disk only when dead history dominates: kv.compact()
-        # rewrites the WHOLE shared store, so an unconditional call
-        # would make N docs' auto-compaction O(store) each — amortize
-        # against live size instead (LevelDB's own trigger is
-        # similarly ratio-based)
-        if kv.log_size > 4 * max(kv.live_size, 1):
-            kv.compact()
+        tracer = get_tracer()
+        with tracer.span("persist.compact"):
+            batch = Batch()
+            for k in kv.keys(_update_prefix(doc_name)):
+                batch.delete(k)
+            batch.put(_update_key(doc_name, 0), bytes(snapshot))
+            if sv is not None:
+                batch.put(_sv_key(doc_name), bytes(sv))
+            batch.put(
+                _meta_key(doc_name),
+                json.dumps(
+                    {"last_updated": time.time(), "size": len(snapshot), "count": 1}
+                ).encode(),
+            )
+            kv.write(batch)
+            self._next_seq[doc_name] = 1
+            # reclaim disk only when dead history dominates: kv.compact()
+            # rewrites the WHOLE shared store, so an unconditional call
+            # would make N docs' auto-compaction O(store) each — amortize
+            # against live size instead (LevelDB's own trigger is
+            # similarly ratio-based)
+            if kv.log_size > 4 * max(kv.live_size, 1):
+                kv.compact()
+        tracer.count("persist.compactions")
+        tracer.gauge("persist.log_size_bytes", kv.log_size)
 
     # -- maintenance -------------------------------------------------------
     def sync(self) -> None:

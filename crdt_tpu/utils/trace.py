@@ -1,142 +1,43 @@
-"""Tracing / metrics for the merge and sync paths (SURVEY.md §5).
+"""Tracing / metrics — compatibility surface over :mod:`crdt_tpu.obs`.
 
-The reference has no observability beyond four console.log lines
-around sync (/root/reference/crdt.js:238,247,287,293). The rebuild's
-north-star metric is merges/sec and convergence wall-clock, so the
-framework carries a lightweight per-phase tracer:
+Historically this module WAS the tracer (an aggregating count/total/
+max phase timer, explicitly not thread-safe). The observability layer
+now lives in :mod:`crdt_tpu.obs`: a thread-safe tracer with
+log-bucketed latency histograms (p50/p90/p99 per span), the sync
+flight recorder, the divergence sentinel, and Prometheus/JSON export.
+Every existing import site (``from crdt_tpu.utils.trace import
+get_tracer`` ...) keeps working through this shim, and the public
+surface here is a strict superset of the old one:
 
-- ``Tracer.span(name)``   context-manager timer; aggregates count /
-  total / max per phase (decode, merge, encode, persist, compact, ...)
-- ``Tracer.count(name)``  monotonic counters (updates applied, bytes
-  broadcast, messages dropped, ...)
-- ``Tracer.gauge(name)``  last-value gauges (pending ops, log size)
-- ``report()``            one plain dict — JSON-ready
+- ``Tracer.span(name)`` — context-manager timer; aggregates count /
+  total / max / min and a latency histogram per phase
+- ``Tracer.count(name, n)`` / ``gauge(name, v)`` — counters, gauges
+- ``Tracer.counters(prefix)`` — filtered counter snapshot
+- ``report()`` — one plain dict, JSON-ready (old keys preserved;
+  adds ``min_s``/``p50_s``/``p90_s``/``p99_s``/``buckets`` per span)
 
-A process-global default tracer is DISABLED by default: every hook in
-the hot path is a single attribute check when off. Enable with
+The process-global default tracer is DISABLED by default: every hook
+in the hot path is a single attribute check when off. Enable with
 ``get_tracer().enabled = True`` (or install your own via
-:func:`set_tracer`).
+:func:`set_tracer`). Subclassers of the old Tracer: see MIGRATING in
+the README.
 
 For device-side profiling, :func:`jax_profile` wraps
 ``jax.profiler.trace`` so a convergence dispatch can be captured for
-TensorBoard/XProf without importing jax anywhere it isn't already.
+TensorBoard/XProf; it degrades with a clear error when jax has no
+profiler and never leaks a running profiler on failure
+(:mod:`crdt_tpu.obs.profiling`).
 """
 
 from __future__ import annotations
 
-import json
-import time
-from contextlib import contextmanager
-from typing import Any, Dict, Iterator, Optional
+from crdt_tpu.obs.profiling import device_annotation, jax_profile
+from crdt_tpu.obs.tracer import Tracer, get_tracer, set_tracer
 
-
-class _Span:
-    __slots__ = ("count", "total_s", "max_s")
-
-    def __init__(self):
-        self.count = 0
-        self.total_s = 0.0
-        self.max_s = 0.0
-
-    def add(self, dt: float) -> None:
-        self.count += 1
-        self.total_s += dt
-        if dt > self.max_s:
-            self.max_s = dt
-
-
-class Tracer:
-    """Aggregating phase timer + counters. Not thread-safe (the
-    framework's host side is single-threaded, poll-driven — same model
-    as the reference's node event loop)."""
-
-    def __init__(self, enabled: bool = False):
-        self.enabled = enabled
-        self._spans: Dict[str, _Span] = {}
-        self._counters: Dict[str, int] = {}
-        self._gauges: Dict[str, float] = {}
-
-    # -- phases ----------------------------------------------------------
-    @contextmanager
-    def span(self, name: str) -> Iterator[None]:
-        if not self.enabled:
-            yield
-            return
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self._spans.setdefault(name, _Span()).add(time.perf_counter() - t0)
-
-    # -- counters / gauges ----------------------------------------------
-    def count(self, name: str, n: int = 1) -> None:
-        if self.enabled:
-            self._counters[name] = self._counters.get(name, 0) + n
-
-    def gauge(self, name: str, value: float) -> None:
-        if self.enabled:
-            self._gauges[name] = value
-
-    def counters(self, prefix: str = "") -> Dict[str, int]:
-        """Counter snapshot, optionally filtered by name prefix —
-        e.g. ``counters("router.relay")`` for the relay path or
-        ``counters("replica.probe")`` for the retry schedule (the
-        partition-tolerance counters: ``router.dial_retries``,
-        ``router.predict_probes``, ``router.relay_*``,
-        ``replica.probe_retries``, ``replica.anti_entropy_rounds``)."""
-        return {
-            k: v for k, v in sorted(self._counters.items())
-            if k.startswith(prefix)
-        }
-
-    # -- reporting -------------------------------------------------------
-    def report(self) -> Dict[str, Any]:
-        return {
-            "spans": {
-                k: {
-                    "count": s.count,
-                    "total_s": s.total_s,
-                    "mean_s": s.total_s / s.count if s.count else 0.0,
-                    "max_s": s.max_s,
-                }
-                for k, s in sorted(self._spans.items())
-            },
-            "counters": dict(sorted(self._counters.items())),
-            "gauges": dict(sorted(self._gauges.items())),
-        }
-
-    def to_json(self) -> str:
-        return json.dumps(self.report())
-
-    def reset(self) -> None:
-        self._spans.clear()
-        self._counters.clear()
-        self._gauges.clear()
-
-
-_tracer = Tracer(enabled=False)
-
-
-def get_tracer() -> Tracer:
-    return _tracer
-
-
-def set_tracer(tracer: Tracer) -> Tracer:
-    global _tracer
-    _tracer = tracer
-    return tracer
-
-
-@contextmanager
-def jax_profile(log_dir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
-    """Capture a device trace (TensorBoard/XProf format) around a
-    block — e.g. one ``converge_maps`` dispatch or a fleet step."""
-    import jax
-
-    opts = jax.profiler.ProfileOptions()
-    opts.host_tracer_level = host_tracer_level
-    jax.profiler.start_trace(log_dir, profiler_options=opts)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+__all__ = [
+    "Tracer",
+    "device_annotation",
+    "get_tracer",
+    "jax_profile",
+    "set_tracer",
+]

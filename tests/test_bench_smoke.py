@@ -1,4 +1,4 @@
-"""Tier-1 guard over the bench pipeline accounting.
+"""Tier-1 guard over the bench pipeline accounting + observability.
 
 ``bench.py --smoke`` replays a tiny trace through all three contenders
 (numpy baseline, one-shot device pipeline, streaming executor) on the
@@ -6,6 +6,12 @@ CPU backend, asserts equality, and prints one JSON line with the
 per-phase + overlap accounting. Running it here catches accounting
 regressions — a phase silently re-serializing, a lane dropping out of
 the busy sum, the streamed path diverging — without a full scale run.
+
+The observability half: the smoke runs with tracing enabled and
+writes a BENCH_OUT-shaped artifact embedding the full tracer report,
+and this test asserts the DOCUMENTED hot-path spans (README
+"Observability" registry) are present with real p50/p99 data — so the
+instrumentation cannot silently rot out of the hot path.
 """
 
 import json
@@ -13,11 +19,22 @@ import os
 import subprocess
 import sys
 
+# the hot-path span registry tier-1 pins (README "Observability"):
+# any rename or dropped hook fails here, not in a future postmortem
+HOT_PATH_SPANS = (
+    "decode", "pack", "converge.dispatch", "converge.fetch",
+    "gather", "materialize", "compact", "persist", "persist.compact",
+)
 
-def test_bench_smoke_mode():
+
+def test_bench_smoke_mode(tmp_path):
+    art = tmp_path / "smoke_bench_out.json"
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial a tunnel
     env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SMOKE_OUT"] = str(art)
+    env["BENCH_TRACE"] = "1"  # pin: an exported BENCH_TRACE=0 must
+    #                           not turn this into a confusing failure
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py"), "--smoke"],
@@ -38,3 +55,18 @@ def test_bench_smoke_mode():
     # the serial contenders' phase dicts stay r05-shaped
     for key in ("decode", "pack", "converge", "materialize", "compact"):
         assert key in out["phases_device_s"], key
+
+    # the BENCH_OUT-shaped artifact embeds a non-empty tracer report
+    # with the documented hot-path spans (p50/p99 per span)
+    assert out.get("tracer_spans_ok") is True
+    full = json.loads(art.read_text())
+    report = full["tracer"]
+    assert report["spans"], "embedded tracer report is empty"
+    for name in HOT_PATH_SPANS:
+        span = report["spans"].get(name)
+        assert span is not None, f"hot-path span {name!r} missing"
+        assert span["count"] > 0
+        for k in ("p50_s", "p90_s", "p99_s", "max_s", "total_s"):
+            assert k in span, (name, k)
+        assert span["p50_s"] <= span["p99_s"] + 1e-12
+        assert span["p99_s"] <= span["max_s"] + 1e-12

@@ -1,0 +1,121 @@
+"""tools/metrics_diff.py: the BENCH_OUT regression gate, on two
+synthetic reports (the round-5 verdict's durable-evidence
+follow-through — committed artifacts must be diffable by one
+command)."""
+
+import copy
+import json
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools",
+    ),
+)
+from metrics_diff import compare, format_table, main  # noqa: E402
+
+OLD = {
+    "metric": "e2e_trace_replay_lww_yata",
+    "value": 100000,
+    "unit": "ops/s",
+    "vs_baseline": 2.0,
+    "dispatch_floor_ms": 30.0,
+    "phases_device_s": {"decode": 1.0, "converge": 2.0},
+    "scale_run": {"vs_baseline": 3.0, "stream_vs_oneshot": 1.5},
+    "tracer": {
+        "spans": {
+            "decode": {"p50_s": 0.10, "p99_s": 0.20, "total_s": 1.0},
+            "pack": {"p50_s": 0.05, "p99_s": 0.08, "total_s": 0.5},
+        }
+    },
+}
+
+
+def test_identical_reports_pass():
+    rows, regressed = compare(OLD, copy.deepcopy(OLD))
+    assert regressed == []
+    assert all(r["verdict"] == "ok" for r in rows)
+    assert format_table(rows)  # renders without crashing
+
+
+def test_regressions_detected_in_both_directions():
+    new = copy.deepcopy(OLD)
+    new["value"] = 50000               # ops/s halved: worse (higher=better)
+    new["dispatch_floor_ms"] = 60.0    # doubled: worse (lower=better)
+    new["tracer"]["spans"]["decode"]["p99_s"] = 0.9  # tail blowup
+    rows, regressed = compare(OLD, new, threshold=0.2)
+    assert "value" in regressed
+    assert "dispatch_floor_ms" in regressed
+    assert "tracer.decode.p99_s" in regressed
+    by_name = {r["metric"]: r for r in rows}
+    assert by_name["value"]["verdict"] == "REGRESSION"
+    assert by_name["value"]["delta_pct"] == -50.0
+
+
+def test_improvements_never_fail():
+    new = copy.deepcopy(OLD)
+    new["value"] = 400000
+    new["tracer"]["spans"]["decode"]["p99_s"] = 0.01
+    rows, regressed = compare(OLD, new)
+    assert regressed == []
+    by_name = {r["metric"]: r for r in rows}
+    assert by_name["value"]["verdict"] == "improved"
+
+
+def test_threshold_is_respected():
+    new = copy.deepcopy(OLD)
+    new["vs_baseline"] = 1.8  # -10%
+    _, regressed = compare(OLD, new, threshold=0.2)
+    assert regressed == []
+    _, regressed = compare(OLD, new, threshold=0.05)
+    assert "vs_baseline" in regressed
+
+
+def test_sub_noise_floor_timings_never_fail():
+    old = {"tracer": {"spans": {
+        "tiny": {"p50_s": 0.0005, "p99_s": 0.001, "total_s": 0.002},
+    }}}
+    new = {"tracer": {"spans": {
+        "tiny": {"p50_s": 0.002, "p99_s": 0.004, "total_s": 0.004},
+    }}}
+    rows, regressed = compare(old, new)
+    assert regressed == []  # 4x worse but under 5ms: scheduler noise
+    assert any(r["verdict"] == "noise" for r in rows)
+
+
+def test_ms_metrics_respect_noise_floor():
+    # the floor is denominated in seconds; a 0.3ms wobble on a 1ms
+    # metric is scheduler noise, a 30ms jump on a 30ms metric is not
+    rows, regressed = compare(
+        {"dispatch_floor_ms": 1.0}, {"dispatch_floor_ms": 1.3}
+    )
+    assert regressed == []
+    assert any(r["verdict"] == "noise" for r in rows)
+    _, regressed = compare(
+        {"dispatch_floor_ms": 30.0}, {"dispatch_floor_ms": 60.0}
+    )
+    assert "dispatch_floor_ms" in regressed
+
+
+def test_missing_sections_are_skipped():
+    rows, regressed = compare({"value": 1, "unit": "ops/s"}, {})
+    assert rows == [] and regressed == []
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    a, b = tmp_path / "old.json", tmp_path / "new.json"
+    a.write_text(json.dumps(OLD))
+    worse = copy.deepcopy(OLD)
+    worse["value"] = 10000
+    b.write_text(json.dumps(worse))
+    assert main([str(a), str(a)]) == 0
+    out = capsys.readouterr()
+    assert "no regressions" in out.out
+    assert main([str(a), str(b)]) == 1
+    out = capsys.readouterr()
+    assert "REGRESSION" in out.out
+    # a loose threshold lets the same pair pass
+    assert main([str(a), str(b), "--threshold", "0.95"]) == 0

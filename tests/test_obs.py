@@ -1,0 +1,511 @@
+"""The observability layer (crdt_tpu/obs): histogram math, tracer
+thread-safety, flight recorder, Prometheus exposition, divergence
+sentinel, trace-id propagation, jax_profile hardening."""
+
+import json
+import sys
+import threading
+import time
+
+import pytest
+
+from crdt_tpu.obs import (
+    DivergenceSentinel,
+    FlightRecorder,
+    Tracer,
+    get_recorder,
+    get_tracer,
+    set_recorder,
+    set_tracer,
+    snapshot_json,
+    state_digest,
+    to_prometheus,
+)
+from crdt_tpu.obs.tracer import BUCKET_EDGES_S, N_BUCKETS, bucket_index
+
+
+@pytest.fixture
+def installed():
+    """Enabled global tracer + recorder, restored afterwards."""
+    old_t, old_r = get_tracer(), get_recorder()
+    tr = set_tracer(Tracer(enabled=True))
+    rec = set_recorder(FlightRecorder(enabled=True))
+    try:
+        yield tr, rec
+    finally:
+        set_tracer(old_t)
+        set_recorder(old_r)
+
+
+# ---------------------------------------------------------------------------
+# histogram bucket math (the edges are a contract: Prometheus les)
+# ---------------------------------------------------------------------------
+
+
+class TestHistogramBuckets:
+    def test_edges_are_powers_of_two_microseconds(self):
+        assert BUCKET_EDGES_S[0] == 1e-6
+        for k in range(1, N_BUCKETS):
+            assert BUCKET_EDGES_S[k] == 2 * BUCKET_EDGES_S[k - 1]
+
+    def test_bucket_index_at_edges_is_upper_inclusive(self):
+        # an observation exactly AT an edge lands in that edge's bucket
+        for k in (0, 1, 5, 17, N_BUCKETS - 1):
+            assert bucket_index(BUCKET_EDGES_S[k]) == k
+        # just above an edge spills into the next bucket
+        for k in (0, 3, 20):
+            assert bucket_index(BUCKET_EDGES_S[k] * 1.0000001) == k + 1
+
+    def test_below_floor_and_overflow(self):
+        assert bucket_index(0.0) == 0
+        assert bucket_index(-1.0) == 0  # clock skew: clamp, not crash
+        assert bucket_index(5e-7) == 0
+        assert bucket_index(1e9) == N_BUCKETS  # +Inf bucket
+
+    def test_single_observation_quantiles_equal_max(self):
+        tr = Tracer(enabled=True)
+        tr.observe("x", 3e-6)  # inside (2e-6, 4e-6]: edge=4e-6 > max
+        s = tr.report()["spans"]["x"]
+        # the bucket-edge estimate is clamped to the observed max
+        assert s["p50_s"] == s["p99_s"] == s["max_s"] == 3e-6
+
+    def test_tail_separates_from_median(self):
+        tr = Tracer(enabled=True)
+        for _ in range(99):
+            tr.observe("x", 1e-3)
+        tr.observe("x", 1.0)
+        s = tr.report()["spans"]["x"]
+        assert s["count"] == 100
+        assert s["p50_s"] <= 2e-3       # median in the 1ms bucket
+        assert s["max_s"] == 1.0
+        assert s["p99_s"] <= 2e-3       # rank 99 of 100 still 1ms...
+        tr.observe("x", 1.0)            # ...until the tail thickens
+        tr.observe("x", 1.0)
+        s = tr.report()["spans"]["x"]
+        assert s["p99_s"] >= 0.5        # now p99 lives in the 1s bucket
+
+    def test_quantiles_are_monotone_and_bounded(self):
+        import random
+
+        rng = random.Random(7)
+        tr = Tracer(enabled=True)
+        for _ in range(500):
+            tr.observe("x", rng.uniform(1e-6, 0.1))
+        s = tr.report()["spans"]["x"]
+        assert s["min_s"] <= s["p50_s"] * 2  # bucket resolution slack
+        assert s["p50_s"] <= s["p90_s"] <= s["p99_s"] <= s["max_s"]
+
+    def test_report_keeps_legacy_schema(self):
+        tr = Tracer(enabled=True)
+        with tr.span("merge"):
+            pass
+        s = tr.report()["spans"]["merge"]
+        for k in ("count", "total_s", "mean_s", "max_s"):
+            assert k in s  # the pinned pre-obs surface
+        assert s["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# thread-safety: the satellite the old tracer failed
+# ---------------------------------------------------------------------------
+
+
+class TestTracerThreadSafety:
+    def test_concurrent_spans_and_counters_are_exact(self):
+        """8 threads hammer one tracer; totals must be EXACT. The old
+        tracer's unlocked read-modify-write dicts lost updates under
+        preemption (models/streaming.py decodes on a thread pool into
+        the process-global tracer), which this pins at a switch
+        interval tight enough to make the race near-certain."""
+        tr = Tracer(enabled=True)
+        threads, per = 8, 3000
+        old_interval = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)
+        try:
+            def work():
+                for _ in range(per):
+                    tr.count("ops")
+                    with tr.span("phase"):
+                        pass
+                    tr.observe("lag", 1e-5)
+
+            ts = [threading.Thread(target=work) for _ in range(threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        finally:
+            sys.setswitchinterval(old_interval)
+        rep = tr.report()
+        assert rep["counters"]["ops"] == threads * per
+        assert rep["spans"]["phase"]["count"] == threads * per
+        assert rep["spans"]["lag"]["count"] == threads * per
+        # histogram buckets must account for every observation too
+        assert sum(
+            rep["spans"]["lag"]["buckets"].values()
+        ) == threads * per
+
+    def test_streaming_decode_pool_records_spans(self, installed):
+        """The real seam: the chunked thread-pooled decode records
+        into the process-global tracer from pool threads."""
+        tr, _ = installed
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+
+        blobs = [
+            v1.encode_update(
+                [ItemRecord(client=c + 1, clock=k, parent_root="m",
+                            key=f"k{k}", content=k)
+                 for k in range(4)],
+                DeleteSet(),
+            )
+            for c in range(8)
+        ]
+        from crdt_tpu.models.streaming import _Phases, stream_decode
+
+        dec = stream_decode(blobs, chunk_blobs=2, ph=_Phases())
+        assert len(dec["client"]) > 0
+        spans = tr.report()["spans"]
+        assert spans["decode"]["count"] >= len(blobs) // 2
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_wraparound_keeps_newest(self):
+        fr = FlightRecorder(capacity=4, enabled=True)
+        for i in range(10):
+            fr.record("k", i=i)
+        assert len(fr) == 4
+        assert fr.recorded == 10
+        assert [e["i"] for e in fr.events()] == [6, 7, 8, 9]
+        # timestamps monotone oldest-first
+        ts = [e["ts"] for e in fr.events()]
+        assert ts == sorted(ts)
+
+    def test_jsonl_dump_roundtrips(self, tmp_path):
+        fr = FlightRecorder(capacity=8, enabled=True)
+        fr.record("update.send", topic="t", size=12, digest="aa")
+        fr.record("update.recv", topic="t", size=12, digest="aa")
+        path = tmp_path / "dump.jsonl"
+        text = fr.dump_jsonl(str(path))
+        assert path.read_text() == text
+        lines = [json.loads(ln) for ln in text.splitlines()]
+        assert [e["kind"] for e in lines] == ["update.send", "update.recv"]
+        assert all("ts" in e for e in lines)
+
+    def test_disabled_records_nothing(self):
+        fr = FlightRecorder(capacity=4, enabled=False)
+        fr.record("k")
+        assert len(fr) == 0 and fr.dump_jsonl() == ""
+
+    def test_kind_filter(self):
+        fr = FlightRecorder(enabled=True)
+        fr.record("a")
+        fr.record("b")
+        fr.record("a")
+        assert len(fr.events("a")) == 2
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+class TestPrometheusExport:
+    def test_types_and_name_sanitization(self):
+        tr = Tracer(enabled=True)
+        tr.count("router.relay-sends")       # dot + dash -> _
+        tr.gauge("9pending", 3)              # leading digit -> prefix
+        with tr.span("converge.dispatch"):
+            pass
+        text = to_prometheus(tr.report())
+        assert "# TYPE crdt_router_relay_sends counter" in text
+        assert "crdt_router_relay_sends 1" in text
+        assert "# TYPE crdt__9pending gauge" in text
+        assert (
+            "# TYPE crdt_converge_dispatch_seconds histogram" in text
+        )
+        assert "crdt_converge_dispatch_seconds_count 1" in text
+
+    def test_histogram_buckets_cumulative_with_inf(self):
+        tr = Tracer(enabled=True)
+        tr.observe("x", 1e-6)
+        tr.observe("x", 2e-6)
+        tr.observe("x", 2e-6)
+        tr.observe("x", 1e9)  # overflow
+        text = to_prometheus(tr.report())
+        assert 'crdt_x_seconds_bucket{le="1e-06"} 1' in text
+        assert 'crdt_x_seconds_bucket{le="2e-06"} 3' in text
+        assert 'crdt_x_seconds_bucket{le="+Inf"} 4' in text
+        assert "crdt_x_seconds_count 4" in text
+        # cumulative counts never decrease
+        counts = [
+            int(ln.rsplit(" ", 1)[1])
+            for ln in text.splitlines() if "_bucket{" in ln
+        ]
+        assert counts == sorted(counts)
+
+    def test_labeled_counters_pass_through(self):
+        tr = Tracer(enabled=True)
+        tr.count("bytes", 7, labels={"peer": "abc", "topic": "t"})
+        text = to_prometheus(tr.report())
+        assert 'crdt_bytes{peer="abc",topic="t"} 7' in text
+
+    def test_one_type_line_per_metric_across_label_sets(self):
+        # a duplicate TYPE line for one metric name is a fatal
+        # exposition parse error: label variants group under ONE
+        tr = Tracer(enabled=True)
+        tr.count("bytes", 1, labels={"peer": "a"})
+        tr.count("bytes", 2, labels={"peer": "b"})
+        tr.gauge("depth", 3, labels={"topic": "x"})
+        tr.gauge("depth", 4, labels={"topic": "y"})
+        text = to_prometheus(tr.report())
+        assert text.count("# TYPE crdt_bytes counter") == 1
+        assert text.count("# TYPE crdt_depth gauge") == 1
+        assert 'crdt_bytes{peer="a"} 1' in text
+        assert 'crdt_bytes{peer="b"} 2' in text
+
+    def test_json_snapshot_matches_report(self):
+        tr = Tracer(enabled=True)
+        tr.count("x")
+        assert json.loads(snapshot_json(tr.report())) == json.loads(
+            json.dumps(tr.report())
+        )
+
+
+# ---------------------------------------------------------------------------
+# jax_profile hardening
+# ---------------------------------------------------------------------------
+
+
+class TestJaxProfile:
+    def test_capture_works_on_cpu(self, tmp_path):
+        import jax.numpy as jnp
+
+        from crdt_tpu.utils.trace import jax_profile
+
+        with jax_profile(str(tmp_path)):
+            (jnp.arange(16) + 1).block_until_ready()
+
+    def test_body_failure_stops_profiler(self, tmp_path):
+        """A crash inside the block must stop the trace: the NEXT
+        capture would otherwise fail with 'profiler already running'
+        (the pre-obs bug class this satellite fixes)."""
+        import jax.numpy as jnp
+
+        from crdt_tpu.utils.trace import jax_profile
+
+        with pytest.raises(RuntimeError, match="boom"):
+            with jax_profile(str(tmp_path / "a")):
+                raise RuntimeError("boom")
+        with jax_profile(str(tmp_path / "b")):  # must not raise
+            (jnp.arange(4) * 2).block_until_ready()
+
+    def test_clear_error_without_profiler(self, monkeypatch):
+        import types
+
+        from crdt_tpu.utils.trace import jax_profile
+
+        monkeypatch.setitem(
+            sys.modules, "jax", types.SimpleNamespace()
+        )
+        with pytest.raises(RuntimeError, match="profiler unavailable"):
+            with jax_profile("/tmp/never"):
+                pass
+
+
+# ---------------------------------------------------------------------------
+# divergence sentinel + trace-id propagation (loopback fabric)
+# ---------------------------------------------------------------------------
+
+
+def _pair(net=None, **kw):
+    from crdt_tpu.net import LoopbackNetwork, LoopbackRouter, Replica
+
+    net = net or LoopbackNetwork()
+    r1 = Replica(LoopbackRouter(net, "a"), topic="t", client_id=1, **kw)
+    r2 = Replica(LoopbackRouter(net, "b"), topic="t", client_id=2, **kw)
+    net.run()
+    return net, r1, r2
+
+
+class TestDivergenceSentinel:
+    def test_silent_on_fault_free_run(self, installed):
+        net, r1, r2 = _pair()
+        for i in range(6):
+            (r1 if i % 2 else r2).set("kv", f"k{i}", i)
+        net.run()
+        assert dict(r1.c) == dict(r2.c)
+        r1.beacon()
+        r2.beacon()
+        net.run()
+        assert r1.sentinel.events == [] and r2.sentinel.events == []
+        tr, _ = installed
+        assert tr.counters()["sentinel.agree"] >= 2
+        # mutate again (invalidates the cached digest), re-beacon:
+        # still silent, still agreeing on the NEW state
+        r1.set("kv", "fresh", 99)
+        net.run()
+        r1.beacon()
+        r2.beacon()
+        net.run()
+        assert r1.sentinel.events == [] and r2.sentinel.events == []
+        assert tr.counters()["sentinel.agree"] >= 4
+
+    def test_fires_on_injected_state_fork(self, installed):
+        from crdt_tpu.net.faults import ForkFault
+
+        tr, rec = installed
+        net, r1, r2 = _pair()
+        r1.set("kv", "k", 1)
+        net.run()
+        assert dict(r1.c) == dict(r2.c)
+        # seeded fork: same id, different content, equal SVs — the
+        # sync protocol sees nothing; only the beacon can
+        fork = ForkFault(seed=3)
+        fork.inject([r1, r2])
+        assert r1.doc.state_vector() == r2.doc.state_vector()
+        assert dict(r1.c) != dict(r2.c)
+        r1.beacon()
+        net.run()
+        assert len(r2.sentinel.events) == 1
+        ev = r2.sentinel.events[0]
+        assert ev["kind"] == "divergence"
+        assert ev["peer"] == "a" and ev["topic"] == "t"
+        assert ev["local_digest"] != ev["peer_digest"]
+        # the event carries a flight-recorder dump with the fork in it
+        kinds = [
+            json.loads(ln)["kind"]
+            for ln in ev["flight_recorder"].splitlines()
+        ]
+        assert "fault.fork" in kinds
+        assert tr.counters()["sentinel.divergence"] == 1
+        # a permanent fork is raised ONCE per peer: later beacons of
+        # the same fork bump the counter but never re-event (no
+        # unbounded event/dump growth on a long-lived divergence)
+        r1.beacon()
+        net.run()
+        assert len(r2.sentinel.events) == 1
+        assert tr.counters()["sentinel.divergence"] == 2
+
+    def test_sv_lag_stays_silent(self, installed):
+        """Unequal SVs (ops in flight) are lag, not divergence."""
+        net, r1, r2 = _pair()
+        r1.set("kv", "k", 1)
+        # beacon BEFORE delivery: r2's SV is behind
+        r1.beacon()
+        net.run()
+        assert r2.sentinel.events == []
+        tr, _ = installed
+        assert tr.counters().get("sentinel.divergence", 0) == 0
+
+    def test_deterministic_fork_schedule(self):
+        from crdt_tpu.net.faults import ForkFault
+
+        a, b = ForkFault(seed=9), ForkFault(seed=9)
+        assert (a.client, a.key) == (b.client, b.key)
+        assert ForkFault(seed=10).client != a.client or \
+            ForkFault(seed=10).key != a.key
+
+
+class TestTraceIdPropagation:
+    def test_tid_rides_updates_and_measures_lag(self, installed):
+        tr, rec = installed
+        net, r1, r2 = _pair()
+        r1.set("kv", "k", 1)
+        r2.push("log", "e")
+        net.run()
+        assert dict(r1.c) == dict(r2.c)
+        sent = [tuple(e["tid"]) for e in rec.events("update.send")]
+        recv = [
+            tuple(e["tid"]) for e in rec.events("update.recv")
+            if e.get("tid")
+        ]
+        assert sent and set(sent) <= set(recv)
+        # tid = (client, seq, ts): origin client rides the stamp
+        clients = {t[0] for t in sent}
+        assert clients == {1, 2}
+        spans = tr.report()["spans"]
+        assert spans["replica.propagation_lag"]["count"] >= 2
+        assert spans["replica.convergence_lag"]["count"] >= 2
+        assert "replica.propagation_lag_s" in tr.report()["gauges"]
+
+    def test_anti_entropy_beacon_detects_fork_on_udp(self, installed):
+        """The acceptance pin: under a seeded fault schedule plus a
+        seeded state fork, the sentinel riding the REAL anti-entropy
+        cadence (UDP routers, chaos faults on the wire) raises a
+        divergence event carrying a flight-recorder dump; the
+        fault-free run stays silent."""
+        from crdt_tpu.net.faults import (
+            FaultSchedule, ForkFault, install_faults, pump_until,
+        )
+        from crdt_tpu.net.replica import Replica
+        from crdt_tpu.net.udp_router import UdpRouter
+
+        def run(forked):
+            routers = [UdpRouter() for _ in range(2)]
+            routers[1].add_peer(*routers[0].addr)
+            try:
+                pump_until(
+                    routers,
+                    lambda: all(len(r.peers) == 1 for r in routers),
+                    timeout_s=30.0,
+                )
+                reps = [
+                    Replica(r, topic="room", client_id=i + 1,
+                            probe_retry_s=0.05, anti_entropy_s=0.05)
+                    for i, r in enumerate(routers)
+                ]
+                pump_until(
+                    routers,
+                    lambda: all(
+                        len(r.peers_on("room")) == 1 for r in routers
+                    ),
+                    timeout_s=30.0,
+                )
+                if forked:
+                    # chaos on the wire + the fork fault itself
+                    for r in routers:
+                        install_faults(
+                            r, FaultSchedule(11, drop=0.05, delay=0.05)
+                        )
+                    ForkFault(seed=11).inject(reps)
+                reps[0].set("kv", "x", 1)
+                pump_until(
+                    routers,
+                    lambda: "kv" in reps[1].c,
+                    timeout_s=30.0,
+                )
+                if forked:
+                    pump_until(
+                        routers,
+                        lambda: any(r.sentinel.events for r in reps),
+                        timeout_s=30.0,
+                    )
+                    events = [
+                        e for r in reps for e in r.sentinel.events
+                    ]
+                    assert events[0]["kind"] == "divergence"
+                    assert events[0]["flight_recorder"]
+                else:
+                    # let several anti-entropy/beacon rounds fire
+                    deadline = time.monotonic() + 0.5
+                    while time.monotonic() < deadline:
+                        for r in routers:
+                            r.poll()
+                        time.sleep(0.002)
+                    assert all(not r.sentinel.events for r in reps)
+                    assert any(
+                        r.sentinel.beacons_checked > 0 for r in reps
+                    )
+            finally:
+                for r in routers:
+                    r.close()
+
+        run(forked=False)
+        run(forked=True)

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Compare two BENCH_OUT.json artifacts: regression table + exit code.
+
+The round-5 verdict's complaint was perf evidence living in session
+logs; PR 3's bench embeds the tracer report into the committed
+artifact, and THIS tool is the follow-through — a one-command answer
+to "did this change regress anything?", usable by hand or as a CI
+gate:
+
+    python tools/metrics_diff.py OLD.json NEW.json [--threshold 0.2]
+
+Compared (whatever of these both artifacts carry):
+
+- headline metrics: ``value`` (direction inferred from ``unit``),
+  ``vs_baseline``, ``vs_python_oracle``, ``kernel_dispatch_ops_per_s``
+  (higher = better), ``dispatch_floor_ms`` (lower = better);
+- scale/section digests: ``scale_run.vs_baseline``,
+  ``scale_run.stream_vs_oneshot``, ``scale_run.rounds.vs_cold_replay``;
+- tracer phase spans: per-span ``p50_s``/``p99_s``/``total_s`` from
+  the embedded ``tracer`` report (lower = better);
+- the serial contenders' ``phases_device_s`` entries (lower = better).
+
+Prints a table (one row per metric: old, new, delta, verdict) and
+exits non-zero when any metric regressed past ``--threshold``
+(relative; default 0.20 = 20%). Improvements never fail the gate.
+Tiny absolute timings (< --min-seconds, default 5ms) are reported but
+never fail: at that scale the delta is scheduler noise, not signal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+# (name, higher_is_better) — None direction means "infer from unit"
+HEADLINE_KEYS: Tuple[Tuple[str, Optional[bool]], ...] = (
+    ("value", None),
+    ("vs_baseline", True),
+    ("vs_python_oracle", True),
+    ("kernel_dispatch_ops_per_s", True),
+    ("dispatch_floor_ms", False),
+)
+SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
+    (("scale_run", "vs_baseline"), True),
+    (("scale_run", "stream_vs_oneshot"), True),
+    (("scale_run", "rounds", "vs_cold_replay"), True),
+)
+SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
+
+
+def _get_path(d: Dict[str, Any], path: Tuple[str, ...]) -> Any:
+    for p in path:
+        if not isinstance(d, dict) or p not in d:
+            return None
+        d = d[p]
+    return d
+
+
+def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
+                 ) -> Iterator[Tuple[str, float, float, bool, bool]]:
+    """Yield (name, old_value, new_value, higher_is_better,
+    is_seconds) for every comparable numeric metric present in BOTH
+    artifacts."""
+    for key, direction in HEADLINE_KEYS:
+        a, b = old.get(key), new.get(key)
+        if not _both_numbers(a, b):
+            continue
+        if direction is None:
+            # headline ``value``: a rate unit means higher is better,
+            # a time unit means lower
+            unit = str(new.get("unit", old.get("unit", "")))
+            direction = "/s" in unit or "ops" in unit
+        yield key, float(a), float(b), direction, key.endswith(
+            ("_s", "_ms")
+        )
+    for path, direction in SECTION_KEYS:
+        a, b = _get_path(old, path), _get_path(new, path)
+        if _both_numbers(a, b):
+            yield ".".join(path), float(a), float(b), direction, False
+    spans_old = (old.get("tracer") or {}).get("spans", {})
+    spans_new = (new.get("tracer") or {}).get("spans", {})
+    for name in sorted(set(spans_old) & set(spans_new)):
+        for field in SPAN_FIELDS:
+            a = spans_old[name].get(field)
+            b = spans_new[name].get(field)
+            if _both_numbers(a, b):
+                yield f"tracer.{name}.{field}", float(a), float(b), \
+                    False, True
+    ph_old = old.get("phases_device_s") or {}
+    ph_new = new.get("phases_device_s") or {}
+    for name in sorted(set(ph_old) & set(ph_new)):
+        a, b = ph_old[name], ph_new[name]
+        if _both_numbers(a, b):
+            yield f"phases_device_s.{name}", float(a), float(b), \
+                False, True
+
+
+def _both_numbers(a: Any, b: Any) -> bool:
+    return (
+        isinstance(a, (int, float)) and not isinstance(a, bool)
+        and isinstance(b, (int, float)) and not isinstance(b, bool)
+    )
+
+
+def compare(old: Dict[str, Any], new: Dict[str, Any], *,
+            threshold: float = 0.20, min_seconds: float = 0.005
+            ) -> Tuple[List[Dict[str, Any]], List[str]]:
+    """Build the regression table. Returns (rows, regressed_names)."""
+    rows: List[Dict[str, Any]] = []
+    regressed: List[str] = []
+    for name, a, b, hib, is_seconds in iter_metrics(old, new):
+        if a == 0:
+            delta = 0.0 if b == 0 else float("inf")
+        else:
+            delta = (b - a) / abs(a)
+        bad = (delta < -threshold) if hib else (delta > threshold)
+        # the noise floor is denominated in seconds; *_ms metrics
+        # scale down before the comparison
+        scale = 1e-3 if name.endswith("_ms") else 1.0
+        noise = is_seconds and max(abs(a), abs(b)) * scale < min_seconds
+        if bad and noise:
+            verdict = "noise"
+        elif bad:
+            verdict = "REGRESSION"
+            regressed.append(name)
+        elif (delta > threshold) if hib else (delta < -threshold):
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        rows.append({
+            "metric": name, "old": a, "new": b,
+            "delta_pct": round(delta * 100, 1), "verdict": verdict,
+        })
+    return rows, regressed
+
+
+def format_table(rows: List[Dict[str, Any]]) -> str:
+    if not rows:
+        return "(no comparable metrics found)"
+    w = max(len(r["metric"]) for r in rows)
+    lines = [
+        f"{'metric':<{w}}  {'old':>12}  {'new':>12}  {'delta':>8}  verdict"
+    ]
+    for r in rows:
+        lines.append(
+            f"{r['metric']:<{w}}  {r['old']:>12.6g}  {r['new']:>12.6g}"
+            f"  {r['delta_pct']:>+7.1f}%  {r['verdict']}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Regression-diff two BENCH_OUT.json artifacts"
+    )
+    ap.add_argument("old", help="baseline BENCH_OUT.json")
+    ap.add_argument("new", help="candidate BENCH_OUT.json")
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="relative regression threshold (default 0.20)")
+    ap.add_argument("--min-seconds", type=float, default=0.005,
+                    help="timings below this never fail (noise floor)")
+    args = ap.parse_args(argv)
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    rows, regressed = compare(
+        old, new, threshold=args.threshold, min_seconds=args.min_seconds
+    )
+    print(format_table(rows))
+    if regressed:
+        print(
+            f"\n{len(regressed)} metric(s) regressed past "
+            f"{args.threshold:.0%}: {', '.join(regressed)}",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"\nno regressions past {args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
