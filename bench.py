@@ -52,8 +52,16 @@ import json
 import os
 import sys
 import time
+import warnings
 
 import numpy as np
+
+# CPU-backend runs have no buffer donation; jax warns once per
+# compiled donated shape (the donation hint is deliberate — it pays
+# off on TPU). Keep the bench's stderr signal-only.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 
 def log(*a):
@@ -414,6 +422,28 @@ def numpy_gather(dec, ds, np_win, np_seg, np_rank):
 # ---------------------------------------------------------------------------
 
 
+def _xfer_counters():
+    """Snapshot of the unlabelled xfer.* counters (the byte-accounting
+    seam in crdt_tpu.ops.device); {} when tracing is off."""
+    from crdt_tpu.obs.tracer import get_tracer
+
+    tr = get_tracer()
+    if not tr.enabled:
+        return {}
+    return {
+        k: v for k, v in tr.counters("xfer.").items() if "{" not in k
+    }
+
+
+def _xfer_diff(before, after):
+    """Per-workload bytes-on-link: counter growth across one leg."""
+    return {
+        k.replace("xfer.", ""): after[k] - before.get(k, 0)
+        for k in after
+        if after[k] != before.get(k, 0)
+    }
+
+
 def min_time(fn, n):
     """(best_seconds, runs) for n timed calls of ``fn`` — the ONE
     min-of-N idiom every published headline uses, so both sides of
@@ -537,18 +567,18 @@ def run_device(blobs, phases):
     # revision overlapped it on a background thread for the device leg
     # only, which mixed a pipeline-structure advantage into the merge
     # comparison (advisor finding, round 2)
-    import jax
+    from crdt_tpu.ops.device import xfer_put
 
     dec = timed("decode", decode_stage, blobs)
     cols, ds = timed("columns", column_stage, dec)
     # above the eager-shipping threshold "pack" includes transfer
-    # INITIATION (async device_put per staged row) and "converge" the
-    # wait — the sum stays the honest total either way; below it a
+    # INITIATION (async accounted put per staged row) and "converge"
+    # the wait — the sum stays the honest total either way; below it a
     # single put inside converge is cheaper (fixed per-put latency)
     big = len(cols["client"]) >= packed.EAGER_PUT_MIN_ROWS
     plan = timed(
         "pack",
-        lambda c: packed.stage(c, put=jax.device_put if big else None),
+        lambda c: packed.stage(c, put=xfer_put if big else None),
         cols,
     )
     detail = {}
@@ -750,9 +780,11 @@ def smoke():
     t_np = time.perf_counter() - t0
 
     p_d: dict = {}
+    xfer_before = _xfer_counters()
     t0 = time.perf_counter()
     cache_dev, snap_dev, *_ = run_device(blobs, p_d)
     t_dev = time.perf_counter() - t0
+    xfer_dev = _xfer_diff(xfer_before, _xfer_counters())
 
     # force the full pipeline shape on the tiny trace: several decode
     # chunks, a real multi-shard converge/materialize pipeline
@@ -786,6 +818,7 @@ def smoke():
         "stream_phases_s": p_s,
         "phases_device_s": p_d,
         "phases_numpy_s": p_n,
+        "xfer": xfer_dev,
         "ok": True,
     }
     report = None
@@ -811,6 +844,22 @@ def smoke():
             assert sp and sp["count"] > 0, \
                 f"smoke: hot-path span {name!r} missing from tracer"
             assert "p50_s" in sp and "p99_s" in sp, name
+        # the byte-accounting seam (transfer diet): every staged
+        # upload and result fetch must land in the xfer.* registry
+        # with its matching latency histogram, or the diet's
+        # regression gate (tools/metrics_diff.py) reads nothing
+        for cname in ("xfer.h2d_bytes", "xfer.h2d_puts",
+                      "xfer.d2h_bytes", "xfer.d2h_fetches"):
+            assert report["counters"].get(cname, 0) > 0, \
+                f"smoke: {cname} missing from counter registry"
+        for sname in ("xfer.h2d", "xfer.d2h"):
+            sp = report["spans"].get(sname)
+            assert sp and sp["count"] > 0, \
+                f"smoke: {sname} histogram missing"
+        assert "xfer.narrowed_ratio" in report["gauges"], \
+            "smoke: xfer.narrowed_ratio gauge missing"
+        assert xfer_dev.get("h2d_bytes", 0) > 0, \
+            "smoke: device leg recorded no h2d bytes"
         out["tracer_spans_ok"] = True
     smoke_out = os.environ.get("BENCH_SMOKE_OUT")
     if smoke_out and report is not None:
@@ -898,17 +947,15 @@ def main():
         nsub = len(cols_w["client"]) // frac
         plan = _pk.stage({k: v[:nsub] for k, v in cols_w.items()})
         with enable_x64(True):
-            dev = jnp.asarray(plan.mat)
+            # undonated repeat-dispatch probe: the production converge
+            # entries donate their staged buffers (one plan, one
+            # dispatch), so the sweep needs its own entry to re-time
+            # the same device matrix
+            dev, sweep_fn = _pk.make_repeat_dispatch(plan)
             jax.block_until_ready(dev)
-            args = dict(num_segments=plan.num_segments,
-                        seq_bucket=plan.seq_bucket,
-                        rank_rounds=plan.rank_rounds,
-                        map_rounds=plan.map_rounds,
-                        client_bits=plan.client_bits)
-            sweep[nsub] = _b2b_ms(
-                lambda: _pk._converge_packed(dev, **args)) / 1e3
+            sweep[nsub] = _b2b_ms(lambda: sweep_fn(dev)) / 1e3
             if frac == 1:
-                null = jax.jit(lambda m: m[0, :1] + 1)
+                null = jax.jit(lambda m: m[0, :1].astype(jnp.int32) + 1)
                 null_floor_ms = _b2b_ms(lambda: null(dev))
     ns = sorted(sweep)
     log("fused-kernel dispatch sweep (8-deep b2b, sync mode): " + ", ".join(
@@ -918,17 +965,21 @@ def main():
 
     # ---- timed end-to-end runs ---------------------------------------
     t_dev = None
+    xfer_headline = None
     for _ in range(iters):
         phases_dev = {}
+        xfer_before = _xfer_counters()
         t0 = time.perf_counter()
         cache_dev, snap_dev, dec, ds, win_rows, win_vis, seq_orders = (
             run_device(blobs, phases_dev)
         )
         dt = time.perf_counter() - t0
+        xfer_after = _xfer_counters()
         if t_dev is None or dt < t_dev:
             t_dev, best_phases_dev = dt, phases_dev
+            xfer_headline = _xfer_diff(xfer_before, xfer_after)
     log(f"device e2e: {t_dev:.3f}s ({total / t_dev:,.0f} ops/s) "
-        f"phases={best_phases_dev}")
+        f"phases={best_phases_dev} xfer={xfer_headline}")
 
     t_np = None
     for _ in range(iters):
@@ -1508,14 +1559,17 @@ def main():
         # not one lucky session (VERDICT r3 item 1).
         runs_s, runs_n = [], []
         p_s, p_n = {}, {}
+        xfer_stream = None
         res_s = None
         for _ in range(2):
             ps = {}
+            xb = _xfer_counters()
             t0 = time.perf_counter()
             res_s = run_stream(blobs_l, ps)
             runs_s.append(round(time.perf_counter() - t0, 2))
             if not p_s or runs_s[-1] <= min(runs_s[:-1]):
                 p_s = ps
+                xfer_stream = _xfer_diff(xb, _xfer_counters())
             pn = {}
             t0 = time.perf_counter()
             cache_ln, _ = run_numpy(blobs_l, pn)
@@ -1526,13 +1580,16 @@ def main():
         # published overlap win never divides a single noisy run
         runs_one = []
         p_d = {}
+        xfer_oneshot = None
         for _ in range(2):
             pd = {}
+            xb = _xfer_counters()
             t0 = time.perf_counter()
             cache_l, snap_l, *_ = run_device(blobs_l, pd)
             runs_one.append(round(time.perf_counter() - t0, 2))
             if not p_d or runs_one[-1] <= min(runs_one[:-1]):
                 p_d = pd
+                xfer_oneshot = _xfer_diff(xb, _xfer_counters())
         t_oneshot = min(runs_one)
         t_dev_l, t_np_l = min(runs_s), min(runs_n)
         # the streamed path must be BIT-IDENTICAL to the one-shot
@@ -1558,6 +1615,10 @@ def main():
             "stream_vs_oneshot": round(t_oneshot / t_dev_l, 2),
             "overlap_efficiency": p_s.get("overlap_efficiency"),
             "wall_vs_phases": p_s.get("wall_vs_phases"),
+            # bytes-on-link per leg (the transfer-diet evidence; best
+            # run's xfer.* counter growth)
+            "xfer_stream": xfer_stream,
+            "xfer_oneshot": xfer_oneshot,
         }
         # the SERIAL pipeline's structural ceiling, kept for the
         # r05-comparable record: with every phase serialized,
@@ -1662,10 +1723,16 @@ def main():
                 inc.device_min_rows = 0        # force device
                 inc.apply(ds[3])               # flush host backlog
                 t_dev_r = float("inf")
+                xb = _xfer_counters()
                 for d in ds[4:6]:
                     t0 = time.perf_counter()
                     inc.apply(d)
                     t_dev_r = min(t_dev_r, time.perf_counter() - t0)
+                # per-round bytes-on-link: steady-state rounds must
+                # ship ~delta-sized uploads against the donated
+                # resident matrix, never the full doc
+                xd = _xfer_diff(xb, _xfer_counters())
+                h2d_round = xd.get("h2d_bytes", 0) // 2
                 inc.device_min_rows = default_min  # restore auto rule
                 scalar_s = None
                 if not skip_oracle:
@@ -1680,6 +1747,7 @@ def main():
                     "host_round_s": round(t_host, 3),
                     "device_round_s": round(t_dev_r, 3),
                     "scalar_round_s": scalar_s,
+                    "device_round_h2d_bytes": h2d_round,
                 }
                 if crossover is None and t_dev_r < t_host:
                     crossover = R_d * K_d
@@ -1756,6 +1824,10 @@ def main():
         "dispatch_floor_ms": round(null_floor_ms, 1),
         "phases_device_s": best_phases_dev,
         "phases_numpy_s": best_phases_np,
+        # headline bytes-on-link (best device run's xfer.* counter
+        # growth: h2d_bytes/d2h_bytes/h2d_bytes_saved; the transfer
+        # diet's regression-gated number — tools/metrics_diff.py)
+        "xfer": xfer_headline,
         "platform": platform,
         "platform_costs_ms": costs,
         "lazy_exec_probe_ms": lazy_probe,

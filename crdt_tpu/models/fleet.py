@@ -141,7 +141,6 @@ class ReplicaFleet:
         packed upload, one dispatch, one packed fetch — the tunnel
         pays three fixed interaction latencies per round, not ~20."""
         import jax
-        import jax.numpy as jnp
 
         from crdt_tpu.parallel.gossip import (
             fleet_out_sizes,
@@ -150,14 +149,19 @@ class ReplicaFleet:
             unpack_fleet_out,
         )
 
+        from crdt_tpu.ops.device import xfer_fetch, xfer_put
+
         tracer = get_tracer()
         with tracer.span("fleet.step"):
+            # one accounted upload per operand; the packed column
+            # block is DONATED to the step (gossip.py), so repeated
+            # rounds recycle the same device allocation
             out = self._step(
-                jnp.asarray(pack_cols(cols)),
-                jnp.asarray(pack_dels(dels)),
+                xfer_put(pack_cols(cols), label="fleet.cols"),
+                xfer_put(pack_dels(dels), label="fleet.dels"),
             )
             jax.block_until_ready(out)
-            vec = np.asarray(out)
+            vec = xfer_fetch(out, label="fleet.out")
         if tracer.enabled:  # the mask reduction isn't free at 100M ops
             tracer.count(
                 "fleet.ops_converged", int(np.asarray(cols["valid"]).sum())
@@ -188,21 +192,27 @@ class ReplicaFleet:
         Returns ``(svs, deficit, needed_count, delta_cols)`` where
         ``delta_cols`` is the gathered delta union as a column dict.
         """
-        import jax.numpy as jnp
-
         from crdt_tpu.parallel.delta import COL_NAMES, make_delta_gossip_step
 
         if len(self.mesh.axis_names) != 1:
             raise ValueError("delta rounds run on a 1D replica mesh")
+        from crdt_tpu.ops.device import xfer_fetch, xfer_put
+
         if self._delta_step is None or self._delta_budget != budget:
             self._delta_step = make_delta_gossip_step(
                 self.mesh, num_clients=self.num_clients, budget=budget
             )
             self._delta_budget = budget
-        out = self._delta_step(*(jnp.asarray(cols[k]) for k in COL_NAMES))
-        svs, deficit, needed = (np.asarray(x) for x in out[:3])
+        out = self._delta_step(*(
+            xfer_put(cols[k], label="fleet.delta_cols")
+            for k in COL_NAMES
+        ))
+        svs, deficit, needed = (
+            xfer_fetch(x, label="fleet.delta_out") for x in out[:3]
+        )
         delta_cols = {
-            name: np.asarray(col) for name, col in zip(COL_NAMES, out[3:])
+            name: xfer_fetch(col, label="fleet.delta_out")
+            for name, col in zip(COL_NAMES, out[3:])
         }
         return svs, deficit, needed, delta_cols
 
@@ -653,7 +663,6 @@ class SegmentedFleet:
         """One packed upload per operand, one dispatch, one packed
         fetch (the per-device blocks concatenate into one vector)."""
         import jax
-        import jax.numpy as jnp
 
         from crdt_tpu.parallel.gossip import (
             pack_cols,
@@ -683,18 +692,20 @@ class SegmentedFleet:
                 f"segments={self.num_segments})"
             )
 
+        from crdt_tpu.ops.device import xfer_fetch, xfer_put
+
         tracer = get_tracer()
         nd, N_d = sharded.row_map.shape
         R = self.n_replicas
         blk = -(-R // nd)
         with tracer.span("fleet.seg_step"):
             out = self._step(
-                jnp.asarray(pack_cols(sharded.cols)),
-                jnp.asarray(sharded.svs),
-                jnp.asarray(pack_dels(sharded.dels)),
+                xfer_put(pack_cols(sharded.cols), label="fleet.cols"),
+                xfer_put(sharded.svs, label="fleet.svs"),
+                xfer_put(pack_dels(sharded.dels), label="fleet.dels"),
             )
             jax.block_until_ready(out)
-            vec = np.asarray(out).reshape(nd, -1)
+            vec = xfer_fetch(out, label="fleet.out").reshape(nd, -1)
         sizes = segment_out_sizes(blk, R, N_d, self.num_segments)
         parts: Dict[str, np.ndarray] = {}
         off = 0

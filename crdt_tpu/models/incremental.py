@@ -1530,14 +1530,21 @@ class IncrementalReplay:
                 _octave(n_sel, floor=1 << 13),
                 self._mat.shape[1],
             )
+            from crdt_tpu.ops.device import xfer_fetch, xfer_put
+
             with enable_x64(True):
+                # the round's ONE upload: the delta block only — the
+                # resident matrix is donated in place, so steady-state
+                # bytes-on-link scale with the delta, never the doc
+                # (xfer.h2d_bytes pins this in tests)
                 self._mat, packed_out = pk._splice_select_converge(
-                    self._mat, jnp.asarray(delta),
+                    self._mat, xfer_put(delta, label="incremental.delta"),
                     jnp.int32(self.n_dev),
                     num_segments=tpad,
                     sel_bucket=sel_bucket, seq_bucket=sel_bucket,
                 )
-                h = np.asarray(packed_out)       # the round's ONE fetch
+                # the round's ONE fetch
+                h = xfer_fetch(packed_out, label="incremental.out")
             # advance by the REAL row count: the padded tail is
             # invalid and the next splice overwrites it, keeping
             # device positions identical to host row ids

@@ -82,9 +82,9 @@ def converge(cols: Dict[str, np.ndarray], *,
     # before this gate existed)
     put = None
     if len(cols["client"]) >= packed.EAGER_PUT_MIN_ROWS:
-        import jax
+        from crdt_tpu.ops.device import xfer_put
 
-        put = jax.device_put
+        put = xfer_put
     plan = packed.stage(cols, put=put)
     if plan is not None:
         return ("packed", packed.converge(plan))
@@ -582,7 +582,11 @@ def replay_trace(
         from crdt_tpu.ops import packed
 
         cols, ds = stage(dec)
-        plan = packed.stage(cols)
+        # wide staging: this route never touches the link (local CPU
+        # backend), so the narrow encode + widening prelude would be
+        # pure overhead AND would credit xfer.* savings for bytes
+        # that never cross anything
+        plan = packed.stage(cols, wide=True)
         if plan is not None:
             handle = ("packed", packed.converge_host(plan))
             win_rows, win_vis, seq_orders = gather(dec, ds, handle)

@@ -314,8 +314,6 @@ def stream_replay(
     on the device route (the packed path interns its own equivalent
     table). ``phases``, when given, receives per-stage busy seconds
     plus the overlap accounting of :func:`overlap_stats`."""
-    import jax
-
     from crdt_tpu.ops import packed
 
     t_wall0 = time.perf_counter()
@@ -366,11 +364,18 @@ def stream_replay(
                 # eager per-row shipping is gated on THIS shard's row
                 # count: a sub-threshold shard's extra per-put fixed
                 # latencies outweigh any staging/transfer overlap
-                # (same rationale as replay.converge's gate)
+                # (same rationale as replay.converge's gate). Uploads
+                # route through the xfer seam (byte accounting), and
+                # each shard's staged buffers are DONATED to its
+                # dispatch — the double-buffered queue then recycles
+                # the same device memory across stream shards instead
+                # of growing a fresh allocation per shard.
+                from crdt_tpu.ops.device import xfer_put
+
                 eager = len(rows_g) >= packed.EAGER_PUT_MIN_ROWS
                 plan = ph.timed(
                     "pack", packed.stage, sub,
-                    put=jax.device_put if eager else None,
+                    put=xfer_put if eager else None,
                 )
                 if plan is None:
                     q.put(("unstageable", None, None))

@@ -117,6 +117,108 @@ NULLI = -1
 _CLOCK_BITS = 40
 
 
+# ---------------------------------------------------------------------------
+# host<->device transfer seam: every staged upload and result fetch in
+# the package routes through these two calls, so bytes-on-link is a
+# first-class, regression-gated metric (``xfer.h2d_bytes`` /
+# ``xfer.d2h_bytes`` counters with matching ``xfer.h2d``/``xfer.d2h``
+# latency histograms) instead of a number reconstructed from shapes in
+# a session log. The tunnel's fixed per-interaction latency made every
+# perf round since r4 argue about exactly these bytes — now they are
+# measured where they move.
+# ---------------------------------------------------------------------------
+
+_WIDE_ENV = "CRDT_TPU_WIDE_STAGING"
+
+
+def wide_staging_forced() -> bool:
+    """Debug knob (README "Transfer diet"): CRDT_TPU_WIDE_STAGING=1
+    forces every staged upload to the wide int32 layout, bypassing the
+    narrow-column encodings — for isolating a suspected narrowing bug
+    without touching code."""
+    return os.environ.get(_WIDE_ENV, "") not in ("", "0")
+
+
+def xfer_put(arr, *, label: str = "stage"):
+    """The ONE host->device seam: ``jax.device_put`` + byte accounting.
+
+    Records ``xfer.h2d_bytes`` / ``xfer.h2d_puts`` (labelled by call
+    site) and observes the put's enqueue latency into the ``xfer.h2d``
+    histogram. The put itself stays ASYNCHRONOUS — the span measures
+    initiation, exactly what the overlapped paths pipeline behind."""
+    from crdt_tpu.obs.tracer import get_tracer
+
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return jax.device_put(arr)
+    import time as _t
+
+    nbytes = int(getattr(arr, "nbytes", 0))
+    t0 = _t.perf_counter()
+    out = jax.device_put(arr)
+    tracer.observe("xfer.h2d", _t.perf_counter() - t0)
+    tracer.count("xfer.h2d_bytes", nbytes)
+    tracer.count("xfer.h2d_puts")
+    tracer.count("xfer.h2d_bytes_by", nbytes, labels={"path": label})
+    return out
+
+
+def xfer_fetch(dev, *, label: str = "result"):
+    """The ONE device->host seam: ``np.asarray`` + byte accounting.
+
+    BLOCKS until the array is on host (that is the point of a fetch).
+    Execution wait is ALWAYS excluded from the ``xfer.d2h`` histogram
+    — the seam blocks on completion itself before timing the
+    transfer, so every call site's sample means the same thing (pure
+    D2H) and a kernel slowdown can never read as a transfer
+    regression in the byte gate."""
+    import numpy as np
+
+    from crdt_tpu.obs.tracer import get_tracer
+
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return np.asarray(dev)
+    import time as _t
+
+    jax.block_until_ready(dev)  # execution wait, not transfer
+    t0 = _t.perf_counter()
+    h = np.asarray(dev)
+    tracer.observe("xfer.d2h", _t.perf_counter() - t0)
+    tracer.count("xfer.d2h_bytes", int(h.nbytes))
+    tracer.count("xfer.d2h_fetches")
+    tracer.count("xfer.d2h_bytes_by", int(h.nbytes),
+                 labels={"path": label})
+    return h
+
+
+def record_staged_widths(widths: dict, shipped_bytes: int,
+                         wide_bytes: int) -> None:
+    """Per-upload narrowing record: one ``xfer.col_width`` count per
+    column at its chosen width (the per-column width histogram) and
+    the ``xfer.narrowed_ratio`` gauge = shipped / wide-equivalent
+    bytes (1.0 = no diet, 0.5 = halved)."""
+    from crdt_tpu.obs.tracer import get_tracer
+
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return
+    for col, bits in widths.items():
+        tracer.count("xfer.col_width", labels={"col": col, "bits": bits})
+    if wide_bytes > 0:
+        tracer.gauge(
+            "xfer.narrowed_ratio", round(shipped_bytes / wide_bytes, 4)
+        )
+        # staged-upload bytes tracked SEPARATELY from the all-traffic
+        # xfer.h2d_bytes: the run-level narrowing ratio is
+        # staged / (staged + saved), and mixing in non-staged traffic
+        # (fleet columns, resident deltas) would let an unrelated
+        # upload-mix change masquerade as a narrowing regression
+        tracer.count("xfer.staged_bytes", shipped_bytes)
+        tracer.count("xfer.h2d_bytes_saved",
+                     max(wide_bytes - shipped_bytes, 0))
+
+
 # shapes whose local-CPU executable already exists in-process (the
 # persistent-cache suppression below is only needed around a fresh
 # compile)
@@ -240,15 +342,13 @@ def fetch_packed_i32(*arrays):
     tunnelled platforms; all kernel index/segment outputs fit int32
     (values < the pad bucket, NULLI = -1). Returns host arrays in
     input order."""
-    import numpy as np
-
     fn = _pack_fns.get(len(arrays))
     if fn is None:
         fn = jax.jit(
             lambda *xs: jnp.concatenate([x.astype(jnp.int32) for x in xs])
         )
         _pack_fns[len(arrays)] = fn
-    h = np.asarray(fn(*arrays))
+    h = xfer_fetch(fn(*arrays), label="packed_i32")
     out, off = [], 0
     for a in arrays:
         n = a.shape[0]

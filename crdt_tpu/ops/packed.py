@@ -50,9 +50,13 @@ from crdt_tpu.ops.device import (
     dfs_ranks,
     lexsort,
     pack_id,
+    record_staged_widths,
     run_edge_lookup,
     scatter_perm,
     searchsorted_ids,
+    wide_staging_forced,
+    xfer_fetch,
+    xfer_put,
 )
 from crdt_tpu.ops.lww import map_winners
 from crdt_tpu.obs.profiling import device_annotation
@@ -83,6 +87,134 @@ _RIGHT_WALK_CAP = 1024
 EAGER_PUT_MIN_ROWS = 1 << 19
 
 
+# ---------------------------------------------------------------------------
+# narrow-column staging: the transfer diet (round 9)
+#
+# The staged upload is pure LAYOUT data — dense ranks, segment numbers,
+# row references — whose values are tiny compared to their int32 slots
+# for every real workload (the headline 100k-op trace tops out at ~12k
+# segments and 1k clients). Each row gets a frame-of-reference/delta
+# encoding into int16, HALVING bytes-on-link, with a fused widening
+# prelude inside the one-dispatch converge program that reconstructs
+# the exact int32 values — kernel semantics and outputs stay
+# byte-identical (differential-tested in tests/test_transfer_diet.py).
+# A row whose values do not fit falls back automatically: the matrix
+# path keeps the int16 dtype and ships that column as two exact hi/lo
+# rows (see below), the eager path ships that array wide int32.
+# CRDT_TPU_WIDE_STAGING=1 forces wide everywhere (README "Transfer
+# diet").
+#
+# Encodings (host encoder and device decoder kept adjacent; each pair
+# must be an exact inverse):
+#   client     : identity (values are dense ranks / group ranks >= 0)
+#   seg        : map seg -> seg; seq seg -> -(seg+2); dead -> -1
+#                (the _SEQ_FLAG bit folded into the sign)
+#   origin     : -1 -> 0; else (row_index - origin_row), biased to the
+#                chain-local distance (same-client chains sit adjacent
+#                in id-sorted order)
+#   seq rows   : strictly-ascending prefix delta-coded (w0 = s0 + 1,
+#                wj = sj - s(j-1), all >= 1); padding -> 0
+#   seq parent : -1 -> 0; else (compact_index - parent_index)
+#
+# A matrix column whose range does NOT fit one int16 row ships as TWO
+# int16 hi/lo rows instead (any int32 splits exactly), so one
+# overflowing column — e.g. the segment row past 32k segments on the
+# scale run's stream shards — costs 6/10 of the wide bytes instead of
+# collapsing the whole upload back to int32.
+# ---------------------------------------------------------------------------
+
+_I16_MIN = -(1 << 15)
+_I16_MAX = (1 << 15) - 1
+
+
+def _narrow_client(r0: np.ndarray):
+    """int16 client-rank row, or None when a rank overflows."""
+    if len(r0) and int(r0.max()) > _I16_MAX:
+        return None
+    return r0.astype(np.int16)
+
+
+def _narrow_seg(r1: np.ndarray, n_segs: int):
+    """int16 segment row with the seq flag folded into the sign, or
+    None when the segment count overflows the narrow space."""
+    if n_segs > _I16_MAX:
+        return None
+    seq = (r1 >= 0) & ((r1 & _SEQ_FLAG) != 0)
+    seg = r1 & (_SEQ_FLAG - 1)
+    out = np.where(r1 < 0, -1, np.where(seq, -(seg + 2), seg))
+    return out.astype(np.int16)
+
+
+def _narrow_delta_ref(vals: np.ndarray):
+    """int16 (index - reference) encoding of a row-reference column
+    (-1 = no reference -> 0), or None when a delta overflows int16 or
+    collides with the no-reference sentinel (a self-referential row —
+    hostile input — forces the wide layout, never a wrong decode)."""
+    idx = np.arange(len(vals), dtype=np.int64)
+    live = vals >= 0
+    d = np.where(live, idx - vals, 0)
+    if live.any():
+        bad = live & ((d == 0) | (d < _I16_MIN) | (d > _I16_MAX))
+        if bad.any():
+            return None
+    return d.astype(np.int16)
+
+
+def _narrow_ascending(rows: np.ndarray):
+    """int16 delta code of a strictly-ascending valid PREFIX (-1
+    padding tail), or None when a gap overflows int16."""
+    w = np.zeros(len(rows), np.int64)
+    m = rows >= 0
+    if m.any():
+        pref = rows[m]
+        w[: len(pref)] = np.diff(pref, prepend=-1)
+    if len(w) and int(w.max()) > _I16_MAX:
+        return None
+    return w.astype(np.int16)
+
+
+def _split_hi_lo(row: np.ndarray):
+    """Any int32 row as TWO exact int16 rows: hi = arithmetic >> 16,
+    lo = low 16 bits biased into int16 range. Always feasible — the
+    matrix path's escape for a column whose values overflow one
+    narrow row."""
+    v = row.astype(np.int32)
+    hi = (v >> 16).astype(np.int16)
+    lo = ((v & 0xFFFF) - 0x8000).astype(np.int16)
+    return hi, lo
+
+
+def _join_hi_lo(hi, lo):
+    """Device inverse of :func:`_split_hi_lo`."""
+    return (
+        (hi.astype(jnp.int32) << 16)
+        | ((lo.astype(jnp.int32) + 0x8000) & 0xFFFF)
+    )
+
+
+def _widen_client(v):
+    return v.astype(jnp.int32)
+
+
+def _widen_seg(v):
+    v = v.astype(jnp.int32)
+    return jnp.where(
+        v >= 0, v, jnp.where(v == NULLI, NULLI, (-v - 2) | _SEQ_FLAG)
+    )
+
+
+def _widen_delta_ref(v):
+    v = v.astype(jnp.int32)
+    idx = jnp.arange(v.shape[0], dtype=jnp.int32)
+    return jnp.where(v == 0, NULLI, idx - v)
+
+
+def _widen_ascending(v):
+    v = v.astype(jnp.int32)
+    c = jnp.cumsum(v)
+    return jnp.where(v > 0, c - 1, NULLI)
+
+
 class PackedPlan(NamedTuple):
     """Host-side staging result: one matrix + static metadata.
 
@@ -97,14 +229,17 @@ class PackedPlan(NamedTuple):
     time and drop the matrix from 7 to 5 rows (one int32 transfer).
     """
 
-    mat: Optional[np.ndarray]  # [5, kpad] int32, rows in id-sorted order:
+    mat: Optional[np.ndarray]  # [5, kpad], rows in id-sorted order:
                               #   0: dense client rank
                               #   1: dense segment id | _SEQ_FLAG (-1 dead)
                               #   2: origin row (map rows; -1 root)
                               #   3: compact block - seq row ids (-1 pad)
                               #   4: compact block - compact parent (-1 root)
-                              # None when rows were shipped eagerly via
-                              # ``stage(put=...)`` — see ``dev``
+                              # int32 wide, or int16 narrow-encoded
+                              # (``narrow`` below; the fused widening
+                              # prelude reconstructs the wide values on
+                              # device). None when rows were shipped
+                              # eagerly via ``stage(put=...)`` — ``dev``
     n: int                    # real rows (rest is padding)
     num_segments: int         # size bucket over distinct segments
     seq_bucket: int           # size bucket over sequence-row count
@@ -121,6 +256,20 @@ class PackedPlan(NamedTuple):
                               # r0/r1/r2 are [kpad], r34 is [2, B] (the
                               # compact sequence block never needs the
                               # full row width on the wire)
+    staged_widths: tuple = () # ((col, bits), ...) chosen per column —
+                              # recorded into the xfer registry at the
+                              # plan's actual UPLOAD (matrix path), so
+                              # plans that never cross the link (host
+                              # route, repeat-dispatch probes) leave
+                              # no phantom width/savings entries
+    narrow: bool = False      # matrix path: mat is the int16 layout
+    narrow_cols: tuple = ()   # matrix path row map (one bool per
+                              # column): True = one delta-encoded row,
+                              # False = two exact hi/lo rows — static
+                              # dispatch arg
+    dev_narrow: tuple = (False, False, False, False)
+                              # eager path: per-array narrow flags for
+                              # (r0, r1, r2, r34) — static dispatch args
 
 
 def _even_up(x: int) -> int:
@@ -283,17 +432,17 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
 
 
 def stage(cols: Dict[str, np.ndarray],
-          put=None) -> Optional[PackedPlan]:
+          put=None, wide: Optional[bool] = None) -> Optional[PackedPlan]:
     """Pack kernel columns into the single-transfer matrix (the
     tracer's ``pack`` span — one per staged union/shard).
 
     See :func:`_stage` for the layout contract."""
     with get_tracer().span("pack"):
-        return _stage(cols, put)
+        return _stage(cols, put, wide)
 
 
 def _stage(cols: Dict[str, np.ndarray],
-           put=None) -> Optional[PackedPlan]:
+           put=None, wide: Optional[bool] = None) -> Optional[PackedPlan]:
     """Pack kernel columns into the single-transfer matrix.
 
     Returns None when the batch exceeds the packed path's bounds
@@ -302,15 +451,26 @@ def _stage(cols: Dict[str, np.ndarray],
     ``pack_id`` bound), >=2^30 segments, or composite sibling keys
     that do not fit an int64 at this row count.
 
-    ``put`` (e.g. ``jax.device_put``) switches staging to EAGER row
-    shipping: each packed row starts its (async) host->device transfer
-    the moment its layout pass finishes, so the upload overlaps the
-    remaining staging work instead of serializing after it — on the
-    tunnelled platform that hides most of one of the two costs. The
-    compact sequence block also ships at its own bucket width (B, not
-    kpad), cutting the transfer by up to a third. The plan then has
-    ``mat=None`` and device refs in ``dev``.
+    ``put`` (e.g. :func:`crdt_tpu.ops.device.xfer_put`) switches
+    staging to EAGER row shipping: each packed row starts its (async)
+    host->device transfer the moment its layout pass finishes, so the
+    upload overlaps the remaining staging work instead of serializing
+    after it — on the tunnelled platform that hides most of one of the
+    two costs. The compact sequence block also ships at its own bucket
+    width (B, not kpad), cutting the transfer by up to a third. The
+    plan then has ``mat=None`` and device refs in ``dev``.
+
+    ``wide`` (None = the CRDT_TPU_WIDE_STAGING env default) disables
+    the narrow-column encodings: every row ships at its int32 width.
+    The default NARROW path halves the staged bytes whenever every
+    column's range fits (see the module's transfer-diet block); a
+    column that does not fit falls back automatically (hi/lo int16
+    row pair on the matrix path, wide int32 array on the eager path)
+    and the chosen widths are recorded per upload
+    (:func:`crdt_tpu.ops.device.record_staged_widths`).
     """
+    if wide is None:
+        wide = wide_staging_forced()
     client = np.asarray(cols["client"], np.int64)
     clock = np.asarray(cols["clock"], np.int64)
     pir = np.asarray(cols["parent_is_root"], bool)
@@ -426,7 +586,11 @@ def _stage(cols: Dict[str, np.ndarray],
     r1[:n] = np.where(
         seg >= 0, seg | np.where(kid_s < 0, _SEQ_FLAG, 0), -1
     )
-    d1 = put(r1) if eager else None
+    s1 = d1 = None
+    if put is not None:  # matrix staging encodes from mat rows instead
+        s1 = None if wide else _narrow_seg(r1, n_segs)
+        if eager:
+            d1 = put(s1 if s1 is not None else r1)
 
     # origin rows by binary search over the sorted ids (leftmost match
     # is the kept representative of any duplicate run)
@@ -443,8 +607,9 @@ def _stage(cols: Dict[str, np.ndarray],
     if put is not None:
         r2 = np.full(kpad, -1, np.int32)
         r2[:n] = origin_map
+        s2 = None if wide else _narrow_delta_ref(r2)
         if eager:
-            d2 = put(r2)
+            d2 = put(s2 if s2 is not None else r2)
 
     # compact sequence block: seq rows ascending (= id rank ascending),
     # same-segment origins resolved to compact positions
@@ -465,8 +630,15 @@ def _stage(cols: Dict[str, np.ndarray],
         r34 = np.full((2, B), -1, np.int32)
         r34[0, :n_seq] = seq_rows
         r34[1, :n_seq] = c_parent
+        s34 = None
+        w3 = w4 = None
+        if not wide:
+            w3 = _narrow_ascending(r34[0])
+            w4 = _narrow_delta_ref(r34[1])
+            if w3 is not None and w4 is not None:
+                s34 = np.stack([w3, w4])
         if eager:
-            d34 = put(r34)
+            d34 = put(s34 if s34 is not None else r34)
 
     # right-origin attachment ordering (mid-inserts/prepends): groups
     # with in-group anchors get their exact conflict-scan ranks
@@ -493,16 +665,44 @@ def _stage(cols: Dict[str, np.ndarray],
     if pbits + cbits + qbits > 63:
         return None
 
+    narrow = False
+    narrow_cols = ()
+    dev_narrow = (False, False, False, False)
     if put is not None:
         if not eager:  # width-deferred stages ship now, post-check
-            d1 = put(r1)
-            d2 = put(r2)
-            d34 = put(r34)
+            d1 = put(s1 if s1 is not None else r1)
+            d2 = put(s2 if s2 is not None else r2)
+            d34 = put(s34 if s34 is not None else r34)
         r0 = np.zeros(kpad, np.int32)
         r0[:n] = client_s
-        d0 = put(r0)
+        s0 = None if wide else _narrow_client(r0)
+        d0 = put(s0 if s0 is not None else r0)
         mat = None
         dev = (d0, d1, d2, d34)
+        dev_narrow = (
+            s0 is not None, s1 is not None, s2 is not None,
+            s34 is not None,
+        )
+        widths = {
+            "client": 16 if s0 is not None else 32,
+            "seg": 16 if s1 is not None else 32,
+            "origin": 16 if s2 is not None else 32,
+            # the r34 block ships as ONE array: when either half's
+            # encoding refuses, BOTH rows go wide — record what
+            # actually crossed the wire, not what could have
+            "seq_rows": 16 if s34 is not None else 32,
+            "seq_parent": 16 if s34 is not None else 32,
+        }
+        staged_widths = tuple(sorted(widths.items()))
+        # eager puts ARE the upload: record here, at the seam's moment
+        record_staged_widths(
+            widths,
+            sum(
+                (s if s is not None else r).nbytes
+                for s, r in ((s0, r0), (s1, r1), (s2, r2), (s34, r34))
+            ),
+            (3 * kpad + 2 * B) * 4,
+        )
     else:
         mat = np.full((5, kpad), -1, np.int32)
         mat[0, :] = 0
@@ -512,6 +712,44 @@ def _stage(cols: Dict[str, np.ndarray],
         mat[3, :n_seq] = seq_rows
         mat[4, :n_seq] = c_parent
         dev = ()
+        if not wide:
+            # ONE upload means one dtype: the matrix always ships
+            # int16, with each column taking one delta-encoded row
+            # when its range fits, or two exact hi/lo rows when it
+            # does not (a >32k-segment shard costs 6/10 of wide, not
+            # a collapse back to int32)
+            encs = (
+                _narrow_client(mat[0]),
+                _narrow_seg(mat[1], n_segs),
+                _narrow_delta_ref(mat[2]),
+                _narrow_ascending(mat[3]),
+                _narrow_delta_ref(mat[4]),
+            )
+            widths = {
+                c: (16 if e is not None else 32)
+                for c, e in zip(
+                    ("client", "seg", "origin", "seq_rows",
+                     "seq_parent"), encs
+                )
+            }
+            rows16 = []
+            for e, wide_row in zip(encs, mat):
+                if e is not None:
+                    rows16.append(e)
+                else:
+                    rows16.extend(_split_hi_lo(wide_row))
+            mat = np.stack(rows16)
+            narrow = True
+            narrow_cols = tuple(e is not None for e in encs)
+        else:
+            widths = {
+                c: 32 for c in ("client", "seg", "origin", "seq_rows",
+                                "seq_parent")
+            }
+        # NOT recorded here: a matrix plan may never cross the link
+        # (converge_host, make_repeat_dispatch) — the width/savings
+        # record fires at the plan's actual upload instead
+        staged_widths = tuple(sorted(widths.items()))
     return PackedPlan(
         mat=mat,
         dev=dev,
@@ -524,6 +762,10 @@ def _stage(cols: Dict[str, np.ndarray],
         rank_rounds=_even_up((max_seq + 2).bit_length() + 1),
         map_rounds=_even_up((max_map + 2).bit_length() + 1),
         hard_rows=tuple(hard_rep_rows),
+        narrow=narrow,
+        narrow_cols=narrow_cols,
+        dev_narrow=dev_narrow,
+        staged_widths=staged_widths,
     )
 
 
@@ -584,18 +826,82 @@ def _converge_packed_body(client, segf, origin_map, sub, cp,
     return jnp.concatenate([win_rows, seg_counts, stream_row])
 
 
+_WIDEN_FNS = (_widen_client, _widen_seg, _widen_delta_ref,
+              _widen_ascending, _widen_delta_ref)
+
+
+def _mat_operands(mat, seq_bucket: int, narrow):
+    """The five kernel operands from a staged matrix — the fused
+    WIDENING PRELUDE when the matrix shipped in the int16 layout (a
+    handful of elementwise ops + one cumsum, traced into the same
+    program as the convergence, so the reconstruction never costs an
+    extra dispatch).
+
+    ``narrow`` is False for the wide int32 matrix, or the plan's
+    ``narrow_cols`` row map: each True column occupies one
+    delta-encoded row (decoded by its paired widener), each False
+    column two exact hi/lo rows."""
+    if narrow is False or narrow == ():
+        return (
+            mat[0], mat[1], mat[2], mat[3, :seq_bucket],
+            mat[4, :seq_bucket],
+        )
+    ops = []
+    r = 0
+    for i, (is_narrow, fn) in enumerate(zip(narrow, _WIDEN_FNS)):
+        sl = slice(None) if i < 3 else slice(0, seq_bucket)
+        if is_narrow:
+            ops.append(fn(mat[r][sl]))
+            r += 1
+        else:
+            ops.append(_join_hi_lo(mat[r][sl], mat[r + 1][sl]))
+            r += 2
+    return tuple(ops)
+
+
 @partial(
     jax.jit,
+    donate_argnums=(0,),
     static_argnames=("num_segments", "seq_bucket", "rank_rounds",
-                     "map_rounds", "client_bits"),
+                     "map_rounds", "client_bits", "narrow"),
 )
 def _converge_packed(mat, num_segments: int, seq_bucket: int,
                      rank_rounds: int, map_rounds: int,
-                     client_bits: int):
-    """Single-matrix entry over :func:`_converge_packed_body` (the
-    bench sweep and matrix-staged plans)."""
+                     client_bits: int, narrow=False):
+    """Single-matrix entry over :func:`_converge_packed_body`
+    (matrix-staged plans). The staged matrix is DONATED: its device
+    buffer is consumed by the dispatch (the allocator reuses it for
+    outputs / the next shard's upload instead of holding both live),
+    so a plan must be converged at most once — repeated-dispatch
+    probes use :func:`make_repeat_dispatch`."""
     return _converge_packed_body(
-        mat[0], mat[1], mat[2], mat[3, :seq_bucket], mat[4, :seq_bucket],
+        *_mat_operands(mat, seq_bucket, narrow),
+        num_segments=num_segments, seq_bucket=seq_bucket,
+        rank_rounds=rank_rounds, map_rounds=map_rounds,
+        client_bits=client_bits,
+    )
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0, 1, 2, 3),
+    static_argnames=("num_segments", "seq_bucket", "rank_rounds",
+                     "map_rounds", "client_bits", "narrow"),
+)
+def _converge_rows(r0, r1, r2, r34, num_segments: int, seq_bucket: int,
+                   rank_rounds: int, map_rounds: int, client_bits: int,
+                   narrow=(False, False, False, False)):
+    """Separate-row entry for eagerly shipped plans (``stage(put=)``):
+    same fused body, rows already resident on device and DONATED to
+    the dispatch (see :func:`_converge_packed`). ``narrow`` carries
+    the per-array encoding flags the stager chose."""
+    n0, n1, n2, n34 = narrow
+    return _converge_packed_body(
+        _widen_client(r0) if n0 else r0,
+        _widen_seg(r1) if n1 else r1,
+        _widen_delta_ref(r2) if n2 else r2,
+        _widen_ascending(r34[0]) if n34 else r34[0],
+        _widen_delta_ref(r34[1]) if n34 else r34[1],
         num_segments=num_segments, seq_bucket=seq_bucket,
         rank_rounds=rank_rounds, map_rounds=map_rounds,
         client_bits=client_bits,
@@ -605,18 +911,40 @@ def _converge_packed(mat, num_segments: int, seq_bucket: int,
 @partial(
     jax.jit,
     static_argnames=("num_segments", "seq_bucket", "rank_rounds",
-                     "map_rounds", "client_bits"),
+                     "map_rounds", "client_bits", "narrow"),
 )
-def _converge_rows(r0, r1, r2, r34, num_segments: int, seq_bucket: int,
-                   rank_rounds: int, map_rounds: int, client_bits: int):
-    """Separate-row entry for eagerly shipped plans (``stage(put=)``):
-    same fused body, rows already resident on device."""
+def _converge_packed_nodonate(mat, num_segments: int, seq_bucket: int,
+                              rank_rounds: int, map_rounds: int,
+                              client_bits: int, narrow=False):
+    """Undonated twin of :func:`_converge_packed` for the consumers
+    that cannot honor (or benefit from) donation: the local-CPU host
+    route (CPU has no donation — the donating entry would warn per
+    compiled shape in library consumers' stderr) and the repeated
+    bench-sweep probe."""
     return _converge_packed_body(
-        r0, r1, r2, r34[0], r34[1],
+        *_mat_operands(mat, seq_bucket, narrow),
         num_segments=num_segments, seq_bucket=seq_bucket,
         rank_rounds=rank_rounds, map_rounds=map_rounds,
         client_bits=client_bits,
     )
+
+
+def make_repeat_dispatch(plan: PackedPlan):
+    """(device_matrix, fn) for REPEATED undonated dispatches of a
+    matrix-staged plan — the bench kernel sweep's probe. The
+    production entries donate their staged buffers to the program
+    (one plan, one dispatch), which makes re-dispatching the same
+    device array through them invalid on donation-capable backends."""
+    if plan.mat is None:
+        raise ValueError("repeat dispatch needs a matrix-staged plan")
+    args = _plan_args(plan)
+    narrow = _mat_narrow_arg(plan)
+
+    def fn(m):
+        with enable_x64(True):  # the id packing needs real int64
+            return _converge_packed_nodonate(m, **args, narrow=narrow)
+
+    return jnp.asarray(plan.mat), fn
 
 
 
@@ -875,6 +1203,12 @@ class PackedResult(NamedTuple):
                              # model cannot express)
 
 
+def _mat_narrow_arg(plan: PackedPlan):
+    """The static ``narrow`` dispatch arg for a matrix-staged plan:
+    False for the wide layout, the row map for the int16 layout."""
+    return plan.narrow_cols if plan.narrow else False
+
+
 def _plan_args(plan: PackedPlan) -> dict:
     return dict(
         num_segments=plan.num_segments,
@@ -883,6 +1217,18 @@ def _plan_args(plan: PackedPlan) -> dict:
         map_rounds=plan.map_rounds,
         client_bits=plan.client_bits,
     )
+
+
+def _put_mat(plan: PackedPlan):
+    """A matrix plan's ONE upload through the xfer seam, with the
+    per-column width/savings record made at the same moment — never
+    at stage time, where a plan destined for the zero-link host route
+    or a repeat-dispatch probe would leave phantom entries."""
+    record_staged_widths(
+        dict(plan.staged_widths), plan.mat.nbytes,
+        5 * plan.mat.shape[1] * 4,
+    )
+    return xfer_put(plan.mat, label="converge.mat")
 
 
 def _assemble_result(plan: PackedPlan, h: np.ndarray) -> PackedResult:
@@ -921,25 +1267,38 @@ def converge_async(plan: PackedPlan):
     args = _plan_args(plan)
     # span = enqueue cost (the dispatch is async); the XProf
     # annotation brackets the jitted call so device timelines
-    # attribute the fused kernel to the converge phase
+    # attribute the fused kernel to the converge phase. The staged
+    # buffers are DONATED to the program (matrix upload through the
+    # xfer seam, eager rows at stage time): one plan, one dispatch.
     with get_tracer().span("converge.dispatch"), \
             device_annotation("crdt.converge.dispatch"), \
             enable_x64(True):
         if plan.dev:
-            out = _converge_rows(*plan.dev, **args)
+            out = _converge_rows(*plan.dev, **args,
+                                 narrow=plan.dev_narrow)
         else:
-            out = _converge_packed(jnp.asarray(plan.mat), **args)
+            out = _converge_packed(
+                _put_mat(plan), **args,
+                narrow=_mat_narrow_arg(plan),
+            )
     return plan, out
 
 
 def converge_fetch(handle) -> PackedResult:
     """Block on an in-flight :func:`converge_async` dispatch and
     assemble its one packed fetch into caller row space (the tracer's
-    ``converge.fetch`` span: wait + transfer + assembly)."""
+    ``converge.fetch`` span: wait + transfer + assembly). The D2H
+    transfer itself goes through :func:`crdt_tpu.ops.device.
+    xfer_fetch` AFTER an explicit wait-for-execution, so the
+    ``xfer.d2h`` histogram records pure transfer time (previously the
+    wait was folded in and the fetch cost was unattributable)."""
     plan, out = handle
     with get_tracer().span("converge.fetch"), \
             device_annotation("crdt.converge.fetch"):
-        return _assemble_result(plan, np.asarray(out))
+        jax.block_until_ready(out)  # execution wait, not transfer
+        return _assemble_result(
+            plan, xfer_fetch(out, label="converge.out")
+        )
 
 
 def converge(plan: PackedPlan,
@@ -976,21 +1335,30 @@ def converge(plan: PackedPlan,
             jax.block_until_ready(plan.dev)  # eager uploads land
             mark("upload_wait", t0)
             t0 = _t.perf_counter()
-            out = _converge_rows(*plan.dev, **args)          # 1 dispatch
+            out = _converge_rows(*plan.dev, **args,          # 1 dispatch
+                                 narrow=plan.dev_narrow)
             jax.block_until_ready(out)
             mark("dispatch", t0)
         else:
             t0 = _t.perf_counter()
-            dev_mat = jnp.asarray(plan.mat)                  # 1 transfer
-            jax.block_until_ready(dev_mat)
+            dev_mat = _put_mat(plan)
+            jax.block_until_ready(dev_mat)                   # 1 transfer
             mark("upload_wait", t0)
             t0 = _t.perf_counter()
-            out = _converge_packed(dev_mat, **args)          # 1 dispatch
+            out = _converge_packed(dev_mat, **args,          # 1 dispatch
+                                   narrow=_mat_narrow_arg(plan))
             jax.block_until_ready(out)
             mark("dispatch", t0)
+        # the fetch is attributed to its OWN phase (and the xfer.d2h
+        # histogram), never folded into dispatch: the dispatch mark
+        # above waits for EXECUTION, this times the D2H transfer +
+        # nothing else, so converge_detail.fetch matches xfer.d2h_bytes
         t0 = _t.perf_counter()
-        h = np.asarray(out)                                  # 1 fetch
+        h = xfer_fetch(out, label="converge.out")            # 1 fetch
         mark("fetch", t0)
+        phases["d2h_bytes"] = int(h.nbytes)
+        if plan.mat is not None:
+            phases["h2d_bytes"] = int(plan.mat.nbytes)
     # mirror the async seam's tracer spans so instrumented runs (the
     # bench's per-phase detail path) still feed the same histograms
     tracer = get_tracer()
@@ -1023,11 +1391,17 @@ def converge_host(plan: PackedPlan) -> PackedResult:
     from crdt_tpu.ops.device import on_local_cpu
 
     args = _plan_args(plan)
-    key = ("converge_host", plan.mat.shape, tuple(sorted(args.items())))
+    key = ("converge_host", plan.mat.shape, _mat_narrow_arg(plan),
+           tuple(sorted(args.items())))
     with get_tracer().span("converge.dispatch"), \
             on_local_cpu(cache_key=key), enable_x64(True):
+        # NO xfer seam here: the whole point of this path is zero
+        # bytes on the tunnel link (local CPU backend) — and the
+        # UNDONATED entry, since CPU can never honor donation and the
+        # donating twin would warn into library consumers' stderr
         h = np.asarray(
-            _converge_packed(jnp.asarray(plan.mat), **args)
+            _converge_packed_nodonate(jnp.asarray(plan.mat), **args,
+                                      narrow=_mat_narrow_arg(plan))
         )
     with get_tracer().span("converge.fetch"):
         return _assemble_result(plan, h)

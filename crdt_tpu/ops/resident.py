@@ -191,6 +191,8 @@ class ResidentColumns:
         kpad = min(_bucket(k, floor=6), self.capacity)
         if self.n + kpad > self.capacity:
             self._grow(self.n + kpad)
+        from crdt_tpu.ops.device import xfer_put
+
         delta = []
         for name, dt in COLUMNS:
             arr = np.full(kpad, _FILL[name], dtype=dt)
@@ -202,7 +204,10 @@ class ResidentColumns:
                 arr[:k] = self._map_clients(raw_ocl, raw_ocl >= 0)
             else:
                 arr[:k] = cols[name][:k]
-            delta.append(jnp.asarray(arr))
+            # the xfer seam accounts every appended delta column:
+            # resident rounds must show DELTA-sized h2d growth, never
+            # the full matrix (pinned by tests/test_transfer_diet.py)
+            delta.append(xfer_put(arr, label="resident.delta"))
         return tuple(delta)
 
     def append(self, cols: Dict[str, np.ndarray]) -> None:

@@ -109,7 +109,11 @@ def make_delta_gossip_step(mesh, num_clients: int, budget: int):
         )
         return (svs, deficit, n_needed) + union
 
-    return jax.jit(step)
+    # per-round column uploads donated (freshly built by the caller
+    # each round — ReplicaFleet.delta_round); backends without
+    # donation skip the reuse (one UserWarning per compiled shape,
+    # filtered in the test config and bench)
+    return jax.jit(step, donate_argnums=tuple(range(9)))
 
 
 def make_ring_delta_step(mesh, num_clients: int, budget: int):
@@ -149,7 +153,7 @@ def make_ring_delta_step(mesh, num_clients: int, budget: int):
         recv = tuple(jax.lax.ppermute(c, axis, perm=fwd) for c in packed)
         return (n_needed,) + recv
 
-    return jax.jit(step)
+    return jax.jit(step, donate_argnums=tuple(range(9)))
 
 
 def synth_resident_columns(

@@ -232,7 +232,15 @@ def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
                       seq_order, seq_seg, seq_rank, seq_len, map_order)
         ])
 
-    return jax.jit(step)
+    # the packed column block (the round's big upload) is DONATED: its
+    # device buffer is consumed by the step, so back-to-back gossip
+    # rounds recycle one allocation instead of holding round k's
+    # columns alive while round k+1 uploads. Callers always build the
+    # block fresh per round (pack_cols -> xfer_put) — nothing re-reads
+    # it after the dispatch. Backends without donation (CPU) skip the
+    # reuse and warn once per compiled shape (filtered in the test
+    # config and bench).
+    return jax.jit(step, donate_argnums=(0,))
 
 
 def make_hierarchical_gossip_step(mesh: Mesh, num_segments: int,
@@ -293,7 +301,8 @@ def make_hierarchical_gossip_step(mesh: Mesh, num_segments: int,
                       seq_order, seq_seg, seq_rank, seq_len, map_order)
         ])
 
-    return jax.jit(step)
+    # packed column block donated — see make_gossip_step
+    return jax.jit(step, donate_argnums=(0,))
 
 
 def make_segment_sharded_step(mesh: Mesh, num_segments: int,
@@ -364,7 +373,8 @@ def make_segment_sharded_step(mesh: Mesh, num_segments: int,
                       seq_seg, seq_rank, seq_len, map_order)
         ])
 
-    return jax.jit(step)
+    # packed column block donated — see make_gossip_step
+    return jax.jit(step, donate_argnums=(0,))
 
 
 def segment_out_sizes(blk: int, R: int, N_d: int, S: int):

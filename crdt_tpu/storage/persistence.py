@@ -108,19 +108,39 @@ class LogPersistence:
 
     # -- the CRDTPersistence surface --------------------------------------
     def store_update(self, doc_name: str, update: bytes, sv: Optional[bytes] = None) -> None:
-        if not isinstance(update, (bytes, bytearray)):
-            raise TypeError("update must be bytes")  # crdt.js:29-31
-        update = bytes(update)
+        self.store_updates(doc_name, [update], sv=sv)
+
+    def store_updates(self, doc_name: str, updates: List[bytes],
+                      sv: Optional[bytes] = None) -> None:
+        """Append a WINDOW of updates as ONE atomic KV batch — N log
+        keys, one state vector, one meta write, one fsync-able log
+        append. This is the batched-incoming path's WAL shape
+        (``Replica.flush_incoming`` applies a whole inbox as one merge
+        transaction; before this, each update still paid its own
+        3-key batch + meta read-modify-write). Counters distinguish
+        units from windows: ``persist.appends`` counts updates,
+        ``persist.batches`` counts KV batches."""
+        # materialize FIRST: a generator argument must survive the
+        # validation pass (iterating it twice would silently store
+        # nothing while still advancing the SV)
+        updates = list(updates)
+        for u in updates:
+            if not isinstance(u, (bytes, bytearray)):
+                raise TypeError("update must be bytes")  # crdt.js:29-31
+        updates = [bytes(u) for u in updates]
+        if not updates:
+            return
         if self.validate:
             from crdt_tpu.codec import v1
 
-            v1.decode_update(update)  # raises on malformed input
+            for u in updates:
+                v1.decode_update(u)  # raises on malformed input
         kv = self._require()
         tracer = get_tracer()
         with tracer.span("persist"):
-            seq = self._seq_for(doc_name)
             batch = Batch()
-            batch.put(_update_key(doc_name, seq), update)
+            for u in updates:
+                batch.put(_update_key(doc_name, self._seq_for(doc_name)), u)
             if sv is not None:
                 batch.put(_sv_key(doc_name), bytes(sv))
             meta = self.get_meta(doc_name) or {"size": 0, "count": 0}
@@ -129,14 +149,15 @@ class LogPersistence:
                 json.dumps(
                     {
                         "last_updated": time.time(),
-                        "size": meta["size"] + len(update),
-                        "count": meta["count"] + 1,
+                        "size": meta["size"] + sum(map(len, updates)),
+                        "count": meta["count"] + len(updates),
                     }
                 ).encode(),
             )
             kv.write(batch)
-        tracer.count("persist.appends")
-        tracer.count("persist.bytes_appended", len(update))
+        tracer.count("persist.appends", len(updates))
+        tracer.count("persist.batches")
+        tracer.count("persist.bytes_appended", sum(map(len, updates)))
 
     def get_all_updates(self, doc_name: str) -> List[bytes]:
         return [v for _, v in self._require().scan_prefix(_update_prefix(doc_name))]

@@ -70,3 +70,20 @@ def test_bench_smoke_mode(tmp_path):
             assert k in span, (name, k)
         assert span["p50_s"] <= span["p99_s"] + 1e-12
         assert span["p99_s"] <= span["max_s"] + 1e-12
+
+    # the byte-accounting registry (transfer diet): counters, latency
+    # histograms, and the narrowing gauge must all be live, or the
+    # xfer regression gate reads nothing and the diet can rot
+    for cname in ("xfer.h2d_bytes", "xfer.h2d_puts", "xfer.d2h_bytes",
+                  "xfer.d2h_fetches"):
+        assert report["counters"].get(cname, 0) > 0, cname
+    for sname in ("xfer.h2d", "xfer.d2h"):
+        span = report["spans"].get(sname)
+        assert span is not None and span["count"] > 0, sname
+    assert "xfer.narrowed_ratio" in report["gauges"]
+    # per-column chosen widths recorded (the width histogram)
+    assert any(k.startswith("xfer.col_width{") for k in
+               report["counters"]), "per-column width histogram missing"
+    # the smoke device leg's own xfer digest rides the stdout line
+    assert out["xfer"]["h2d_bytes"] > 0
+    assert out["xfer"]["d2h_bytes"] > 0

@@ -25,11 +25,21 @@ OLD = {
     "dispatch_floor_ms": 30.0,
     "phases_device_s": {"decode": 1.0, "converge": 2.0},
     "scale_run": {"vs_baseline": 3.0, "stream_vs_oneshot": 1.5},
+    "xfer": {"h2d_bytes": 1_000_000, "d2h_bytes": 200_000,
+             "h2d_bytes_saved": 1_000_000},
     "tracer": {
         "spans": {
             "decode": {"p50_s": 0.10, "p99_s": 0.20, "total_s": 1.0},
             "pack": {"p50_s": 0.05, "p99_s": 0.08, "total_s": 0.5},
-        }
+        },
+        "counters": {
+            "xfer.h2d_bytes": 2_000_000,
+            "xfer.d2h_bytes": 400_000,
+            "xfer.staged_bytes": 2_000_000,
+            "xfer.h2d_bytes_saved": 2_000_000,
+            'xfer.col_width{bits="16",col="client"}': 4,
+        },
+        "gauges": {"xfer.narrowed_ratio": 0.5},
     },
 }
 
@@ -103,6 +113,64 @@ def test_ms_metrics_respect_noise_floor():
 def test_missing_sections_are_skipped():
     rows, regressed = compare({"value": 1, "unit": "ops/s"}, {})
     assert rows == [] and regressed == []
+
+
+def test_xfer_bytes_lower_is_better():
+    # the transfer diet undone (staged bytes doubled) must regress;
+    # labelled per-column width counters are layout detail, never
+    # compared
+    new = copy.deepcopy(OLD)
+    new["xfer"]["h2d_bytes"] = 3_000_000
+    new["tracer"]["counters"]["xfer.h2d_bytes"] = 6_000_000
+    new["tracer"]["counters"]["xfer.staged_bytes"] = 6_000_000
+    rows, regressed = compare(OLD, new)
+    assert "xfer.h2d_bytes" in regressed
+    assert "tracer.xfer.h2d_bytes" in regressed
+    # run-level ratio derived from the STAGED counters regresses too
+    # (0.5 -> 0.75 staged/wide); the raw last-writer-wins gauge is
+    # per-upload detail and must NOT be gated (it flaps with shard
+    # staging order)
+    assert "xfer.narrowed_ratio_run" in regressed
+    assert not any(
+        r["metric"] == "tracer.xfer.narrowed_ratio" for r in rows
+    )
+    assert not any("col_width" in r["metric"] for r in rows)
+
+
+def test_xfer_ratio_ignores_non_staged_traffic_mix():
+    # growing fleet/resident-delta uploads (xfer.h2d_bytes) without
+    # touching the staged uploads must NOT move the narrowing ratio
+    new = copy.deepcopy(OLD)
+    new["tracer"]["counters"]["xfer.h2d_bytes"] = 20_000_000
+    rows, regressed = compare(OLD, new)
+    by_name = {r["metric"]: r for r in rows}
+    assert by_name["xfer.narrowed_ratio_run"]["delta_pct"] == 0.0
+    assert "xfer.narrowed_ratio_run" not in regressed
+
+
+def test_xfer_bytes_saved_higher_is_better():
+    # saving MORE bytes is an improvement, not a byte regression
+    new = copy.deepcopy(OLD)
+    new["xfer"]["h2d_bytes_saved"] = 4_000_000
+    new["tracer"]["counters"]["xfer.h2d_bytes_saved"] = 8_000_000
+    rows, regressed = compare(OLD, new)
+    assert regressed == []
+    by_name = {r["metric"]: r for r in rows}
+    assert by_name["xfer.h2d_bytes_saved"]["verdict"] == "improved"
+    # ...and saving fewer bytes regresses
+    worse = copy.deepcopy(OLD)
+    worse["tracer"]["counters"]["xfer.h2d_bytes_saved"] = 100
+    _, regressed = compare(OLD, worse)
+    assert "tracer.xfer.h2d_bytes_saved" in regressed
+
+
+def test_xfer_byte_regressions_ignore_seconds_noise_floor():
+    # bytes are not time: a small-but-real byte regression must not
+    # be muted by the seconds noise floor
+    old = {"xfer": {"h2d_bytes": 2048}}
+    new = {"xfer": {"h2d_bytes": 4096}}
+    _, regressed = compare(old, new)
+    assert "xfer.h2d_bytes" in regressed
 
 
 def test_cli_exit_codes(tmp_path, capsys):

@@ -33,16 +33,25 @@ def _cols(n, *, clock_base=0, clients=None, seq=False):
 
 class TestStage:
     def test_staged_matrix(self):
+        # a tiny batch narrows to the int16 transfer-diet layout
         plan = packed.stage(_cols(8))
         assert plan is not None
-        assert plan.mat.dtype == np.int32
+        assert plan.narrow and plan.mat.dtype == np.int16
         assert plan.mat.shape[0] == 5
         assert plan.n == 8
+
+    def test_forced_wide_matrix(self):
+        plan = packed.stage(_cols(8), wide=True)
+        assert plan is not None
+        assert not plan.narrow and plan.mat.dtype == np.int32
+        assert plan.mat.shape[0] == 5
 
     def test_wide_clock_stays_packed(self):
         # clocks below the shared pack_id bound stay on the packed path
         plan = packed.stage(_cols(8, clock_base=1 << 33))
-        assert plan is not None and plan.mat.dtype == np.int32
+        assert plan is not None and plan.mat.dtype in (
+            np.int16, np.int32
+        )
 
     def test_clock_beyond_pack_bound_falls_back(self):
         assert packed.stage(_cols(8, clock_base=1 << 40)) is None

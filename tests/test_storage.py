@@ -208,6 +208,139 @@ def test_store_and_replay_updates(path):
     p.close()
 
 
+def test_store_updates_batched_window(path):
+    """The batched WAL verb: one KV batch per merge window — N log
+    keys, one SV, one meta — with persist.appends counting updates
+    and persist.batches counting windows."""
+    from crdt_tpu.obs import Tracer, get_tracer, set_tracer
+
+    old = get_tracer()
+    tr = set_tracer(Tracer(enabled=True))
+    try:
+        p = LogPersistence(path)
+        us = [_mk_update(c) for c in (1, 2, 3)]
+        p.store_updates("topic", us, sv=b"\x09")
+        assert p.get_all_updates("topic") == us
+        assert p.get_state_vector("topic") == b"\x09"
+        meta = p.get_meta("topic")
+        assert meta["count"] == 3
+        assert meta["size"] == sum(map(len, us))
+        c = tr.counters("persist.")
+        assert c["persist.appends"] == 3
+        assert c["persist.batches"] == 1
+        # singular store_update rides the same path: +1 append, +1 batch
+        u4 = _mk_update(4)
+        p.store_update("topic", u4)
+        c = tr.counters("persist.")
+        assert c["persist.appends"] == 4
+        assert c["persist.batches"] == 2
+        p.close()
+        # restart: batched sequence numbers resume correctly (D6)
+        p = LogPersistence(path)
+        u5 = _mk_update(5)
+        p.store_updates("topic", [u5])
+        assert p.get_all_updates("topic") == us + [u4, u5]
+        # empty window: no batch written, no counters moved
+        before = tr.counters("persist.")
+        p.store_updates("topic", [])
+        assert tr.counters("persist.") == before
+        # one malformed update poisons its whole batch atomically
+        with pytest.raises(Exception):
+            p.store_updates("topic", [_mk_update(6), b"\xff garbage"])
+        assert p.get_all_updates("topic") == us + [u4, u5]
+        p.close()
+    finally:
+        set_tracer(old)
+
+
+def test_store_updates_accepts_generator(path):
+    """A generator argument must survive the validation pass — the
+    naive two-pass shape would silently store NOTHING while still
+    advancing the state vector (silent data loss on recovery)."""
+    p = LogPersistence(path)
+    us = [_mk_update(c) for c in (1, 2)]
+    p.store_updates("g", (u for u in us), sv=b"\x05")
+    assert p.get_all_updates("g") == us
+    assert p.get_meta("g")["count"] == 2
+    p.close()
+    from crdt_tpu.net.replica import MemoryPersistence
+
+    mp = MemoryPersistence()
+    mp.store_updates("g", (u for u in us), sv=b"\x05")
+    assert mp.get_all_updates("g") == us
+
+
+def test_persist_many_respects_store_update_overrides(path):
+    """A subclass overriding only store_update (the sole verb that
+    existed before round 9) must intercept every batched write — the
+    inherited batch verb would silently bypass it."""
+    from crdt_tpu.net.replica import MemoryPersistence, _prefers_batch_verb
+
+    seen = []
+
+    class Intercepting(MemoryPersistence):
+        def store_update(self, doc, update, sv=None):
+            seen.append(update)
+            super().store_update(doc, update, sv=sv)
+
+    class BatchAware(MemoryPersistence):
+        def store_updates(self, doc, updates, sv=None):
+            super().store_updates(doc, updates, sv=sv)
+
+    assert not _prefers_batch_verb(Intercepting)
+    assert _prefers_batch_verb(BatchAware)
+    assert _prefers_batch_verb(MemoryPersistence)
+    assert _prefers_batch_verb(LogPersistence)
+
+    class SingleOnly:  # third-party, no batch verb at all
+        def store_update(self, doc, update, sv=None):
+            pass
+
+    assert not _prefers_batch_verb(SingleOnly)
+
+    from crdt_tpu.net import LoopbackNetwork, LoopbackRouter, ypear_crdt
+
+    net = LoopbackNetwork()
+    a = ypear_crdt(LoopbackRouter(net, "pkA"), topic="t", client_id=1)
+    b = ypear_crdt(LoopbackRouter(net, "pkB"), topic="t", client_id=2,
+                   batch_incoming=True, persistence=Intercepting())
+    net.run()
+    for i in range(4):
+        a.set("m", f"k{i}", i)
+    net.run()
+    b.flush_incoming()
+    assert len(seen) >= 4  # every window update went through the hook
+    assert dict(b.c) == dict(a.c)
+
+
+def test_replica_batched_inbox_persists_one_window(path):
+    """flush_incoming applies a whole inbox as one merge transaction;
+    the WAL must get ONE batch for it, not one append per update."""
+    from crdt_tpu.net import LoopbackNetwork, LoopbackRouter, ypear_crdt
+    from crdt_tpu.obs import Tracer, get_tracer, set_tracer
+
+    old = get_tracer()
+    tr = set_tracer(Tracer(enabled=True))
+    try:
+        net = LoopbackNetwork()
+        a = ypear_crdt(LoopbackRouter(net, "pkA"), topic="t",
+                       client_id=1)
+        b = ypear_crdt(LoopbackRouter(net, "pkB"), topic="t",
+                       client_id=2, batch_incoming=True,
+                       persistence=LogPersistence(path))
+        net.run()
+        for i in range(5):
+            a.set("m", f"k{i}", i)
+        net.run()          # deliver into b's inbox
+        b.flush_incoming()  # ONE merge window
+        c = tr.counters("persist.")
+        assert c["persist.appends"] >= 5
+        assert c["persist.batches"] < c["persist.appends"]
+        assert dict(b.c) == dict(a.c)
+    finally:
+        set_tracer(old)
+
+
 def test_docs_are_isolated(path):
     p = LogPersistence(path)
     ua, ub = _mk_update(1), _mk_update(2)

@@ -1,0 +1,440 @@
+"""Transfer diet (round 9): narrow-column staging, donated device
+buffers, and end-to-end byte accounting.
+
+Three contracts under test:
+
+1. **Byte-identical narrowing.** The int16 narrow-encoded staging
+   matrix, decoded by the fused widening prelude, must reproduce the
+   wide int32 layout EXACTLY — the converge outputs, the materialized
+   cache, and the snapshot bytes are compared narrow-vs-forced-wide at
+   values straddling every width boundary (int16 edges per encoding,
+   clocks at 2^15-1 / 2^31-1, forced-wide fallbacks), across all three
+   merge routes (one-shot, stream, fleet), including delete-only and
+   empty chunks.
+2. **Donation safety.** The converge dispatches donate their staged
+   buffers and the fleet/gossip steps donate their packed column
+   blocks; a reused executor fed a second trace must stage FRESH
+   buffers and never read freed ones (back-to-back double runs,
+   byte-identical).
+3. **Byte accounting.** ``xfer.h2d_bytes`` growth across steady-state
+   resident rounds must be DELTA-sized (the donated resident matrix
+   never re-uploads), and the narrow path must ship half the wide
+   path's staged bytes (``xfer.narrowed_ratio``).
+"""
+
+import numpy as np
+import pytest
+
+from crdt_tpu.codec import v1
+from crdt_tpu.core.engine import Engine
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.models import replay_trace, stream_replay
+from crdt_tpu.obs import Tracer, get_tracer, set_tracer
+from crdt_tpu.ops import packed
+
+
+@pytest.fixture
+def tracer():
+    old = get_tracer()
+    tr = set_tracer(Tracer(enabled=True))
+    try:
+        yield tr
+    finally:
+        set_tracer(old)
+
+
+# ---------------------------------------------------------------------------
+# encoder/decoder unit round trips at the width boundaries
+# ---------------------------------------------------------------------------
+
+
+class TestNarrowEncodings:
+    """Each host encoder and its device decoder must be exact
+    inverses; infeasible ranges must refuse (None), never wrap."""
+
+    @staticmethod
+    def _widen(fn, arr):
+        import jax.numpy as jnp
+
+        return np.asarray(fn(jnp.asarray(arr)))
+
+    def test_client_boundary(self):
+        ok = np.asarray([0, 1, (1 << 15) - 1], np.int32)
+        enc = packed._narrow_client(ok)
+        assert enc is not None and enc.dtype == np.int16
+        assert (self._widen(packed._widen_client, enc) == ok).all()
+        assert packed._narrow_client(
+            np.asarray([1 << 15], np.int32)) is None
+
+    def test_seg_boundary_and_flag_fold(self):
+        # map segs, seq segs (flag folded into sign), dead rows
+        segs = np.asarray(
+            [0, 5, (1 << 15) - 3], np.int32)
+        r1 = np.concatenate([
+            segs,                          # map rows
+            segs | packed._SEQ_FLAG,       # seq rows
+            np.asarray([-1], np.int32),    # dead
+        ])
+        enc = packed._narrow_seg(r1, n_segs=(1 << 15) - 2)
+        assert enc is not None and enc.dtype == np.int16
+        assert (self._widen(packed._widen_seg, enc) == r1).all()
+        assert packed._narrow_seg(r1, n_segs=(1 << 15)) is None
+
+    def test_delta_ref_boundaries(self):
+        n = 10
+        vals = np.full(n, -1, np.int32)
+        vals[3] = 1    # delta +2
+        vals[2] = 9    # delta -7 (forward reference)
+        enc = packed._narrow_delta_ref(vals)
+        assert enc is not None
+        assert (self._widen(packed._widen_delta_ref, enc) == vals).all()
+        # a self-reference collides with the no-ref sentinel: refuse
+        self_ref = np.full(4, -1, np.int32)
+        self_ref[2] = 2
+        assert packed._narrow_delta_ref(self_ref) is None
+        # a delta beyond int16: refuse
+        far = np.full((1 << 15) + 8, -1, np.int64)
+        far[-1] = 0  # delta = len-1 > 32767
+        assert packed._narrow_delta_ref(far) is None
+
+    def test_ascending_boundaries(self):
+        rows = np.asarray([0, 1, 5, 40000, -1, -1], np.int64)
+        enc = packed._narrow_ascending(rows)
+        assert enc is None  # gap 5 -> 40000 overflows int16
+        rows2 = np.asarray([2, 3, (1 << 15) + 5, -1], np.int64)
+        # first delta = 3 <= int16, gap = 32770 -> refuse
+        assert packed._narrow_ascending(rows2) is None
+        ok = np.asarray([7, 8, 100, -1, -1], np.int64)
+        enc = packed._narrow_ascending(ok)
+        assert enc is not None
+        assert (self._widen(packed._widen_ascending, enc) == ok).all()
+        # empty prefix (no sequence rows) stays all-pad
+        empty = np.full(6, -1, np.int64)
+        enc = packed._narrow_ascending(empty)
+        assert (self._widen(packed._widen_ascending, enc) == empty).all()
+
+
+# ---------------------------------------------------------------------------
+# staged-plan differentials: narrow vs forced wide, boundary values
+# ---------------------------------------------------------------------------
+
+
+def boundary_blobs(clock_base=0, R=6, K=20, seed=4):
+    """Chained map sets + list appends + right-bearing mid-inserts,
+    with clocks offset to straddle a chosen width boundary."""
+    rng = np.random.default_rng(seed)
+    blobs = []
+    for r in range(R):
+        client = r + 1
+        recs, chain, last = [], [], {}
+        for k in range(K):
+            clock = clock_base + k
+            kind = int(rng.integers(0, 3))
+            if kind == 0:
+                recs.append(ItemRecord(
+                    client=client, clock=clock, parent_root="m",
+                    key=f"k{int(rng.integers(0, 5))}", content=k))
+            elif kind == 1 and chain:
+                j = int(rng.integers(0, len(chain)))
+                recs.append(ItemRecord(
+                    client=client, clock=clock, parent_root="text",
+                    origin=chain[j - 1] if j > 0 else None,
+                    right=chain[j], content=k))
+                chain.insert(j, (client, clock))
+            else:
+                prev = last.get(0)
+                recs.append(ItemRecord(
+                    client=client, clock=clock, parent_root="l",
+                    origin=(client, prev) if prev is not None else None,
+                    content=k))
+                last[0] = clock
+                chain.append((client, clock))
+        ds = DeleteSet()
+        ds.add(client, clock_base + int(rng.integers(0, K)))
+        blobs.append(v1.encode_update(recs, ds))
+    return blobs
+
+
+def _routes_identical(blobs, monkeypatch):
+    """one-shot narrow == one-shot wide == stream (both) == fleet,
+    cache and snapshot byte-identical."""
+    monkeypatch.delenv("CRDT_TPU_WIDE_STAGING", raising=False)
+    narrow = replay_trace(blobs, route="device")
+    monkeypatch.setenv("CRDT_TPU_WIDE_STAGING", "1")
+    wide = replay_trace(blobs, route="device")
+    assert narrow.cache == wide.cache
+    assert narrow.snapshot == wide.snapshot
+    st_wide = stream_replay(
+        blobs, chunk_blobs=2, max_shards=3, min_shard_rows=1
+    )
+    monkeypatch.delenv("CRDT_TPU_WIDE_STAGING", raising=False)
+    st = stream_replay(
+        blobs, chunk_blobs=2, max_shards=3, min_shard_rows=1
+    )
+    assert st.cache == narrow.cache and st.snapshot == narrow.snapshot
+    assert st_wide.cache == narrow.cache
+    assert st_wide.snapshot == narrow.snapshot
+    from crdt_tpu.models.fleet import fleet_replay
+    from crdt_tpu.parallel.gossip import make_mesh
+
+    fl = fleet_replay(blobs, mesh=make_mesh(1))
+    assert fl.cache == narrow.cache
+    assert fl.snapshot == narrow.snapshot
+    return narrow
+
+
+class TestBoundaryDifferentials:
+    def test_small_clocks_all_routes(self, monkeypatch):
+        res = _routes_identical(boundary_blobs(0), monkeypatch)
+        # pin against the scalar oracle too
+        eng = Engine(10 ** 6)
+        for b in boundary_blobs(0):
+            v1.apply_update(eng, b)
+        assert res.cache == eng.to_json()
+
+    def test_clocks_straddle_int16_boundary(self, monkeypatch):
+        _routes_identical(boundary_blobs((1 << 15) - 8), monkeypatch)
+
+    def test_clocks_straddle_int31_boundary(self, monkeypatch):
+        # 2^31-1 clocks: far beyond any narrow field but well under
+        # the 2^40 pack_id bound — the staged path must keep them
+        _routes_identical(boundary_blobs((1 << 31) - 8), monkeypatch)
+
+    def test_delete_only_and_empty_updates(self, monkeypatch):
+        ds = DeleteSet()
+        ds.add(1, 3, 4)
+        blobs = boundary_blobs(0, R=4, K=12) + [
+            v1.encode_update([], ds),   # delete-only
+            v1.encode_update([], DeleteSet()),  # empty
+        ]
+        _routes_identical(blobs, monkeypatch)
+
+    def test_self_referential_origin_takes_hi_lo_rows(self):
+        """A row claiming itself as origin collides with the delta
+        encoding's sentinel: that COLUMN must fall back to the exact
+        hi/lo row pair (never decode wrong) and still converge like
+        the wide path."""
+        n = 6
+        cols = {
+            "client": np.full(n, 1, np.int64),
+            "clock": np.arange(n, dtype=np.int64),
+            "parent_is_root": np.ones(n, bool),
+            "parent_a": np.zeros(n, np.int64),
+            "parent_b": np.full(n, -1, np.int64),
+            "key_id": np.zeros(n, np.int64),
+            "origin_client": np.full(n, -1, np.int64),
+            "origin_clock": np.full(n, -1, np.int64),
+            "valid": np.ones(n, bool),
+        }
+        cols["origin_client"][3] = 1
+        cols["origin_clock"][3] = 3  # row 3's origin is row 3
+        plan = packed.stage(cols)
+        assert plan is not None and plan.narrow
+        # the origin column (index 2) degraded to hi/lo; others narrow
+        assert plan.narrow_cols[2] is False
+        assert all(plan.narrow_cols[i] for i in (0, 1, 3, 4))
+        assert plan.mat.dtype == np.int16
+        assert plan.mat.shape[0] == 6  # five columns + one extra row
+        res = packed.converge(plan)
+        wide = packed.converge(packed.stage(cols, wide=True))
+        assert list(res.win_rows) == list(wide.win_rows)
+
+    def test_hi_lo_split_round_trips_any_int32(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        v = rng.integers(-(2 ** 31), 2 ** 31, 4096).astype(np.int32)
+        v[:4] = (-1, 0, 2 ** 31 - 1, -(2 ** 31))
+        hi, lo = packed._split_hi_lo(v)
+        assert hi.dtype == np.int16 and lo.dtype == np.int16
+        back = np.asarray(
+            packed._join_hi_lo(jnp.asarray(hi), jnp.asarray(lo))
+        )
+        assert (back == v).all()
+
+    def test_many_segments_keep_int16_matrix(self):
+        """Past 32k segments the seg column cannot delta-narrow; the
+        matrix must ship hi/lo rows for it, NOT collapse to int32 —
+        this is the scale run's stream-shard shape."""
+        n = 40_000
+        cols = {
+            "client": np.ones(n, np.int64),
+            "clock": np.arange(n, dtype=np.int64),
+            "parent_is_root": np.ones(n, bool),
+            "parent_a": np.zeros(n, np.int64),
+            "parent_b": np.full(n, -1, np.int64),
+            "key_id": np.arange(n, dtype=np.int64),  # n distinct segs
+            "origin_client": np.full(n, -1, np.int64),
+            "origin_clock": np.full(n, -1, np.int64),
+            "valid": np.ones(n, bool),
+        }
+        plan = packed.stage(cols)
+        assert plan.narrow and plan.mat.dtype == np.int16
+        assert plan.narrow_cols[1] is False  # seg -> hi/lo
+        assert plan.mat.shape[0] == 6
+        res = packed.converge(plan)
+        wide = packed.converge(packed.stage(cols, wide=True))
+        assert list(res.win_rows[res.win_rows >= 0]) == \
+            list(wide.win_rows[wide.win_rows >= 0])
+
+    def test_forced_wide_env_knob(self, monkeypatch):
+        monkeypatch.setenv("CRDT_TPU_WIDE_STAGING", "1")
+        plan = packed.stage({
+            "client": np.ones(8, np.int64),
+            "clock": np.arange(8, dtype=np.int64),
+            "parent_is_root": np.ones(8, bool),
+            "parent_a": np.zeros(8, np.int64),
+            "parent_b": np.full(8, -1, np.int64),
+            "key_id": np.full(8, -1, np.int64),
+            "origin_client": np.full(8, -1, np.int64),
+            "origin_clock": np.full(8, -1, np.int64),
+            "valid": np.ones(8, bool),
+        })
+        assert plan is not None
+        assert not plan.narrow and plan.mat.dtype == np.int32
+
+    def test_eager_path_narrow_matches_matrix(self):
+        """stage(put=...) ships per-array narrow encodings; results
+        must match the matrix-staged plan exactly."""
+        from crdt_tpu.ops.device import xfer_put
+
+        blobs = boundary_blobs(0, R=4, K=16)
+        from crdt_tpu.models import replay as rp
+
+        dec = rp.decode(blobs)
+        cols, _ = rp.stage(dec)
+        mat_res = packed.converge(packed.stage(cols))
+        eager_plan = packed.stage(cols, put=xfer_put)
+        assert eager_plan.mat is None and any(eager_plan.dev_narrow)
+        eager_res = packed.converge(eager_plan)
+        assert list(mat_res.win_rows) == list(eager_res.win_rows)
+        assert list(mat_res.stream_row) == list(eager_res.stream_row)
+        assert list(mat_res.stream_seg) == list(eager_res.stream_seg)
+
+
+# ---------------------------------------------------------------------------
+# donation safety
+# ---------------------------------------------------------------------------
+
+
+class TestDonationSafety:
+    def test_stream_double_run_after_donation(self):
+        """Back-to-back run_stream: every shard of the second run must
+        stage fresh buffers — byte-identical results, no
+        'Invalid buffer' from reading donated memory."""
+        blobs = boundary_blobs(0, R=8, K=16, seed=9)
+        r1 = stream_replay(
+            blobs, chunk_blobs=2, max_shards=3, min_shard_rows=1
+        )
+        r2 = stream_replay(
+            blobs, chunk_blobs=2, max_shards=3, min_shard_rows=1
+        )
+        assert r1.cache == r2.cache
+        assert r1.snapshot == r2.snapshot
+
+    def test_fleet_second_trace_after_donation(self):
+        """A reused ReplicaFleet fed a second trace must not read the
+        first round's donated column block."""
+        from crdt_tpu.models.fleet import (
+            fleet_for_trace,
+            gather_fleet,
+            load_trace,
+        )
+        from crdt_tpu.parallel.gossip import make_mesh
+
+        mesh = make_mesh(1)
+        tr = load_trace(boundary_blobs(0, R=4, K=12, seed=2),
+                        replicas_multiple=1)
+        fleet = fleet_for_trace(tr, mesh=mesh)
+        out1 = fleet.step(tr.cols, tr.dels)
+        out2 = fleet.step(tr.cols, tr.dels)  # same trace, fresh upload
+        assert (out1.global_sv == out2.global_sv).all()
+        w1 = gather_fleet(tr, out1)
+        w2 = gather_fleet(tr, out2)
+        assert w1[0] == w2[0] and w1[2] == w2[2]
+
+    def test_repeat_dispatch_helper_is_undonated(self):
+        """The bench sweep's probe re-dispatches one device matrix."""
+        cols = {
+            "client": np.ones(8, np.int64),
+            "clock": np.arange(8, dtype=np.int64),
+            "parent_is_root": np.ones(8, bool),
+            "parent_a": np.zeros(8, np.int64),
+            "parent_b": np.full(8, -1, np.int64),
+            "key_id": np.full(8, -1, np.int64),
+            "origin_client": np.full(8, -1, np.int64),
+            "origin_clock": np.full(8, -1, np.int64),
+            "valid": np.ones(8, bool),
+        }
+        dev, fn = packed.make_repeat_dispatch(packed.stage(cols))
+        a = np.asarray(fn(dev))
+        b = np.asarray(fn(dev))
+        assert (a == b).all()
+
+
+# ---------------------------------------------------------------------------
+# byte accounting
+# ---------------------------------------------------------------------------
+
+
+class TestByteAccounting:
+    def test_narrow_ships_half_the_wide_bytes(self, tracer):
+        blobs = boundary_blobs(0, R=6, K=18, seed=5)
+        from crdt_tpu.models import replay as rp
+
+        dec = rp.decode(blobs)
+        cols, _ = rp.stage(dec)
+
+        def staged_bytes(wide):
+            before = tracer.counters("xfer.").get("xfer.h2d_bytes", 0)
+            packed.converge(packed.stage(cols, wide=wide))
+            return tracer.counters("xfer.")["xfer.h2d_bytes"] - before
+
+        wide_b = staged_bytes(True)
+        narrow_b = staged_bytes(False)
+        assert narrow_b * 2 == wide_b
+        assert tracer.report()["gauges"]["xfer.narrowed_ratio"] == 0.5
+
+    def test_resident_rounds_ship_delta_bytes_only(self, tracer):
+        """Steady-state device rounds against the donated resident
+        matrix: per-round xfer.h2d_bytes growth must be delta-sized,
+        never the full matrix (the no-per-round-full-device_put
+        pin)."""
+        from crdt_tpu.models.incremental import IncrementalReplay
+
+        inc = IncrementalReplay(capacity=1 << 13)
+        inc.device_min_rows = 0  # force the device path every round
+        base = boundary_blobs(0, R=8, K=30, seed=6)
+        inc.apply(base)
+        full_mat_bytes = 7 * inc._mat.shape[1] * 8
+
+        def one_round(i):
+            recs = [
+                ItemRecord(client=99, clock=i * 40 + k, parent_root="m",
+                           key=f"k{k % 5}", content=k)
+                for k in range(40)
+            ]
+            blob = v1.encode_update(recs, DeleteSet())
+            before = tracer.counters("xfer.").get("xfer.h2d_bytes", 0)
+            inc.apply([blob])
+            return tracer.counters("xfer.")["xfer.h2d_bytes"] - before
+
+        growths = [one_round(i) for i in range(3)]
+        for g in growths:
+            assert 0 < g < full_mat_bytes // 2, (growths, full_mat_bytes)
+        # rounds of equal delta size ship equal bytes: no creeping
+        # re-upload of resident state
+        assert len(set(growths)) == 1, growths
+
+    def test_d2h_accounting_and_histograms(self, tracer):
+        blobs = boundary_blobs(0, R=4, K=12, seed=7)
+        replay_trace(blobs, route="device")
+        rep = tracer.report()
+        assert rep["counters"]["xfer.d2h_bytes"] > 0
+        assert rep["counters"]["xfer.h2d_bytes"] > 0
+        assert rep["spans"]["xfer.h2d"]["count"] > 0
+        assert rep["spans"]["xfer.d2h"]["count"] > 0
+        widths = [k for k in rep["counters"]
+                  if k.startswith("xfer.col_width{")]
+        assert widths, "per-column width histogram missing"

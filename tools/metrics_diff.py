@@ -18,7 +18,11 @@ Compared (whatever of these both artifacts carry):
   ``scale_run.stream_vs_oneshot``, ``scale_run.rounds.vs_cold_replay``;
 - tracer phase spans: per-span ``p50_s``/``p99_s``/``total_s`` from
   the embedded ``tracer`` report (lower = better);
-- the serial contenders' ``phases_device_s`` entries (lower = better).
+- the serial contenders' ``phases_device_s`` entries (lower = better);
+- bytes-on-link: the ``xfer.*`` counters/gauges from the embedded
+  tracer report and the headline/scale ``xfer`` digests
+  (``h2d_bytes``/``d2h_bytes``/``narrowed_ratio`` — LOWER is better:
+  the transfer diet is regression-gated like every latency).
 
 Prints a table (one row per metric: old, new, delta, verdict) and
 exits non-zero when any metric regressed past ``--threshold``
@@ -95,6 +99,50 @@ def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
         if _both_numbers(a, b):
             yield f"phases_device_s.{name}", float(a), float(b), \
                 False, True
+    # bytes-on-link (the transfer diet): xfer.* tracer counters and
+    # gauges, plus the per-workload xfer digests — direction-aware,
+    # bytes/puts/ratio all lower-is-better. Not time-denominated, so
+    # the seconds noise floor never mutes a byte regression.
+    for section in ("counters", "gauges"):
+        xo = (old.get("tracer") or {}).get(section, {})
+        xn = (new.get("tracer") or {}).get(section, {})
+        for name in sorted(set(xo) & set(xn)):
+            if not name.startswith("xfer.") or "{" in name:
+                continue
+            if name == "xfer.narrowed_ratio":
+                # last-writer-wins PER-UPLOAD gauge: whichever shard
+                # staged last sets it, which flaps run to run — the
+                # stable run-level ratio is derived from the gated
+                # byte counters below instead
+                continue
+            if _both_numbers(xo[name], xn[name]):
+                # bytes saved by the diet is the one xfer metric where
+                # MORE is better
+                yield f"tracer.{name}", float(xo[name]), \
+                    float(xn[name]), name.endswith("_saved"), False
+    # run-level narrowing ratio: shipped / wide-equivalent over the
+    # WHOLE run's STAGED uploads only (stable, unlike the per-upload
+    # gauge; xfer.staged_bytes excludes fleet/resident-delta traffic,
+    # whose mix shifting must not read as a narrowing change)
+    def _agg_ratio(art):
+        cnt = (art.get("tracer") or {}).get("counters", {})
+        staged, saved = cnt.get("xfer.staged_bytes"), \
+            cnt.get("xfer.h2d_bytes_saved")
+        if _both_numbers(staged, saved) and staged + saved > 0:
+            return staged / (staged + saved)
+        return None
+
+    a, b = _agg_ratio(old), _agg_ratio(new)
+    if a is not None and b is not None:
+        yield "xfer.narrowed_ratio_run", a, b, False, False
+    for path in (("xfer",), ("scale_run", "xfer_stream"),
+                 ("scale_run", "xfer_oneshot")):
+        a, b = _get_path(old, path), _get_path(new, path)
+        if isinstance(a, dict) and isinstance(b, dict):
+            for name in sorted(set(a) & set(b)):
+                if _both_numbers(a[name], b[name]):
+                    yield ".".join(path) + f".{name}", float(a[name]), \
+                        float(b[name]), name.endswith("_saved"), False
 
 
 def _both_numbers(a: Any, b: Any) -> bool:

@@ -190,7 +190,7 @@ def main():
     blobs = bench.build_trace(R, 100)
     dec = bench.decode_stage(blobs)
     cols, _ = bench.column_stage(dec)
-    plan = pk.stage(cols)
+    plan = pk.stage(cols, wide=True)  # ablations read raw int32 rows
     print(f"staged {len(cols['client'])} rows in {time.perf_counter()-t0:.1f}s "
           f"(segs={plan.num_segments} seqB={plan.seq_bucket} "
           f"kpad={plan.mat.shape[1]} dtype={plan.mat.dtype})", flush=True)
