@@ -737,6 +737,91 @@ def fleet_mesh_child(argv):
     print(json.dumps(out))
 
 
+def overload_leg(seed: int = 11) -> dict:
+    """Seeded overload evidence (guard layer): flood one replica at 4x
+    its inbox byte budget in a single delivery round, record the
+    bounded peak + shed counters, then measure post-heal convergence
+    through the re-probe path. The robustness analogue of the xfer.*
+    legs — ``tools/metrics_diff.py`` gates ``overload.peak_inbox_bytes``
+    and the shed counts so the guards can't silently regress."""
+    from crdt_tpu.net.replica import Replica
+    from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+    from crdt_tpu.obs import Tracer, get_tracer, set_tracer
+
+    budget = int(os.environ.get("BENCH_OVERLOAD_BUDGET", 4096))
+    tracer = get_tracer()
+    restore = None
+    if not tracer.enabled:
+        # the leg's evidence IS counter-based: under BENCH_TRACE=0 a
+        # disabled tracer would report shed_count/shed_bytes as 0
+        # while shedding really happened, poisoning the metrics_diff
+        # gate — force a leg-local tracer instead
+        restore = tracer
+        tracer = set_tracer(Tracer(enabled=True))
+    try:
+        return _overload_leg_body(seed, budget, tracer)
+    finally:
+        if restore is not None:
+            set_tracer(restore)
+
+
+def _overload_leg_body(seed: int, budget: int, tracer) -> dict:
+    from crdt_tpu.net.replica import Replica
+    from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+
+    shed0 = tracer.counters().get("guard.inbox_shed", 0)
+    shed_b0 = tracer.counters().get("guard.inbox_shed_bytes", 0)
+    net = LoopbackNetwork(seed=seed)
+    a = Replica(
+        LoopbackRouter(net, "a"), topic="bench-overload", client_id=1,
+        batch_incoming=True, inbox_max_bytes=budget,
+        # first repair probe deferred past the flood round: a mid-
+        # flood repair diff (admitted whole under keep-the-newest)
+        # would muddy the bounded-peak evidence
+        resync_retry_s=0.5,
+    )
+    b = Replica(LoopbackRouter(net, "b"), topic="bench-overload",
+                client_id=2)
+    net.run()
+    sizes = []
+    orig = b.doc.on_update
+
+    def hook(u, m):
+        sizes.append(len(u))
+        orig(u, m)
+
+    b.doc.on_update = hook
+    i = 0
+    while sum(sizes) < 4 * budget:
+        b.set("m", f"k{i}", "x" * 64)
+        i += 1
+    net.run()  # ONE delivery round carrying the whole 4x flood
+    peak = a.inbox_peak_bytes
+    t0 = time.perf_counter()
+    deadline = t0 + 30.0
+    while dict(a.c) != dict(b.c) or len(dict(a.c).get("m", {})) != i:
+        if time.perf_counter() > deadline:
+            raise TimeoutError("overload leg did not re-converge")
+        a.tick()
+        b.tick()
+        net.run()
+        time.sleep(0.002)
+    heal_s = time.perf_counter() - t0
+    counters = tracer.counters()
+    return {
+        "seed": seed,
+        "inbox_budget_bytes": budget,
+        "flood_bytes": sum(sizes),
+        "flood_updates": len(sizes),
+        "peak_inbox_bytes": peak,
+        "bounded": peak <= budget,
+        "shed_count": counters.get("guard.inbox_shed", 0) - shed0,
+        "shed_bytes": counters.get("guard.inbox_shed_bytes", 0) - shed_b0,
+        "heal_s": round(heal_s, 4),
+        "converged": True,
+    }
+
+
 def smoke():
     """Fast pipeline-accounting smoke: a tiny trace through all three
     contenders (numpy, one-shot device pipeline, streaming executor)
@@ -836,7 +921,60 @@ def smoke():
                 lp.store_update("smoke", blob)
             lp.compact("smoke", snap_dev)
             lp.close()
+        # guard-layer registry leg: fire each degradation ladder once
+        # so the robustness counters the regression gate reads can't
+        # rot (README "Overload & failure policy" registry)
+        from crdt_tpu.core.engine import Engine
+        from crdt_tpu.core.records import ItemRecord
+        from crdt_tpu.guard.device import dispatch_guarded
+        from crdt_tpu.guard.faults import (
+            DeviceFaultPlan,
+            DiskFaultSchedule,
+            FaultyKv,
+        )
+        from crdt_tpu.net.replica import Replica
+        from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+
+        with DeviceFaultPlan(fail_attempts=2):  # retry -> host route
+            dispatch_guarded("smoke.guard", lambda: 0, host=lambda: 0)
+        eng = Engine(1)
+        eng.pending_limit = 2  # cap -> evictions
+        eng.apply_records([
+            ItemRecord(client=9, clock=k, parent_root="s",
+                       origin=(9, k - 1), content=k)
+            for k in range(1, 7)
+        ])
+        with tempfile.TemporaryDirectory() as td:
+            lp = LogPersistence(  # retry -> degrade -> write-back
+                os.path.join(td, "guard.kvlog"),
+                kv_wrapper=lambda kv: FaultyKv(
+                    kv, DiskFaultSchedule(fail_writes={0, 1, 2})
+                ),
+                retries=2, retry_backoff_s=0.001,
+            )
+            lp.store_update("g", blobs[0])   # degrades
+            lp.store_update("g", blobs[1])   # drains + syncs
+            assert lp.get_all_updates("g") == blobs[:2]
+            lp.close()
+        net = LoopbackNetwork()
+        ra = Replica(LoopbackRouter(net, "a"), topic="g", client_id=1,
+                     batch_incoming=True, inbox_max_bytes=128)
+        rb = Replica(LoopbackRouter(net, "b"), topic="g", client_id=2)
+        net.run()
+        for i in range(4):  # one round >> budget -> sheds
+            rb.set("m", f"k{i}", "x" * 48)
+        net.run()
         report = tracer.report()
+        for cname in ("guard.inbox_shed", "guard.inbox_shed_bytes",
+                      "engine.pending_evictions", "persist.retries",
+                      "persist.degraded_writes",
+                      "persist.recovered_updates",
+                      "device.retries", "device.fallback"):
+            assert report["counters"].get(cname, 0) > 0, \
+                f"smoke: {cname} missing from guard registry"
+        assert report["gauges"].get("persist.degraded") == 0, \
+            "smoke: degraded gauge did not clear after write-back"
+        out["guard_registry_ok"] = True
         for name in ("decode", "pack", "converge.dispatch",
                      "converge.fetch", "materialize", "gather",
                      "compact", "persist", "persist.compact"):
@@ -1858,6 +1996,13 @@ def main():
         out["fleet_run"] = fleet_result
     if scale_result:
         out["scale_run"] = scale_result
+    if os.environ.get("BENCH_OVERLOAD", "1") != "0":
+        # robustness evidence: seeded 4x-budget flood, bounded peak,
+        # shed counts, post-heal convergence (regression-gated)
+        try:
+            out["overload"] = overload_leg()
+        except Exception as exc:
+            out["overload"] = {"error": repr(exc)}
     if bench_tracer is not None:
         # the full observability report (shared Tracer.report schema):
         # per-span p50/p90/p99/max histograms + counters + gauges —

@@ -74,6 +74,20 @@ class _ResidentEngineShim:
     def pending(self):
         return self._replay._pending
 
+    # guard layer: the pending-budget contract (Engine parity) — the
+    # replica layer sets the cap and drains evicted ranges through
+    # ``doc.engine`` without caring which backend answers
+    @property
+    def pending_limit(self):
+        return self._replay.pending_limit
+
+    @pending_limit.setter
+    def pending_limit(self, value) -> None:
+        self._replay.pending_limit = value
+
+    def take_evicted_ranges(self):
+        return self._replay.take_evicted_ranges()
+
     def delete_set(self) -> DeleteSet:
         # the divergence sentinel's tombstone guard reads the full
         # recorded delete set (resident state records it immediately)

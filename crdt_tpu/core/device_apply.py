@@ -122,13 +122,17 @@ def rebuild_chains(engine) -> None:
     verbatim. Host work and kernel dispatch size scale with the
     affected parents' rows, not the document (VERDICT r1 item #8; the
     HBM-resident union for the firehose path is
-    :mod:`crdt_tpu.ops.resident`)."""
-    import jax
-    import jax.numpy as jnp
+    :mod:`crdt_tpu.ops.resident`).
 
-    from crdt_tpu.ops.merge import converge_maps
-    from crdt_tpu.ops.yata import tree_order_ranks
-
+    The kernel dispatches run under the guard layer's failure ladder
+    (:func:`crdt_tpu.guard.device.dispatch_guarded`): a transient
+    device ``RuntimeError`` retries once, a persistent one splits the
+    affected parents in half (independent work — an OOM a half-size
+    dispatch survives), and a dead device falls back to the exact
+    scalar ordering (:func:`_rebuild_host`) — bit-identical state,
+    device optional. Each rung is idempotent: the rebuild clears the
+    affected chains before recomputing, so a retry after a mid-rebuild
+    failure converges to the same state."""
     s = engine.store
     n = s.n
     if n == 0:
@@ -163,6 +167,123 @@ def rebuild_chains(engine) -> None:
     if not affected:
         return  # only GC fillers admitted: no chain is touched
 
+    from crdt_tpu.guard.device import dispatch_guarded
+
+    sids = sorted(affected)
+
+    def halves():
+        if len(sids) < 2:
+            return None
+        mid = len(sids) // 2
+        lo, hi = sids[:mid], sids[mid:]
+        return [
+            (lambda: _rebuild_kernel(engine, lo),
+             lambda: _rebuild_host(engine, lo)),
+            (lambda: _rebuild_kernel(engine, hi),
+             lambda: _rebuild_host(engine, hi)),
+        ]
+
+    dispatch_guarded(
+        "engine.rebuild",
+        lambda: _rebuild_kernel(engine, sids),
+        split=halves,
+        host=lambda: _rebuild_host(engine, sids),
+    )
+
+
+def _clear_specs(engine, sids) -> None:
+    """Drop chain-derived state for the given parents (shared by the
+    kernel and host rebuild rungs; idempotent, so every ladder retry
+    starts from the same cleared baseline)."""
+    st = engine._device_rebuild_state
+    specs, spec_rows = st["specs"], st["spec_rows"]
+    for sid in sids:
+        spec = specs[sid]
+        engine._seq_head.pop(spec, None)
+        engine._seq_tail.pop(spec, None)
+        for k in engine._map_kids.pop(spec, {}):
+            engine._map_head.pop((spec, k), None)
+            engine._map_tail.pop((spec, k), None)
+        for r in spec_rows[sid]:
+            engine._next.pop(r, None)
+            engine._prev.pop(r, None)
+
+
+def _link(engine, spec, rows_in_order) -> None:
+    """Materialize one parent's chain links from an ordered row list."""
+    prev = None
+    for row in rows_in_order:
+        if prev is None:
+            engine._seq_head[spec] = row
+            engine._prev[row] = NULL
+        else:
+            engine._next[prev] = row
+            engine._prev[row] = prev
+        prev = row
+    if prev is not None:
+        engine._next[prev] = NULL
+        engine._seq_tail[spec] = prev
+
+
+def _rebuild_host(engine, sids) -> None:
+    """The ladder's last rung: rebuild the given parents' chains
+    entirely on host with the exact scalar ordering
+    (``order_hard_segment`` — the same oracle the kernel's hostile-
+    shape fallback already uses), so a dead device degrades to a
+    slower bit-identical answer instead of an exception mid-merge."""
+    from crdt_tpu.ops.yata import order_hard_segment
+
+    st = engine._device_rebuild_state
+    specs, spec_rows = st["specs"], st["spec_rows"]
+    s = engine.store
+    _clear_specs(engine, sids)
+    for sid in sids:
+        spec = specs[sid]
+        by_key: Dict[int, List[int]] = {}
+        seq_rows: List[int] = []
+        for r in spec_rows[sid]:
+            k = int(s.key_id[r])
+            if k != NO_KEY:
+                by_key.setdefault(k, []).append(r)
+            else:
+                seq_rows.append(r)
+        for k, rws in by_key.items():
+            engine._map_kids.setdefault(spec, {})[k] = None
+            recs = [engine.record_of_row(r) for r in rws]
+            ordered = order_hard_segment(
+                recs, ref_exists=lambda ref: s.has(*ref)
+            )
+            tail = s.find(*ordered[-1]) if ordered else None
+            if tail is not None:
+                engine._map_tail[(spec, k)] = tail
+            for r in rws:
+                if r != tail and not s.deleted[r]:
+                    # LWW loser tombstones: same post-hoc invariant the
+                    # kernel path enforces (Yjs Item.integrate)
+                    engine._delete_row(r)
+        if seq_rows:
+            recs = [engine.record_of_row(r) for r in seq_rows]
+            ordered = order_hard_segment(
+                recs, ref_exists=lambda ref: s.has(*ref)
+            )
+            _link(engine, spec, [s.find(c, k) for c, k in ordered])
+
+
+def _rebuild_kernel(engine, sids) -> None:
+    """One kernel-driven rebuild pass over the given parents (the
+    ladder's first rung; see :func:`rebuild_chains`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from crdt_tpu.ops.merge import converge_maps
+    from crdt_tpu.ops.yata import tree_order_ranks
+
+    s = engine.store
+    st = engine._device_rebuild_state
+    specs, spec_rows = st["specs"], st["spec_rows"]
+    row_spec = st["row_spec"]
+    affected = set(sids)
+
     # -- select the affected parents' rows: O(their rows), not O(doc) --
     sel = np.sort(
         np.fromiter(
@@ -173,16 +294,7 @@ def rebuild_chains(engine) -> None:
     m = len(sel)
 
     # -- clear derived state for affected parents only -----------------
-    for sid in affected:
-        spec = specs[sid]
-        engine._seq_head.pop(spec, None)
-        engine._seq_tail.pop(spec, None)
-        for k in engine._map_kids.pop(spec, {}):
-            engine._map_head.pop((spec, k), None)
-            engine._map_tail.pop((spec, k), None)
-    for r in sel.tolist():
-        engine._next.pop(r, None)
-        engine._prev.pop(r, None)
+    _clear_specs(engine, sids)
 
     raw_client = s.client[sel]
     clock = s.clock[sel]
@@ -359,23 +471,10 @@ def rebuild_chains(engine) -> None:
             by_seg.setdefault(int(seg[j]), []).append((int(rank[j]), j))
         inv = {lsid: gsid for gsid, lsid in local_seg_of.items()}
 
-        def link(spec, rows_in_order):
-            prev = None
-            for row in rows_in_order:
-                if prev is None:
-                    engine._seq_head[spec] = row
-                    engine._prev[row] = NULL
-                else:
-                    engine._next[prev] = row
-                    engine._prev[row] = prev
-                prev = row
-            if prev is not None:
-                engine._next[prev] = NULL
-                engine._seq_tail[spec] = prev
-
         for lsid, pairs in by_seg.items():
             pairs.sort()
-            link(specs[inv[lsid]], [int(sel[j]) for _, j in pairs])
+            _link(engine, specs[inv[lsid]],
+                  [int(sel[j]) for _, j in pairs])
 
         if hard_local:
             from crdt_tpu.ops.yata import order_hard_segment
@@ -388,7 +487,8 @@ def rebuild_chains(engine) -> None:
                 ordered = order_hard_segment(
                     recs, ref_exists=lambda ref: engine.store.has(*ref)
                 )
-                link(
+                _link(
+                    engine,
                     specs[inv[lsid]],
                     [engine.store.find(c, k) for c, k in ordered],
                 )

@@ -72,6 +72,14 @@ class Engine:
         # pending remote records / deletes waiting on dependencies
         self.pending: List[ItemRecord] = []
         self.pending_deletes = DeleteSet()
+        # pending-stash budget (guard layer): None = unbounded (the
+        # historical behavior); an int caps len(pending) — overflow
+        # evicts the records FURTHEST from integrable (largest clocks
+        # per client: their blocker is deepest) and records the
+        # evicted (client, clock) ranges so the replica layer can
+        # re-probe the blocking peer (crdt_tpu/guard).
+        self.pending_limit: Optional[int] = None
+        self.evicted_ranges: Dict[int, Tuple[int, int]] = {}
         # per-client next expected clock (contiguity guard)
         self._next_clock: Dict[int, int] = {}
         # root name -> kind hint ("map"/"array") from observed items
@@ -366,6 +374,11 @@ class Engine:
             raise
         for recs in waiting.values():
             self.pending.extend(recs)
+        if (
+            self.pending_limit is not None
+            and len(self.pending) > self.pending_limit
+        ):
+            self._evict_pending()
         if delete_set is not None:
             self._apply_delete_set(delete_set)
         self._retry_pending_deletes()
@@ -385,6 +398,41 @@ class Engine:
                 "engine.pending_delete_ranges",
                 sum(len(v) for v in self.pending_deletes.ranges.values()),
             )
+
+    def _evict_pending(self) -> None:
+        """Shrink the stash to ``pending_limit`` by dropping the
+        records DEEPEST in their own client's queue (the shared
+        fairness/recovery policy —
+        :func:`crdt_tpu.guard.limits.evict_deepest`). Evicted ids
+        merge into ``evicted_ranges`` (client -> (lo, hi)); the
+        replica layer drains them via :meth:`take_evicted_ranges` and
+        re-probes."""
+        from crdt_tpu.guard.limits import evict_deepest
+
+        evicted, ranges = evict_deepest(
+            [(r.client, r.clock) for r in self.pending], self.pending_limit
+        )
+        if not evicted:
+            return
+        ev = set(evicted)
+        n_before = len(self.pending)
+        self.pending = [
+            r for r in self.pending if (r.client, r.clock) not in ev
+        ]
+        for c, (lo, hi) in ranges.items():
+            plo, phi = self.evicted_ranges.get(c, (lo, hi))
+            self.evicted_ranges[c] = (min(plo, lo), max(phi, hi))
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.count(
+                "engine.pending_evictions", n_before - len(self.pending)
+            )
+
+    def take_evicted_ranges(self) -> Dict[int, Tuple[int, int]]:
+        """Drain the evicted (client, clock) range bookkeeping — the
+        replica layer's cue to issue targeted SV re-probes."""
+        ev, self.evicted_ranges = self.evicted_ranges, {}
+        return ev
 
     def _blocker_of(self, rec: ItemRecord) -> Optional[Tuple[int, int]]:
         """The first id this record is waiting on: the previous clock

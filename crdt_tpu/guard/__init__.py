@@ -1,0 +1,54 @@
+"""Resource governance + failure policy: degrade, don't die.
+
+Every other layer of the stack has a loss story (PR 2's retry/relay/
+anti-entropy ladder for the network, PR 3's recorder/sentinel for
+observing failure) — this package is the same discipline for MEMORY,
+DISK, and DEVICE. Four bounded-degradation ladders, each observable
+through the tracer and each exercisable by a seeded fault schedule:
+
+- **ingest**  — ``Replica._inbox`` byte/count budget; overflow sheds
+  the OLDEST buffered updates and re-arms the anti-entropy/re-probe
+  path to re-fetch them (``guard.inbox_shed`` counters).
+- **engine**  — ``Engine.pending`` / ``IncrementalReplay._pending``
+  record cap; overflow evicts the records FURTHEST from integrable
+  (largest clocks — their blocker is deepest), records the missing
+  ``(client, clock)`` ranges, and the replica re-probes the blocking
+  peer with bounded backoff until the evicted state is re-fetched
+  (``engine.pending_evictions``, ``guard.resync_probes``).
+- **storage** — ``LogPersistence`` retries failed KV batches with
+  backoff, then degrades to a bounded in-memory overflow buffer
+  (``persist.degraded`` gauge) and writes it back + ``sync()`` on the
+  first successful write (``persist.recovered_updates``).
+- **device**  — converge dispatches run through a
+  retry → split-in-half → host-route ladder
+  (:func:`crdt_tpu.guard.device.dispatch_guarded`), so a TPU OOM or
+  transient XLA error yields a slower correct answer instead of an
+  exception mid-merge (``device.retries``, ``device.fallback``).
+
+The adversaries live in :mod:`crdt_tpu.guard.faults` (seeded
+ENOSPC/EIO/torn-batch disk schedules, crash points, scripted device
+faults, a dependency-withholding network schedule) in the
+:mod:`crdt_tpu.net.faults` style: deterministic, replayable, pinned by
+tier-1 chaos tests (tests/test_guard.py). See README "Overload &
+failure policy" for the knob table and counter registry.
+"""
+
+from crdt_tpu.guard.device import dispatch_guarded
+from crdt_tpu.guard.limits import evict_deepest
+from crdt_tpu.guard.faults import (
+    DeviceFaultPlan,
+    DiskFaultSchedule,
+    FaultyKv,
+    SimulatedCrash,
+    WithholdDeps,
+)
+
+__all__ = [
+    "DeviceFaultPlan",
+    "DiskFaultSchedule",
+    "FaultyKv",
+    "SimulatedCrash",
+    "WithholdDeps",
+    "dispatch_guarded",
+    "evict_deepest",
+]

@@ -367,6 +367,12 @@ class IncrementalReplay:
         # has a gap, or whose origin/right has not arrived, stash here
         # (columns + content keyed by id) and retry on every apply
         self._pending: Dict[Tuple[int, int], Tuple] = {}
+        # pending-stash budget (guard layer) — same contract as
+        # Engine.pending_limit: None = unbounded; overflow evicts the
+        # largest-clock entries and records the evicted ranges for the
+        # replica's targeted re-probe (take_evicted_ranges)
+        self.pending_limit: Optional[int] = None
+        self.evicted_ranges: Dict[int, Tuple[int, int]] = {}
         # packed delete-RANGE cache over self.ds (client, start, end
         # arrays for rows_visible) — tombstones are never expanded to
         # per-clock ids: a few delete-set bytes can declare ranges
@@ -401,19 +407,24 @@ class IncrementalReplay:
             return
         shifted = bool(self._clients) and new[0] < self._clients[-1]
         old = dict(self._dense) if shifted else None
-        self._clients = sorted(self._clients + new)
-        self._clients_arr = np.asarray(self._clients)
-        self._dense = {raw: i for i, raw in enumerate(self._clients)}
+        clients = sorted(self._clients + new)
+        dense = {raw: i for i, raw in enumerate(clients)}
         if old and self.n_dev:
             perm = np.zeros(len(old), np.int32)
             for raw, od in old.items():
-                perm[od] = self._dense[raw]
+                perm[od] = dense[raw]
             with enable_x64(True):
                 self._mat = pk._relabel_mat(
                     self._mat, self._jnp.asarray(perm)
                 )
             # host columns keep RAW ids; only the device matrix embeds
             # dense ids, so no host fixups
+        # the table commits only AFTER the device relabel succeeded: a
+        # guarded-ladder retry must redo the relabel, not skip it
+        # against a matrix still carrying the old dense ids
+        self._clients = clients
+        self._clients_arr = np.asarray(clients)
+        self._dense = dense
 
     def _dense_of(self, raw: np.ndarray) -> np.ndarray:
         return np.searchsorted(self._clients_arr, raw).astype(np.int64)
@@ -1037,6 +1048,11 @@ class IncrementalReplay:
                 int(oc[j]), int(ock[j]), int(rc[j]), int(rk[j]),
                 int(kind[j]), int(tref[j]), contents[j],
             )
+        if (
+            self.pending_limit is not None
+            and len(self._pending) > self.pending_limit
+        ):
+            self._evict_pending()
         if not admit.any():
             return np.empty(0, np.int64)
         # bump per-client next clocks past the admitted runs
@@ -1097,6 +1113,32 @@ class IncrementalReplay:
                 else:
                     self._rootless.add(sk)
         return rows
+
+    def _evict_pending(self) -> None:
+        """Shrink the stash to ``pending_limit``: drop the ids deepest
+        in their own client's queue (the shared fairness/recovery
+        policy — :func:`crdt_tpu.guard.limits.evict_deepest`) and
+        record the evicted ranges for the replica's targeted
+        re-probe."""
+        from crdt_tpu.guard.limits import evict_deepest
+
+        evicted, ranges = evict_deepest(
+            list(self._pending), self.pending_limit
+        )
+        for key in evicted:
+            del self._pending[key]
+        for c, (lo, hi) in ranges.items():
+            plo, phi = self.evicted_ranges.get(c, (lo, hi))
+            self.evicted_ranges[c] = (min(plo, lo), max(phi, hi))
+        if evicted:
+            from crdt_tpu.obs.tracer import get_tracer
+
+            get_tracer().count("engine.pending_evictions", len(evicted))
+
+    def take_evicted_ranges(self) -> Dict[int, Tuple[int, int]]:
+        """Drain evicted-range bookkeeping (Engine contract)."""
+        ev, self.evicted_ranges = self.evicted_ranges, {}
+        return ev
 
     # -- cache laziness -----------------------------------------------
     @property
@@ -1494,57 +1536,98 @@ class IncrementalReplay:
             rows = np.arange(self.n_dev, self.cols.n)
             k = len(rows)
             oc_tail = self.cols.col("oc")[rows]
-            self._intern_clients(np.concatenate([
-                self.cols.col("client")[rows], oc_tail[oc_tail >= 0],
-            ]))
             tpad = _octave(len(dev_segs), floor=1 << 10)
             kpad = max(_octave(k, floor=1 << 6), tpad)
-            delta = np.zeros((8, kpad), np.int64)
-            delta[3:6, :] = -1
-            delta[7, :] = np.iinfo(np.int64).max
-            delta[7, : len(dev_segs)] = dev_segs
-            oc_raw = oc_tail
-            delta[0, :k] = self._dense_of(self.cols.col("client")[rows])
-            delta[1, :k] = self.cols.col("clock")[rows]
-            delta[2, :k] = np.maximum(self.cols.col("pref")[rows], 0)
-            delta[3, :k] = self.cols.col("kid")[rows]
-            delta[4, :k] = np.where(oc_raw >= 0, self._dense_of(
-                np.clip(oc_raw, self._clients[0] if self._clients else 0,
-                        None)
-            ), -1)
-            delta[5, :k] = self.cols.col("ock")[rows]
-            delta[6, :k] = self.cols.col("pref")[rows] >= 0
-            # rows without a resolvable parent (incl. GC fillers) stay
-            # invalid on device: origin lookups that miss them fall
-            # back to root attachment, same convention as the cold path
 
-            self._ensure_mat()
-            need = self.n_dev + kpad
-            if need > self._mat.shape[1]:
-                with enable_x64(True):
-                    self._mat = pk._grow_mat(
-                        self._mat, new_cap=bucket_pow2(need)
-                    )
-            n_sel = sum(len(self._seg_rows[sk]) for sk in dev_segs)
-            sel_bucket = min(
-                _octave(n_sel, floor=1 << 13),
-                self._mat.shape[1],
-            )
+            from crdt_tpu.guard.device import dispatch_guarded
             from crdt_tpu.ops.device import xfer_fetch, xfer_put
 
-            with enable_x64(True):
-                # the round's ONE upload: the delta block only — the
-                # resident matrix is donated in place, so steady-state
-                # bytes-on-link scale with the delta, never the doc
-                # (xfer.h2d_bytes pins this in tests)
-                self._mat, packed_out = pk._splice_select_converge(
-                    self._mat, xfer_put(delta, label="incremental.delta"),
-                    jnp.int32(self.n_dev),
-                    num_segments=tpad,
-                    sel_bucket=sel_bucket, seq_bucket=sel_bucket,
-                )
-                # the round's ONE fetch
-                h = xfer_fetch(packed_out, label="incremental.out")
+            n_sel = sum(len(self._seg_rows[sk]) for sk in dev_segs)
+
+            def _dispatch():
+                # EVERY device interaction of the round — client
+                # interning (which may relabel the resident matrix)
+                # and matrix allocation/growth included — runs inside
+                # the guarded attempt, so a dying device (or a matrix
+                # invalidated by a previous post-donation failure)
+                # lands in the ladder instead of escaping as a raw
+                # RuntimeError. Idempotent on retry: intern commits
+                # only after its relabel succeeds, ensure/grow are
+                # no-ops once capacity exists, and the delta block is
+                # rebuilt per attempt.
+                self._intern_clients(np.concatenate([
+                    self.cols.col("client")[rows], oc_tail[oc_tail >= 0],
+                ]))
+                delta = np.zeros((8, kpad), np.int64)
+                delta[3:6, :] = -1
+                delta[7, :] = np.iinfo(np.int64).max
+                delta[7, : len(dev_segs)] = dev_segs
+                oc_raw = oc_tail
+                delta[0, :k] = self._dense_of(self.cols.col("client")[rows])
+                delta[1, :k] = self.cols.col("clock")[rows]
+                delta[2, :k] = np.maximum(self.cols.col("pref")[rows], 0)
+                delta[3, :k] = self.cols.col("kid")[rows]
+                delta[4, :k] = np.where(oc_raw >= 0, self._dense_of(
+                    np.clip(oc_raw,
+                            self._clients[0] if self._clients else 0,
+                            None)
+                ), -1)
+                delta[5, :k] = self.cols.col("ock")[rows]
+                delta[6, :k] = self.cols.col("pref")[rows] >= 0
+                # rows without a resolvable parent (incl. GC fillers)
+                # stay invalid on device: origin lookups that miss
+                # them fall back to root attachment, same convention
+                # as the cold path
+                self._ensure_mat()
+                need = self.n_dev + kpad
+                with enable_x64(True):
+                    if need > self._mat.shape[1]:
+                        self._mat = pk._grow_mat(
+                            self._mat, new_cap=bucket_pow2(need)
+                        )
+                    sel_bucket = min(
+                        _octave(n_sel, floor=1 << 13),
+                        self._mat.shape[1],
+                    )
+                    # the round's ONE upload: the delta block only —
+                    # the resident matrix is donated in place, so
+                    # steady-state bytes-on-link scale with the delta,
+                    # never the doc (xfer.h2d_bytes pins this)
+                    mat, packed_out = pk._splice_select_converge(
+                        self._mat,
+                        xfer_put(delta, label="incremental.delta"),
+                        jnp.int32(self.n_dev),
+                        num_segments=tpad,
+                        sel_bucket=sel_bucket, seq_bucket=sel_bucket,
+                    )
+                    # the round's ONE fetch
+                    return mat, xfer_fetch(
+                        packed_out, label="incremental.out"
+                    ), sel_bucket
+
+            # device failure ladder (crdt_tpu/guard): retry once, then
+            # route the WHOLE round host-side — host segments converge
+            # against the resident columns with zero device work, and
+            # the unspliced tail simply waits for the next healthy
+            # device round (the same contract the crossover uses), so
+            # a dying device costs latency, never state. The matrix is
+            # only reassigned on success.
+            res = dispatch_guarded(
+                "incremental.converge", _dispatch, host=lambda: None
+            )
+            if res is None:
+                # ladder exhausted: a post-donation execution failure
+                # may have invalidated the resident matrix, so drop it
+                # — the next device round re-splices the ENTIRE host
+                # column set into a fresh matrix (n_dev=0). A full
+                # rebuild once the device heals, never a permanent
+                # host-route degrade on a healthy device.
+                self._mat = None
+                self.n_dev = 0
+                host_segs.extend(dev_segs)
+                dev_segs = []
+        if dev_segs:
+            self._mat, h, sel_bucket = res
             # advance by the REAL row count: the padded tail is
             # invalid and the next splice overwrites it, keeping
             # device positions identical to host row ids

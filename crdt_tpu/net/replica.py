@@ -161,6 +161,11 @@ class Replica:
         anti_entropy_max_s: Optional[float] = None,
         sentinel: Optional[bool] = None,
         on_divergence: Optional[Callable[[dict], None]] = None,
+        inbox_max_bytes: Optional[int] = None,
+        inbox_max_updates: Optional[int] = None,
+        pending_max_records: Optional[int] = None,
+        resync_retry_s: float = 0.25,
+        resync_max_retries: int = 20,
     ):
         if not getattr(router, "is_ypear_router", False):
             raise TypeError("router is not a ypear router")  # crdt.js:172
@@ -267,6 +272,30 @@ class Replica:
         self.batch_incoming = batch_incoming
         self._inbox: List[tuple] = []  # (update bytes, meta dict)
 
+        # resource guards (crdt_tpu/guard): the inbox byte/count
+        # budget sheds the OLDEST buffered updates (re-fetched via the
+        # anti-entropy/re-probe path — our SV never advertised them),
+        # and the pending-stash cap evicts blocked records whose
+        # missing (client, clock) ranges the re-probe machinery below
+        # then re-fetches from the blocking peer. None = unbounded
+        # (the historical behavior).
+        self.inbox_max_bytes = inbox_max_bytes
+        self.inbox_max_updates = inbox_max_updates
+        self._inbox_bytes = 0
+        self.inbox_peak_bytes = 0  # bench/test evidence of boundedness
+        if pending_max_records is not None:
+            self.doc.engine.pending_limit = pending_max_records
+        # bounded-backoff targeted re-probe: armed by sheds/evictions,
+        # pumped by tick(); independent of the un-synced probe retry
+        # schedule (a replica can be "synced" and still owe itself a
+        # re-fetch of evicted state)
+        self.resync_retry_s = resync_retry_s
+        self.resync_max_retries = resync_max_retries
+        self._resync_at: Optional[float] = None
+        self._resync_interval = resync_retry_s
+        self._resync_retries = 0
+        self._resync_needs: Dict[int, int] = {}  # client -> clock owed
+
         # divergence sentinel (obs.sentinel): snapshot-hash beacons
         # ride the anti-entropy cadence (``sentinel=None`` => beacons
         # enabled exactly when ``anti_entropy_s`` is set). Inbound
@@ -357,7 +386,8 @@ class Replica:
             return
         self.probe()
 
-    def probe(self, public_key: Optional[str] = None) -> None:
+    def probe(self, public_key: Optional[str] = None, *,
+              _rearm: bool = True) -> None:
         """Unconditional ready probe (unlike :meth:`sync`, which is a
         no-op once synced): ask one peer — or everyone — for whatever
         we lack. The two-way handshake then reconciles both sides.
@@ -365,7 +395,9 @@ class Replica:
         A topology-triggered probe (``public_key`` set: someone
         joined) re-arms the retry schedule from its base interval —
         new peers are new chances to sync, whatever the retry budget
-        said before."""
+        said before. The resync pump passes ``_rearm=False``: its
+        probes ride their OWN backoff and must not refresh the join
+        schedule's retry budget on every pump."""
         if self.closed:
             return
         self.flush_incoming()  # advertise the SV incl. buffered updates
@@ -381,19 +413,21 @@ class Replica:
                 replica=self.router.public_key, peer=public_key,
             )
         if public_key is not None:
-            self._probe_retries = 0
-            self._probe_interval = self.probe_retry_s
-            if not self.synced:
-                # re-arm from the BASE interval even when a (backed-
-                # off) deadline is already pending: the new peer is a
-                # fresh chance to sync and must be retried promptly
-                self._next_probe_at = (
-                    time.monotonic() + self._probe_interval * jitter()
-                )
+            if _rearm:
+                self._probe_retries = 0
+                self._probe_interval = self.probe_retry_s
+                if not self.synced:
+                    # re-arm from the BASE interval even when a
+                    # (backed-off) deadline is already pending: the
+                    # new peer is a fresh chance to sync and must be
+                    # retried promptly
+                    self._next_probe_at = (
+                        time.monotonic() + self._probe_interval * jitter()
+                    )
             self._to_peer(public_key, msg)
         else:
             self._broadcast(msg)
-        if not self.synced and self._next_probe_at is None:
+        if _rearm and not self.synced and self._next_probe_at is None:
             self._next_probe_at = (
                 time.monotonic() + self._probe_interval * jitter()
             )
@@ -425,6 +459,8 @@ class Replica:
                     now + self._probe_interval * jitter()
                 )
                 self.probe()
+        if self._resync_at is not None and now >= self._resync_at:
+            self._pump_resync(now)
         if self._next_ae_at is not None and now >= self._next_ae_at:
             get_tracer().count("replica.anti_entropy_rounds")
             sent = self.anti_entropy()
@@ -445,6 +481,102 @@ class Replica:
                     self._ae_interval * 2, self.anti_entropy_max_s
                 )
             self._next_ae_at = now + self._ae_interval * jitter()
+
+    # ------------------------------------------------------------------
+    # guard layer: shed + targeted re-probe (crdt_tpu/guard)
+    # ------------------------------------------------------------------
+    def _shed_inbox(self) -> None:
+        """Enforce the inbox budget: drop the OLDEST buffered updates
+        until within bounds (always keeping the newest — a single
+        over-budget update must still make progress). Shed updates
+        were never applied, so our advertised SV doesn't cover them
+        and any ready-probe answer re-ships them; shedding therefore
+        trades latency for bounded memory, never state. Each shed
+        re-arms the anti-entropy cadence and the re-probe schedule so
+        the re-fetch is immediate, not left to luck."""
+        def over(n_left: int, bytes_left: int) -> bool:
+            return (
+                (self.inbox_max_bytes is not None
+                 and bytes_left > self.inbox_max_bytes)
+                or (self.inbox_max_updates is not None
+                    and n_left > self.inbox_max_updates)
+            )
+
+        if not over(len(self._inbox), self._inbox_bytes):
+            return
+        # one O(shed) slice, not per-item pop(0): a tiny-update flood
+        # against a byte budget can hold MANY buffered items, and the
+        # guard must stay linear exactly when it is needed
+        shed_n = shed_b = 0
+        n = len(self._inbox)
+        while n - shed_n > 1 and over(n - shed_n, self._inbox_bytes):
+            shed_b += len(self._inbox[shed_n][0])
+            self._inbox_bytes -= len(self._inbox[shed_n][0])
+            shed_n += 1
+        if not shed_n:
+            return
+        self._inbox = self._inbox[shed_n:]
+        tracer = get_tracer()
+        tracer.count("guard.inbox_shed", shed_n)
+        tracer.count("guard.inbox_shed_bytes", shed_b)
+        tracer.gauge("guard.inbox_bytes", self._inbox_bytes)
+        rec = get_recorder()
+        if rec.enabled:
+            rec.record(
+                "guard.shed", topic=self.topic,
+                replica=self.router.public_key, n=shed_n, size=shed_b,
+            )
+        # immediate AE re-arm: the next tick runs the repair round now
+        if self._next_ae_at is not None:
+            self._next_ae_at = time.monotonic()
+        self._arm_resync()
+
+    def _arm_resync(self, needs: Optional[Dict[int, int]] = None) -> None:
+        """Arm (or extend) the bounded-backoff re-probe. ``needs``
+        maps client -> highest evicted clock; satisfaction = our SV
+        passing that clock. A shed arms with no needs: one prompt
+        probe re-fetches whatever was dropped (the answer is an SV
+        diff, so it is exact), with the AE cadence as the backstop."""
+        if needs:
+            for c, hi in needs.items():
+                self._resync_needs[c] = max(self._resync_needs.get(c, -1), hi)
+        if self._resync_at is None:
+            self._resync_interval = self.resync_retry_s
+            self._resync_retries = 0
+            self._resync_at = (
+                time.monotonic() + self._resync_interval * jitter()
+            )
+
+    def _resync_target(self) -> Optional[str]:
+        """A peer whose recorded SV covers an owed range — the
+        BLOCKING peer, probed by unicast; None broadcasts."""
+        for c, hi in self._resync_needs.items():
+            for pk, sv in self.peer_state_vectors.items():
+                if sv.get(c) > hi:
+                    return pk
+        return None
+
+    def _pump_resync(self, now: float) -> None:
+        sv = self.doc.state_vector()
+        self._resync_needs = {
+            c: hi for c, hi in self._resync_needs.items()
+            if sv.get(c) <= hi
+        }
+        if self._resync_retries >= self.resync_max_retries:
+            # bounded: the periodic anti-entropy cadence (and any
+            # topology change) remains the backstop
+            self._resync_at = None
+            return
+        self._resync_retries += 1
+        get_tracer().count("guard.resync_probes")
+        self.probe(self._resync_target(), _rearm=False)
+        if self._resync_needs:
+            self._resync_interval = min(
+                self._resync_interval * 2, self.probe_retry_max_s
+            )
+            self._resync_at = now + self._resync_interval * jitter()
+        else:
+            self._resync_at = None  # satisfied (or shed-only: one shot)
 
     def beacon(self) -> None:
         """Broadcast one divergence-sentinel beacon: our state vector
@@ -597,21 +729,44 @@ class Replica:
         if self.persistence is None or self.persistence.closed:
             return
         tracer = get_tracer()
-        with tracer.span("replica.persist"):
-            sv = self.doc.encode_state_vector()
-            if _prefers_batch_verb(type(self.persistence)):
-                self.persistence.store_updates(
-                    self.topic, list(updates), sv=sv
+        try:
+            with tracer.span("replica.persist"):
+                sv = self.doc.encode_state_vector()
+                if _prefers_batch_verb(type(self.persistence)):
+                    self.persistence.store_updates(
+                        self.topic, list(updates), sv=sv
+                    )
+                else:  # no batch verb, or store_update overridden below it
+                    for u in updates:
+                        self.persistence.store_update(self.topic, u, sv=sv)
+        except (OSError, RuntimeError) as e:
+            # storage failure policy, last-resort rung: a disk fault
+            # must degrade (the doc still holds the state; the WAL is
+            # merely behind), never kill the apply path mid-merge.
+            # LogPersistence retries + buffers internally and only
+            # raises once ITS policy is exhausted or set to "raise";
+            # this guard covers third-party backends with no policy.
+            tracer.count("persist.errors")
+            rec = get_recorder()
+            if rec.enabled:
+                rec.record(
+                    "persist.error", topic=self.topic,
+                    replica=self.router.public_key, error=repr(e)[:200],
                 )
-            else:  # no batch verb, or store_update overridden below it
-                for u in updates:
-                    self.persistence.store_update(self.topic, u, sv=sv)
+            return
         for u in updates:
             tracer.count("replica.bytes_persisted", len(u))
         if self.compact_every:
-            meta = self.persistence.get_meta(self.topic)
-            if meta and meta.get("count", 0) >= self.compact_every:
-                self.compact()
+            try:
+                meta = self.persistence.get_meta(self.topic)
+                if meta and meta.get("count", 0) >= self.compact_every:
+                    self.compact()
+            except (OSError, RuntimeError):
+                # same policy as the store verbs above: a failing
+                # compaction trigger (meta read or the compact write)
+                # must degrade — skipped now, retried at the next
+                # threshold crossing — never kill the apply path
+                tracer.count("persist.errors")
 
     def compact(self) -> None:
         """Squash the update log into one full-state snapshot."""
@@ -704,6 +859,13 @@ class Replica:
         if "update" in msg:
             if self.batch_incoming:
                 self._inbox.append((msg["update"], dict(msg), from_pk))
+                self._inbox_bytes += len(msg["update"])
+                self._shed_inbox()
+                # peak measured post-shed: the budget is a real bound
+                # (exceeded only by a single over-budget update, which
+                # is always kept — see _shed_inbox)
+                if self._inbox_bytes > self.inbox_peak_bytes:
+                    self.inbox_peak_bytes = self._inbox_bytes
                 return
             self._apply_incoming([(msg["update"], dict(msg), from_pk)])
 
@@ -714,6 +876,14 @@ class Replica:
         if not self._inbox:
             return 0
         items, self._inbox = self._inbox, []
+        if self._inbox_bytes and (
+            self.inbox_max_bytes is not None
+            or self.inbox_max_updates is not None
+        ):
+            # keep the budget gauge honest: a drained inbox is 0
+            # bytes, not whatever the last shed left behind
+            get_tracer().gauge("guard.inbox_bytes", 0)
+        self._inbox_bytes = 0
         self._apply_incoming(items)
         return len(items)
 
@@ -736,8 +906,11 @@ class Replica:
                     self.doc.apply_updates(syncs, origin="sync")
         except ValueError:
             # a malformed blob poisons its whole batch decode; isolate
-            # it so other peers' valid updates still land (application
-            # is idempotent, so re-applying survivors is safe)
+            # it by RECURSIVE BISECTION so one poisoned blob in an
+            # N-update flush costs O(log N) extra merge transactions,
+            # not O(N) per-item retries (application is idempotent, so
+            # re-applying survivors is safe; replica.isolation_splits
+            # pins the cost in the malformed-update tests)
             if len(items) == 1:
                 tracer.count("replica.malformed_updates")
                 if rec.enabled:
@@ -748,11 +921,26 @@ class Replica:
                         digest=update_digest(items[0][0]),
                     )
                 return
-            for item in items:
-                self._apply_incoming([item])
+            tracer.count("replica.isolation_splits")
+            mid = len(items) // 2
+            self._apply_incoming(items[:mid])
+            self._apply_incoming(items[mid:])
             return
         if updates:
             self._reset_ae_backoff()  # remote activity: stay chatty
+        # pending-stash evictions (guard layer): the engine recorded
+        # the missing (client, clock) ranges; arm the targeted
+        # bounded-backoff re-probe that re-fetches the evicted state
+        take = getattr(self.doc.engine, "take_evicted_ranges", None)
+        ev = take() if take is not None else None
+        if ev:
+            if rec.enabled:
+                rec.record(
+                    "guard.evict", topic=self.topic,
+                    replica=self.router.public_key,
+                    ranges={c: list(r) for c, r in ev.items()},
+                )
+            self._arm_resync({c: hi for c, (_, hi) in ev.items()})
         if obs_on:
             # observability tail AFTER a successful merge (so the
             # malformed-batch per-item retry above records each

@@ -130,6 +130,31 @@ _CLOCK_BITS = 40
 
 _WIDE_ENV = "CRDT_TPU_WIDE_STAGING"
 
+# ---------------------------------------------------------------------------
+# device fault hook: the injection seam for the guarded-dispatch
+# ladder (crdt_tpu.guard.device). The hook fires before every guarded
+# dispatch attempt and may raise RuntimeError to simulate a device
+# fault (OOM, preemption, a dropped tunnel) — chaos schedules drive
+# the retry → split → host ladder without a real dying accelerator.
+# ---------------------------------------------------------------------------
+
+_DEVICE_FAULT_HOOK = None
+
+
+def set_device_fault_hook(fn):
+    """Install ``fn(stage, attempt)`` as the guarded-dispatch fault
+    hook (None uninstalls). Returns the previous hook so callers can
+    restore it; :class:`crdt_tpu.guard.faults.DeviceFaultPlan` wraps
+    this in a context manager."""
+    global _DEVICE_FAULT_HOOK
+    old = _DEVICE_FAULT_HOOK
+    _DEVICE_FAULT_HOOK = fn
+    return old
+
+
+def device_fault_hook():
+    return _DEVICE_FAULT_HOOK
+
 
 def wide_staging_forced() -> bool:
     """Debug knob (README "Transfer diet"): CRDT_TPU_WIDE_STAGING=1

@@ -137,6 +137,23 @@ class Batch:
     def payload(self) -> bytes:
         return bytes(self._buf)
 
+    def ops(self):
+        """Decode the buffered ops back out: yields ("put", key,
+        value) / ("del", key, None). The wire layout (op byte, klen
+        u32le, vlen u32le, key, value) lives HERE only — fault
+        injectors (crdt_tpu.guard.faults.FaultyKv) replay batches op
+        by op through this iterator, so a format change cannot
+        silently desynchronize the crash-point harness."""
+        buf, i = self._buf, 0
+        while i < len(buf):
+            op = buf[i]
+            klen = int.from_bytes(buf[i + 1:i + 5], "little")
+            vlen = int.from_bytes(buf[i + 5:i + 9], "little")
+            key = bytes(buf[i + 9:i + 9 + klen])
+            val = bytes(buf[i + 9 + klen:i + 9 + klen + vlen])
+            i += 9 + klen + vlen
+            yield ("put", key, val) if op == 0 else ("del", key, None)
+
 
 class KvLog:
     """One open store (= one log file). Not multi-process safe — same

@@ -60,17 +60,75 @@ def _meta_key(doc: str) -> bytes:
     return f"doc_{_esc(doc)}_meta".encode()
 
 
+# Process-wide degraded registry: the ``persist.degraded`` gauge counts
+# currently-degraded (store, doc) windows across EVERY LogPersistence
+# in the process (they share one tracer), so one store's recovery can
+# never mask another store's still-active degradation. 0 = all clear.
+_DEGRADED: set = set()
+
+
+def _set_degraded(store, doc_name: str, on: bool) -> None:
+    key = (id(store), doc_name)
+    if on:
+        _DEGRADED.add(key)
+    else:
+        _DEGRADED.discard(key)
+    get_tracer().gauge("persist.degraded", len(_DEGRADED))
+
+
 class LogPersistence:
     """Drop-in for :class:`crdt_tpu.net.replica.MemoryPersistence`,
     backed by the native store. One kvlog file may hold many docs (the
     reference opens one LevelDB per path; the keyspace is already
-    doc-prefixed so sharing is safe and cheaper)."""
+    doc-prefixed so sharing is safe and cheaper).
 
-    def __init__(self, path: str, *, validate: bool = True):
+    Failure policy (crdt_tpu/guard): a failed KV batch retries with
+    backoff (``retries`` x ``retry_backoff_s``, ``persist.retries``
+    counter), then — under the default ``failure_policy="degrade"`` —
+    the window lands in a BOUNDED in-memory overflow buffer
+    (``overflow_max_bytes``, enforced across every doc the store
+    buffers; the ``persist.degraded`` gauge counts currently-degraded
+    (store, doc) windows process-wide, 0 = all clear) instead of
+    raising into the apply path. The buffer drains into the
+    next successful write (one batch, followed by ``sync()``;
+    ``persist.recovered_updates``), and reads (``get_all_updates`` /
+    ``get_state_vector``) see buffered state meanwhile, so replicas
+    syncing FROM persistence never observe the outage. Past the bound
+    the OLDEST buffered updates drop (``persist.dropped_updates`` —
+    visible, bounded, and only lossy if the process dies while the
+    disk is still down). ``failure_policy="raise"`` restores the
+    historical propagate-everything behavior.
+
+    ``kv_wrapper`` is the fault-injection seam: a callable applied to
+    every freshly opened :class:`KvLog` (e.g. ``lambda kv:
+    FaultyKv(kv, schedule)`` — :mod:`crdt_tpu.guard.faults`), so
+    seeded ENOSPC/EIO/torn-batch/crash schedules survive close/open
+    cycles."""
+
+    def __init__(self, path: str, *, validate: bool = True,
+                 retries: int = 2, retry_backoff_s: float = 0.01,
+                 failure_policy: str = "degrade",
+                 overflow_max_bytes: int = 4 << 20,
+                 kv_wrapper=None):
+        if failure_policy not in ("degrade", "raise"):
+            raise ValueError(f"unknown failure_policy {failure_policy!r}")
         self.path = str(path)
         self.validate = validate
-        self._kv: Optional[KvLog] = KvLog(self.path)
+        self.retries = retries
+        self.retry_backoff_s = retry_backoff_s
+        self.failure_policy = failure_policy
+        self.overflow_max_bytes = overflow_max_bytes
+        self._kv_wrapper = kv_wrapper
+        self._kv: Optional[KvLog] = None
         self._next_seq: dict = {}
+        self._overflow: dict = {}      # doc -> [update bytes]
+        self._overflow_sv: dict = {}   # doc -> latest sv bytes
+        self._overflow_bytes = 0
+        self._kv = self._make_kv()
+
+    def _make_kv(self):
+        kv = KvLog(self.path)
+        return self._kv_wrapper(kv) if self._kv_wrapper else kv
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -79,14 +137,44 @@ class LogPersistence:
 
     def open(self) -> None:
         if self._kv is None:
-            self._kv = KvLog(self.path)
+            self._kv = self._make_kv()
+            # _next_seq is derived from the log scan on every open:
+            # a cached value can be stale after a crashed compact
+            # (satellite fix, round 10 — see _seq_for/compact)
             self._next_seq.clear()
 
     def close(self) -> None:
         if self._kv is not None:
-            self._kv.sync()
+            # best-effort write-back of degraded-mode buffers: the
+            # process is exiting, so a still-failing disk drops them
+            # (counted — the honest semantics of degraded mode)
+            for doc in list(self._overflow):
+                try:
+                    self._flush_overflow(doc)
+                except OSError:
+                    lost = self._overflow.pop(doc, [])
+                    self._overflow_sv.pop(doc, None)
+                    _set_degraded(self, doc, False)
+                    get_tracer().count(
+                        "persist.dropped_updates", len(lost)
+                    )
+            self._overflow_bytes = 0
+            try:
+                self._kv.sync()
+            except OSError:
+                pass  # nothing more to do on a dead disk at close
             self._kv.close()
             self._kv = None
+
+    def __del__(self):
+        # a degraded store dropped without close() must not pin the
+        # process-wide gauge forever (and its registry keys embed
+        # id(self), which the allocator may reuse after this dealloc)
+        try:
+            for key in [k for k in _DEGRADED if k[0] == id(self)]:
+                _set_degraded(self, key[1], False)
+        except Exception:
+            pass  # interpreter shutdown: globals may already be gone
 
     def _require(self) -> KvLog:
         if self._kv is None:
@@ -128,41 +216,177 @@ class LogPersistence:
             if not isinstance(u, (bytes, bytearray)):
                 raise TypeError("update must be bytes")  # crdt.js:29-31
         updates = [bytes(u) for u in updates]
-        if not updates:
+        if not updates and not self._overflow.get(doc_name):
             return
         if self.validate:
             from crdt_tpu.codec import v1
 
             for u in updates:
                 v1.decode_update(u)  # raises on malformed input
-        kv = self._require()
+        self._require()
         tracer = get_tracer()
+        # drain any degraded-mode buffer FIRST (same batch): recovery
+        # is automatic on the first write the disk accepts
+        drain = self._overflow.pop(doc_name, [])
+        if drain:
+            self._overflow_bytes -= sum(map(len, drain))
+            if sv is None:
+                sv = self._overflow_sv.get(doc_name)
+        window = drain + updates
         with tracer.span("persist"):
-            batch = Batch()
-            for u in updates:
-                batch.put(_update_key(doc_name, self._seq_for(doc_name)), u)
-            if sv is not None:
-                batch.put(_sv_key(doc_name), bytes(sv))
-            meta = self.get_meta(doc_name) or {"size": 0, "count": 0}
-            batch.put(
-                _meta_key(doc_name),
-                json.dumps(
-                    {
-                        "last_updated": time.time(),
-                        "size": meta["size"] + sum(map(len, updates)),
-                        "count": meta["count"] + len(updates),
-                    }
-                ).encode(),
-            )
-            kv.write(batch)
-        tracer.count("persist.appends", len(updates))
+            try:
+                self._write_with_retry(doc_name, window, sv)
+            except OSError:
+                if self.failure_policy == "raise":
+                    # restore the drained buffer: raising must not
+                    # silently discard previously accepted updates
+                    if drain:
+                        self._overflow[doc_name] = (
+                            drain + self._overflow.get(doc_name, [])
+                        )
+                        self._overflow_bytes += sum(map(len, drain))
+                    raise
+                self._degrade(doc_name, window, sv)
+                return
+        if drain:
+            # recovered: the buffered window is durable — make it so
+            # on disk too before declaring the degradation over
+            self._require().sync()
+            self._overflow_sv.pop(doc_name, None)
+            tracer.count("persist.recovered_updates", len(drain))
+        _set_degraded(self, doc_name, False)
+        tracer.count("persist.appends", len(window))
         tracer.count("persist.batches")
-        tracer.count("persist.bytes_appended", sum(map(len, updates)))
+        tracer.count("persist.bytes_appended", sum(map(len, window)))
+
+    def _write_batch(self, doc_name: str, updates: List[bytes],
+                     sv: Optional[bytes]) -> None:
+        kv = self._require()
+        batch = Batch()
+        for u in updates:
+            batch.put(_update_key(doc_name, self._seq_for(doc_name)), u)
+        if sv is not None:
+            batch.put(_sv_key(doc_name), bytes(sv))
+        meta = self.get_meta(doc_name) or {"size": 0, "count": 0}
+        batch.put(
+            _meta_key(doc_name),
+            json.dumps(
+                {
+                    "last_updated": time.time(),
+                    "size": meta["size"] + sum(map(len, updates)),
+                    "count": meta["count"] + len(updates),
+                }
+            ).encode(),
+        )
+        kv.write(batch)
+
+    def _write_with_retry(self, doc_name: str, updates: List[bytes],
+                          sv: Optional[bytes]) -> None:
+        """One window write with bounded-backoff retries. On any
+        failure the cached ``_next_seq`` is invalidated so the next
+        attempt re-derives it from the log scan — a torn batch on a
+        non-atomic store may have landed a prefix of the keys."""
+        from crdt_tpu.guard.faults import retry_with_backoff
+
+        def attempt():
+            try:
+                self._write_batch(doc_name, updates, sv)
+            except OSError:
+                self._next_seq.pop(doc_name, None)
+                raise
+
+        retry_with_backoff(
+            attempt, retries=self.retries,
+            backoff_s=self.retry_backoff_s, counter="persist.retries",
+        )
+
+    def _degrade(self, doc_name: str, updates: List[bytes],
+                 sv: Optional[bytes]) -> None:
+        """Disk still failing after retries: buffer the window in RAM
+        (bounded — oldest drop past ``overflow_max_bytes``), flip the
+        ``persist.degraded`` gauge, and let the next successful write
+        (or ``flush_degraded``) drain it back."""
+        tracer = get_tracer()
+        buf = self._overflow.setdefault(doc_name, [])
+        buf.extend(updates)
+        self._overflow_bytes += sum(map(len, updates))
+        if sv is not None:
+            self._overflow_sv[doc_name] = bytes(sv)
+        _set_degraded(self, doc_name, True)
+        # the bound is GLOBAL across every doc this store buffers:
+        # drop the oldest update of the largest buffered doc, always
+        # keeping the newest update of the window degrading right now
+        # (a single over-budget update must still make progress)
+        sizes = {d: sum(map(len, b)) for d, b in self._overflow.items()}
+        dropped_n = 0
+        while self._overflow_bytes > self.overflow_max_bytes:
+            victim = max(
+                (d for d in self._overflow
+                 if d != doc_name or len(self._overflow[d]) > 1),
+                key=lambda d: sizes[d], default=None,
+            )
+            if victim is None:
+                break  # only the current window's newest remains
+            vbuf = self._overflow[victim]
+            dropped = vbuf.pop(0)
+            self._overflow_bytes -= len(dropped)
+            sizes[victim] -= len(dropped)
+            dropped_n += 1
+            if not vbuf:
+                del self._overflow[victim]
+                del sizes[victim]
+                self._overflow_sv.pop(victim, None)
+                _set_degraded(self, victim, False)
+        if dropped_n:
+            tracer.count("persist.dropped_updates", dropped_n)
+        tracer.count("persist.degraded_writes")
+        tracer.gauge("persist.overflow_bytes", self._overflow_bytes)
+
+    def flush_degraded(self) -> bool:
+        """Explicitly retry the degraded-mode write-back for every
+        buffered doc (the drain also rides every ordinary write).
+        Returns True when no buffer remains."""
+        for doc in list(self._overflow):
+            try:
+                self._flush_overflow(doc)
+            except OSError:
+                return False
+        return not self._overflow
+
+    def _flush_overflow(self, doc_name: str) -> None:
+        drain = self._overflow.pop(doc_name, [])
+        if not drain:
+            return
+        self._overflow_bytes -= sum(map(len, drain))
+        try:
+            self._write_with_retry(
+                doc_name, drain, self._overflow_sv.get(doc_name)
+            )
+        except OSError:
+            self._overflow[doc_name] = (
+                drain + self._overflow.get(doc_name, [])
+            )
+            self._overflow_bytes += sum(map(len, drain))
+            raise
+        self._require().sync()
+        self._overflow_sv.pop(doc_name, None)
+        tracer = get_tracer()
+        tracer.count("persist.recovered_updates", len(drain))
+        _set_degraded(self, doc_name, False)
 
     def get_all_updates(self, doc_name: str) -> List[bytes]:
-        return [v for _, v in self._require().scan_prefix(_update_prefix(doc_name))]
+        # degraded-mode buffers append after the log: readers (replica
+        # restarts-within-process, peers syncing from persistence) see
+        # accepted updates whether or not the disk took them yet
+        logged = [
+            v for _, v in self._require().scan_prefix(_update_prefix(doc_name))
+        ]
+        return logged + list(self._overflow.get(doc_name, []))
 
     def get_state_vector(self, doc_name: str) -> Optional[bytes]:
+        ov = self._overflow_sv.get(doc_name)
+        if ov is not None and self._overflow.get(doc_name):
+            return ov
         return self._require().get(_sv_key(doc_name))
 
     def get_meta(self, doc_name: str) -> Optional[dict]:
@@ -171,14 +395,33 @@ class LogPersistence:
 
     def compact(self, doc_name: str, snapshot: bytes, sv: Optional[bytes] = None) -> None:
         """Replace the doc's update log with one snapshot update, then
-        drop dead log history from disk."""
+        drop dead log history from disk.
+
+        Crash-safe at every intermediate write, even on a store WITHOUT
+        atomic batches (the torn-batch adversary in
+        :mod:`crdt_tpu.guard.faults`): the snapshot is PUT at a fresh
+        sequence number BEFORE the old log keys are deleted, so any
+        prefix of the batch leaves either the full old log, old log +
+        snapshot (idempotent replay), or a partial old log + snapshot
+        (the snapshot dominates) — never an empty log. The native
+        store's batch is atomic anyway; the ordering is the defense in
+        depth the crash-point matrix pins. A compaction failure
+        degrades (the un-compacted log is perfectly valid; retried at
+        the next threshold crossing) and invalidates the cached
+        ``_next_seq`` so sequence numbers re-derive from the log scan
+        — a stale cache after a torn compact could otherwise overwrite
+        a live key (satellite fix, round 10)."""
         kv = self._require()
         tracer = get_tracer()
         with tracer.span("persist.compact"):
+            old_keys = kv.keys(_update_prefix(doc_name))
             batch = Batch()
-            for k in kv.keys(_update_prefix(doc_name)):
+            batch.put(
+                _update_key(doc_name, self._seq_for(doc_name)),
+                bytes(snapshot),
+            )
+            for k in old_keys:
                 batch.delete(k)
-            batch.put(_update_key(doc_name, 0), bytes(snapshot))
             if sv is not None:
                 batch.put(_sv_key(doc_name), bytes(sv))
             batch.put(
@@ -187,8 +430,28 @@ class LogPersistence:
                     {"last_updated": time.time(), "size": len(snapshot), "count": 1}
                 ).encode(),
             )
-            kv.write(batch)
-            self._next_seq[doc_name] = 1
+            try:
+                from crdt_tpu.guard.faults import retry_with_backoff
+
+                retry_with_backoff(
+                    lambda: kv.write(batch), retries=self.retries,
+                    backoff_s=self.retry_backoff_s,
+                    counter="persist.retries",
+                )
+            except OSError:
+                self._next_seq.pop(doc_name, None)
+                tracer.count("persist.compact_errors")
+                if self.failure_policy == "raise":
+                    raise
+                return
+            # compaction squashed everything the overflow buffer held
+            # (the snapshot is full state): the buffer is now redundant
+            if self._overflow.pop(doc_name, None) is not None:
+                self._overflow_sv.pop(doc_name, None)
+                self._overflow_bytes = sum(
+                    sum(map(len, v)) for v in self._overflow.values()
+                )
+                _set_degraded(self, doc_name, False)
             # reclaim disk only when dead history dominates: kv.compact()
             # rewrites the WHOLE shared store, so an unconditional call
             # would make N docs' auto-compaction O(store) each — amortize

@@ -87,3 +87,16 @@ def test_bench_smoke_mode(tmp_path):
     # the smoke device leg's own xfer digest rides the stdout line
     assert out["xfer"]["h2d_bytes"] > 0
     assert out["xfer"]["d2h_bytes"] > 0
+
+    # the guard-layer registry (README "Overload & failure policy"):
+    # each degradation ladder fired once in the smoke and its
+    # counters are live, so the robustness regression gate
+    # (tools/metrics_diff.py GUARD_PREFIXES) always has data to read
+    assert out.get("guard_registry_ok") is True
+    for cname in ("guard.inbox_shed", "guard.inbox_shed_bytes",
+                  "engine.pending_evictions", "persist.retries",
+                  "persist.degraded_writes", "persist.recovered_updates",
+                  "device.retries", "device.fallback"):
+        assert report["counters"].get(cname, 0) > 0, cname
+    # degraded flipped on AND recovered during the leg
+    assert report["gauges"].get("persist.degraded") == 0

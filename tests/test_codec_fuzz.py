@@ -1,0 +1,118 @@
+"""Adversarial decoder fuzz: malformed v1 blobs fail CLOSED.
+
+Random truncations, bit mutations, and splices of valid v1 update
+blobs, applied to both the raw decoder and the full document apply
+path, must uphold three contracts:
+
+- ``ValueError`` only — never a hang, never any other exception type
+  (an AssertionError/KeyError escaping the decode seam means a
+  crafted blob can kill a replica's poll loop);
+- all-or-nothing per blob — a rejected update leaves the document
+  byte-identical (state, pending stash, delete set): partial mutation
+  would silently fork replicas;
+- bounded cost — the corpus is seeded and fixed-size so this stays
+  tier-1 (the decoder's expansion budget, pinned elsewhere, is what
+  makes "never hang" hold for the hostile-length family).
+
+A mutant that still decodes cleanly is FINE (bit flips can land in
+content bytes); the contracts above are about the rejects.
+"""
+
+import random
+
+from crdt_tpu.api.doc import Crdt
+from crdt_tpu.codec import v1
+
+
+def _corpus():
+    """Deterministic blobs covering every struct family: map sets,
+    nested arrays, sequence runs, deletes, GC-able history, plus a
+    full-state snapshot (the densest wire shape)."""
+    src = Crdt(7)
+    blobs = []
+    src.on_update = lambda u, m: blobs.append(u)
+    src.set("m", "k1", {"a": [1, 2], "b": None})
+    src.set("m", "k2", "v" * 40)
+    src.push("l", ["x", "y", "z"])
+    src.insert("l", 1, "mid")
+    src.cut("l", 0, 2)
+    src.delete("m", "k2")
+    src.set("nest", "arr", [9, 8], array_method="push")
+    src.set("nest", "arr", 7, array_method="insert", index=1)
+    blobs.append(src.encode_state_as_update())
+    return blobs
+
+
+def _mutants(blobs, rng, per_blob=60):
+    for blob in blobs:
+        for _ in range(per_blob):
+            b = bytearray(blob)
+            op = rng.randrange(3)
+            if op == 0 and len(b) > 1:  # truncation
+                yield bytes(b[: rng.randrange(1, len(b))])
+            elif op == 1:  # bit mutation (1-3 flips)
+                for _ in range(rng.randrange(1, 4)):
+                    b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+                yield bytes(b)
+            else:  # splice two blobs at random offsets
+                other = blobs[rng.randrange(len(blobs))]
+                cut = rng.randrange(1, len(b) + 1)
+                yield bytes(b[:cut]) + other[rng.randrange(len(other)):]
+
+
+def _doc_fingerprint(doc):
+    return (
+        doc.encode_state_as_update(),
+        doc.encode_state_vector(),
+        [r.id for r in doc.engine.pending],
+        sorted(doc.engine.pending_deletes.ranges.items()),
+    )
+
+
+def test_fuzzed_blobs_raise_value_error_only_and_never_partially_apply():
+    blobs = _corpus()
+    rng = random.Random(20260803)
+    base = blobs[0]
+
+    checked = rejected = 0
+    for m in _mutants(blobs, rng):
+        checked += 1
+        # raw decoder: ValueError is the whole error contract
+        try:
+            v1.decode_update(m)
+        except ValueError:
+            pass
+
+        # full apply path (native codec when available): rejected
+        # blobs must leave the doc byte-identical — state, SV,
+        # pending stash, pending deletes
+        doc = Crdt(9)
+        doc.apply_update(base)
+        before = _doc_fingerprint(doc)
+        try:
+            doc.apply_update(m)
+        except ValueError:
+            rejected += 1
+            assert _doc_fingerprint(doc) == before
+    assert checked == 540
+    # the corpus is adversarial enough that most mutants reject
+    assert rejected > checked // 4, (checked, rejected)
+
+
+def test_fuzzed_single_records_keep_engine_consistent():
+    """Mutants that DO decode must still integrate without raising
+    anything but ValueError — and an integrated mutant's doc must
+    re-encode to a decodable update (no poisoned re-export)."""
+    blobs = _corpus()
+    rng = random.Random(77)
+    for m in _mutants(blobs, rng, per_blob=20):
+        try:
+            records, ds = v1.decode_update(m)
+        except ValueError:
+            continue
+        doc = Crdt(9)
+        try:
+            doc.apply_update(m)
+        except ValueError:
+            continue
+        v1.decode_update(doc.encode_state_as_update())

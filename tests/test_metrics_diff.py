@@ -187,3 +187,42 @@ def test_cli_exit_codes(tmp_path, capsys):
     assert "REGRESSION" in out.out
     # a loose threshold lets the same pair pass
     assert main([str(a), str(b), "--threshold", "0.95"]) == 0
+
+
+def test_guard_counters_lower_is_better():
+    """The robustness registry (guard.* / evictions / degraded /
+    device fallbacks) gates direction-aware: MORE degradation on the
+    same workload is a regression, less is an improvement."""
+    old = copy.deepcopy(OLD)
+    old["tracer"]["counters"].update({
+        "guard.inbox_shed": 10,
+        "engine.pending_evictions": 4,
+        "persist.degraded_writes": 1,
+        "device.fallback": 2,
+        "persist.recovered_updates": 1,
+        'device.fallback_by{route="host"}': 2,  # labeled: skipped
+    })
+    old["tracer"]["gauges"]["persist.degraded"] = 0
+    new = copy.deepcopy(old)
+    new["tracer"]["counters"]["guard.inbox_shed"] = 30
+    new["tracer"]["counters"]["device.fallback"] = 1
+    new["tracer"]["counters"]["persist.recovered_updates"] = 99
+    rows, regressed = compare(old, new)
+    assert "tracer.guard.inbox_shed" in regressed
+    by_name = {r["metric"]: r for r in rows}
+    assert by_name["tracer.device.fallback"]["verdict"] == "improved"
+    # recovered_updates and labeled counters are not gated
+    assert "tracer.persist.recovered_updates" not in by_name
+    assert not any("route=" in r["metric"] for r in rows)
+
+
+def test_overload_section_gated():
+    old = copy.deepcopy(OLD)
+    old["overload"] = {
+        "peak_inbox_bytes": 300, "shed_count": 12, "shed_bytes": 900,
+        "heal_s": 0.5,
+    }
+    new = copy.deepcopy(old)
+    new["overload"]["peak_inbox_bytes"] = 500
+    rows, regressed = compare(old, new)
+    assert "overload.peak_inbox_bytes" in regressed

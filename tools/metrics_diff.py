@@ -50,8 +50,34 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     (("scale_run", "vs_baseline"), True),
     (("scale_run", "stream_vs_oneshot"), True),
     (("scale_run", "rounds", "vs_cold_replay"), True),
+    # the overload evidence leg (bench.py overload_leg): bounded peak
+    # inbox bytes + shed counts + post-heal convergence — robustness
+    # regression-gated like xfer.* (all lower-is-better)
+    (("overload", "peak_inbox_bytes"), False),
+    (("overload", "shed_count"), False),
+    (("overload", "shed_bytes"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
+
+# guard-layer counters/gauges (crdt_tpu/guard): sheds, evictions,
+# degraded windows, device fallbacks — every one LOWER-is-better (a
+# rise means the same workload leaned harder on a degradation ladder),
+# and none is time-denominated, so the seconds noise floor never mutes
+# a regression. Exact names and prefixes, unlabeled variants only.
+GUARD_PREFIXES: Tuple[str, ...] = (
+    "guard.",
+    "engine.pending_evictions",
+    "persist.degraded",
+    "persist.errors",
+    "persist.retries",
+    "persist.dropped_updates",
+    "persist.compact_errors",
+    "device.retries",
+    "device.fallback",
+    "device.dispatch_errors",
+    "replica.isolation_splits",
+    "replica.malformed_updates",
+)
 
 
 def _get_path(d: Dict[str, Any], path: Tuple[str, ...]) -> Any:
@@ -120,6 +146,18 @@ def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
                 # MORE is better
                 yield f"tracer.{name}", float(xo[name]), \
                     float(xn[name]), name.endswith("_saved"), False
+    # guard-layer degradation counters/gauges: all lower-is-better
+    # (persist.recovered_updates is deliberately NOT gated — it rises
+    # and falls with degraded_writes, which already is), never seconds
+    for section in ("counters", "gauges"):
+        go = (old.get("tracer") or {}).get(section, {})
+        gn = (new.get("tracer") or {}).get(section, {})
+        for name in sorted(set(go) & set(gn)):
+            if "{" in name or not name.startswith(GUARD_PREFIXES):
+                continue
+            if _both_numbers(go[name], gn[name]):
+                yield f"tracer.{name}", float(go[name]), \
+                    float(gn[name]), False, False
     # run-level narrowing ratio: shipped / wide-equivalent over the
     # WHOLE run's STAGED uploads only (stable, unlike the per-upload
     # gauge; xfer.staged_bytes excludes fleet/resident-delta traffic,
