@@ -36,7 +36,7 @@ from types import MappingProxyType
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from crdt_tpu.codec import v1
-from crdt_tpu.core.engine import Engine, ParentSpec
+from crdt_tpu.core.engine import Engine, ParentSpec  # noqa: F401 — ParentSpec is part of the Doc API surface
 from crdt_tpu.core.ids import DeleteSet, StateVector
 from crdt_tpu.core.store import NULL, TYPE_ARRAY
 

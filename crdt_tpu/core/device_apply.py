@@ -272,7 +272,6 @@ def _rebuild_host(engine, sids) -> None:
 def _rebuild_kernel(engine, sids) -> None:
     """One kernel-driven rebuild pass over the given parents (the
     ladder's first rung; see :func:`rebuild_chains`)."""
-    import jax
     import jax.numpy as jnp
 
     from crdt_tpu.ops.merge import converge_maps

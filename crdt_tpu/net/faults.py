@@ -191,6 +191,7 @@ class FaultyEndpoint:
                 elif d["dup"]:
                     kinds.append("dup")
             for kind in kinds:
+                # crdtlint: emits=fault.drop,fault.partition,fault.corrupt,fault.delay,fault.dup
                 rec.record(
                     f"fault.{kind}", src=flow[0], dst=flow[1], seq=n,
                     size=len(data), digest=update_digest(data),

@@ -15,6 +15,7 @@ Conventions for all kernels in this package:
 from __future__ import annotations
 
 import os
+import threading
 from contextlib import contextmanager
 
 import jax
@@ -139,6 +140,12 @@ _WIDE_ENV = "CRDT_TPU_WIDE_STAGING"
 # ---------------------------------------------------------------------------
 
 _DEVICE_FAULT_HOOK = None
+# guards the fault hook and the one-time reset-hook warning flag:
+# this module is reached from the streaming decode pool, and the
+# hook's swap-and-return-old contract (DeviceFaultPlan nests restore
+# inside install) is only correct if the read-modify-write is atomic
+# (crdtlint CL601)
+_HOOK_LOCK = threading.Lock()
 
 
 def set_device_fault_hook(fn):
@@ -147,9 +154,10 @@ def set_device_fault_hook(fn):
     restore it; :class:`crdt_tpu.guard.faults.DeviceFaultPlan` wraps
     this in a context manager."""
     global _DEVICE_FAULT_HOOK
-    old = _DEVICE_FAULT_HOOK
-    _DEVICE_FAULT_HOOK = fn
-    return old
+    with _HOOK_LOCK:
+        old = _DEVICE_FAULT_HOOK
+        _DEVICE_FAULT_HOOK = fn
+        return old
 
 
 def device_fault_hook():
@@ -249,6 +257,12 @@ def record_staged_widths(widths: dict, shipped_bytes: int,
 # compile)
 _LOCAL_CPU_COMPILED: set = set()
 
+# guards the module-level memo caches (_LOCAL_CPU_COMPILED, _pack_fns):
+# this module is reached from the streaming thread pool, and an
+# unlocked read-then-write loses one thread's entry (a wasted
+# recompile, and CL601 exists to keep the class of bug out)
+_CACHE_LOCK = threading.Lock()
+
 
 _RESET_HOOK_WARNED = False
 
@@ -262,19 +276,21 @@ def _warn_no_reset_hook() -> None:
     tests/test_device_merge.py pins the hook so a jax upgrade that
     removes it fails loudly instead of landing here in production."""
     global _RESET_HOOK_WARNED
-    if not _RESET_HOOK_WARNED:
+    with _HOOK_LOCK:
+        if _RESET_HOOK_WARNED:
+            return
         _RESET_HOOK_WARNED = True
-        import warnings
+    import warnings
 
-        warnings.warn(
-            "jax._src.compilation_cache.reset_cache is unavailable: "
-            "persistent-cache suppression around local-CPU compiles "
-            "is a no-op (SIGILL hazard for cross-backend cached "
-            "artifacts). Pin CRDT_TPU_COMPILE_CACHE=\"\" to disable "
-            "the cache, or update crdt_tpu for this jax version.",
-            RuntimeWarning,
-            stacklevel=3,
-        )
+    warnings.warn(
+        "jax._src.compilation_cache.reset_cache is unavailable: "
+        "persistent-cache suppression around local-CPU compiles "
+        "is a no-op (SIGILL hazard for cross-backend cached "
+        "artifacts). Pin CRDT_TPU_COMPILE_CACHE=\"\" to disable "
+        "the cache, or update crdt_tpu for this jax version.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
 
 
 def _cache_singleton_reset(cache_dir) -> bool:
@@ -329,7 +345,8 @@ def on_local_cpu(cache_key=None):
         with _jax.default_device(cpu):
             yield
         if cache_key is not None:
-            _LOCAL_CPU_COMPILED.add(cache_key)
+            with _CACHE_LOCK:
+                _LOCAL_CPU_COMPILED.add(cache_key)
     finally:
         if suppress:
             _cache_singleton_reset(old)
@@ -367,12 +384,17 @@ def fetch_packed_i32(*arrays):
     tunnelled platforms; all kernel index/segment outputs fit int32
     (values < the pad bucket, NULLI = -1). Returns host arrays in
     input order."""
-    fn = _pack_fns.get(len(arrays))
-    if fn is None:
-        fn = jax.jit(
-            lambda *xs: jnp.concatenate([x.astype(jnp.int32) for x in xs])
-        )
-        _pack_fns[len(arrays)] = fn
+    with _CACHE_LOCK:
+        fn = _pack_fns.get(len(arrays))
+        if fn is None:
+            # cheap under the lock: jax.jit only wraps here, the
+            # actual compile happens at the (unlocked) call below
+            fn = jax.jit(
+                lambda *xs: jnp.concatenate(
+                    [x.astype(jnp.int32) for x in xs]
+                )
+            )
+            _pack_fns[len(arrays)] = fn
     h = xfer_fetch(fn(*arrays), label="packed_i32")
     out, off = [], 0
     for a in arrays:

@@ -33,7 +33,6 @@ from crdt_tpu.core.records import ItemRecord
 from crdt_tpu.ops import deleteset as ds_ops
 from crdt_tpu.ops.device import (
     NULLI,
-    dense_ranks_sorted,
     lexsort,
     pack_id,
     scatter_perm,

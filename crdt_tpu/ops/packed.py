@@ -45,7 +45,6 @@ import numpy as np
 from crdt_tpu.ops.device import (
     NULLI,
     bucket_grid,
-    bucket_pow2,
     dense_ranks_sorted,
     dfs_ranks,
     lexsort,
@@ -1386,8 +1385,6 @@ def converge_host(plan: PackedPlan) -> PackedResult:
         raise ValueError(
             "converge_host needs a matrix-staged plan (stage(put=None))"
         )
-    import jax as _jax
-
     from crdt_tpu.ops.device import on_local_cpu
 
     args = _plan_args(plan)

@@ -1,7 +1,6 @@
 """v1 update codec tests: round-trips, run coalescing, diff updates,
 golden byte layouts, and malformed input."""
 
-import json
 import random
 
 import pytest
@@ -10,8 +9,7 @@ from crdt_tpu.codec import v1
 from crdt_tpu.codec.lib0 import Decoder, Encoder
 from crdt_tpu.core.engine import Engine
 from crdt_tpu.core.ids import DeleteSet, StateVector
-from crdt_tpu.core.records import ItemRecord
-from crdt_tpu.core.store import K_ANY, K_DELETED, K_GC, K_STRING, TYPE_ARRAY
+from crdt_tpu.core.store import K_ANY, K_GC, K_STRING, TYPE_ARRAY
 
 
 def test_state_vector_roundtrip():

@@ -1,0 +1,999 @@
+"""Unit tests for the crdtlint analyzer itself (tools/crdtlint).
+
+Synthetic in-memory snippets per checker — positive (fires),
+negative (clean), suppressed (inline disable), and baseline-matched —
+plus the suppression-comment and baseline-file round-trips. The
+tier-1 gate over the real package lives in tests/test_lint.py; THIS
+file proves the analyzer's own semantics, so a checker regression
+shows up as a unit failure, not as silently-green lint.
+"""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from tools.crdtlint.core import (  # noqa: E402
+    BaselineError,
+    LintConfig,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from tools.crdtlint.registry import Registry  # noqa: E402
+
+
+def lint(files, registry=None, baseline=None):
+    """Lint {relpath: source} snippets with an empty default registry
+    (synthetic runs opt into documented names explicitly)."""
+    config = LintConfig(
+        repo_root="/synthetic", readme_path="", smoke_test_path="",
+        baseline_path="/synthetic/absent.json",
+    )
+    return run_lint(
+        [(path, textwrap.dedent(src)) for path, src in files.items()],
+        config=config,
+        baseline=baseline or {},
+        shared={
+            "metric_registry":
+                registry if registry is not None else Registry()
+        },
+    )
+
+
+def codes(result):
+    return sorted(f.code for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# CL101 use-after-donate
+
+
+DONATING_DEF = '''
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _converge(mat, n):
+        return mat * n
+
+    def _converge_nodonate(mat, n):
+        return mat * n
+'''
+
+
+def test_cl101_read_after_donate_fires():
+    r = lint({"crdt_tpu/ops/x.py": DONATING_DEF + '''
+    def caller(mat):
+        out = _converge(mat, 3)
+        return mat.sum() + out
+    '''})
+    assert "CL101" in codes(r)
+
+
+def test_cl101_rebind_is_clean():
+    r = lint({"crdt_tpu/ops/x.py": DONATING_DEF + '''
+    def caller(mat):
+        mat = _converge(mat, 3)
+        return mat.sum()
+    '''})
+    assert "CL101" not in codes(r)
+
+
+def test_cl101_self_attribute_tracking():
+    r = lint({"crdt_tpu/ops/x.py": DONATING_DEF + '''
+    class C:
+        def run(self):
+            out = _converge(self._mat, 3)
+            return self._mat.shape
+    '''})
+    assert "CL101" in codes(r)
+
+
+def test_cl101_branches_do_not_cross():
+    # donation in one branch must not poison the sibling branch
+    r = lint({"crdt_tpu/ops/x.py": DONATING_DEF + '''
+    def caller(mat, flag):
+        if flag:
+            out = _converge(mat, 3)
+        else:
+            out = mat.sum()
+        return out
+    '''})
+    assert "CL101" not in codes(r)
+
+
+def test_cl101_donation_in_if_test_fires():
+    # a donation INSIDE the if-test expression flows into both
+    # branches and past the if (the test is evaluated exactly once,
+    # before either branch runs)
+    r = lint({"crdt_tpu/ops/x.py": DONATING_DEF + '''
+    def caller(mat):
+        if _converge(mat, 3):
+            return mat.sum()
+        return mat.shape
+    '''})
+    assert codes(r).count("CL101") == 2
+
+
+def test_cl101_loop_without_rebind_fires():
+    r = lint({"crdt_tpu/ops/x.py": DONATING_DEF + '''
+    def caller(mat):
+        acc = 0
+        for i in range(3):
+            acc += _converge(mat, i)
+        return acc
+    '''})
+    assert any(
+        f.code == "CL101" and "loop" in f.message for f in r.findings
+    )
+
+
+def test_cl101_loop_with_rebind_clean():
+    r = lint({"crdt_tpu/ops/x.py": DONATING_DEF + '''
+    def caller(mat):
+        for i in range(3):
+            mat = _converge(mat, i)
+        return mat
+    '''})
+    assert "CL101" not in codes(r)
+
+
+def test_cl101_factory_result_in_loop():
+    # the gossip factory pattern: step donates arg 0; packed rebuilt
+    # each round is clean, reused is a finding
+    src = '''
+    import jax
+
+    def make_step(n):
+        def step(block, dels):
+            return block * n
+        return jax.jit(step, donate_argnums=(0,))
+
+    def good(n, build):
+        step = make_step(n)
+        for i in range(4):
+            block = build(i)
+            out = step(block, ())
+        return out
+
+    def bad(n, block):
+        step = make_step(n)
+        for i in range(4):
+            out = step(block, ())
+        return out
+    '''
+    r = lint({"crdt_tpu/parallel/x.py": src})
+    bad_lines = [f for f in r.findings if f.code == "CL101"]
+    assert len(bad_lines) == 1
+    assert "block" in bad_lines[0].message
+
+
+def test_cl102_missing_twin_and_satisfied_twin():
+    src = '''
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _converge_solo(mat):
+        return mat
+    '''
+    r = lint({"crdt_tpu/ops/x.py": src})
+    assert "CL102" in codes(r)
+    # DONATING_DEF has a _nodonate twin: no CL102
+    r2 = lint({"crdt_tpu/ops/y.py": DONATING_DEF})
+    assert "CL102" not in codes(r2)
+
+
+# ---------------------------------------------------------------------------
+# CL201/202/203 registry conformance
+
+
+def reg(*names, events=()):
+    r = Registry()
+    for n in names:
+        r.add(n, "metric", "README.md", 1)
+    for n in events:
+        r.add(n, "event", "README.md", 2)
+    return r
+
+
+def test_cl201_unregistered_metric_fires():
+    r = lint(
+        {"crdt_tpu/core/x.py": '''
+    def f(tracer):
+        tracer.count("engine.bogus_counter", 1)
+    '''},
+        registry=reg("engine.real"),
+    )
+    assert "CL201" in codes(r)
+    # and the documented-but-dead entry fires the other way
+    assert "CL202" in codes(r)
+
+
+def test_cl202_documented_and_emitted_clean():
+    r = lint(
+        {"crdt_tpu/core/x.py": '''
+    def f(tracer):
+        tracer.count("engine.real", 1)
+    '''},
+        registry=reg("engine.real"),
+    )
+    assert codes(r) == []
+
+
+def test_cl203_computed_name_fires_and_emits_declares():
+    src_bad = '''
+    def f(tracer, name):
+        tracer.count(name, 1)
+    '''
+    r = lint({"crdt_tpu/core/x.py": src_bad}, registry=reg("engine.real"))
+    assert "CL203" in codes(r)
+
+    src_declared = '''
+    def f(rec, kind):
+        # crdtlint: emits=fault.drop,fault.dup
+        rec.record(f"fault.{kind}", size=1)
+    '''
+    r2 = lint(
+        {"crdt_tpu/net/x.py": src_declared},
+        registry=reg(events=("fault.drop", "fault.dup")),
+    )
+    assert "CL203" not in codes(r2)
+    assert "CL202" not in codes(r2)  # declared names count as emitted
+
+
+def test_cl203_symbol_uses_innermost_enclosing_function():
+    # the old lineno-keyed map attributed a closure's lines to the
+    # OUTERMOST function, so two closures' findings could collide on
+    # one symbol (and an allowlisted outer name would leak to nested
+    # helpers); the fingerprint must anchor on the innermost def
+    r = lint({"crdt_tpu/core/x.py": '''
+    def outer(tracer, name):
+        def inner():
+            tracer.count(name, 1)
+        return inner
+    '''}, registry=reg("engine.real"))
+    cl = [f for f in r.findings if f.code == "CL203"]
+    assert [f.symbol for f in cl] == ["inner:count"]
+
+
+def test_cl201_counter_kwarg_literal_checked():
+    r = lint(
+        {"crdt_tpu/storage/x.py": '''
+    def f():
+        retry(lambda: 0, counter="persist.bogus")
+    '''},
+        registry=reg("persist.real"),
+    )
+    assert "CL201" in codes(r)
+
+
+# ---------------------------------------------------------------------------
+# CL301/302/303 exception discipline
+
+
+def test_cl301_bare_except_in_codec():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_thing(b):
+        try:
+            return b[0]
+        except:
+            return None
+    '''})
+    assert "CL301" in codes(r)
+
+
+def test_cl302_decode_raises_non_valueerror():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_thing(b):
+        if not b:
+            raise KeyError("empty")
+        return b[0]
+    '''})
+    assert "CL302" in codes(r)
+
+
+def test_cl302_valueerror_and_encode_paths_clean():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_thing(b):
+        if not b:
+            raise ValueError("empty")
+        return b[0]
+
+    def write_thing(v):
+        raise TypeError("encode paths may type-check")
+    '''})
+    assert "CL302" not in codes(r)
+
+
+def test_cl303_guard_catches_simulated_crash():
+    r = lint({"crdt_tpu/guard/x.py": '''
+    def ladder(fn):
+        try:
+            return fn()
+        except SimulatedCrash:
+            return None
+    '''})
+    assert "CL303" in codes(r)
+
+
+def test_cl30x_out_of_scope_module_clean():
+    r = lint({"crdt_tpu/models/x.py": '''
+    def decode_thing(b):
+        try:
+            raise KeyError("x")
+        except:
+            pass
+    '''})
+    assert "CL301" not in codes(r)
+    assert "CL302" not in codes(r)
+
+
+# ---------------------------------------------------------------------------
+# CL401 transfer seam
+
+
+def test_cl401_device_put_outside_seam():
+    r = lint({"crdt_tpu/models/x.py": '''
+    import jax
+
+    def upload(arr):
+        return jax.device_put(arr)
+    '''})
+    assert "CL401" in codes(r)
+
+
+def test_cl401_asarray_of_dispatch_result():
+    r = lint({"crdt_tpu/models/x.py": DONATING_DEF + '''
+    import numpy as np
+
+    def fetch(mat):
+        out = _converge_nodonate(mat, 2)
+        dev = _converge(out, 2)
+        return np.asarray(dev)
+    '''})
+    assert any(
+        f.code == "CL401" and "asarray" in f.message for f in r.findings
+    )
+
+
+def test_cl401_rebind_through_xfer_fetch_is_clean():
+    # `dev = xfer_fetch(dev, n)` yields a HOST array — the later
+    # asarray/.item() is not a seam bypass (the whole-function taint
+    # pass used to flag it anyway, forcing bogus baseline entries)
+    r = lint({"crdt_tpu/models/x.py": DONATING_DEF + '''
+    import numpy as np
+
+    def fetch(mat, xfer_fetch):
+        dev = _converge(mat, 2)
+        dev = xfer_fetch(dev, 8)
+        return np.asarray(dev) + dev.item()
+    '''})
+    assert "CL401" not in codes(r)
+
+
+def test_cl401_asarray_before_dispatch_is_clean():
+    # the host-materialization textually PRECEDES the dispatch that
+    # binds the name — order matters, this is not a bypass
+    r = lint({"crdt_tpu/models/x.py": DONATING_DEF + '''
+    import numpy as np
+
+    def fetch(dev, mat):
+        host = np.asarray(dev)
+        dev = _converge(mat, 2)
+        return host
+    '''})
+    assert "CL401" not in codes(r)
+
+
+def test_cl401_same_line_rebinding_asarray_still_fires():
+    # `x = np.asarray(x)` on a tainted x is the bypass itself; the
+    # rebind must not untaint before the use is checked
+    r = lint({"crdt_tpu/models/x.py": DONATING_DEF + '''
+    import numpy as np
+
+    def fetch(mat):
+        dev = _converge(mat, 2)
+        dev = np.asarray(dev)
+        return dev
+    '''})
+    assert any(
+        f.code == "CL401" and "asarray" in f.message for f in r.findings
+    )
+
+
+def test_cl401_seam_module_itself_clean():
+    r = lint({"crdt_tpu/ops/device.py": '''
+    import jax
+
+    def xfer_put(arr):
+        return jax.device_put(arr)
+    '''})
+    assert "CL401" not in codes(r)
+
+
+# ---------------------------------------------------------------------------
+# CL501-504 determinism
+
+
+def test_cl501_time_time_in_core():
+    r = lint({"crdt_tpu/ops/x.py": '''
+    import time
+
+    def stamp():
+        return time.time()
+    '''})
+    assert "CL501" in codes(r)
+    # perf_counter is fine; net/ modules are out of scope
+    r2 = lint({"crdt_tpu/ops/y.py": '''
+    import time
+
+    def span():
+        return time.perf_counter()
+    '''})
+    assert "CL501" not in codes(r2)
+    r3 = lint({"crdt_tpu/net/x.py": '''
+    import time
+
+    def stamp():
+        return time.time()
+    '''})
+    assert "CL501" not in codes(r3)
+
+
+def test_cl502_unseeded_randomness():
+    r = lint({"crdt_tpu/parallel/x.py": '''
+    import random
+    import numpy as np
+
+    def jitter():
+        return random.random()
+
+    def rng():
+        return np.random.default_rng()
+    '''})
+    assert codes(r).count("CL502") == 2
+    r2 = lint({"crdt_tpu/parallel/y.py": '''
+    import numpy as np
+
+    def rng(seed):
+        return np.random.default_rng(seed)
+    '''})
+    assert "CL502" not in codes(r2)
+
+
+def test_cl503_unseeded_fault_schedule():
+    faults = '''
+    class FaultSchedule:
+        def __init__(self, seed: int = 0, *, drop=0.0):
+            self.seed = seed
+    '''
+    user_bad = '''
+    from crdt_tpu.net.faults import FaultSchedule
+
+    def chaos():
+        return FaultSchedule(drop=0.5)
+    '''
+    user_good = '''
+    from crdt_tpu.net.faults import FaultSchedule
+
+    def chaos():
+        return FaultSchedule(seed=7, drop=0.5)
+    '''
+    r = lint({
+        "crdt_tpu/net/faults.py": faults,
+        "crdt_tpu/parallel/bad.py": user_bad,
+        "crdt_tpu/parallel/good.py": user_good,
+    })
+    hits = [f for f in r.findings if f.code == "CL503"]
+    assert len(hits) == 1
+    assert hits[0].path.endswith("bad.py")
+
+
+def test_cl504_set_iteration():
+    r = lint({"crdt_tpu/core/x.py": '''
+    def pack(items):
+        out = []
+        for k in set(items):
+            out.append(k)
+        return out
+    '''})
+    assert "CL504" in codes(r)
+    r2 = lint({"crdt_tpu/core/y.py": '''
+    def pack(items):
+        return [k for k in sorted(set(items))]
+    '''})
+    assert "CL504" not in codes(r2)
+
+
+# ---------------------------------------------------------------------------
+# CL601 thread-shared state
+
+
+def test_cl601_unlocked_global_fires_and_locked_clean():
+    r = lint({"crdt_tpu/obs/tracer.py": '''
+    import threading
+
+    _tracer = dict()
+    _LOCK = threading.Lock()
+
+
+    def set_bad(v):
+        global _tracer
+        _tracer = v
+
+
+    def set_good(v):
+        global _tracer
+        with _LOCK:
+            _tracer = v
+
+
+    def mutate_bad(k, v):
+        _tracer[k] = v
+
+
+    def mutate_good(k, v):
+        with _LOCK:
+            _tracer[k] = v
+    '''})
+    cl = [f for f in r.findings if f.code == "CL601"]
+    assert {f.symbol for f in cl} == {"set_bad:_tracer",
+                                      "mutate_bad:_tracer"}
+
+
+def test_cl601_lock_like_names_only():
+    # `self._blocker` contains 'lock' as a raw substring (b·lock) but
+    # is NOT a lock — it must not silence the checker; real lock
+    # spellings (_CACHE_LOCK, threading.Lock(), cacheLock) all count
+    r = lint({"crdt_tpu/obs/tracer.py": '''
+    import threading
+
+    _events = dict()
+    _CACHE_LOCK = threading.Lock()
+    cacheLock = threading.Lock()
+
+
+    class W:
+        def mutate_blocker(self, k, v):
+            with self._blocker:
+                _events[k] = v
+
+        def mutate_unblocked(self, k, v):
+            with _unblocked_region():
+                _events[k] = v
+
+        def mutate_const_lock(self, k, v):
+            with _CACHE_LOCK:
+                _events[k] = v
+
+        def mutate_ctor_lock(self, k, v):
+            with threading.Lock():
+                _events[k] = v
+
+        def mutate_camel_lock(self, k, v):
+            with cacheLock:
+                _events[k] = v
+    '''})
+    cl = {f.symbol for f in r.findings if f.code == "CL601"}
+    assert cl == {"mutate_blocker:_events", "mutate_unblocked:_events"}
+
+
+def test_cl601_untargeted_module_clean():
+    r = lint({"crdt_tpu/core/x.py": '''
+    _cache = {}
+
+    def put(k, v):
+        _cache[k] = v
+    '''})
+    assert "CL601" not in codes(r)
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline machinery
+
+
+VIOLATION = '''
+import jax
+
+def upload(arr):
+    return jax.device_put(arr)
+'''
+
+
+def test_inline_disable_suppresses():
+    src = '''
+    import jax
+
+    def upload(arr):
+        return jax.device_put(arr)  # crdtlint: disable=CL401
+    '''
+    r = lint({"crdt_tpu/models/x.py": src})
+    assert "CL401" not in codes(r)
+    assert any(f.code == "CL401" for f in r.suppressed)
+
+
+def test_inline_disable_on_line_above():
+    src = '''
+    import jax
+
+    def upload(arr):
+        # crdtlint: disable=CL401
+        return jax.device_put(arr)
+    '''
+    r = lint({"crdt_tpu/models/x.py": src})
+    assert "CL401" not in codes(r)
+
+
+def test_inline_disable_wrong_code_does_not_suppress():
+    src = '''
+    import jax
+
+    def upload(arr):
+        return jax.device_put(arr)  # crdtlint: disable=CL999
+    '''
+    r = lint({"crdt_tpu/models/x.py": src})
+    assert "CL401" in codes(r)
+
+
+def test_disable_file_directive():
+    src = '''
+    # crdtlint: disable-file=CL401
+    import jax
+
+    def upload(arr):
+        return jax.device_put(arr)
+
+    def download(arr):
+        return jax.device_get(arr)
+    '''
+    r = lint({"crdt_tpu/models/x.py": src})
+    assert "CL401" not in codes(r)
+    assert len([f for f in r.suppressed if f.code == "CL401"]) == 2
+
+
+def test_baseline_roundtrip(tmp_path):
+    # 1. a violation fires
+    r = lint({"crdt_tpu/models/x.py": VIOLATION})
+    assert len(r.findings) == 1
+    # 2. write it to a baseline file, justify it, reload
+    path = tmp_path / "baseline.json"
+    write_baseline(str(path), r.findings)
+    data = json.loads(path.read_text())
+    assert len(data["entries"]) == 1
+    data["entries"][0]["justification"] = "synthetic fixture"
+    path.write_text(json.dumps(data))
+    base = load_baseline(str(path))
+    # 3. the same violation is now baselined, not open
+    r2 = lint({"crdt_tpu/models/x.py": VIOLATION}, baseline=base)
+    assert r2.findings == []
+    assert len(r2.baselined) == 1
+    # 4. fixing the code leaves a stale baseline entry (warned)
+    r3 = lint(
+        {"crdt_tpu/models/x.py": "def upload(arr):\n    return arr\n"},
+        baseline=base,
+    )
+    assert r3.findings == [] and r3.baselined == []
+    assert len(r3.stale_baseline) == 1
+
+
+def test_baseline_requires_justification(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps({
+        "entries": [{"fingerprint": "a|CL401|b", "justification": ""}]
+    }))
+    with pytest.raises(BaselineError):
+        load_baseline(str(path))
+
+
+def test_baseline_fingerprint_survives_line_moves(tmp_path):
+    r = lint({"crdt_tpu/models/x.py": VIOLATION})
+    fp = r.findings[0].fingerprint
+    shifted = "'''module docstring'''\nX = 1\n" + VIOLATION
+    r2 = lint({"crdt_tpu/models/x.py": shifted})
+    assert r2.findings[0].fingerprint == fp
+
+
+def test_syntax_error_is_a_finding():
+    r = lint({"crdt_tpu/models/x.py": "def broken(:\n"})
+    assert [f.code for f in r.findings] == ["CL000"]
+
+
+def test_run_lint_public_entry(tmp_path):
+    """The public run_lint() surface used by the CLI and bench."""
+    config = LintConfig(
+        repo_root=str(tmp_path), readme_path="", smoke_test_path="",
+        baseline_path=str(tmp_path / "none.json"),
+    )
+    result = run_lint(
+        [("crdt_tpu/models/x.py", VIOLATION)], config=config
+    )
+    assert [f.code for f in result.findings] == ["CL401"]
+    assert result.total_raw == 1
+
+
+# ---------------------------------------------------------------------------
+# review-pass regressions: analyzer gaps found after the first run
+
+
+def test_cl601_annotated_module_global_fires():
+    # `X: set = set()` binds the same shared state as `X = set()` —
+    # a type annotation must not silence CL601 (the ops/device.py
+    # memo-cache shape the first review pass slipped through)
+    r = lint({"crdt_tpu/ops/device.py": '''
+    _CACHE: set = set()
+
+    def remember(key):
+        _CACHE.add(key)
+    '''})
+    assert "CL601" in codes(r)
+
+
+def test_cl601_annotated_global_locked_clean():
+    r = lint({"crdt_tpu/ops/device.py": '''
+    import threading
+
+    _CACHE: set = set()
+    _CACHE_LOCK = threading.Lock()
+
+    def remember(key):
+        with _CACHE_LOCK:
+            _CACHE.add(key)
+    '''})
+    assert "CL601" not in codes(r)
+
+
+def test_cl401_method_form_block_until_ready_fires():
+    # `out.block_until_ready()` — the array-method spelling — is the
+    # same wait as `jax.block_until_ready(out)` and must not slip
+    # past the seam checker
+    r = lint({"crdt_tpu/models/x.py": '''
+    def wait(out):
+        out.block_until_ready()
+        return out
+    '''})
+    assert "CL401" in codes(r)
+
+
+def test_cl401_method_form_on_call_result_fires():
+    # even with no dotted receiver (`f(x).block_until_ready()`)
+    r = lint({"crdt_tpu/models/x.py": '''
+    def wait(f, x):
+        f(x).block_until_ready()
+    '''})
+    assert "CL401" in codes(r)
+
+
+def test_cl101_local_def_shadows_foreign_donating_name():
+    # module B's own non-donating `_step` shadows module A's donating
+    # `_step`: reading the arg after B's local call is NOT a
+    # use-after-donate (the collision used to invent one)
+    r = lint({
+        "crdt_tpu/ops/a.py": '''
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _step(mat):
+            return mat
+        ''',
+        "crdt_tpu/models/b.py": '''
+        def _step(mat):
+            return mat + 1
+
+        def caller(mat):
+            out = _step(mat)
+            return mat.sum() + out
+        ''',
+    })
+    assert "CL101" not in codes(r)
+
+
+def test_cl101_same_name_donating_defs_keep_their_argnums():
+    # two modules donate under one name with DIFFERENT argnums; the
+    # old name-keyed index let one overwrite the other, hiding one
+    # module's real use-after-donate — both must fire
+    r = lint({
+        "crdt_tpu/ops/a.py": '''
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _step(x, y):
+            return x + y
+
+        def caller(x, y):
+            out = _step(x, y)
+            return x.sum() + out
+        ''',
+        "crdt_tpu/ops/b.py": '''
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _step(x, y):
+            return x + y
+
+        def caller(x, y):
+            out = _step(x, y)
+            return y.sum() + out
+        ''',
+    })
+    assert codes(r).count("CL101") == 2
+
+
+def test_cl101_import_resolves_defining_module():
+    # B imports A's donating `_step`; the import picks A's argnums
+    # even though B defines nothing itself
+    r = lint({
+        "crdt_tpu/ops/a.py": '''
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _step(mat):
+            return mat
+        ''',
+        "crdt_tpu/models/b.py": '''
+        from crdt_tpu.ops.a import _step
+
+        def caller(mat):
+            out = _step(mat)
+            return mat.sum() + out
+        ''',
+    })
+    assert "CL101" in codes(r)
+
+
+MOD_ATTR_DEFS = {
+    "crdt_tpu/ops/a.py": '''
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def _step(x, y):
+        return x + y
+    ''',
+    "crdt_tpu/ops/b.py": '''
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def _step(x, y):
+        return x + y
+    ''',
+}
+
+
+def test_cl101_module_attr_call_resolves_receiver_module():
+    # `b._step(x, y)` must take b's argnums (donates y), not whichever
+    # same-named def was scanned first — reading x stays clean, reading
+    # y fires
+    r = lint({
+        **MOD_ATTR_DEFS,
+        "crdt_tpu/models/c.py": '''
+        from crdt_tpu.ops import b
+
+        def caller(x, y):
+            out = b._step(x, y)
+            return x.sum() + y.sum() + out
+        ''',
+    })
+    hits = [f for f in r.findings if f.code == "CL101"]
+    assert len(hits) == 1 and "`y`" in hits[0].message
+
+
+def test_cl101_module_attr_full_dotted_path_resolves():
+    # plain `import crdt_tpu.ops.a` — the attribute chain spells the
+    # real module path, so a's argnums (donates x) apply
+    r = lint({
+        **MOD_ATTR_DEFS,
+        "crdt_tpu/models/c.py": '''
+        import crdt_tpu.ops.a
+
+        def caller(x, y):
+            out = crdt_tpu.ops.a._step(x, y)
+            return x.sum() + out
+        ''',
+    })
+    hits = [f for f in r.findings if f.code == "CL101"]
+    assert len(hits) == 1 and "`x`" in hits[0].message
+
+
+def test_cl101_module_attr_without_donating_def_refuses_guess():
+    # the receiver resolves to a module that defines NO donating
+    # `_step` — another module's same-named argnums must not be lent
+    r = lint({
+        **MOD_ATTR_DEFS,
+        "crdt_tpu/ops/plain.py": '''
+        def _step(x, y):
+            return x + y
+        ''',
+        "crdt_tpu/models/c.py": '''
+        from crdt_tpu.ops import plain
+
+        def caller(x, y):
+            out = plain._step(x, y)
+            return x.sum() + y.sum() + out
+        ''',
+    })
+    assert "CL101" not in codes(r)
+
+
+def test_write_baseline_preserves_justifications(tmp_path):
+    # regenerating a baseline must MERGE: hand-written justifications
+    # for still-live findings survive verbatim, only open findings get
+    # TODO skeletons (the old CLI wrote open-findings-only, wiping the
+    # whole ledger)
+    r = lint({"crdt_tpu/models/x.py": VIOLATION})
+    path = tmp_path / "baseline.json"
+    write_baseline(str(path), r.findings)
+    data = json.loads(path.read_text())
+    data["entries"][0]["justification"] = "hand-written reason"
+    path.write_text(json.dumps(data))
+    base = load_baseline(str(path))
+
+    # a second, new violation appears; the first is baselined
+    two = VIOLATION + '''
+    def upload2(arr):
+        return jax.device_put(arr)
+    '''
+    r2 = lint({"crdt_tpu/models/x.py": two}, baseline=base)
+    assert len(r2.findings) == 1 and len(r2.baselined) == 1
+    # the __main__ --write-baseline flow: preserved = still-live
+    # baseline entries, skeletons only for the open finding
+    live = {f.fingerprint for f in r2.baselined}
+    preserved = [e for fp, e in base.items() if fp in live]
+    write_baseline(str(path), r2.findings, preserved)
+    merged = load_baseline(str(path))
+    assert len(merged) == 2
+    justs = sorted(e["justification"] for e in merged.values())
+    assert justs == ["TODO: justify or fix", "hand-written reason"]
+
+
+def test_write_baseline_cli_merges_not_clobbers(tmp_path, capsys):
+    """End-to-end through the CLI entry: pointing --write-baseline at
+    the live baseline file must not wipe existing justifications."""
+    from tools.crdtlint.__main__ import main
+
+    src = tmp_path / "ops" / "bad.py"
+    src.parent.mkdir()
+    src.write_text(
+        "import jax\n\n\ndef f(x):\n    return jax.device_put(x)\n"
+    )
+    bl = tmp_path / "bl.json"
+    # generation 1: one skeleton; justify it by hand
+    assert main([str(src), "--baseline", str(bl),
+                 "--write-baseline", str(bl)]) == 0
+    data = json.loads(bl.read_text())
+    assert len(data["entries"]) == 1
+    data["entries"][0]["justification"] = "hand-written reason"
+    bl.write_text(json.dumps(data))
+    # a second violation lands; regenerate against the live baseline
+    src.write_text(
+        src.read_text()
+        + "\n\ndef g(x):\n    return jax.device_get(x)\n"
+    )
+    assert main([str(src), "--baseline", str(bl),
+                 "--write-baseline", str(bl)]) == 0
+    merged = load_baseline(str(bl))
+    assert len(merged) == 2
+    justs = sorted(e["justification"] for e in merged.values())
+    assert justs == ["TODO: justify or fix", "hand-written reason"]
+    # --no-baseline only changes reporting; combined with
+    # --write-baseline it must STILL merge against the ledger, not
+    # rewrite every live entry as a TODO skeleton
+    assert main([str(src), "--baseline", str(bl), "--no-baseline",
+                 "--write-baseline", str(bl)]) == 0
+    remerged = load_baseline(str(bl))
+    assert len(remerged) == 2
+    justs = sorted(e["justification"] for e in remerged.values())
+    assert justs == ["TODO: justify or fix", "hand-written reason"]
+    capsys.readouterr()

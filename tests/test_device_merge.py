@@ -12,7 +12,6 @@ import numpy as np
 import pytest
 
 from crdt_tpu.api.doc import Crdt
-from crdt_tpu.core.engine import Engine
 
 
 def _drain(docs):

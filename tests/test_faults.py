@@ -28,7 +28,7 @@ from crdt_tpu.net.faults import (
 )
 from crdt_tpu.net.replica import Replica
 from crdt_tpu.net.udp_router import UdpRouter
-from crdt_tpu.utils.trace import Tracer, get_tracer, set_tracer
+from crdt_tpu.utils.trace import Tracer, set_tracer
 
 SEED = 7
 CHAOS = dict(drop=0.12, duplicate=0.1, delay=0.1, delay_polls=(1, 6),

@@ -15,7 +15,6 @@ import pytest
 from crdt_tpu.codec import v1
 from crdt_tpu.core.engine import Engine
 from crdt_tpu.models.fleet import (
-    ReplicaFleet,
     fleet_for_trace,
     fleet_replay,
     load_trace,
@@ -210,7 +209,6 @@ class TestFleetReplay:
         and its SV handshake must match the replica-sharded step's."""
         from crdt_tpu.models.fleet import (
             SegmentedFleet,
-            gather_sharded,
             load_trace,
             shard_trace,
         )

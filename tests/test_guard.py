@@ -533,8 +533,8 @@ class TestCrashPointMatrix:
         # clean run records every batch's op count (the matrix axes)
         holder = []
 
-        def wrapper(kv, sched=DiskFaultSchedule()):
-            fk = FaultyKv(kv, sched)
+        def wrapper(kv, sched=None):
+            fk = FaultyKv(kv, sched or DiskFaultSchedule())
             holder.append(fk)
             return fk
 

@@ -8,7 +8,6 @@ tombstones, redelivery, and nested collections.
 """
 
 import numpy as np
-import pytest
 
 from crdt_tpu.codec import v1
 from crdt_tpu.core.ids import DeleteSet

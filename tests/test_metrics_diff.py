@@ -226,3 +226,35 @@ def test_overload_section_gated():
     new["overload"]["peak_inbox_bytes"] = 500
     rows, regressed = compare(old, new)
     assert "overload.peak_inbox_bytes" in regressed
+
+
+def test_lint_findings_gated_lower_is_better():
+    """crdtlint satellite: a PR that grows the lint baseline (or
+    sprinkles inline disables) moves lint.findings and fails the
+    gate — and because it's a count, the seconds noise floor must
+    never mute it."""
+    old = copy.deepcopy(OLD)
+    old["lint"] = {"findings": 23, "open": 0, "baselined": 23,
+                   "suppressed": 0}
+    new = copy.deepcopy(old)
+    new["lint"]["findings"] = 31
+    new["lint"]["baselined"] = 31
+    rows, regressed = compare(old, new)
+    assert "lint.findings" in regressed
+    assert "lint.baselined" in regressed
+    # shrinking the baseline reads as an improvement, never a failure
+    shrunk = copy.deepcopy(old)
+    shrunk["lint"]["findings"] = 2
+    shrunk["lint"]["baselined"] = 2
+    rows, regressed = compare(old, shrunk)
+    assert regressed == []
+    by_name = {r["metric"]: r for r in rows}
+    assert by_name["lint.findings"]["verdict"] == "improved"
+    # tiny absolute counts still gate (no noise floor for counts):
+    # 0 -> 1 open finding is an infinite relative regression
+    zero = copy.deepcopy(old)
+    zero["lint"]["findings"] = 0
+    one = copy.deepcopy(old)
+    one["lint"]["findings"] = 1
+    rows, regressed = compare(zero, one)
+    assert "lint.findings" in regressed

@@ -26,7 +26,6 @@ def test_fleet_winners_match_scalar_oracle():
     from crdt_tpu.core.engine import Engine
     from crdt_tpu.core.ids import DeleteSet
     from crdt_tpu.core.records import ItemRecord
-    from crdt_tpu.ops.merge import records_to_columns
 
     fleet = ReplicaFleet(4, 8, n_devices=4, num_clients=8, num_segments=64)
     cols, dels = fleet.synth(num_maps=2, keys_per_map=4, seed=3)

@@ -10,7 +10,6 @@ import time
 import pytest
 
 from crdt_tpu.obs import (
-    DivergenceSentinel,
     FlightRecorder,
     Tracer,
     get_recorder,
@@ -18,7 +17,6 @@ from crdt_tpu.obs import (
     set_recorder,
     set_tracer,
     snapshot_json,
-    state_digest,
     to_prometheus,
 )
 from crdt_tpu.obs.tracer import BUCKET_EDGES_S, N_BUCKETS, bucket_index

@@ -8,7 +8,6 @@ the stream layout.
 """
 
 import numpy as np
-import pytest
 
 from crdt_tpu.ops import packed
 

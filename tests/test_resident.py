@@ -6,7 +6,6 @@ per-update host work proportional to the touched parents.
 """
 
 import numpy as np
-import pytest
 
 from crdt_tpu.ops.resident import ResidentColumns
 

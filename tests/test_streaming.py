@@ -12,7 +12,6 @@ here chains the streamed path to the scalar reference.
 """
 
 import numpy as np
-import pytest
 
 from crdt_tpu.codec import native, v1
 from crdt_tpu.core.engine import Engine

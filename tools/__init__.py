@@ -1,0 +1,2 @@
+"""Repo tooling: bench diffing (`metrics_diff`), kernel profiling,
+and the static invariant checker (`tools.crdtlint`)."""

@@ -1,0 +1,37 @@
+"""crdtlint: AST-based invariant checker for the crdt_tpu package.
+
+Five rounds of PRs accumulated contracts that lived only in prose and
+runtime tests — donated buffers are dead after dispatch (round 9),
+every metric matches the documented registry (round 8), decoders
+raise ValueError and nothing else (round 10), all H2D/D2H bytes flow
+through the ``xfer_put``/``xfer_fetch`` seam (round 9), fault
+schedules are seeded (round 7). crdtlint makes them machine-enforced:
+
+    python -m tools.crdtlint crdt_tpu/
+
+Findings print as ``file:line CODE message`` and fail the run (exit
+1) unless suppressed inline (``# crdtlint: disable=CODE``) or listed
+with a justification in ``tools/crdtlint/baseline.json``. Tier-1
+(``tests/test_lint.py``) runs the suite over the package, so every
+future PR inherits the contracts. Stdlib-only by design — no jax, no
+crdt_tpu import, runs in well under ten seconds.
+
+See README "Static analysis" for the checker table and the
+suppression/baseline workflow.
+"""
+
+from tools.crdtlint.core import (  # noqa: F401
+    BaselineError,
+    Checker,
+    Finding,
+    LintConfig,
+    LintContext,
+    LintResult,
+    Module,
+    load_baseline,
+    load_modules,
+    run_lint,
+    write_baseline,
+)
+
+__version__ = "1.0"

@@ -1,0 +1,125 @@
+"""CLI: ``python -m tools.crdtlint [paths...]``.
+
+Exit codes: 0 clean (baselined/suppressed findings allowed), 1
+unsuppressed findings, 2 usage/baseline errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="crdtlint",
+        description="AST-based invariant checker for crdt_tpu "
+                    "(donation safety, registry conformance, codec "
+                    "exception discipline, transfer-seam accounting, "
+                    "determinism, thread-shared state)",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: crdt_tpu/)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: "
+                         "tools/crdtlint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (show everything)")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="write current unsuppressed findings as a "
+                         "baseline skeleton (justifications TODO) "
+                         "and exit")
+    ap.add_argument("--list-checkers", action="store_true")
+    ap.add_argument("--statistics", action="store_true",
+                    help="per-code counts incl. suppressed/baselined")
+    args = ap.parse_args(argv)
+
+    # repo root = parent of tools/ — resolves default paths whether
+    # invoked from the root or elsewhere
+    from tools.crdtlint.core import (
+        BaselineError, LintConfig, load_baseline, load_modules,
+        run_lint, write_baseline,
+    )
+    from tools.crdtlint.checkers import ALL_CHECKERS, ALL_CODES
+
+    if args.list_checkers:
+        for cls in ALL_CHECKERS:
+            print(f"{cls.name}:")
+            for code, desc in cls.codes.items():
+                print(f"  {code}  {desc}")
+        return 0
+
+    config = LintConfig(baseline_path=args.baseline)
+    paths = args.paths or [os.path.join(config.repo_root, "crdt_tpu")]
+    t0 = time.perf_counter()
+    modules = load_modules(paths, config.repo_root)
+    if not modules:
+        print("crdtlint: no python files found", file=sys.stderr)
+        return 2
+    try:
+        result = run_lint(
+            modules, config=config,
+            use_baseline=not args.no_baseline,
+        )
+    except BaselineError as e:
+        print(f"crdtlint: {e}", file=sys.stderr)
+        return 2
+    dt = time.perf_counter() - t0
+
+    if args.write_baseline:
+        # merge, never clobber: existing justified entries that still
+        # match a live finding are carried over verbatim; only OPEN
+        # findings get TODO skeletons, and stale entries are pruned.
+        # --no-baseline only changes reporting (every live finding
+        # shows as open) — the committed ledger stays the merge
+        # source, so regenerating with it can't wipe justifications.
+        live = {f.fingerprint for f in result.findings}
+        live.update(f.fingerprint for f in result.baselined)
+        try:
+            existing = load_baseline(config.baseline_path)
+        except BaselineError as e:
+            print(f"crdtlint: {e}", file=sys.stderr)
+            return 2
+        preserved = [e for fp, e in existing.items() if fp in live]
+        kept = {e["fingerprint"] for e in preserved}
+        fresh = [f for f in result.findings if f.fingerprint not in kept]
+        write_baseline(args.write_baseline, fresh, preserved)
+        print(
+            f"wrote {len(fresh) + len(preserved)} entr(ies) "
+            f"to {args.write_baseline} — {len(fresh)} new "
+            f"skeleton(s) need justifications, {len(preserved)} "
+            f"preserved"
+        )
+        return 0
+
+    for f in result.findings:
+        print(f.format())
+    for fp in result.stale_baseline:
+        print(f"crdtlint: stale baseline entry (fixed?): {fp}",
+              file=sys.stderr)
+    if args.statistics:
+        from collections import Counter
+
+        by_code = Counter(f.code for f in result.findings)
+        base_code = Counter(f.code for f in result.baselined)
+        supp_code = Counter(f.code for f in result.suppressed)
+        for code in sorted(ALL_CODES):
+            n, b, s = by_code[code], base_code[code], supp_code[code]
+            if n or b or s:
+                print(f"{code}: {n} open, {b} baselined, "
+                      f"{s} suppressed")
+    summary = (
+        f"crdtlint: {len(modules)} files, "
+        f"{len(result.findings)} finding(s), "
+        f"{len(result.baselined)} baselined, "
+        f"{len(result.suppressed)} suppressed "
+        f"({dt:.2f}s)"
+    )
+    print(summary, file=sys.stderr)
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,123 @@
+"""Small shared AST helpers for crdtlint checkers (stdlib-only)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def import_map(tree: ast.Module) -> Dict[str, str]:
+    """local name -> fully qualified imported name.
+
+    ``from crdt_tpu.parallel.gossip import make_gossip_step as g`` maps
+    ``g -> crdt_tpu.parallel.gossip.make_gossip_step``; ``import jax``
+    maps ``jax -> jax``. Relative imports keep their dots stripped —
+    checker indexes match on trailing components anyway.
+    """
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for alias in node.names:
+                out[alias.asname or alias.name] = (
+                    f"{mod}.{alias.name}" if mod else alias.name
+                )
+    return out
+
+
+def kw(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def int_tuple(node: ast.AST) -> Optional[Tuple[int, ...]]:
+    """Literal int tuple/list value, or ``tuple(range(n))``."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                vals.append(e.value)
+            else:
+                return None
+        return tuple(vals)
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if (isinstance(node, ast.Call) and call_name(node) == "tuple"
+            and len(node.args) == 1):
+        inner = node.args[0]
+        if (isinstance(inner, ast.Call) and call_name(inner) == "range"
+                and len(inner.args) == 1):
+            n = inner.args[0]
+            if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                return tuple(range(n.value))
+    return None
+
+
+def assigned_names(target: ast.AST) -> Iterable[str]:
+    """Dotted names bound by an assignment target (tuple targets
+    flattened; subscripts/stars report their base name)."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from assigned_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from assigned_names(target.value)
+    elif isinstance(target, ast.Subscript):
+        base = dotted(target.value)
+        if base:
+            yield base
+    else:
+        d = dotted(target)
+        if d:
+            yield d
+
+
+def in_scope(path: str, prefixes: Iterable[str]) -> bool:
+    """Does a repo-relative path fall under any scope prefix? Matched
+    on the path's ``crdt_tpu/``-rooted tail so synthetic test paths
+    (``pkg/crdt_tpu/ops/x.py``) scope the same way."""
+    idx = path.find("crdt_tpu/")
+    tail = path[idx:] if idx >= 0 else path
+    return any(tail.startswith(p) for p in prefixes)
+
+
+def enclosing_function_map(tree: ast.Module) -> Dict[int, str]:
+    """id(node) -> name of the INNERMOST enclosing function
+    (``"<module>"`` at top level)."""
+    out: Dict[int, str] = {}
+
+    def visit(node, current):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            current = node.name
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = current
+            visit(child, current)
+
+    visit(tree, "<module>")
+    return out

@@ -1,0 +1,32 @@
+"""Checker plugin registry.
+
+Adding a checker: subclass :class:`tools.crdtlint.core.Checker` in a
+new module here, give it a unique ``name`` and ``codes`` (pick an
+unused ``CLxxx`` range), and append the class to ``ALL_CHECKERS``.
+Add a violating + clean snippet pair to ``tests/test_lint.py``'s
+still-fires matrix (the tier-1 gate requires every registered code to
+fire on its synthetic violation — a checker that can't fire is dead
+weight) and a README "Static analysis" table row.
+"""
+
+from tools.crdtlint.checkers.donate import DonateChecker
+from tools.crdtlint.checkers.determinism import DeterminismChecker
+from tools.crdtlint.checkers.exceptions import ExceptionDisciplineChecker
+from tools.crdtlint.checkers.metrics import MetricsRegistryChecker
+from tools.crdtlint.checkers.threadshare import ThreadSharedStateChecker
+from tools.crdtlint.checkers.xfer import TransferSeamChecker
+
+ALL_CHECKERS = [
+    DonateChecker,
+    MetricsRegistryChecker,
+    ExceptionDisciplineChecker,
+    TransferSeamChecker,
+    DeterminismChecker,
+    ThreadSharedStateChecker,
+]
+
+ALL_CODES = {
+    code: desc
+    for cls in ALL_CHECKERS
+    for code, desc in cls.codes.items()
+}

@@ -1,0 +1,176 @@
+"""CL501–CL504: determinism in the staged-packing core (round 7).
+
+Byte-identical convergence under seeded fault schedules — the chaos
+harness's whole proof — requires the packing/converge core to be a
+pure function of its inputs. Wall-clock reads, unseeded RNGs, and
+hash-order iteration each smuggle ambient state into staged layouts.
+
+Scope: ``ops/``, ``parallel/``, ``core/`` (the staging + converge
+core). Seeding (CL503) is checked package-wide at every call site of
+a ``net/faults.py`` schedule constructor.
+
+- **CL501** — ``time.time()`` / ``time.time_ns()`` in core scope
+  (``perf_counter`` / ``monotonic`` are fine: they time, they don't
+  *decide*).
+- **CL502** — unseeded randomness: module-level ``random.*`` calls,
+  ``random.Random()`` / ``np.random.default_rng()`` with no seed, or
+  legacy ``np.random.<dist>`` globals.
+- **CL503** — a fault-schedule constructor (any ``net/faults.py``
+  class taking a ``seed`` parameter) called without an explicit
+  seed — replay of a chaos run must never depend on the default.
+- **CL504** — iteration over a ``set`` expression (set literal /
+  ``set()`` / ``frozenset()`` / set comprehension) that isn't wrapped
+  in ``sorted()``: set order is hash-salted across processes, so any
+  packing fed by it differs run to run.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from tools.crdtlint.astutil import call_name, in_scope
+from tools.crdtlint.core import Checker, Finding, LintContext, Module
+
+CORE_SCOPE = ("crdt_tpu/ops/", "crdt_tpu/parallel/", "crdt_tpu/core/")
+FAULTS_SUFFIX = "net/faults.py"
+
+# random-module functions that are fine without a seed argument
+_RANDOM_OK = {"Random", "SystemRandom", "seed"}
+# numpy legacy global-state distributions
+_NP_RANDOM_GLOBALS = {
+    "random", "rand", "randn", "randint", "choice", "shuffle",
+    "permutation", "uniform", "normal", "bytes",
+}
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        return (call_name(node) or "") in ("set", "frozenset")
+    return False
+
+
+class DeterminismChecker(Checker):
+    name = "determinism"
+    codes = {
+        "CL501": "wall-clock read (time.time) in the deterministic "
+                 "packing core",
+        "CL502": "unseeded randomness in the deterministic packing "
+                 "core",
+        "CL503": "fault-schedule constructor called without an "
+                 "explicit seed",
+        "CL504": "unsorted set iteration feeding the packing core "
+                 "(hash-salted order)",
+    }
+
+    def prepare(self, ctx: LintContext) -> None:
+        """Collect ``net/faults.py`` classes whose __init__ takes a
+        ``seed`` parameter — the constructors CL503 covers."""
+        seeded: Set[str] = set()
+        mod = ctx.module_by_path(FAULTS_SUFFIX)
+        if mod is not None and mod.tree is not None:
+            for node in mod.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                for sub in node.body:
+                    if (isinstance(sub, ast.FunctionDef)
+                            and sub.name == "__init__"):
+                        params = [
+                            a.arg for a in (
+                                sub.args.posonlyargs + sub.args.args
+                                + sub.args.kwonlyargs
+                            )
+                        ]
+                        if "seed" in params:
+                            seeded.add(node.name)
+        ctx.shared["seeded_ctors"] = seeded
+
+    def check_module(self, mod: Module,
+                     ctx: LintContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        seeded_ctors: Set[str] = ctx.shared.get("seeded_ctors", set())
+        core = in_scope(mod.path, CORE_SCOPE)
+
+        for node in ast.walk(mod.tree):
+            # CL503 — package-wide
+            if isinstance(node, ast.Call):
+                cname = (call_name(node) or "").rsplit(".", 1)[-1]
+                if cname in seeded_ctors and not mod.path.endswith(
+                    FAULTS_SUFFIX
+                ):
+                    has_seed = bool(node.args) or any(
+                        k.arg == "seed" for k in node.keywords
+                    )
+                    if not has_seed:
+                        findings.append(Finding(
+                            mod.path, node.lineno, "CL503",
+                            f"`{cname}(...)` without an explicit "
+                            f"seed — fault schedules must be "
+                            f"seeded for deterministic replay "
+                            f"(round-7 contract)",
+                            symbol=cname,
+                        ))
+            if not core:
+                continue
+            if isinstance(node, ast.Call):
+                cname = call_name(node) or ""
+                tail = cname.rsplit(".", 1)[-1]
+                # CL501
+                if cname in ("time.time", "time.time_ns"):
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL501",
+                        "wall-clock read in the packing core — "
+                        "timestamps must arrive as inputs "
+                        "(perf_counter/monotonic are fine for "
+                        "spans)",
+                        symbol=cname,
+                    ))
+                # CL502
+                parts = cname.split(".")
+                if (len(parts) == 2 and parts[0] == "random"
+                        and parts[1] not in _RANDOM_OK):
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL502",
+                        f"`{cname}()` uses the process-global "
+                        f"unseeded RNG — thread a seeded "
+                        f"Random/default_rng through instead",
+                        symbol=cname,
+                    ))
+                elif (tail in _NP_RANDOM_GLOBALS
+                        and ".random." in f".{cname}"
+                        and "default_rng" not in cname):
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL502",
+                        f"`{cname}()` uses numpy's legacy global "
+                        f"RNG — use np.random.default_rng(seed)",
+                        symbol=cname,
+                    ))
+                elif tail in ("default_rng", "Random") and not (
+                    node.args or node.keywords
+                ):
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL502",
+                        f"`{cname}()` without a seed draws OS "
+                        f"entropy — pass an explicit seed in the "
+                        f"packing core",
+                        symbol=f"{cname}:unseeded",
+                    ))
+            # CL504
+            iters: List[ast.AST] = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                iters.extend(g.iter for g in node.generators)
+            for it in iters:
+                if _is_set_expr(it):
+                    findings.append(Finding(
+                        mod.path, it.lineno, "CL504",
+                        "iterating a set in the packing core — "
+                        "set order is hash-salted across "
+                        "processes; wrap in sorted(...)",
+                        symbol="set-iter",
+                    ))
+        return findings

@@ -1,0 +1,504 @@
+"""CL101/CL102: donated device buffers (the round-9 contract).
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's device
+buffer to the compiled program — the allocator reuses it for outputs,
+so the caller's array is *invalidated* by the call. The repo's rules:
+
+- **CL101 — use-after-donate.** At any call site of a donating
+  callable, reading a donated argument after the call (before
+  rebinding it) is a bug on donation-capable backends; so is donating
+  the same un-rebound buffer on every loop iteration (the second
+  dispatch consumes a dead buffer).
+- **CL102 — donating converge entry without an undonated twin.** A
+  donating *converge entry point* must ship an escape hatch for
+  consumers that redispatch the same buffer (bench probes, host
+  routes): a ``<name>_nodonate`` twin in the same module (the
+  ``_converge_packed_nodonate`` / ``make_repeat_dispatch`` pattern).
+  In-place update kernels (splice/grow/relabel) whose call sites
+  always rebind are baselined, not exempted — the ledger keeps the
+  reasoning reviewable.
+
+Donating callables are resolved three ways: decorated module-level
+defs (``@partial(jax.jit, donate_argnums=...)``), factory functions
+returning ``jax.jit(fn, donate_argnums=...)`` (the gossip/delta
+``make_*_step`` pattern, including ``self.attr = factory(...)``
+assignments), and imports of either.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.crdtlint.astutil import (
+    assigned_names,
+    call_name,
+    dotted,
+    import_map,
+    int_tuple,
+    kw,
+)
+from tools.crdtlint.core import Checker, Finding, LintContext, Module
+
+
+def _donate_argnums_of_jit_call(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """argnums if ``call`` is a jit/partial(jit) call carrying
+    ``donate_argnums``."""
+    name = call_name(call) or ""
+    is_jit = name.endswith("jit")
+    is_partial_jit = name.endswith("partial") and any(
+        (dotted(a) or "").endswith("jit") for a in call.args
+    )
+    if not (is_jit or is_partial_jit):
+        return None
+    dn = kw(call, "donate_argnums")
+    if dn is None:
+        return None
+    return int_tuple(dn) or ()
+
+
+def _decorated_donation(fn: ast.FunctionDef) -> Optional[Tuple[int, ...]]:
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            nums = _donate_argnums_of_jit_call(dec)
+            if nums is not None:
+                return nums
+    return None
+
+
+@dataclass
+class _DonatingDef:
+    module: str
+    name: str
+    line: int
+    argnums: Tuple[int, ...]
+    is_factory: bool  # returns a donating jit rather than being one
+
+
+class DonateChecker(Checker):
+    name = "donate"
+    codes = {
+        "CL101": "donated argument read (or re-donated in a loop) "
+                 "after the donating dispatch",
+        "CL102": "donating converge entry lacks an undonated twin "
+                 "(`_nodonate` / make_repeat_dispatch pattern)",
+    }
+
+    def prepare(self, ctx: LintContext) -> None:
+        # name -> ALL donating defs with that name, one per defining
+        # module: same-named defs in different modules must not
+        # overwrite each other (a collision either hid a real CL101 or
+        # invented one on an unrelated local function)
+        defs: Dict[str, List[_DonatingDef]] = {}
+        module_defs: Dict[str, Set[str]] = {}
+        for mod in ctx.modules:
+            if mod.tree is None:
+                continue
+            names: Set[str] = set()
+            for node in mod.tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    names.add(node.name)
+                    nums = _decorated_donation(node)
+                    if nums:
+                        defs.setdefault(node.name, []).append(
+                            _DonatingDef(
+                                mod.path, node.name, node.lineno, nums,
+                                False,
+                            )
+                        )
+                        continue
+                    fact = self._factory_argnums(node)
+                    if fact:
+                        defs.setdefault(node.name, []).append(
+                            _DonatingDef(
+                                mod.path, node.name, node.lineno, fact,
+                                True,
+                            )
+                        )
+            module_defs[mod.path] = names
+        ctx.shared["donating_defs"] = defs
+        ctx.shared["module_defs"] = module_defs
+
+    @staticmethod
+    def _make_resolver(mod: Module, defs: Dict[str, List[_DonatingDef]],
+                       module_defs: Dict[str, Set[str]]):
+        """Module-aware donating-def lookup: the calling module's own
+        defs win, a local non-donating def SHADOWS another module's
+        same-named donating def, and an explicit import picks the
+        defining module when several donate under one name."""
+        imap = import_map(mod.tree) if mod.tree is not None else {}
+        local_names = module_defs.get(mod.path, set())
+
+        def resolve(name: str) -> Optional[_DonatingDef]:
+            tail = name.rsplit(".", 1)[-1]
+            cands = defs.get(tail)
+            if not cands:
+                return None
+            for d in cands:
+                if d.module == mod.path:
+                    return d
+            if name == tail:
+                if tail in local_names:
+                    return None  # local non-donating def shadows it
+                qual = imap.get(tail)
+                if qual and "." in qual:
+                    src = (qual.rsplit(".", 1)[0].replace(".", "/")
+                           + ".py")
+                    for d in cands:
+                        if d.module.endswith(src):
+                            return d
+            else:
+                # module-attribute spelling (`pk._step`): the receiver
+                # names the defining module — match on IT, and refuse
+                # to guess when the receiver resolves to a module with
+                # no such donating def (same-named defs elsewhere must
+                # not lend their argnums)
+                chain = name.split(".")[:-1]
+                qual = imap.get(chain[0])
+                if qual:
+                    full = (
+                        ".".join(chain)
+                        if chain[0] == qual.split(".", 1)[0]
+                        else ".".join([qual] + chain[1:])
+                    )
+                    src = full.replace(".", "/") + ".py"
+                    for d in cands:
+                        if d.module.endswith(src):
+                            return d
+                    return None
+                # receiver isn't an imported module (`self.x._step`):
+                # can't localize — keep the historical first-def guess
+            return cands[0]
+
+        return resolve
+
+    @staticmethod
+    def _factory_argnums(fn: ast.FunctionDef) -> Optional[Tuple[int, ...]]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and isinstance(
+                node.value, ast.Call
+            ):
+                nums = _donate_argnums_of_jit_call(node.value)
+                if nums:
+                    return nums
+        return None
+
+    # -- per-module use-after-donate ------------------------------------
+    def check_module(self, mod: Module,
+                     ctx: LintContext) -> Iterable[Finding]:
+        defs: Dict[str, List[_DonatingDef]] = ctx.shared["donating_defs"]
+        module_defs: Dict[str, Set[str]] = ctx.shared["module_defs"]
+        if mod.tree is None:
+            return ()
+        findings: List[Finding] = []
+        resolve = self._make_resolver(mod, defs, module_defs)
+        # factory-built donating callables bound to self attributes
+        # anywhere in the module: attr name -> argnums
+        attr_callables: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                argnums = self._resolve_factory_call(node.value, resolve)
+                if argnums is None:
+                    continue
+                for tgt in node.targets:
+                    d = dotted(tgt)
+                    if d and d.startswith("self."):
+                        attr_callables[d] = argnums
+
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            self._scan_function(
+                fn, mod, resolve, attr_callables, findings
+            )
+        return findings
+
+    @staticmethod
+    def _resolve_factory_call(
+        call: ast.Call, resolve
+    ) -> Optional[Tuple[int, ...]]:
+        """argnums when ``call`` invokes a known donating factory —
+        directly, through a module alias, or through an IfExp choosing
+        between factories (the fleet ``build = (a if ... else b)``
+        shape collapses to a Name by then, so also accept calls whose
+        func resolves via a local binding; that resolution happens in
+        ``_scan_function`` for plain names)."""
+        fn = call.func
+        if isinstance(fn, ast.IfExp):
+            cands = [dotted(fn.body), dotted(fn.orelse)]
+        else:
+            cands = [dotted(fn)]
+        for cand in cands:
+            if not cand:
+                continue
+            d = resolve(cand)
+            if d is not None and d.is_factory:
+                return d.argnums
+        return None
+
+    def _scan_function(
+        self,
+        fn: ast.FunctionDef,
+        mod: Module,
+        resolve,
+        attr_callables: Dict[str, Tuple[int, ...]],
+        findings: List[Finding],
+    ) -> None:
+        # local names bound to donating callables within this function
+        # (``step = make_gossip_step(...)`` / ``build = a if c else b``)
+        local_callables: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                val = node.value
+                argnums = None
+                if isinstance(val, ast.Call):
+                    argnums = self._resolve_factory_call(val, resolve)
+                elif isinstance(val, (ast.IfExp, ast.Name, ast.Attribute)):
+                    # a bare factory reference (not yet called):
+                    # ``build = make_a if cond else make_b``
+                    cands = (
+                        [dotted(val.body), dotted(val.orelse)]
+                        if isinstance(val, ast.IfExp) else [dotted(val)]
+                    )
+                    for c in cands:
+                        d = resolve(c) if c else None
+                        if d is not None and d.is_factory:
+                            # calling this name CREATES a donating fn;
+                            # the created value donates d.argnums
+                            for t in node.targets:
+                                nm = dotted(t)
+                                if nm:
+                                    local_callables[f"{nm}()"] = d.argnums
+                if argnums is not None:
+                    for t in node.targets:
+                        nm = dotted(t)
+                        if nm:
+                            local_callables[nm] = argnums
+
+        def donating_call(call: ast.Call) -> Optional[Tuple[int, ...]]:
+            name = call_name(call)
+            if not name:
+                return None
+            d = resolve(name)
+            if d is not None and not d.is_factory:
+                return d.argnums
+            if name in local_callables:
+                return local_callables[name]
+            if name in attr_callables:
+                return attr_callables[name]
+            # ``build(...)`` where build holds a factory reference:
+            # the RESULT donates, the call itself doesn't
+            return None
+
+        donated: Dict[str, Tuple[int, str]] = {}  # name -> (line, callee)
+        self._walk_block(
+            list(fn.body), donated, donating_call, mod, findings
+        )
+
+    # -- dataflow --------------------------------------------------------
+    def _walk_block(self, stmts, donated, donating_call, mod, findings):
+        for st in stmts:
+            self._walk_stmt(st, donated, donating_call, mod, findings)
+
+    def _walk_stmt(self, st, donated, donating_call, mod, findings):
+        if isinstance(st, ast.If):
+            # test first: a donation inside the test expression (e.g.
+            # ``if _converge(mat):``) must flow into both branches
+            self._eval_expr(st.test, donated, donating_call, mod, findings)
+            d1, d2 = dict(donated), dict(donated)
+            self._walk_block(st.body, d1, donating_call, mod, findings)
+            self._walk_block(st.orelse, d2, donating_call, mod, findings)
+            donated.clear()
+            donated.update(d1)
+            donated.update(d2)
+        elif isinstance(st, (ast.For, ast.While)):
+            if isinstance(st, ast.For):
+                self._eval_expr(
+                    st.iter, donated, donating_call, mod, findings
+                )
+                for nm in assigned_names(st.target):
+                    donated.pop(nm, None)
+            else:
+                self._eval_expr(
+                    st.test, donated, donating_call, mod, findings
+                )
+            body_donated: Dict[str, Tuple[int, str]] = dict(donated)
+            self._walk_block(
+                st.body, body_donated, donating_call, mod, findings
+            )
+            # back-edge: a name donated inside the body with NO rebind
+            # anywhere in the body is re-donated (dead) next iteration
+            kills = set()
+            for sub in ast.walk(st):
+                for t in self._stmt_targets(sub):
+                    kills.add(t)
+                if isinstance(sub, ast.For):
+                    kills.update(assigned_names(sub.target))
+                elif isinstance(sub, ast.withitem) and sub.optional_vars:
+                    kills.update(assigned_names(sub.optional_vars))
+            for nm, (line, callee) in body_donated.items():
+                if nm in donated and donated[nm] == (line, callee):
+                    continue  # donated before the loop, not inside it
+                if nm not in kills:
+                    findings.append(Finding(
+                        mod.path, line, "CL101",
+                        f"`{nm}` is donated to `{callee}` inside a "
+                        f"loop and never rebound in the loop body — "
+                        f"the next iteration dispatches a dead buffer",
+                        symbol=f"loop:{callee}:{nm}",
+                    ))
+            donated.update(body_donated)
+            self._walk_block(
+                st.orelse, donated, donating_call, mod, findings
+            )
+        elif isinstance(st, ast.Try):
+            branches = []
+            d0 = dict(donated)
+            self._walk_block(st.body, d0, donating_call, mod, findings)
+            branches.append(d0)
+            for h in st.handlers:
+                dh = dict(donated)
+                self._walk_block(
+                    h.body, dh, donating_call, mod, findings
+                )
+                branches.append(dh)
+            donated.clear()
+            for b in branches:
+                donated.update(b)
+            self._walk_block(st.orelse, donated, donating_call, mod,
+                             findings)
+            self._walk_block(st.finalbody, donated, donating_call, mod,
+                             findings)
+        elif isinstance(st, ast.With):
+            for item in st.items:
+                self._eval_expr(
+                    item.context_expr, donated, donating_call, mod,
+                    findings,
+                )
+            self._walk_block(st.body, donated, donating_call, mod,
+                             findings)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            pass  # nested scopes are scanned independently
+        else:
+            # leaf statement: evaluate expressions (uses + donations),
+            # then apply assignment kills
+            for node in ast.iter_child_nodes(st):
+                if isinstance(node, ast.expr):
+                    self._eval_expr(
+                        node, donated, donating_call, mod, findings
+                    )
+            for nm in self._stmt_targets(st):
+                donated.pop(nm, None)
+
+    @staticmethod
+    def _stmt_targets(st) -> List[str]:
+        out: List[str] = []
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                out.extend(assigned_names(t))
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            out.extend(assigned_names(st.target))
+        return out
+
+    def _eval_expr(self, expr, donated, donating_call, mod, findings):
+        """Check Loads against the donated set, then apply donations
+        from any donating calls in the expression (reads happen before
+        the dispatch's donation takes effect)."""
+        for nm, line in _loads(expr):
+            # `self._mat.shape` is a use of donated `self._mat`:
+            # match the donated name or any deeper attribute chain
+            hit = nm if nm in donated else next(
+                (d for d in donated if nm.startswith(d + ".")), None
+            )
+            if hit is not None:
+                dline, callee = donated[hit]
+                findings.append(Finding(
+                    mod.path, line, "CL101",
+                    f"`{hit}` read after being donated to `{callee}` "
+                    f"(line {dline}); donated buffers are dead after "
+                    f"dispatch — rebind or use an undonated entry",
+                    symbol=f"use:{callee}:{hit}",
+                ))
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                argnums = donating_call(node)
+                if not argnums:
+                    continue
+                callee = call_name(node) or "<donating>"
+                names = self._donated_arg_names(node, argnums)
+                for nm in names:
+                    donated[nm] = (node.lineno, callee)
+
+    @staticmethod
+    def _donated_arg_names(call: ast.Call, argnums) -> List[str]:
+        out = []
+        pos = 0
+        for a in call.args:
+            if isinstance(a, ast.Starred):
+                # a starred arg covers every remaining donated index:
+                # track the starred base name itself
+                if any(n >= pos for n in argnums):
+                    d = dotted(a.value)
+                    if d:
+                        out.append(d)
+                break
+            if pos in argnums:
+                d = dotted(a)
+                if d:
+                    out.append(d)
+            pos += 1
+        return out
+
+    # -- missing-twin (finalize: needs the whole-module def sets) -------
+    def finalize(self, ctx: LintContext) -> Iterable[Finding]:
+        defs: Dict[str, List[_DonatingDef]] = ctx.shared["donating_defs"]
+        module_defs: Dict[str, Set[str]] = ctx.shared["module_defs"]
+        findings: List[Finding] = []
+        for d in (d for lst in defs.values() for d in lst):
+            siblings = module_defs.get(d.module, set())
+            if d.is_factory:
+                # factories: any sibling undonated path (a
+                # `*_nodonate` def or a repeat-dispatch maker) counts
+                has_twin = any(
+                    s.endswith("_nodonate") or "repeat_dispatch" in s
+                    for s in siblings
+                )
+            else:
+                if "converge" not in d.name:
+                    continue  # in-place update kernels: CL101 covers
+                    #           their call sites; no twin required
+                has_twin = f"{d.name}_nodonate" in siblings
+            if not has_twin:
+                findings.append(Finding(
+                    d.module, d.line, "CL102",
+                    f"donating jit `{d.name}` has no undonated twin "
+                    f"(`{d.name}_nodonate`) — repeat-dispatch "
+                    f"consumers (bench probes, host routes) cannot "
+                    f"use it",
+                    symbol=d.name,
+                ))
+        return findings
+
+
+def _loads(expr) -> List[Tuple[str, int]]:
+    """Outermost dotted Load chains in an expression, with lines."""
+    out: List[Tuple[str, int]] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Attribute(self, node):
+            d = dotted(node)
+            if d is not None and isinstance(node.ctx, ast.Load):
+                out.append((d, node.lineno))
+                return  # don't descend into our own chain
+            self.generic_visit(node)
+
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Load):
+                out.append((node.id, node.lineno))
+
+    V().visit(expr)
+    return out

@@ -1,0 +1,140 @@
+"""CL301/CL302/CL303: exception discipline (rounds 7 and 10).
+
+The codec fuzz suite (540 seeded mutants) and the replica's
+malformed-batch isolation both rest on one contract: **decode paths
+raise ``ValueError`` and nothing else** — the replica catches exactly
+``ValueError`` to isolate a poisoned blob, so a stray ``KeyError`` or
+``struct.error`` escaping a decoder kills the apply path instead of
+triggering bisection. Symmetrically, the ALICE crash-point matrix
+relies on ``SimulatedCrash`` deriving from ``BaseException`` so that
+NO handler in the storage/guard ladders can swallow a simulated
+kill — catching it (or ``BaseException``) un-tests every crash point.
+
+- **CL301** — bare ``except:`` or ``except BaseException`` in the
+  codec/storage/guard scope (swallows ``SimulatedCrash``,
+  ``KeyboardInterrupt``, everything).
+- **CL302** — a decode-path function (``decode*`` / ``read_*`` /
+  ``parse*`` / ``apply_update`` / ``loads`` or a ``*Decoder`` method)
+  raising anything but ``ValueError``.
+- **CL303** — catching ``SimulatedCrash`` (or ``BaseException``)
+  inside ``guard/`` — the crash adversary must always propagate.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List
+
+from tools.crdtlint.astutil import dotted, in_scope
+from tools.crdtlint.core import Checker, Finding, LintContext, Module
+
+SCOPE = ("crdt_tpu/codec/", "crdt_tpu/storage/kv.py", "crdt_tpu/guard/")
+GUARD_SCOPE = ("crdt_tpu/guard/",)
+DECODE_SCOPE = ("crdt_tpu/codec/", "crdt_tpu/storage/kv.py")
+
+_DECODE_FN = re.compile(
+    r"^(_?decode|_?read_|_?parse|apply_update$|loads$|from_bytes)"
+)
+
+
+def _is_decode_path(fn: ast.FunctionDef, class_name: str) -> bool:
+    if _DECODE_FN.match(fn.name):
+        return True
+    return "Decoder" in class_name and not fn.name.startswith("__")
+
+
+def _handler_names(h: ast.ExceptHandler) -> List[str]:
+    if h.type is None:
+        return ["<bare>"]
+    types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    return [(dotted(t) or "?").rsplit(".", 1)[-1] for t in types]
+
+
+class ExceptionDisciplineChecker(Checker):
+    name = "exceptions"
+    codes = {
+        "CL301": "bare `except:` / `except BaseException` in "
+                 "codec/storage/guard scope",
+        "CL302": "decode path raises something other than ValueError",
+        "CL303": "guard ladder catches SimulatedCrash/BaseException "
+                 "(defeats the crash-point matrix)",
+    }
+
+    def check_module(self, mod: Module,
+                     ctx: LintContext) -> Iterable[Finding]:
+        if not in_scope(mod.path, SCOPE):
+            return ()
+        findings: List[Finding] = []
+        in_guard = in_scope(mod.path, GUARD_SCOPE)
+        in_decode_scope = in_scope(mod.path, DECODE_SCOPE)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler):
+                names = _handler_names(node)
+                if "<bare>" in names or "BaseException" in names:
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL301",
+                        "bare `except:`/`except BaseException` "
+                        "swallows SimulatedCrash and "
+                        "KeyboardInterrupt — catch concrete "
+                        "exception types",
+                        symbol=",".join(names),
+                    ))
+                if in_guard and "SimulatedCrash" in names:
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL303",
+                        "guard code catches SimulatedCrash — the "
+                        "ALICE crash-point adversary must always "
+                        "propagate (it derives from BaseException "
+                        "precisely so ladders can't eat it)",
+                        symbol="SimulatedCrash",
+                    ))
+
+        if not in_decode_scope:
+            return findings
+        # decode-path raise discipline, per enclosing function
+        for parent, class_name in _defs_with_class(mod.tree):
+            if not _is_decode_path(parent, class_name):
+                continue
+            for node in ast.walk(parent):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                exc = node.exc
+                name = (
+                    dotted(exc.func) if isinstance(exc, ast.Call)
+                    else dotted(exc)
+                ) or "?"
+                short = name.rsplit(".", 1)[-1]
+                if short != "ValueError":
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL302",
+                        f"decode path `{parent.name}` raises "
+                        f"`{short}` — decoders must raise ValueError "
+                        f"only (the replica's malformed-blob "
+                        f"isolation catches exactly that; round-10 "
+                        f"fuzz contract)",
+                        symbol=f"{parent.name}:{short}",
+                    ))
+        return findings
+
+
+def _defs_with_class(tree: ast.Module):
+    """(function def, enclosing class name or "") pairs, top-level
+    functions included — without double-visiting methods."""
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            yield node, ""
+            yield from _nested(node, "")
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef):
+                    yield sub, node.name
+                    yield from _nested(sub, node.name)
+
+
+def _nested(fn: ast.FunctionDef, class_name: str):
+    for node in fn.body:
+        if isinstance(node, ast.FunctionDef):
+            yield node, class_name
+            yield from _nested(node, class_name)

@@ -1,0 +1,194 @@
+"""CL601: unlocked module-level mutable state in threaded modules.
+
+``models/streaming.py`` decodes on a thread pool, and that pool calls
+into the process-global tracer, the transfer seam, and the device
+fault hook. The round-8 tracer rewrite exists because a module-level
+dict was mutated bare from those threads; this checker keeps the
+class of bug from coming back.
+
+Scope: the modules the streaming thread pool touches
+(``models/streaming.py``, ``obs/tracer.py``, ``obs/recorder.py``,
+``ops/device.py``). Flagged:
+
+- assignment to a module-level name through ``global NAME`` inside a
+  function, outside any ``with <…lock…>:`` block;
+- in-place mutation (``.append``/``.update``/``.pop``/``.add``/
+  ``[...] =`` / ``+=``) of a module-level name bound to a mutable
+  literal (dict/list/set/deque), outside a lock block.
+
+A ``with`` context naming a lock-like identifier counts as holding a
+lock: any dotted component whose ``_``/camelCase segments include
+``lock``/``rlock``/``mutex``/``semaphore`` (``self._lock``,
+``_TRACER_LOCK``, ``threading.Lock()``) — but NOT incidental
+substrings like ``self._blocker``, which must not silence the
+checker. Atomic
+publish-only rebinds (``set_tracer``-style) are *findings* —
+intentionally-unlocked ones belong in the baseline with that
+justification, where a reviewer can see the reasoning.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set
+
+from tools.crdtlint.astutil import dotted
+from tools.crdtlint.core import Checker, Finding, LintContext, Module
+
+THREADED_SUFFIXES = (
+    "models/streaming.py", "obs/tracer.py", "obs/recorder.py",
+    "ops/device.py",
+)
+_MUTATORS = {
+    "append", "update", "pop", "add", "extend", "remove", "clear",
+    "setdefault", "appendleft", "popleft", "discard", "insert",
+}
+_MUTABLE_CTORS = {"dict", "list", "set", "deque", "defaultdict",
+                  "OrderedDict", "Counter"}
+
+
+def _module_mutables(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to mutable containers."""
+    out: Set[str] = set()
+    for node in tree.body:
+        # `X = set()` and the annotated `X: set = set()` bind the same
+        # shared state — a type annotation must not silence CL601
+        if isinstance(node, ast.Assign):
+            targets, val = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, val = [node.target], node.value
+        else:
+            continue
+        mutable = isinstance(
+            val, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                  ast.DictComp, ast.SetComp)
+        ) or (
+            isinstance(val, ast.Call)
+            and (dotted(val.func) or "").rsplit(".", 1)[-1]
+            in _MUTABLE_CTORS
+        )
+        if mutable:
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+_LOCK_SEGMENTS = {"lock", "rlock", "mutex", "semaphore"}
+
+
+def _is_lock_expr(expr: ast.AST) -> bool:
+    """Does a with-item's context expression name a lock? Matched on
+    whole ``_``/camelCase segments of every identifier in the
+    expression — ``self._lock`` / ``_CACHE_LOCK`` / ``threading.Lock()``
+    hold, ``self._blocker`` / ``_unblocked_region()`` do NOT (the raw
+    substring test let ``b·lock`` silence the checker)."""
+    idents: List[str] = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name):
+            idents.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            idents.append(node.attr)
+    for ident in idents:
+        camel_split = re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", ident)
+        segs = [s for s in re.split(r"[^A-Za-z0-9]+|_", camel_split) if s]
+        if any(s.lower() in _LOCK_SEGMENTS for s in segs):
+            return True
+    return False
+
+
+def _lock_depth_map(fn: ast.FunctionDef) -> Set[int]:
+    """ids of statements lexically inside a ``with <lock>:`` block."""
+    inside: Set[int] = set()
+
+    def visit(node, locked):
+        if isinstance(node, ast.With):
+            holds = any(
+                _is_lock_expr(item.context_expr) for item in node.items
+            )
+            locked = locked or holds
+        for child in ast.iter_child_nodes(node):
+            if locked:
+                inside.add(id(child))
+            visit(child, locked)
+
+    visit(fn, False)
+    return inside
+
+
+class ThreadSharedStateChecker(Checker):
+    name = "thread-shared"
+    codes = {
+        "CL601": "module-level mutable state mutated without a lock "
+                 "in a thread-pool-reachable module",
+    }
+
+    def check_module(self, mod: Module,
+                     ctx: LintContext) -> Iterable[Finding]:
+        if not any(mod.path.endswith(s) for s in THREADED_SUFFIXES):
+            return ()
+        findings: List[Finding] = []
+        mutables = _module_mutables(mod.tree)
+
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, ast.FunctionDef):
+                continue
+            globals_declared: Set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Global):
+                    globals_declared.update(node.names)
+            if not globals_declared and not mutables:
+                continue
+            locked_ids = _lock_depth_map(fn)
+
+            for node in ast.walk(fn):
+                if id(node) in locked_ids:
+                    continue
+                # global rebind
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        nm = None
+                        if isinstance(t, ast.Name):
+                            nm = t.id
+                        elif isinstance(t, ast.Subscript) and isinstance(
+                            t.value, ast.Name
+                        ):
+                            nm = t.value.id
+                            if nm not in mutables:
+                                nm = None
+                        if nm is None:
+                            continue
+                        if (nm in globals_declared
+                                or (isinstance(t, ast.Subscript)
+                                    and nm in mutables)):
+                            findings.append(Finding(
+                                mod.path, node.lineno, "CL601",
+                                f"module global `{nm}` mutated in "
+                                f"`{fn.name}` without holding a lock "
+                                f"— this module is reached from the "
+                                f"streaming thread pool (round-8 "
+                                f"tracer race class)",
+                                symbol=f"{fn.name}:{nm}",
+                            ))
+                # in-place mutator call on a module-level container
+                elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute
+                ):
+                    if node.func.attr not in _MUTATORS:
+                        continue
+                    base = node.func.value
+                    if isinstance(base, ast.Name) and base.id in mutables:
+                        findings.append(Finding(
+                            mod.path, node.lineno, "CL601",
+                            f"module-level container `{base.id}` "
+                            f"mutated via `.{node.func.attr}()` in "
+                            f"`{fn.name}` without a lock",
+                            symbol=f"{fn.name}:{base.id}.{node.func.attr}",
+                        ))
+        return findings

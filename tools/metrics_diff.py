@@ -22,7 +22,11 @@ Compared (whatever of these both artifacts carry):
 - bytes-on-link: the ``xfer.*`` counters/gauges from the embedded
   tracer report and the headline/scale ``xfer`` digests
   (``h2d_bytes``/``d2h_bytes``/``narrowed_ratio`` — LOWER is better:
-  the transfer diet is regression-gated like every latency).
+  the transfer diet is regression-gated like every latency);
+- static analysis: ``lint.findings`` / ``lint.baselined`` from the
+  embedded crdtlint digest (lower = better, no noise floor) — a PR
+  that grows the crdtlint baseline or adds inline disables moves the
+  count and lands in this table, even though tier-1 still passes.
 
 Prints a table (one row per metric: old, new, delta, verdict) and
 exits non-zero when any metric regressed past ``--threshold``
@@ -56,6 +60,14 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     (("overload", "peak_inbox_bytes"), False),
     (("overload", "shed_count"), False),
     (("overload", "shed_bytes"), False),
+    # static analysis (tools/crdtlint): TOTAL findings incl. baselined
+    # + suppressed — the committed tree always lints clean (tier-1),
+    # so this moves exactly when a PR grows the baseline or sprinkles
+    # new inline disables, and that shows up in the diff table like
+    # any perf regression. Lower is better; counts, not seconds, so
+    # the noise floor never mutes it.
+    (("lint", "findings"), False),
+    (("lint", "baselined"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 

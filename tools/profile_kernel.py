@@ -29,7 +29,7 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 from crdt_tpu.ops import packed as pk
 from crdt_tpu.ops.device import (
     NULLI, dense_ranks_sorted, dfs_ranks, lexsort, pack_id,
-    run_edge_lookup, scatter_perm, searchsorted_ids, pointer_double,
+    run_edge_lookup, scatter_perm, searchsorted_ids,
 )
 from crdt_tpu.ops.lww import map_winners
 
