@@ -856,6 +856,223 @@ def _overload_leg_body(seed: int, budget: int, tracer) -> dict:
     }
 
 
+def kernel_ablation_leg(cols, b2b_ms, null_floor_ms) -> dict:
+    """Per-primitive sort-diet ablation at the headline shape: the
+    three primitives the round-12 Pallas kernels replaced, each timed
+    on BOTH paths with the sweep's b2b methodology, net of the
+    null-dispatch floor.
+
+    - ``sort_ms``: document-order assembly. jnp = the two global
+      argsorts the old dispatch ran (sibling key + (seg, rank) key at
+      the seq bucket); pallas = the ``stream_scatter`` permutation
+      kernel that replaced them.
+    - ``map_winners_ms``: the LWW winner chain. jnp = the sort +
+      run-edge + doubling chain of ``lww.map_winners`` at the padded
+      kernel width; pallas = the segmented Lamport argmax scan +
+      doubling at map-bucket width over the staged grouped layout.
+    - ``rank_ms``: YATA ranking. jnp = the on-device sibling-table
+      build (run edges, next/first-child scatters) + Wyllie ranking;
+      pallas = the ranking alone over the tables staging now
+      precomputes (the build fell out of the dispatch).
+
+    ``pallas`` names the production kernel path: compiled Pallas on
+    TPU, the kernels' jnp oracles (same sortless algorithms) on other
+    backends — i.e. exactly what :func:`packed.kernel_mode_for`
+    dispatches on this rig. ``sort_map_speedup`` is the acceptance
+    number: (sort + map) jnp / (sort + map) pallas, net of floor.
+    """
+    import jax
+    import jax.numpy as jnp
+    from functools import partial as _partial
+    from crdt_tpu.ops import packed as _pk
+    from crdt_tpu.ops import pallas_kernels as _plk
+    from crdt_tpu.ops.device import (
+        _CLOCK_BITS,
+        NULLI,
+        bucket_grid,
+        dfs_ranks,
+        run_edge_lookup,
+        scatter_perm,
+    )
+    from crdt_tpu.ops.lww import map_winners
+
+    # ---- host mini-staging: the id-sorted dense columns the OLD
+    # (round-11) fused dispatch consumed (mirrors packed._stage's
+    # prefix — rig-local: values only shape the timing, the
+    # differential suites own exactness)
+    client = np.asarray(cols["client"], np.int64)
+    clock = np.asarray(cols["clock"], np.int64)
+    pir = np.asarray(cols["parent_is_root"], bool)
+    pa = np.asarray(cols["parent_a"], np.int64)
+    pb = np.asarray(cols["parent_b"], np.int64)
+    kid = np.asarray(cols["key_id"], np.int64)
+    oc = np.asarray(cols["origin_client"], np.int64)
+    ock = np.asarray(cols["origin_clock"], np.int64)
+    valid = np.asarray(cols["valid"], bool)
+    n = len(client)
+    uniq = np.unique(np.concatenate([client[valid],
+                                     oc[valid & (oc >= 0)]]))
+    cd = np.searchsorted(uniq, np.clip(client, uniq[0], None))
+    porder = np.lexsort((pb, pa, pir))
+    pir_s, pa_s, pb_s = pir[porder], pa[porder], pb[porder]
+    runs = np.r_[True, (pir_s[1:] != pir_s[:-1])
+                 | (pa_s[1:] != pa_s[:-1]) | (pb_s[1:] != pb_s[:-1])]
+    pref = np.empty(n, np.int64)
+    pref[porder] = np.cumsum(runs) - 1
+    ikey = np.where(valid, (cd << _CLOCK_BITS) | clock,
+                    np.int64(2 ** 62))
+    order = np.argsort(ikey, kind="stable")
+    ikey_s = ikey[order]
+    kid_s = kid[order]
+    valid_s = valid[order]
+    dup = np.r_[False, ikey_s[1:] == ikey_s[:-1]]
+    uv = valid_s & ~dup
+    sk = _pk.segkey_of(pref[order], kid_s)
+    _, seg_inv = np.unique(sk[uv], return_inverse=True)
+    seg = np.full(n, -1, np.int64)
+    seg[uv] = seg_inv
+    okey = np.where(oc[order] >= 0,
+                    (np.searchsorted(uniq, np.clip(oc[order], uniq[0],
+                                                   None)) << _CLOCK_BITS)
+                    | ock[order], np.int64(-1))
+    pos = np.clip(np.searchsorted(ikey_s, okey), 0, n - 1)
+    origin_row = np.where((okey >= 0) & (ikey_s[pos] == okey), pos, -1)
+
+    kpad = bucket_grid(n, floor=6)
+
+    def _pad(a, fill):
+        return np.concatenate([a, np.full(kpad - n, fill, a.dtype)])
+
+    is_map = uv & (kid_s >= 0)
+    seg_map = np.where(is_map, seg, NULLI)
+    plan = _pk.stage(cols)
+    B, S, M = plan.seq_bucket, plan.num_segments, plan.map_bucket
+    mode = _plk.converge_kernel_mode(M, B)
+    secs = _pk._decode_sections(
+        jnp.asarray(plan.mat), _pk._section_sizes(S, B, M), plan.encs
+    )
+    sseg, soff, cp, nxt, fc, mkey, cend, rend = [
+        jax.device_put(s) for s in secs
+    ]
+
+    def net(ms):
+        return round(max(ms - null_floor_ms, 0.01), 2)
+
+    out = {"shape": n, "mode": mode,
+           "seq_bucket": B, "map_bucket": M}
+
+    # ---- map_winners: old sort chain at kpad vs segmented argmax at M
+    fn_old_map = jax.jit(_partial(
+        map_winners, num_segments=S, rows_id_ranked=True,
+        chain_rounds=plan.map_rounds, client_bits=23,
+    ))
+    a_seg = jnp.asarray(_pad(seg_map.astype(np.int32), NULLI))
+    a_cl = jnp.asarray(_pad(cd[order].astype(np.int32), 0))
+    a_ck = jnp.asarray(_pad(clock[order], 0))
+    a_or = jnp.asarray(_pad(origin_row.astype(np.int32), NULLI))
+    a_im = jnp.asarray(_pad(is_map, False))
+
+    # the new side times packed._map_block ITSELF (one shared
+    # definition with the production dispatch, so these gated numbers
+    # can never drift onto a stale copy of the algorithm)
+    fn_new_map = jax.jit(_partial(
+        _pk._map_block, map_rounds=plan.map_rounds, mode=mode,
+    ))
+
+    out["map_winners_ms"] = {
+        "jnp": net(b2b_ms(
+            lambda: fn_old_map(a_seg, a_cl, a_ck, a_or, a_im))),
+        "pallas": net(b2b_ms(
+            lambda: fn_new_map(mkey, cend, rend))),
+    }
+
+    # ---- rank: table build + Wyllie vs Wyllie over prebuilt tables
+    parent = jnp.where(sseg >= 0, jnp.where(cp >= 0, cp,
+                                            B + jnp.maximum(sseg, 0)),
+                       B + S).astype(jnp.int32)
+    c_ok = jax.device_put(sseg >= 0)
+    rng = np.random.default_rng(12)
+    sib_client = jnp.asarray(rng.integers(0, 1 << 14, B)
+                             .astype(np.int64))
+    pos_desc = jnp.asarray(np.arange(B - 1, -1, -1, dtype=np.int64))
+    qbits = int(max(B - 1, 1)).bit_length()
+
+    @jax.jit
+    def fn_old_rank(p_s, sord2, parent, c_ok):
+        # the table build _rank_compact ran on device every dispatch
+        # (sibling run edges + next/first-child scatters), then the
+        # shared Wyllie ranking — sorted inputs given, so the sibling
+        # argsort itself is charged to the sort leg, not here
+        same = jnp.concatenate([p_s[1:] == p_s[:-1],
+                                jnp.zeros(1, bool)])
+        nxt_sorted = jnp.where(same, jnp.roll(sord2, -1),
+                               NULLI).astype(jnp.int32)
+        next_sib = scatter_perm(sord2, nxt_sorted)
+        first_pos, _ = run_edge_lookup(p_s, B + S, side="left")
+        first_child = jnp.where(
+            first_pos >= 0, sord2[jnp.clip(first_pos, 0, B - 1)], NULLI
+        ).astype(jnp.int32)
+        return dfs_ranks(parent, next_sib, first_child, c_ok, S,
+                         rank_rounds=plan.rank_rounds)
+
+    @jax.jit
+    def fn_new_rank(parent, nxt, fc, c_ok):
+        return dfs_ranks(parent, nxt.astype(jnp.int32),
+                         fc.astype(jnp.int32), c_ok, S,
+                         rank_rounds=plan.rank_rounds)
+
+    sibkey = ((parent.astype(jnp.int64) << (23 + qbits))
+              | (sib_client << qbits) | pos_desc)
+    sord2 = jnp.argsort(sibkey, stable=True)
+    p_s = parent[sord2]
+    out["rank_ms"] = {
+        "jnp": net(b2b_ms(lambda: fn_old_rank(p_s, sord2, parent,
+                                              c_ok))),
+        "pallas": net(b2b_ms(lambda: fn_new_rank(parent, nxt, fc,
+                                                 c_ok))),
+    }
+
+    # ---- sort: the removed global argsorts vs the scatter kernel
+    dist = fn_new_rank(parent, nxt, fc, c_ok)
+    root_dist = dist[B + jnp.maximum(sseg, 0)]
+    c_rank = jnp.where(c_ok, root_dist - dist[:B] - 1, NULLI)
+    scat_pos = jnp.where(
+        c_ok & (c_rank >= 0),
+        soff[jnp.clip(sseg, 0, S - 1)] + c_rank, NULLI
+    ).astype(jnp.int32)
+    skey2 = jnp.where(c_ok, sseg.astype(jnp.int64) * B
+                      + jnp.maximum(c_rank, 0), jnp.int64(2 ** 62))
+
+    @jax.jit
+    def fn_old_sort(sibkey, skey2):
+        return jnp.argsort(sibkey, stable=True), \
+            jnp.argsort(skey2, stable=True)
+
+    @_partial(jax.jit, static_argnames=("kmode",))
+    def fn_new_sort(scat_pos, kmode):
+        return _plk.stream_scatter(scat_pos, B, mode=kmode)
+
+    out["sort_ms"] = {
+        "jnp": net(b2b_ms(lambda: fn_old_sort(sibkey, skey2))),
+        "pallas": net(b2b_ms(lambda: fn_new_sort(scat_pos,
+                                                 kmode=mode))),
+    }
+
+    old_share = out["sort_ms"]["jnp"] + out["map_winners_ms"]["jnp"]
+    new_share = out["sort_ms"]["pallas"] \
+        + out["map_winners_ms"]["pallas"]
+    out["sort_map_speedup"] = round(old_share / max(new_share, 1e-3), 2)
+    out["note"] = (
+        "per-primitive b2b timings net of the null-dispatch floor; "
+        "'pallas' is the production kernel path on this rig "
+        f"(mode={mode}: compiled Pallas on TPU, the kernels' sortless "
+        "jnp oracles elsewhere), 'jnp' the pre-round-12 sort-based "
+        "primitives at their old widths. sort_map_speedup = "
+        "(sort+map) jnp / pallas — the ROADMAP item-3 >=2x claim."
+    )
+    return out
+
+
 def smoke():
     """Fast pipeline-accounting smoke: a tiny trace through all three
     contenders (numpy, one-shot device pipeline, streaming executor)
@@ -1032,6 +1249,13 @@ def smoke():
             "smoke: xfer.narrowed_ratio gauge missing"
         assert xfer_dev.get("h2d_bytes", 0) > 0, \
             "smoke: device leg recorded no h2d bytes"
+        # the round-12 kernel-dispatch registry: every fused converge
+        # counts its static kernel-mode decision, so the sort-diet
+        # evidence (and the metrics_diff gates reading it) can't rot
+        assert any(k.startswith('converge.pallas{mode=')
+                   for k in report["counters"]), \
+            "smoke: converge.pallas mode counter missing"
+        out["kernel_registry_ok"] = True
         out["tracer_spans_ok"] = True
     smoke_out = os.environ.get("BENCH_SMOKE_OUT")
     if smoke_out and report is not None:
@@ -1127,13 +1351,31 @@ def main():
             jax.block_until_ready(dev)
             sweep[nsub] = _b2b_ms(lambda: sweep_fn(dev)) / 1e3
             if frac == 1:
-                null = jax.jit(lambda m: m[0, :1].astype(jnp.int32) + 1)
+                # the staged upload is one flat section array (round
+                # 12); the null program touches a single element of it
+                null = jax.jit(lambda m: m[:1].astype(jnp.int32) + 1)
                 null_floor_ms = _b2b_ms(lambda: null(dev))
     ns = sorted(sweep)
     log("fused-kernel dispatch sweep (8-deep b2b, sync mode): " + ", ".join(
         f"{n}: {sweep[n]*1e3:.1f}ms" for n in ns)
         + f"; null-dispatch floor {null_floor_ms:.1f}ms")
     kernel_ops_s = round(ns[-1] / sweep[ns[-1]])
+
+    # ---- per-primitive sort-diet ablation (round 12) -----------------
+    try:
+        with enable_x64(True):
+            ablation = kernel_ablation_leg(cols_w, _b2b_ms,
+                                           null_floor_ms)
+        log("kernel ablation (net ms, jnp -> pallas): "
+            + ", ".join(
+                f"{k.split('_ms')[0]} {v['jnp']:.2f} -> "
+                f"{v['pallas']:.2f}"
+                for k, v in ablation.items()
+                if isinstance(v, dict) and "jnp" in v)
+            + f"; sort+map speedup {ablation['sort_map_speedup']}x")
+    except Exception as exc:
+        log(f"kernel ablation failed: {exc!r}")
+        ablation = {"error": repr(exc)}
 
     # ---- timed end-to-end runs ---------------------------------------
     t_dev = None
@@ -1993,6 +2235,7 @@ def main():
             str(n): round(max(sweep[n] * 1e3 - null_floor_ms, 0.0), 1)
             for n in ns
         },
+        "kernel_ablation": ablation,
         "dispatch_floor_ms": round(null_floor_ms, 1),
         "phases_device_s": best_phases_dev,
         "phases_numpy_s": best_phases_np,

@@ -1599,6 +1599,7 @@ class IncrementalReplay:
                         jnp.int32(self.n_dev),
                         num_segments=tpad,
                         sel_bucket=sel_bucket, seq_bucket=sel_bucket,
+                        mode=pk.kernel_mode_for(sel_bucket),
                     )
                     # the round's ONE fetch
                     return mat, xfer_fetch(

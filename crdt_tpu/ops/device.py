@@ -499,7 +499,10 @@ def dfs_ranks(
     next sibling of the nearest ancestor (itself included) that has
     one — the "climb past last-child chains" step, itself a pointer
     doubling. Shared by :func:`crdt_tpu.ops.yata.tree_order_ranks`
-    (full-width) and the packed replay kernel (compact-width).
+    (full-width) and the packed replay kernel (compact-width; since
+    round 12 the staged cold path feeds PRE-BUILT next_sib /
+    first_child tables straight from staging, so this ranking is the
+    only tree machinery left in that dispatch).
 
     ``rank_rounds`` (static), when the caller can bound the longest
     per-segment DFS path on the host (e.g. max segment population from

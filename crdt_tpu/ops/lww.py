@@ -27,6 +27,16 @@ This is the "segmented argmax over Lamport clocks" of the north star
 client) would disagree with Yjs whenever concurrent branches of
 different depths exist; the tree argmax + pointer doubling is both
 vectorized and exact.
+
+Round 12 (the sort diet): the staged cold replay no longer routes
+here — staging groups each node's children into contiguous runs and
+the Pallas segmented argmax scan
+(``ops.pallas_kernels.seg_argmax_scan``) reads every run's last child
+in one VMEM pass, keeping only the chain doubling (step 2) at
+map-bucket width. THIS kernel remains the engine of the general merge
+(``ops.merge.converge_maps``) and the incremental splice
+(``ops.packed._converge_core``), and the oracle the scan is
+differential-tested against.
 """
 
 from __future__ import annotations

@@ -15,6 +15,11 @@ idempotent merge, SURVEY.md Q2) and computes, entirely on device:
 Content values never touch the device: the kernel returns winner
 *indices* into the caller's record list; materializing the JSON cache
 is a host-side gather (crdt.c rebuild, crdt.js:304).
+
+This full-width kernel serves the engine-backed merge modes and the
+differential suites; the staged cold replay runs the round-12
+sortless dispatch instead (``ops.packed._converge_packed_body`` over
+Pallas kernels + staging-precomputed layout — see ops/packed.py).
 """
 
 from __future__ import annotations

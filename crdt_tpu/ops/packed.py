@@ -49,6 +49,7 @@ from crdt_tpu.ops.device import (
     dfs_ranks,
     lexsort,
     pack_id,
+    pointer_double,
     record_staged_widths,
     run_edge_lookup,
     scatter_perm,
@@ -87,68 +88,82 @@ EAGER_PUT_MIN_ROWS = 1 << 19
 
 
 # ---------------------------------------------------------------------------
-# narrow-column staging: the transfer diet (round 9)
+# narrow-section staging: the transfer diet (round 9), re-cut for the
+# round-12 sort diet's precomputed-layout upload
 #
-# The staged upload is pure LAYOUT data — dense ranks, segment numbers,
-# row references — whose values are tiny compared to their int32 slots
-# for every real workload (the headline 100k-op trace tops out at ~12k
-# segments and 1k clients). Each row gets a frame-of-reference/delta
-# encoding into int16, HALVING bytes-on-link, with a fused widening
-# prelude inside the one-dispatch converge program that reconstructs
-# the exact int32 values — kernel semantics and outputs stay
-# byte-identical (differential-tested in tests/test_transfer_diet.py).
-# A row whose values do not fit falls back automatically: the matrix
-# path keeps the int16 dtype and ships that column as two exact hi/lo
-# rows (see below), the eager path ships that array wide int32.
-# CRDT_TPU_WIDE_STAGING=1 forces wide everywhere (README "Transfer
-# diet").
+# The staged upload is pure LAYOUT data — dense ranks, run flags,
+# block-local tree tables — whose values are tiny compared to their
+# int32 slots for every real workload. Round 12 moves the sibling
+# grouping the device used to re-derive with global argsorts INTO
+# staging (host radix passes any columnar store pays at ingest), so
+# what ships is no longer raw columns but the layout's OUTPUT, cut
+# into named SECTIONS of one flat array:
 #
-# Encodings (host encoder and device decoder kept adjacent; each pair
-# must be an exact inverse):
-#   client     : identity (values are dense ranks / group ranks >= 0)
-#   seg        : map seg -> seg; seq seg -> -(seg+2); dead -> -1
-#                (the _SEQ_FLAG bit folded into the sign)
-#   origin     : -1 -> 0; else (row_index - origin_row), biased to the
-#                chain-local distance (same-client chains sit adjacent
-#                in id-sorted order)
-#   seq rows   : strictly-ascending prefix delta-coded (w0 = s0 + 1,
-#                wj = sj - s(j-1), all >= 1); padding -> 0
-#   seq parent : -1 -> 0; else (compact_index - parent_index)
+#   seq_seg      [B]   dense segment id per compact seq row (-1 pad)
+#   seg_off      [S]   doc-order exclusive offset per segment (the
+#                      scatter targets: out[off[seg] + rank] = row)
+#   seq_parent   [B]   compact origin-tree parent, -1 root
+#   seq_next     [B]   next sibling in (parent, client, clock desc)
+#                      order, -1 at group end
+#   seq_first    [B+S] first child per node (items + virtual roots)
+#   map_key      [M]   map rows grouped by chain parent: dense client
+#                      rank << 1 | run-start flag (-1 pad)
+#   map_chain_end[M]   grouped END position of each node's child run,
+#                      -1 leaf
+#   map_root_end [S]   grouped END position of each segment's
+#                      root-children run, -1 no map rows
 #
-# A matrix column whose range does NOT fit one int16 row ships as TWO
-# int16 hi/lo rows instead (any int32 splits exactly), so one
-# overflowing column — e.g. the segment row past 32k segments on the
-# scale run's stream shards — costs 6/10 of the wide bytes instead of
-# collapsing the whole upload back to int32.
+# Each section gets a frame-of-reference/delta encoding into int16
+# when its values fit ('i16' identity / 'd16' delta-from-position),
+# with a fused widening prelude inside the one-dispatch converge
+# program reconstructing the exact int32 values — kernel semantics
+# and outputs stay byte-identical (tests/test_transfer_diet.py,
+# tests/test_sort_diet.py). A section whose values do not fit ships
+# as TWO exact int16 hi/lo stretches ('hilo': any int32 splits
+# exactly), so one overflowing section never collapses the whole
+# upload back to int32. CRDT_TPU_WIDE_STAGING=1 forces plain int32
+# everywhere ('i32', README "Transfer diet").
 # ---------------------------------------------------------------------------
 
 _I16_MIN = -(1 << 15)
 _I16_MAX = (1 << 15) - 1
 
+# fixed section order of the flat staged array; the eager path ships
+# the same sections as three group uploads (see _SECTION_GROUPS)
+SECTION_NAMES = (
+    "seq_seg", "seg_off", "seq_parent", "seq_next", "seq_first",
+    "map_key", "map_chain_end", "map_root_end",
+)
 
-def _narrow_client(r0: np.ndarray):
-    """int16 client-rank row, or None when a rank overflows."""
-    if len(r0) and int(r0.max()) > _I16_MAX:
+# section name -> preferred narrow encoder; 'hilo' is the shared
+# exact fallback when the preferred one refuses
+_SECTION_NARROW = {
+    "seq_seg": "i16", "seg_off": "i16", "seq_parent": "d16",
+    "seq_next": "d16", "seq_first": "d16",
+    "map_key": "i16", "map_chain_end": "d16", "map_root_end": "i16",
+}
+
+# eager (stage(put=...)) upload groups, as index ranges over
+# SECTION_NAMES: group 0 and 2 are complete before the right-origin
+# pass and ship immediately; group 1 (the sibling tables) depends on
+# the simulated group ranks and ships last
+_SECTION_GROUPS = ((0, 3), (3, 5), (5, 8))
+
+
+def _narrow_ident(vals: np.ndarray):
+    """int16 identity encoding (values in [-1, 32767]), or None."""
+    if len(vals) and (int(vals.max()) > _I16_MAX
+                      or int(vals.min()) < -1):
         return None
-    return r0.astype(np.int16)
-
-
-def _narrow_seg(r1: np.ndarray, n_segs: int):
-    """int16 segment row with the seq flag folded into the sign, or
-    None when the segment count overflows the narrow space."""
-    if n_segs > _I16_MAX:
-        return None
-    seq = (r1 >= 0) & ((r1 & _SEQ_FLAG) != 0)
-    seg = r1 & (_SEQ_FLAG - 1)
-    out = np.where(r1 < 0, -1, np.where(seq, -(seg + 2), seg))
-    return out.astype(np.int16)
+    return vals.astype(np.int16)
 
 
 def _narrow_delta_ref(vals: np.ndarray):
-    """int16 (index - reference) encoding of a row-reference column
-    (-1 = no reference -> 0), or None when a delta overflows int16 or
-    collides with the no-reference sentinel (a self-referential row —
-    hostile input — forces the wide layout, never a wrong decode)."""
+    """int16 (index - reference) encoding of a position-reference
+    section (-1 = no reference -> 0), or None when a delta overflows
+    int16 or collides with the no-reference sentinel (a
+    self-referential slot — hostile input — forces the hi/lo layout,
+    never a wrong decode)."""
     idx = np.arange(len(vals), dtype=np.int64)
     live = vals >= 0
     d = np.where(live, idx - vals, 0)
@@ -159,24 +174,11 @@ def _narrow_delta_ref(vals: np.ndarray):
     return d.astype(np.int16)
 
 
-def _narrow_ascending(rows: np.ndarray):
-    """int16 delta code of a strictly-ascending valid PREFIX (-1
-    padding tail), or None when a gap overflows int16."""
-    w = np.zeros(len(rows), np.int64)
-    m = rows >= 0
-    if m.any():
-        pref = rows[m]
-        w[: len(pref)] = np.diff(pref, prepend=-1)
-    if len(w) and int(w.max()) > _I16_MAX:
-        return None
-    return w.astype(np.int16)
-
-
 def _split_hi_lo(row: np.ndarray):
-    """Any int32 row as TWO exact int16 rows: hi = arithmetic >> 16,
-    lo = low 16 bits biased into int16 range. Always feasible — the
-    matrix path's escape for a column whose values overflow one
-    narrow row."""
+    """Any int32 section as TWO exact int16 stretches: hi =
+    arithmetic >> 16, lo = low 16 bits biased into int16 range.
+    Always feasible — the escape for a section whose values overflow
+    one narrow stretch."""
     v = row.astype(np.int32)
     hi = (v >> 16).astype(np.int16)
     lo = ((v & 0xFFFF) - 0x8000).astype(np.int16)
@@ -191,84 +193,120 @@ def _join_hi_lo(hi, lo):
     )
 
 
-def _widen_client(v):
-    return v.astype(jnp.int32)
-
-
-def _widen_seg(v):
-    v = v.astype(jnp.int32)
-    return jnp.where(
-        v >= 0, v, jnp.where(v == NULLI, NULLI, (-v - 2) | _SEQ_FLAG)
-    )
-
-
 def _widen_delta_ref(v):
     v = v.astype(jnp.int32)
     idx = jnp.arange(v.shape[0], dtype=jnp.int32)
     return jnp.where(v == 0, NULLI, idx - v)
 
 
-def _widen_ascending(v):
-    v = v.astype(jnp.int32)
-    c = jnp.cumsum(v)
-    return jnp.where(v > 0, c - 1, NULLI)
+def _encode_sections(named, wide: bool):
+    """[(name, int-array)] -> (flat staged array, enc tuple, widths).
+    Narrow: each section becomes one int16 stretch via its preferred
+    encoder, or two exact hi/lo stretches when the encoder refuses.
+    Wide: one int32 stretch per section."""
+    if wide:
+        flat = np.concatenate([a.astype(np.int32) for _, a in named])
+        return flat, tuple("i32" for _ in named), {
+            name: 32 for name, _ in named
+        }
+    parts, encs, widths = [], [], {}
+    for name, arr in named:
+        kind = _SECTION_NARROW[name]
+        enc = (_narrow_ident(arr) if kind == "i16"
+               else _narrow_delta_ref(arr))
+        if enc is not None:
+            parts.append(enc)
+            encs.append(kind)
+            widths[name] = 16
+        else:
+            hi, lo = _split_hi_lo(arr)
+            parts.extend((hi, lo))
+            encs.append("hilo")
+            widths[name] = 32
+    return np.concatenate(parts), tuple(encs), widths
+
+
+def _decode_sections(flat, sizes, encs):
+    """Device inverse of :func:`_encode_sections` — the fused widening
+    prelude (a handful of elementwise ops traced into the same program
+    as the convergence, so reconstruction never costs a dispatch).
+    ``sizes``/``encs`` are static per plan."""
+    out = []
+    off = 0
+    for size, enc in zip(sizes, encs):
+        if enc == "hilo":
+            out.append(_join_hi_lo(flat[off:off + size],
+                                   flat[off + size:off + 2 * size]))
+            off += 2 * size
+        elif enc == "d16":
+            out.append(_widen_delta_ref(flat[off:off + size]))
+            off += size
+        else:  # 'i16' / 'i32': identity widen
+            out.append(flat[off:off + size].astype(jnp.int32))
+            off += size
+    return out
 
 
 class PackedPlan(NamedTuple):
-    """Host-side staging result: one matrix + static metadata.
+    """Host-side staging result: one flat staged array + static
+    metadata + host-retained translation tables.
 
     Staging does the layout work a tuned columnar store would do
     anyway — id radix sort, dedup, origin resolution, dense segment
-    numbering — and ships its OUTPUT: the device dispatch starts at
-    the combinatorial core (sibling sort, tree tables, pointer-doubled
-    ranking) instead of re-deriving layout with device-width sorts.
-    Measured on v5e (tools/profile_kernel.py), the id sort + origin
-    searchsorted + segment sort cost ~14ms of the fused dispatch at
-    100k rows; as numpy radix passes at staging they cost ~6ms of host
-    time and drop the matrix from 7 to 5 rows (one int32 transfer).
+    numbering, and (round 12, the sort diet) the chain-parent
+    grouping of the map block plus the sibling/first-child tables of
+    the sequence forest — and ships its OUTPUT: the device dispatch
+    starts at the combinatorial core (segmented argmax scan, pointer
+    doubling, document-order scatter) with ZERO device-width sorts.
+    Raw columns (client ranks, segment flags, origin rows) no longer
+    ship at all; the device translates everything through block-local
+    indices, and the host maps the two small result vectors back
+    through ``map_back``/``seq_back`` after the fetch.
     """
 
-    mat: Optional[np.ndarray]  # [5, kpad], rows in id-sorted order:
-                              #   0: dense client rank
-                              #   1: dense segment id | _SEQ_FLAG (-1 dead)
-                              #   2: origin row (map rows; -1 root)
-                              #   3: compact block - seq row ids (-1 pad)
-                              #   4: compact block - compact parent (-1 root)
-                              # int32 wide, or int16 narrow-encoded
-                              # (``narrow`` below; the fused widening
-                              # prelude reconstructs the wide values on
-                              # device). None when rows were shipped
-                              # eagerly via ``stage(put=...)`` — ``dev``
+    mat: Optional[np.ndarray]  # flat 1-D staged array: the SECTION_NAMES
+                              # sections concatenated, int16
+                              # narrow-encoded per section (``encs``)
+                              # or int32 wide. None when sections were
+                              # shipped eagerly via ``stage(put=...)``
+                              # — see ``dev``
     n: int                    # real rows (rest is padding)
     num_segments: int         # size bucket over distinct segments
     seq_bucket: int           # size bucket over sequence-row count
-    order: np.ndarray         # id-sort permutation: mat row i = caller
-                              # row order[i] (maps device output back)
+    map_bucket: int           # size bucket over map-row count (the
+                              # map chain runs at THIS width, not
+                              # padded n — round-12 satellite)
+    order: np.ndarray         # id-sort permutation: staged row i =
+                              # caller row order[i]
     clients: np.ndarray       # sorted raw client ids (dense rank = index)
-    client_bits: int          # dense client rank width (static)
     rank_rounds: int          # doubling rounds bound (seq DFS)
     map_rounds: int           # doubling rounds bound (map chains)
     hard_rows: tuple = ()     # caller-space rows marking segments the
                               # scalar fallback must re-order (gather)
-    dev: tuple = ()           # device refs (r0, r1, r2, r34) when rows
-                              # were shipped eagerly during staging:
-                              # r0/r1/r2 are [kpad], r34 is [2, B] (the
-                              # compact sequence block never needs the
-                              # full row width on the wire)
-    staged_widths: tuple = () # ((col, bits), ...) chosen per column —
-                              # recorded into the xfer registry at the
-                              # plan's actual UPLOAD (matrix path), so
-                              # plans that never cross the link (host
-                              # route, repeat-dispatch probes) leave
-                              # no phantom width/savings entries
-    narrow: bool = False      # matrix path: mat is the int16 layout
-    narrow_cols: tuple = ()   # matrix path row map (one bool per
-                              # column): True = one delta-encoded row,
-                              # False = two exact hi/lo rows — static
-                              # dispatch arg
-    dev_narrow: tuple = (False, False, False, False)
-                              # eager path: per-array narrow flags for
-                              # (r0, r1, r2, r34) — static dispatch args
+    dev: tuple = ()           # device refs (one per _SECTION_GROUPS
+                              # entry) when sections were shipped
+                              # eagerly during staging
+    staged_widths: tuple = () # ((section, bits), ...) chosen per
+                              # section — recorded into the xfer
+                              # registry at the plan's actual UPLOAD
+                              # (matrix path), so plans that never
+                              # cross the link (host route,
+                              # repeat-dispatch probes) leave no
+                              # phantom width/savings entries
+    encs: tuple = ()          # per-section encoding kinds
+                              # ('i16'/'d16'/'hilo'/'i32'), aligned
+                              # with SECTION_NAMES — static dispatch
+                              # arg driving the widening prelude
+    map_back: Optional[np.ndarray] = None
+                              # [M] grouped map position -> caller row
+                              # (-1 pad): winner translation, on host
+    seq_back: Optional[np.ndarray] = None
+                              # [B] compact seq index -> caller row
+                              # (-1 pad): stream translation, on host
+    seg_counts: Optional[np.ndarray] = None
+                              # [S] sequence-row count per segment
+                              # (host-known; rebuilds stream_seg
+                              # without fetching a segment column)
 
 
 def _even_up(x: int) -> int:
@@ -447,8 +485,10 @@ def _stage(cols: Dict[str, np.ndarray],
     Returns None when the batch exceeds the packed path's bounds
     (callers fall back to the general kernels): >=2^25 distinct
     parents, >=2^21 distinct map keys, clocks >= 2^40 (the shared
-    ``pack_id`` bound), >=2^30 segments, or composite sibling keys
-    that do not fit an int64 at this row count.
+    ``pack_id`` bound), or >=2^30 segments. (The round-11 63-bit
+    sibling-key precheck is gone: the sort diet builds the sibling
+    order on the host with ``np.lexsort`` over separate keys, so no
+    packed device key exists to overflow.)
 
     ``put`` (e.g. :func:`crdt_tpu.ops.device.xfer_put`) switches
     staging to EAGER row shipping: each packed row starts its (async)
@@ -460,12 +500,12 @@ def _stage(cols: Dict[str, np.ndarray],
     plan then has ``mat=None`` and device refs in ``dev``.
 
     ``wide`` (None = the CRDT_TPU_WIDE_STAGING env default) disables
-    the narrow-column encodings: every row ships at its int32 width.
-    The default NARROW path halves the staged bytes whenever every
-    column's range fits (see the module's transfer-diet block); a
-    column that does not fit falls back automatically (hi/lo int16
-    row pair on the matrix path, wide int32 array on the eager path)
-    and the chosen widths are recorded per upload
+    the narrow-section encodings: every section ships at its int32
+    width. The default NARROW path halves the staged bytes whenever
+    every section's range fits (see the module's transfer-diet
+    block); a section that does not fit falls back automatically to
+    two exact int16 hi/lo stretches — on BOTH the matrix and eager
+    paths — and the chosen widths are recorded per upload
     (:func:`crdt_tpu.ops.device.record_staged_widths`).
     """
     if wide is None:
@@ -552,44 +592,20 @@ def _stage(cols: Dict[str, np.ndarray],
     max_seq = int(seg_counts[~map_seg].max()) if (~map_seg).any() else 1
 
     # size buckets early: eager shipping needs the padded widths now,
-    # and the width feasibility checks must run BEFORE the first put —
-    # an infeasible plan must not queue dead transfers through the
-    # tunnel only to fall back and re-ship via the general path
+    # and the int32-index guard must run BEFORE the first put — an
+    # infeasible plan must not queue dead transfers through the
+    # tunnel only to fall back and re-ship via the general path.
+    # (The round-11 63-bit sibling-key prechecks are GONE: the sort
+    # diet builds the sibling order on the host with np.lexsort over
+    # separate keys, so no packed device key exists to overflow.)
     kpad = bucket_grid(n, floor=6)
     Sb = bucket_grid(max(n_segs, 1), floor=6)
     n_seq_early = int(np.count_nonzero(uniq_valid & (kid_s < 0)))
+    n_map_early = int(np.count_nonzero(uniq_valid & (kid_s >= 0)))
     B = min(kpad, bucket_grid(max(n_seq_early, 1), floor=6))
-    if max(kpad, B) + Sb >= (1 << 31) - 1:
+    M = min(kpad, bucket_grid(max(n_map_early, 1), floor=6))
+    if max(kpad, B, M) + Sb >= (1 << 31) - 1:
         return None
-    # rank-0 lower-bound width precheck (the exact check re-runs after
-    # _stage_rights can only RAISE cbits via simulated group ranks)
-    pbits = int(max(kpad, B) + Sb + 1).bit_length()
-    qbits = (kpad - 1).bit_length()
-    if pbits + _even_up(max(8, len(uniq).bit_length())) + qbits > 63:
-        return None
-    # eagerness gate: a group's simulated rank is bounded by its
-    # segment's row count, so if even the pessimistic cbits (max_seq
-    # as the rank bound) fit, _stage_rights cannot push the exact
-    # check past 63 and the stages may ship before it runs. A batch
-    # near the width limit defers its puts until the exact check —
-    # otherwise three dead tunnel transfers would queue before the
-    # fallback (advisor finding, round 4).
-    eager = put is not None and (
-        pbits
-        + _even_up(max(
-            8, len(uniq).bit_length(), (max_seq + 1).bit_length()
-        ))
-        + qbits
-    ) <= 63
-    r1 = np.full(kpad, -1, np.int32)
-    r1[:n] = np.where(
-        seg >= 0, seg | np.where(kid_s < 0, _SEQ_FLAG, 0), -1
-    )
-    s1 = d1 = None
-    if put is not None:  # matrix staging encodes from mat rows instead
-        s1 = None if wide else _narrow_seg(r1, n_segs)
-        if eager:
-            d1 = put(s1 if s1 is not None else r1)
 
     # origin rows by binary search over the sorted ids (leftmost match
     # is the kept representative of any duplicate run)
@@ -602,13 +618,6 @@ def _stage(cols: Dict[str, np.ndarray],
         (okey >= 0) & (ikey_s[posc] == okey), posc, -1
     )
     is_map_row = uniq_valid & (kid_s >= 0)
-    origin_map = np.where(is_map_row, origin_row, -1)
-    if put is not None:
-        r2 = np.full(kpad, -1, np.int32)
-        r2[:n] = origin_map
-        s2 = None if wide else _narrow_delta_ref(r2)
-        if eager:
-            d2 = put(s2 if s2 is not None else r2)
 
     # compact sequence block: seq rows ascending (= id rank ascending),
     # same-segment origins resolved to compact positions
@@ -625,307 +634,311 @@ def _stage(cols: Dict[str, np.ndarray],
         )
     else:
         c_parent = np.empty(0, np.int64)
+
+    # group 0 sections (complete now): segment ids + doc-order
+    # offsets + compact parents. The offsets are the scatter targets:
+    # document order is out[off[seg] + dfs_rank] = row, so the device
+    # never sorts by (seg, rank) again
+    seq_seg = np.full(B, -1, np.int64)
+    seq_seg[:n_seq] = seg[seq_rows]
+    counts = np.zeros(Sb, np.int64)
+    if n_seq:
+        bc = np.bincount(seg[seq_rows], minlength=1)
+        counts[: len(bc)] = bc
+    seg_off = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    seq_parent = np.full(B, -1, np.int64)
+    seq_parent[:n_seq] = c_parent
+    g0 = [("seq_seg", seq_seg), ("seg_off", seg_off),
+          ("seq_parent", seq_parent)]
+    d0 = d1 = d2 = None
+    enc0 = enc1 = enc2 = ()
+    w_all: dict = {}
+    shipped = 0
     if put is not None:
-        r34 = np.full((2, B), -1, np.int32)
-        r34[0, :n_seq] = seq_rows
-        r34[1, :n_seq] = c_parent
-        s34 = None
-        w3 = w4 = None
-        if not wide:
-            w3 = _narrow_ascending(r34[0])
-            w4 = _narrow_delta_ref(r34[1])
-            if w3 is not None and w4 is not None:
-                s34 = np.stack([w3, w4])
-        if eager:
-            d34 = put(s34 if s34 is not None else r34)
+        f0, enc0, w0 = _encode_sections(g0, wide)
+        w_all.update(w0)
+        shipped += f0.nbytes
+        d0 = put(f0)
+
+    # group 2 sections: the map block, grouped by chain parent. One
+    # stable host radix pass puts every node's children in one
+    # contiguous run ordered (client asc, clock asc), so the device's
+    # segmented argmax scan reads each run's last child at its END —
+    # the sort + run-edge chain of lww.map_winners collapses to one
+    # VMEM pass at map-bucket width M, not padded n
+    map_rows = np.flatnonzero(is_map_row)
+    n_map = len(map_rows)
+    map_key = np.full(M, -1, np.int64)
+    chain_end = np.full(M, -1, np.int64)
+    root_end = np.full(Sb, -1, np.int64)
+    if n_map:
+        o = origin_row[map_rows]
+        o_c = np.clip(o, 0, n - 1)
+        # same-segment origin => chain parent; anything else (missing,
+        # cross-segment, a sequence row) roots the chain — the GC'd
+        # -origin convention shared with lww.map_winners
+        same = (o >= 0) & (seg[o_c] == seg[map_rows])
+        cm_par = np.where(same, np.searchsorted(map_rows, o_c), -1)
+        pslot = np.where(cm_par >= 0, cm_par, M + seg[map_rows])
+        gorder = np.argsort(pslot, kind="stable")
+        ps = pslot[gorder]
+        newrun = np.r_[True, ps[1:] != ps[:-1]]
+        ends = np.r_[np.flatnonzero(ps[1:] != ps[:-1]), n_map - 1]
+        run_key = ps[ends]
+        inv_g = np.empty(n_map, np.int64)
+        inv_g[gorder] = np.arange(n_map)
+        item_run = run_key < M
+        # chain_end is indexed by the PARENT's grouped position — the
+        # node space the device's last-child doubling runs in
+        chain_end[inv_g[run_key[item_run]]] = ends[item_run]
+        root_end[run_key[~item_run] - M] = ends[~item_run]
+        # dense client rank with the run-start flag folded into bit 0
+        # (one section instead of two; clients past 2^14 ranks spill
+        # the section to hi/lo, never a wrong decode)
+        map_key[:n_map] = (client_s[map_rows[gorder]] << 1) | newrun
+    else:
+        gorder = np.empty(0, np.int64)
+    g2 = [("map_key", map_key), ("map_chain_end", chain_end),
+          ("map_root_end", root_end)]
+    if put is not None:
+        f2, enc2, w2 = _encode_sections(g2, wide)
+        w_all.update(w2)
+        shipped += f2.nbytes
+        d2 = put(f2)
 
     # right-origin attachment ordering (mid-inserts/prepends): groups
     # with in-group anchors get their exact conflict-scan ranks
     # written INTO the client column (ranks are unique per group, so
-    # the id tie-break never fires and the device kernel needs no
+    # the id tie-break never fires and the sibling tables need no
     # change); inexpressible shapes mark their segments hard for the
     # scalar fallback at gather
     hard_rep_rows: list = []
-    max_rank = 0
     if "right_client" in cols:
-        client_s, hard_rep_rows, max_rank = _stage_rights(
+        client_s, hard_rep_rows, _ = _stage_rights(
             cols, order, ikey_s, uniq, seg, origin_row, oc_s, seq_rows,
             uniq_valid, kid_s, client_s.copy(), client[order],
             clock[order],
         )
 
-    # static key widths (the client field must also hold the largest
-    # simulated group rank)
-    cbits = _even_up(max(
-        8, len(uniq).bit_length(), (max_rank + 1).bit_length()
-    ))
-    # (the 2^31 width guard already ran before the first eager put;
-    # only the rank-dependent cbits can have grown since)
-    if pbits + cbits + qbits > 63:
-        return None
+    # group 1 sections (after the rank overwrites): the sequence
+    # forest's sibling tables. ONE host lexsort by (parent, client,
+    # clock desc) — cost scales with the compact block, and the
+    # next-sibling / first-child tables fall out of the same pass, so
+    # the device's B-width sibling argsort + run-edge searchsorted
+    # disappear from the dispatch entirely
+    nxt = np.full(B, -1, np.int64)
+    fc = np.full(B + Sb, -1, np.int64)
+    if n_seq:
+        cl_q = client_s[seq_rows]
+        posd = (n - 1) - seq_rows  # clock desc within (parent, client)
+        pslot2 = np.where(c_parent >= 0, c_parent, B + seg[seq_rows])
+        sord2 = np.lexsort((posd, cl_q, pslot2))
+        ps2 = pslot2[sord2]
+        same2 = ps2[1:] == ps2[:-1]
+        nxt[sord2[:-1][same2]] = sord2[1:][same2]
+        starts = np.r_[0, np.flatnonzero(~same2) + 1]
+        fc[ps2[starts]] = sord2[starts]
+    g1 = [("seq_next", nxt), ("seq_first", fc)]
 
-    narrow = False
-    narrow_cols = ()
-    dev_narrow = (False, False, False, False)
     if put is not None:
-        if not eager:  # width-deferred stages ship now, post-check
-            d1 = put(s1 if s1 is not None else r1)
-            d2 = put(s2 if s2 is not None else r2)
-            d34 = put(s34 if s34 is not None else r34)
-        r0 = np.zeros(kpad, np.int32)
-        r0[:n] = client_s
-        s0 = None if wide else _narrow_client(r0)
-        d0 = put(s0 if s0 is not None else r0)
+        f1, enc1, w1 = _encode_sections(g1, wide)
+        w_all.update(w1)
+        shipped += f1.nbytes
+        d1 = put(f1)
         mat = None
-        dev = (d0, d1, d2, d34)
-        dev_narrow = (
-            s0 is not None, s1 is not None, s2 is not None,
-            s34 is not None,
-        )
-        widths = {
-            "client": 16 if s0 is not None else 32,
-            "seg": 16 if s1 is not None else 32,
-            "origin": 16 if s2 is not None else 32,
-            # the r34 block ships as ONE array: when either half's
-            # encoding refuses, BOTH rows go wide — record what
-            # actually crossed the wire, not what could have
-            "seq_rows": 16 if s34 is not None else 32,
-            "seq_parent": 16 if s34 is not None else 32,
-        }
-        staged_widths = tuple(sorted(widths.items()))
-        # eager puts ARE the upload: record here, at the seam's moment
-        record_staged_widths(
-            widths,
-            sum(
-                (s if s is not None else r).nbytes
-                for s, r in ((s0, r0), (s1, r1), (s2, r2), (s34, r34))
-            ),
-            (3 * kpad + 2 * B) * 4,
-        )
+        dev = (d0, d1, d2)
+        encs = enc0 + enc1 + enc2
+        # eager puts ARE the upload: record here, at the seam's
+        # moment. The diet baseline stays the PRE-diet (round-8)
+        # staging of the same union — raw int32 columns + compact
+        # block — so both the round-9 narrowing and the round-12
+        # section re-cut count as transfer savings
+        record_staged_widths(w_all, shipped, (3 * kpad + 2 * B) * 4)
     else:
-        mat = np.full((5, kpad), -1, np.int32)
-        mat[0, :] = 0
-        mat[0, :n] = client_s
-        mat[1, :] = r1
-        mat[2, :n] = origin_map
-        mat[3, :n_seq] = seq_rows
-        mat[4, :n_seq] = c_parent
+        named = g0 + g1 + g2
+        mat, encs, w_all = _encode_sections(named, wide)
         dev = ()
-        if not wide:
-            # ONE upload means one dtype: the matrix always ships
-            # int16, with each column taking one delta-encoded row
-            # when its range fits, or two exact hi/lo rows when it
-            # does not (a >32k-segment shard costs 6/10 of wide, not
-            # a collapse back to int32)
-            encs = (
-                _narrow_client(mat[0]),
-                _narrow_seg(mat[1], n_segs),
-                _narrow_delta_ref(mat[2]),
-                _narrow_ascending(mat[3]),
-                _narrow_delta_ref(mat[4]),
-            )
-            widths = {
-                c: (16 if e is not None else 32)
-                for c, e in zip(
-                    ("client", "seg", "origin", "seq_rows",
-                     "seq_parent"), encs
-                )
-            }
-            rows16 = []
-            for e, wide_row in zip(encs, mat):
-                if e is not None:
-                    rows16.append(e)
-                else:
-                    rows16.extend(_split_hi_lo(wide_row))
-            mat = np.stack(rows16)
-            narrow = True
-            narrow_cols = tuple(e is not None for e in encs)
-        else:
-            widths = {
-                c: 32 for c in ("client", "seg", "origin", "seq_rows",
-                                "seq_parent")
-            }
         # NOT recorded here: a matrix plan may never cross the link
         # (converge_host, make_repeat_dispatch) — the width/savings
         # record fires at the plan's actual upload instead
-        staged_widths = tuple(sorted(widths.items()))
+
+    map_back = np.full(M, NULLI, np.int32)
+    if n_map:
+        map_back[:n_map] = order[map_rows[gorder]]
+    seq_back = np.full(B, NULLI, np.int32)
+    seq_back[:n_seq] = order[seq_rows]
     return PackedPlan(
         mat=mat,
         dev=dev,
         n=n,
         num_segments=Sb,
         seq_bucket=B,
+        map_bucket=M,
         order=order,
         clients=uniq,
-        client_bits=cbits,
         rank_rounds=_even_up((max_seq + 2).bit_length() + 1),
         map_rounds=_even_up((max_map + 2).bit_length() + 1),
         hard_rows=tuple(hard_rep_rows),
-        narrow=narrow,
-        narrow_cols=narrow_cols,
-        dev_narrow=dev_narrow,
-        staged_widths=staged_widths,
+        staged_widths=tuple(sorted(w_all.items())),
+        encs=encs,
+        map_back=map_back,
+        seq_back=seq_back,
+        seg_counts=counts,
     )
 
 
-def _converge_packed_body(client, segf, origin_map, sub, cp,
+def _section_sizes(num_segments: int, seq_bucket: int,
+                   map_bucket: int) -> tuple:
+    """Static per-section lengths, aligned with SECTION_NAMES."""
+    B, S, M = seq_bucket, num_segments, map_bucket
+    return (B, S, B, B, B + S, M, M, S)
+
+
+def _map_block(mkey, cend, rend, *, map_rounds: int, mode: str):
+    """Map side of the fused converge: segmented Lamport argmax over
+    chain-parent runs + winner-chain doubling, all at map-bucket
+    width. Each node's children sit in one contiguous run (staging
+    grouped them), ordered (client asc, clock asc); the scan's
+    run-prefix argmax read at a run's END is the run's (max client,
+    min clock) member — the last child of the Yjs sibling order. The
+    chain walk (deep key chains) stays pointer doubling.
+
+    ONE definition shared by :func:`_converge_packed_body` and the
+    bench ablation rig (``bench.kernel_ablation_leg``), so the gated
+    ``kernel_ablation.map_winners_ms`` numbers always time the
+    algorithm production runs."""
+    M = mkey.shape[0]
+    mflag = jnp.where(mkey >= 0, mkey & 1, 1).astype(jnp.int32)
+    mcl = jnp.where(mkey >= 0, mkey >> 1, NULLI).astype(jnp.int32)
+    from crdt_tpu.ops.pallas_kernels import seg_argmax_scan
+
+    arg = seg_argmax_scan(mcl, mflag, mode=mode)
+    iota_m = jnp.arange(M, dtype=jnp.int32)
+    last = jnp.where(
+        cend >= 0, arg[jnp.clip(cend, 0, M - 1)], iota_m
+    ).astype(jnp.int32)
+    tail = pointer_double(last, max_iters=map_rounds)
+    start = jnp.where(rend >= 0, arg[jnp.clip(rend, 0, M - 1)], NULLI)
+    return jnp.where(
+        start >= 0, tail[jnp.clip(start, 0, M - 1)], NULLI
+    ).astype(jnp.int32)
+
+
+def _converge_packed_body(sseg, soff, cp, nxt, fc, mkey, cend, rend, *,
                           num_segments: int, seq_bucket: int,
-                          rank_rounds: int, map_rounds: int,
-                          client_bits: int):
-    """The fused convergence over STAGED rows (id-sorted, deduped,
-    origin-resolved, segment-numbered — see :class:`PackedPlan`).
+                          map_bucket: int, rank_rounds: int,
+                          map_rounds: int, mode: str):
+    """The fused convergence over PRECOMPUTED layout sections (see the
+    module's section table): the round-12 sort diet. The dispatch
+    contains ZERO sorts and zero searchsorteds — its work is the two
+    Pallas kernels (segmented Lamport argmax, document-order scatter),
+    the pointer-doubling loops, and a handful of block-width gathers.
     Returns one packed int32 array:
 
-      [ win_rows[S] | seg_counts[S] | stream_row[B] ]
+      [ win_pos[S] | stream_perm[B] ]
 
-    - win_rows: id-sorted row index of each map segment's winner (-1
-      for non-map / empty segments; the host maps back through
-      ``plan.order``);
-    - seg_counts: ranked-row count per segment — the host rebuilds the
-      per-segment stream boundaries from these instead of fetching a
-      B-wide segment column (one third less result transfer);
-    - stream_row: sequence rows in document order, grouped by segment
-      id ascending (B = seq_bucket; -1 padding at the tail).
+    - win_pos: grouped map-block position of each segment's winner
+      (-1 for non-map / empty segments; the host maps back through
+      ``plan.map_back``);
+    - stream_perm: compact sequence index at each document-order
+      position, grouped by segment id ascending (-1 padding at the
+      tail; the host maps back through ``plan.seq_back``).
+
+    ``mode`` is the static kernel-dispatch decision
+    (:func:`crdt_tpu.ops.pallas_kernels.converge_kernel_mode`).
     """
-    n = client.shape[0]
-    live = segf >= 0
-    seg = jnp.where(live, segf & (_SEQ_FLAG - 1), NULLI)
-    is_map = live & ((segf & _SEQ_FLAG) == 0)
-    seg_map = jnp.where(is_map, seg, NULLI)
+    from crdt_tpu.ops.pallas_kernels import stream_scatter
 
-    winners = map_winners(
-        seg_map, client, None, origin_map, is_map, num_segments,
-        rows_id_ranked=True, chain_rounds=map_rounds,
-        client_bits=client_bits,
+    B, S, M = seq_bucket, num_segments, map_bucket
+
+    win_pos = _map_block(mkey, cend, rend, map_rounds=map_rounds,
+                         mode=mode)
+
+    # ---- sequence side: DFS ranks over the PRE-BUILT sibling tables
+    # (no sibling sort, no run-edge searchsorted), then document
+    # order as a permutation scatter out[off[seg] + rank] = row
+    c_ok = sseg >= 0
+    mB = B + S
+    parent = jnp.where(c_ok & (cp >= 0), cp, B + jnp.maximum(sseg, 0))
+    parent = jnp.where(c_ok, parent, mB).astype(jnp.int32)
+    dist = dfs_ranks(
+        parent, nxt.astype(jnp.int32), fc.astype(jnp.int32), c_ok, S,
+        rank_rounds=rank_rounds,
     )
-    win_rows = winners.astype(jnp.int32)
-
-    B = seq_bucket
-    c_ok = sub >= 0
-    subc = jnp.clip(sub, 0, n - 1)
-    c_seg = jnp.where(c_ok, seg[subc], NULLI)
-    parent = jnp.where(c_ok & (cp >= 0), cp, B + jnp.maximum(c_seg, 0))
-    parent = jnp.where(c_ok, parent, B + num_segments).astype(jnp.int32)
-    c_client = client[subc]
-    pos_desc = jnp.where(c_ok, (n - 1) - sub, 0)
-    stream_seg, stream_row = _rank_compact(
-        parent, c_client, pos_desc, c_seg, c_ok, sub,
-        num_segments=num_segments, rank_rounds=rank_rounds,
-        client_bits=client_bits,
-        qbits=int(max(n - 1, 1)).bit_length(),
+    root_dist = dist[B + jnp.maximum(sseg, 0)]
+    c_rank = jnp.where(c_ok, root_dist - dist[:B] - 1, NULLI)
+    pos = jnp.where(
+        c_ok & (c_rank >= 0),
+        soff[jnp.clip(sseg, 0, S - 1)] + c_rank,
+        NULLI,
     )
-    # stream_seg is ascending over its valid prefix (doc order groups
-    # by segment) with -1 padding at the tail: counts come from one
-    # searchsorted over the monotone remap
-    ss = jnp.where(stream_seg >= 0, stream_seg, num_segments)
-    bounds = jnp.searchsorted(
-        ss, jnp.arange(num_segments + 1, dtype=ss.dtype), method="sort"
+    perm = stream_scatter(pos.astype(jnp.int32), B, mode=mode)
+    return jnp.concatenate([win_pos, perm])
+
+
+_STATIC_ARGS = ("num_segments", "seq_bucket", "map_bucket",
+                "rank_rounds", "map_rounds", "encs", "mode")
+
+
+def _body_from_flat(mat, num_segments, seq_bucket, map_bucket,
+                    rank_rounds, map_rounds, encs, mode):
+    secs = _decode_sections(
+        mat, _section_sizes(num_segments, seq_bucket, map_bucket), encs
     )
-    seg_counts = (bounds[1:] - bounds[:-1]).astype(jnp.int32)
-    return jnp.concatenate([win_rows, seg_counts, stream_row])
+    return _converge_packed_body(
+        *secs, num_segments=num_segments, seq_bucket=seq_bucket,
+        map_bucket=map_bucket, rank_rounds=rank_rounds,
+        map_rounds=map_rounds, mode=mode,
+    )
 
 
-_WIDEN_FNS = (_widen_client, _widen_seg, _widen_delta_ref,
-              _widen_ascending, _widen_delta_ref)
-
-
-def _mat_operands(mat, seq_bucket: int, narrow):
-    """The five kernel operands from a staged matrix — the fused
-    WIDENING PRELUDE when the matrix shipped in the int16 layout (a
-    handful of elementwise ops + one cumsum, traced into the same
-    program as the convergence, so the reconstruction never costs an
-    extra dispatch).
-
-    ``narrow`` is False for the wide int32 matrix, or the plan's
-    ``narrow_cols`` row map: each True column occupies one
-    delta-encoded row (decoded by its paired widener), each False
-    column two exact hi/lo rows."""
-    if narrow is False or narrow == ():
-        return (
-            mat[0], mat[1], mat[2], mat[3, :seq_bucket],
-            mat[4, :seq_bucket],
-        )
-    ops = []
-    r = 0
-    for i, (is_narrow, fn) in enumerate(zip(narrow, _WIDEN_FNS)):
-        sl = slice(None) if i < 3 else slice(0, seq_bucket)
-        if is_narrow:
-            ops.append(fn(mat[r][sl]))
-            r += 1
-        else:
-            ops.append(_join_hi_lo(mat[r][sl], mat[r + 1][sl]))
-            r += 2
-    return tuple(ops)
-
-
-@partial(
-    jax.jit,
-    donate_argnums=(0,),
-    static_argnames=("num_segments", "seq_bucket", "rank_rounds",
-                     "map_rounds", "client_bits", "narrow"),
-)
+@partial(jax.jit, donate_argnums=(0,), static_argnames=_STATIC_ARGS)
 def _converge_packed(mat, num_segments: int, seq_bucket: int,
-                     rank_rounds: int, map_rounds: int,
-                     client_bits: int, narrow=False):
-    """Single-matrix entry over :func:`_converge_packed_body`
-    (matrix-staged plans). The staged matrix is DONATED: its device
-    buffer is consumed by the dispatch (the allocator reuses it for
-    outputs / the next shard's upload instead of holding both live),
-    so a plan must be converged at most once — repeated-dispatch
-    probes use :func:`make_repeat_dispatch`."""
+                     map_bucket: int, rank_rounds: int,
+                     map_rounds: int, encs=(), mode="jnp"):
+    """Single-array entry over :func:`_converge_packed_body`
+    (matrix-staged plans): widening prelude + fused body. The staged
+    array is DONATED: its device buffer is consumed by the dispatch
+    (the allocator reuses it for outputs / the next shard's upload
+    instead of holding both live), so a plan must be converged at
+    most once — repeated-dispatch probes use
+    :func:`make_repeat_dispatch`."""
+    return _body_from_flat(mat, num_segments, seq_bucket, map_bucket,
+                           rank_rounds, map_rounds, encs, mode)
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2),
+         static_argnames=_STATIC_ARGS)
+def _converge_rows(d0, d1, d2, num_segments: int, seq_bucket: int,
+                   map_bucket: int, rank_rounds: int, map_rounds: int,
+                   encs=(), mode="jnp"):
+    """Separate-group entry for eagerly shipped plans (``stage(put=)``):
+    same fused body, the three section groups already resident on
+    device and DONATED to the dispatch (see :func:`_converge_packed`).
+    ``encs`` carries the full per-section encoding tuple; each group
+    decodes its own slice of it."""
+    sizes = _section_sizes(num_segments, seq_bucket, map_bucket)
+    secs = []
+    for dref, (a, b) in zip((d0, d1, d2), _SECTION_GROUPS):
+        secs.extend(_decode_sections(dref, sizes[a:b], encs[a:b]))
     return _converge_packed_body(
-        *_mat_operands(mat, seq_bucket, narrow),
-        num_segments=num_segments, seq_bucket=seq_bucket,
-        rank_rounds=rank_rounds, map_rounds=map_rounds,
-        client_bits=client_bits,
+        *secs, num_segments=num_segments, seq_bucket=seq_bucket,
+        map_bucket=map_bucket, rank_rounds=rank_rounds,
+        map_rounds=map_rounds, mode=mode,
     )
 
 
-@partial(
-    jax.jit,
-    donate_argnums=(0, 1, 2, 3),
-    static_argnames=("num_segments", "seq_bucket", "rank_rounds",
-                     "map_rounds", "client_bits", "narrow"),
-)
-def _converge_rows(r0, r1, r2, r34, num_segments: int, seq_bucket: int,
-                   rank_rounds: int, map_rounds: int, client_bits: int,
-                   narrow=(False, False, False, False)):
-    """Separate-row entry for eagerly shipped plans (``stage(put=)``):
-    same fused body, rows already resident on device and DONATED to
-    the dispatch (see :func:`_converge_packed`). ``narrow`` carries
-    the per-array encoding flags the stager chose."""
-    n0, n1, n2, n34 = narrow
-    return _converge_packed_body(
-        _widen_client(r0) if n0 else r0,
-        _widen_seg(r1) if n1 else r1,
-        _widen_delta_ref(r2) if n2 else r2,
-        _widen_ascending(r34[0]) if n34 else r34[0],
-        _widen_delta_ref(r34[1]) if n34 else r34[1],
-        num_segments=num_segments, seq_bucket=seq_bucket,
-        rank_rounds=rank_rounds, map_rounds=map_rounds,
-        client_bits=client_bits,
-    )
-
-
-@partial(
-    jax.jit,
-    static_argnames=("num_segments", "seq_bucket", "rank_rounds",
-                     "map_rounds", "client_bits", "narrow"),
-)
+@partial(jax.jit, static_argnames=_STATIC_ARGS)
 def _converge_packed_nodonate(mat, num_segments: int, seq_bucket: int,
-                              rank_rounds: int, map_rounds: int,
-                              client_bits: int, narrow=False):
+                              map_bucket: int, rank_rounds: int,
+                              map_rounds: int, encs=(), mode="jnp"):
     """Undonated twin of :func:`_converge_packed` for the consumers
     that cannot honor (or benefit from) donation: the local-CPU host
     route (CPU has no donation — the donating entry would warn per
     compiled shape in library consumers' stderr) and the repeated
     bench-sweep probe."""
-    return _converge_packed_body(
-        *_mat_operands(mat, seq_bucket, narrow),
-        num_segments=num_segments, seq_bucket=seq_bucket,
-        rank_rounds=rank_rounds, map_rounds=map_rounds,
-        client_bits=client_bits,
-    )
+    return _body_from_flat(mat, num_segments, seq_bucket, map_bucket,
+                           rank_rounds, map_rounds, encs, mode)
 
 
 def make_repeat_dispatch(plan: PackedPlan):
@@ -937,11 +950,17 @@ def make_repeat_dispatch(plan: PackedPlan):
     if plan.mat is None:
         raise ValueError("repeat dispatch needs a matrix-staged plan")
     args = _plan_args(plan)
-    narrow = _mat_narrow_arg(plan)
 
     def fn(m):
-        with enable_x64(True):  # the id packing needs real int64
-            return _converge_packed_nodonate(m, **args, narrow=narrow)
+        # the mode decision (and its converge.pallas{mode} count) is
+        # made PER DISPATCH, honoring the counter's one-count-per-
+        # dispatch contract for the repeat probe too — a closure
+        # built but never invoked records nothing
+        mode = kernel_mode_for(plan.map_bucket, plan.seq_bucket)
+        with enable_x64(True):  # the ranking loop packs int64 words
+            return _converge_packed_nodonate(
+                m, **args, encs=plan.encs, mode=mode
+            )
 
     return jnp.asarray(plan.mat), fn
 
@@ -950,17 +969,29 @@ def make_repeat_dispatch(plan: PackedPlan):
 
 def _rank_compact(parent, c_client, pos_desc, c_seg, c_ok, row_of, *,
                   num_segments: int, rank_rounds: Optional[int],
-                  client_bits: int, qbits: int):
+                  client_bits: int, qbits: int, doc_off=None,
+                  mode: str = "jnp"):
     """Sibling sort + tree tables + climb + Wyllie ranking + document
     order over the COMPACT sequence space (B rows + S virtual roots).
     ``row_of[i]`` is the caller-space row of compact row i, used only
-    to label the output stream. Shared by the cold staged dispatch and
-    the general/incremental :func:`_converge_core`.
+    to label the output stream. Engine of the general/incremental
+    :func:`_converge_core` (the cold staged dispatch now precomputes
+    the sibling tables at staging and runs the sortless
+    :func:`_converge_packed_body` instead).
 
     Sibling order is (parent, client asc, clock DESC); ``pos_desc``
     must be descending in clock within one (parent, client) group —
     all callers derive it from id-sorted row positions.
+
+    ``doc_off`` [S] is each segment's first compact position (the
+    caller reads it off its already-sorted segment keys): document
+    order becomes the scatter out[doc_off[seg] + rank] = row — the
+    round-12 sort diet's replacement for the B-width argsort over
+    (seg, rank) keys. ``mode`` picks the scatter kernel
+    (:func:`crdt_tpu.ops.pallas_kernels.converge_kernel_mode`).
     """
+    from crdt_tpu.ops.pallas_kernels import stream_scatter
+
     B = parent.shape[0]
     mB = B + num_segments
     pbits = int(mB).bit_length()
@@ -993,24 +1024,26 @@ def _rank_compact(parent, c_client, pos_desc, c_seg, c_ok, row_of, *,
     root_dist = dist_to_end[B + jnp.maximum(c_seg, 0)]
     c_rank = jnp.where(c_ok, root_dist - dist_to_end[:B] - 1, NULLI)
 
-    skey2 = jnp.where(
-        c_ok & (c_rank >= 0),
-        (c_seg.astype(jnp.int64) << qbits) | c_rank.astype(jnp.int64),
-        jnp.int64(2**62),
+    ranked = c_ok & (c_rank >= 0)
+    pos = jnp.where(
+        ranked,
+        doc_off[jnp.clip(c_seg, 0, num_segments - 1)].astype(jnp.int32)
+        + c_rank.astype(jnp.int32),
+        NULLI,
     )
-    dorder = jnp.argsort(skey2, stable=True)
-    d_ok = (c_ok & (c_rank >= 0))[dorder]
-    stream_seg = jnp.where(d_ok, c_seg[dorder], NULLI).astype(jnp.int32)
-    stream_row = jnp.where(
-        d_ok, row_of[dorder], NULLI
-    ).astype(jnp.int32)
+    perm = stream_scatter(pos.astype(jnp.int32), B, mode=mode)
+    okp = perm >= 0
+    permc = jnp.clip(perm, 0, B - 1)
+    stream_seg = jnp.where(okp, c_seg[permc], NULLI).astype(jnp.int32)
+    stream_row = jnp.where(okp, row_of[permc], NULLI).astype(jnp.int32)
     return stream_seg, stream_row
 
 
 def _converge_core(client, clock, pref, kid, oc, ock, valid, *,
                    num_segments: int, seq_bucket: int,
                    rank_rounds: Optional[int] = None,
-                   map_rounds: Optional[int] = None):
+                   map_rounds: Optional[int] = None,
+                   mode: str = "jnp"):
     """Traced body of the GENERAL packed convergence: does its own id
     sort, dedup, origin resolution, and segment numbering on device.
     The cold replay no longer routes here (its staging precomputes the
@@ -1093,10 +1126,17 @@ def _converge_core(client, clock, pref, kid, oc, ock, valid, *,
     # making the whole key fit one int64 when the static widths allow.
     c_client = client[sub]
     pos_desc = (n - 1) - sub  # descending position == descending clock
+    # document-order offsets off the ALREADY segment-sorted keys: one
+    # S-vs-n searchsorted instead of re-deriving them with the B-width
+    # (seg, rank) argsort the scatter now replaces. Compact space is
+    # the sorted prefix, so a segment's first sorted position IS its
+    # exclusive document-order offset.
+    doc_off, _ = run_edge_lookup(seg_sorted, num_segments, side="left")
     stream_seg, stream_row = _rank_compact(
         parent, c_client, pos_desc, c_seg, c_ok, order[sub],
         num_segments=num_segments, rank_rounds=rank_rounds,
         client_bits=23, qbits=int(max(n - 1, 1)).bit_length(),
+        doc_off=doc_off, mode=mode,
     )
     return jnp.concatenate([win_rows, stream_seg, stream_row])
 
@@ -1122,11 +1162,12 @@ def segkey_of(pref, kid):
 @partial(
     jax.jit,
     donate_argnums=(0,),
-    static_argnames=("num_segments", "sel_bucket", "seq_bucket"),
+    static_argnames=("num_segments", "sel_bucket", "seq_bucket",
+                     "mode"),
 )
 def _splice_select_converge(mat, delta8, n_off,
                             num_segments: int, sel_bucket: int,
-                            seq_bucket: int):
+                            seq_bucket: int, mode: str = "jnp"):
     """Incremental warm dispatch — exactly THREE host<->device
     interactions per round: ONE upload (``delta8``: the packed delta
     columns with the touched-segment keys riding as row 7 — ascending
@@ -1163,7 +1204,7 @@ def _splice_select_converge(mat, delta8, n_off,
     out = _converge_core(
         client[sel_rows], clock[sel_rows], pref[sel_rows], kid[sel_rows],
         oc[sel_rows], ock[sel_rows], sub_valid,
-        num_segments=num_segments, seq_bucket=seq_bucket,
+        num_segments=num_segments, seq_bucket=seq_bucket, mode=mode,
     )
     packed_out = jnp.concatenate([
         out, jnp.where(sub_valid, sel_rows, NULLI).astype(jnp.int32)
@@ -1202,53 +1243,79 @@ class PackedResult(NamedTuple):
                              # model cannot express)
 
 
-def _mat_narrow_arg(plan: PackedPlan):
-    """The static ``narrow`` dispatch arg for a matrix-staged plan:
-    False for the wide layout, the row map for the int16 layout."""
-    return plan.narrow_cols if plan.narrow else False
+def kernel_mode_for(*widths: int) -> str:
+    """The static kernel-dispatch decision for a converge call
+    (:func:`crdt_tpu.ops.pallas_kernels.converge_kernel_mode`), with
+    the mode evidence counted at the same moment: one
+    ``converge.pallas{mode}`` count per dispatch, plus a
+    ``converge.pallas_fallback`` count when the Pallas kernels were
+    requested but a block past the VMEM width guard forced the jnp
+    oracle path. ONE helper for every dispatch site (packed plans,
+    the incremental splice) so the evidence is uniform."""
+    from crdt_tpu.ops.pallas_kernels import (
+        converge_kernel_mode,
+        use_pallas,
+    )
+
+    mode = converge_kernel_mode(*widths)
+    tracer = get_tracer()
+    if tracer.enabled:
+        tracer.count("converge.pallas", labels={"mode": mode})
+        if mode == "jnp" and use_pallas():
+            tracer.count("converge.pallas_fallback")
+    return mode
 
 
 def _plan_args(plan: PackedPlan) -> dict:
     return dict(
         num_segments=plan.num_segments,
         seq_bucket=plan.seq_bucket,
+        map_bucket=plan.map_bucket,
         rank_rounds=plan.rank_rounds,
         map_rounds=plan.map_rounds,
-        client_bits=plan.client_bits,
     )
 
 
 def _put_mat(plan: PackedPlan):
     """A matrix plan's ONE upload through the xfer seam, with the
-    per-column width/savings record made at the same moment — never
+    per-section width/savings record made at the same moment — never
     at stage time, where a plan destined for the zero-link host route
-    or a repeat-dispatch probe would leave phantom entries."""
+    or a repeat-dispatch probe would leave phantom entries. The diet
+    baseline is the PRE-diet (round-8) staging of the same union
+    (five int32 columns at padded n), so the round-9 narrowing and
+    the round-12 section re-cut both count as transfer savings."""
     record_staged_widths(
         dict(plan.staged_widths), plan.mat.nbytes,
-        5 * plan.mat.shape[1] * 4,
+        5 * bucket_grid(plan.n, floor=6) * 4,
     )
     return xfer_put(plan.mat, label="converge.mat")
 
 
 def _assemble_result(plan: PackedPlan, h: np.ndarray) -> PackedResult:
     """The one fetch -> caller-space result (shared by the device and
-    local-CPU executions of the identical kernel)."""
+    local-CPU executions of the identical kernel). The device returns
+    block-local positions; the host maps them through the staged
+    translation tables (``map_back``/``seq_back``) and rebuilds the
+    per-segment stream boundaries from the host-known counts — no
+    segment column ever crosses the link."""
     s = plan.num_segments
     b = plan.seq_bucket
-    order = plan.order
     win = h[:s]
-    counts = h[s:2 * s]
-    srow = h[2 * s:2 * s + b]
+    perm = h[s:s + b]
+    counts = plan.seg_counts
     k = int(counts.sum())
     stream_seg = np.full(b, NULLI, np.int32)
-    stream_seg[:k] = np.repeat(
-        np.arange(s, dtype=np.int32), counts
-    )
-    last = max(len(order) - 1, 0)
+    stream_seg[:k] = np.repeat(np.arange(s, dtype=np.int32), counts)
+    mb = plan.map_back
+    sb = plan.seq_back
     return PackedResult(
-        win_rows=np.where(win >= 0, order[np.clip(win, 0, last)], NULLI),
+        win_rows=np.where(
+            win >= 0, mb[np.clip(win, 0, len(mb) - 1)], NULLI
+        ),
         stream_seg=stream_seg,
-        stream_row=np.where(srow >= 0, order[np.clip(srow, 0, last)], NULLI),
+        stream_row=np.where(
+            perm >= 0, sb[np.clip(perm, 0, len(sb) - 1)], NULLI
+        ),
         hard_rows=plan.hard_rows,
     )
 
@@ -1264,21 +1331,23 @@ def converge_async(plan: PackedPlan):
     point in the whole (stage -> upload -> dispatch) chain is the
     fetch."""
     args = _plan_args(plan)
+    mode = kernel_mode_for(plan.map_bucket, plan.seq_bucket)
     # span = enqueue cost (the dispatch is async); the XProf
     # annotation brackets the jitted call so device timelines
     # attribute the fused kernel to the converge phase. The staged
     # buffers are DONATED to the program (matrix upload through the
-    # xfer seam, eager rows at stage time): one plan, one dispatch.
+    # xfer seam, eager sections at stage time): one plan, one
+    # dispatch.
     with get_tracer().span("converge.dispatch"), \
             device_annotation("crdt.converge.dispatch"), \
             enable_x64(True):
         if plan.dev:
             out = _converge_rows(*plan.dev, **args,
-                                 narrow=plan.dev_narrow)
+                                 encs=plan.encs, mode=mode)
         else:
             out = _converge_packed(
                 _put_mat(plan), **args,
-                narrow=_mat_narrow_arg(plan),
+                encs=plan.encs, mode=mode,
             )
     return plan, out
 
@@ -1320,6 +1389,7 @@ def converge(plan: PackedPlan,
         return converge_fetch(converge_async(plan))
 
     args = _plan_args(plan)
+    mode = kernel_mode_for(plan.map_bucket, plan.seq_bucket)
 
     def mark(name, t0):
         phases[name] = round(_t.perf_counter() - t0, 4)
@@ -1335,7 +1405,7 @@ def converge(plan: PackedPlan,
             mark("upload_wait", t0)
             t0 = _t.perf_counter()
             out = _converge_rows(*plan.dev, **args,          # 1 dispatch
-                                 narrow=plan.dev_narrow)
+                                 encs=plan.encs, mode=mode)
             jax.block_until_ready(out)
             mark("dispatch", t0)
         else:
@@ -1345,7 +1415,7 @@ def converge(plan: PackedPlan,
             mark("upload_wait", t0)
             t0 = _t.perf_counter()
             out = _converge_packed(dev_mat, **args,          # 1 dispatch
-                                   narrow=_mat_narrow_arg(plan))
+                                   encs=plan.encs, mode=mode)
             jax.block_until_ready(out)
             mark("dispatch", t0)
         # the fetch is attributed to its OWN phase (and the xfer.d2h
@@ -1388,7 +1458,8 @@ def converge_host(plan: PackedPlan) -> PackedResult:
     from crdt_tpu.ops.device import on_local_cpu
 
     args = _plan_args(plan)
-    key = ("converge_host", plan.mat.shape, _mat_narrow_arg(plan),
+    mode = kernel_mode_for(plan.map_bucket, plan.seq_bucket)
+    key = ("converge_host", plan.mat.shape, plan.encs, mode,
            tuple(sorted(args.items())))
     with get_tracer().span("converge.dispatch"), \
             on_local_cpu(cache_key=key), enable_x64(True):
@@ -1398,7 +1469,7 @@ def converge_host(plan: PackedPlan) -> PackedResult:
         # donating twin would warn into library consumers' stderr
         h = np.asarray(
             _converge_packed_nodonate(jnp.asarray(plan.mat), **args,
-                                      narrow=_mat_narrow_arg(plan))
+                                      encs=plan.encs, mode=mode)
         )
     with get_tracer().span("converge.fetch"):
         return _assemble_result(plan, h)

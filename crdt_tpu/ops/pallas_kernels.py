@@ -309,3 +309,248 @@ def sv_deficit(svs: jnp.ndarray) -> jnp.ndarray:
         return statevec.exact_missing(cent).astype(svs.dtype)
 
     return jax.lax.cond(safe, _pallas, _exact, centered)
+
+
+# ---------------------------------------------------------------------------
+# converge hot-path kernels (round 12, the sort diet): segmented
+# Lamport argmax + document-order scatter
+#
+# The fused converge dispatch (ops/packed.py) stages its rows GROUPED
+# by dense segment id — staging is a host radix pass that any columnar
+# store pays at ingest — so the two primitives that used to burn the
+# dispatch budget on global XLA argsorts become one-VMEM-pass kernels:
+#
+# - ``seg_argmax_scan``: per-run argmax over Lamport (client, position)
+#   keys for CONTIGUOUS runs (child groups of the LWW map chain
+#   forest, root-children runs per segment). A Hillis–Steele segmented
+#   scan over the whole block resident in VMEM: log2(N) rounds of flat
+#   rolls + selects, ZERO random gathers and ZERO sorts — the
+#   replacement for the collapsed-key argsort + run-edge sort chain in
+#   ``lww.map_winners``.
+# - ``stream_scatter``: document-order assembly. Per-segment DFS ranks
+#   are a permutation within each segment, so with contiguous segments
+#   the final stream is out[offset[seg] + rank] = row — a permutation
+#   scatter into VMEM, replacing the global ``argsort(skey2)``
+#   document-order sort.
+#
+# Both run in interpret mode off-TPU (the tier-1 differential suite,
+# tests/test_sort_diet.py) against the jnp oracles below, which are
+# the SAME algorithms expressed as XLA ops (associative_scan /
+# .at[].set) — the production fallback for non-TPU backends and for
+# blocks past the VMEM width guard. Callers pass the dispatch decision
+# as a STATIC mode argument (see :func:`converge_kernel_mode`) so an
+# env-var flip between calls recompiles instead of reusing a stale
+# cached branch.
+# ---------------------------------------------------------------------------
+
+# whole-block-in-VMEM width guard for the scan/scatter kernels: above
+# this the jnp oracle path runs (a 1.6M-row scale shard would not fit
+# the scan's working set in 16 MB of VMEM). Like _DS_PALLAS_CROSSOVER
+# this is a dispatch bound, not a correctness bound.
+_SCAN_PALLAS_MAX = 1 << 17
+
+_SUBLANES = 8  # int32 min tile is (8, 128): pad rows to a multiple
+
+
+def converge_kernel_mode(*widths: int) -> str:
+    """STATIC dispatch decision for the fused converge's kernels:
+    ``"pallas"`` (compiled), ``"interpret"`` (CPU-mesh tests), or
+    ``"jnp"`` (kernels off, or any block past the VMEM width guard).
+    Computed by the host wrapper per call and passed down as a static
+    argument, so CRDT_TPU_PALLAS flips take effect on the next call
+    instead of silently reusing a stale compiled branch."""
+    if not use_pallas() or max(widths, default=0) > _SCAN_PALLAS_MAX:
+        return "jnp"
+    return "interpret" if _interpret() else "pallas"
+
+
+def _rows2d(x: jnp.ndarray):
+    """Flat [N] -> (R, 128) VMEM layout, R a multiple of the int32
+    sublane tile."""
+    n = x.shape[0]
+    npad = _pad_len(n, _SUBLANES * _LANES)
+    return jnp.pad(x, (0, npad - n), constant_values=-1).reshape(-1, _LANES)
+
+
+def _flat_roll(x, s: int):
+    """x[i - s] at flat position i of a row-major (R, 128) block
+    (positions < s receive wrapped garbage — callers mask)."""
+    a, b = s // _LANES, s % _LANES
+    if b == 0:
+        return pltpu.roll(x, shift=a, axis=0)
+    y1 = pltpu.roll(pltpu.roll(x, shift=a, axis=0), shift=b, axis=1)
+    y2 = pltpu.roll(pltpu.roll(x, shift=a + 1, axis=0), shift=b, axis=1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    return jnp.where(lane >= b, y1, y2)
+
+
+def _seg_argmax_kernel(cl_ref, fl_ref, arg_ref):
+    """Segmented inclusive argmax scan, whole block in VMEM.
+
+    State per position: (best client, best arg, boundary-seen flag).
+    Round k combines each position with the one 2^k before it unless a
+    run boundary lies between them — the textbook segmented-scan
+    operator, with the argmax tie rule "equal client keeps the EARLIER
+    position" (clock ascends within a (run, client) group, and the
+    sibling rule wants minimum clock at equal client — exactly the
+    run-tail the sort-based path selects). log2(N) rounds of flat
+    rolls + selects: no sorts, no gathers, no HBM round trips.
+    """
+    cl = cl_ref[:]
+    fl = fl_ref[:]
+    shape = cl.shape
+    n = shape[0] * shape[1]
+    arg = (
+        jax.lax.broadcasted_iota(jnp.int32, shape, 0) * jnp.int32(_LANES)
+        + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    )
+    flat = arg  # flat position index (reused for the wrap mask)
+    best_c, best_a, seen = cl, arg, fl
+    s = 1
+    while s < n:
+        ok = flat >= s
+        p_c = jnp.where(ok, _flat_roll(best_c, s), jnp.int32(-1))
+        p_a = jnp.where(ok, _flat_roll(best_a, s), jnp.int32(0))
+        p_f = jnp.where(ok, _flat_roll(seen, s), jnp.int32(1))
+        take_prev = (seen == 0) & (
+            (p_c > best_c) | ((p_c == best_c) & (p_a < best_a))
+        )
+        best_c = jnp.where(take_prev, p_c, best_c)
+        best_a = jnp.where(take_prev, p_a, best_a)
+        seen = seen | p_f
+        s <<= 1
+    arg_ref[:] = best_a
+
+
+def seg_argmax_scan_jnp(client: jnp.ndarray,
+                        flags: jnp.ndarray) -> jnp.ndarray:
+    """jnp oracle of the segmented argmax scan: the identical
+    segmented-scan operator via ``lax.associative_scan`` (log-depth
+    shifted selects — still sortless; the Pallas kernel wins by
+    keeping the whole working set in VMEM)."""
+    n = client.shape[0]
+    arg0 = jnp.arange(n, dtype=jnp.int32)
+
+    def comb(a, b):
+        c1, a1, f1 = a
+        c2, a2, f2 = b
+        blocked = f2 != 0
+        take_prev = (~blocked) & (
+            (c1 > c2) | ((c1 == c2) & (a1 < a2))
+        )
+        return (
+            jnp.where(take_prev, c1, c2),
+            jnp.where(take_prev, a1, a2),
+            f1 | f2,
+        )
+
+    _, arg, _ = jax.lax.associative_scan(
+        comb, (client.astype(jnp.int32), arg0, flags.astype(jnp.int32))
+    )
+    return arg
+
+
+def seg_argmax_scan(client: jnp.ndarray, flags: jnp.ndarray, *,
+                    mode: str) -> jnp.ndarray:
+    """Per-position inclusive argmax over contiguous runs.
+
+    ``client`` [N] int32 (the Lamport major key; -1 on padding rows),
+    ``flags`` [N] int32 (1 = run start; padding rows are their own
+    runs). Returns [N] int32: the position holding the run-prefix
+    argmax — read at a run's END it is the run's argmax, i.e. the
+    sibling-sorted run TAIL of the sort-based path. ``mode`` is the
+    static :func:`converge_kernel_mode` decision.
+    """
+    if mode == "jnp":
+        return seg_argmax_scan_jnp(client, flags)
+    n = client.shape[0]
+    cl2 = _rows2d(client.astype(jnp.int32))
+    # _rows2d pads with -1: padded flag slots normalize to 1, so the
+    # pad tail forms its own runs and never leaks into a real one
+    fl2 = jnp.where(_rows2d(flags.astype(jnp.int32)) != 0, 1, 0).astype(
+        jnp.int32
+    )
+    with enable_x64(False):
+        out = pl.pallas_call(
+            _seg_argmax_kernel,
+            out_shape=jax.ShapeDtypeStruct(cl2.shape, jnp.int32),
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+                pl.BlockSpec(memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=(mode == "interpret"),
+        )(cl2, fl2)
+    return out.reshape(-1)[:n]
+
+
+NULL_I32 = -1
+
+
+def _stream_scatter_kernel(pos_ref, out_ref):
+    """Permutation scatter: out[pos[i]] = i for in-range targets.
+
+    One program, whole block in VMEM; the fori_loop walks the input
+    once doing dynamic scalar stores — sequential, but each store is a
+    VMEM write with zero HBM traffic, and the targets are unique by
+    construction (per-segment DFS ranks + exclusive segment offsets),
+    so there is no ordering hazard. Rows routed past the output width
+    (invalid / padding) fall out via the bounds predicate.
+    """
+    out_ref[:] = jnp.full(out_ref.shape, NULL_I32, jnp.int32)
+    n_in = pos_ref.shape[0] * pos_ref.shape[1]
+    limit = jnp.int32(out_ref.shape[0] * out_ref.shape[1])
+    # explicit i32 scalars: the kernel body may be traced outside the
+    # wrapper's enable_x64(False) window, where a weak python literal
+    # promotes to i64 and breaks the i32 index arithmetic
+    lanes = jnp.int32(_LANES)
+
+    def body(i, _):
+        p = pos_ref[i // lanes, i % lanes]
+
+        @pl.when((p >= 0) & (p < limit))
+        def _():
+            out_ref[p // lanes, p % lanes] = i
+
+        return jnp.int32(0)  # explicit: a weak `0` promotes to i64
+        #                      under an x64-tracing caller and breaks
+        #                      the loop carry
+
+    jax.lax.fori_loop(jnp.int32(0), jnp.int32(n_in), body, jnp.int32(0))
+
+
+def stream_scatter_jnp(pos: jnp.ndarray, n_out: int) -> jnp.ndarray:
+    """jnp oracle of the document-order scatter: one XLA scatter with
+    out-of-range targets dropped. Targets are unique by construction
+    (rank + exclusive offset), so drop-mode scatter is deterministic
+    here. Negative targets are redirected PAST the output before the
+    scatter: ``.at[-1]`` would wrap to the last slot (jnp negative
+    indexing), not drop."""
+    idx = jnp.arange(pos.shape[0], dtype=jnp.int32)
+    tgt = jnp.where(pos >= 0, pos, jnp.int32(n_out))
+    return jnp.full(n_out, NULL_I32, jnp.int32).at[tgt].set(
+        idx, mode="drop"
+    )
+
+
+def stream_scatter(pos: jnp.ndarray, n_out: int, *,
+                   mode: str) -> jnp.ndarray:
+    """Document-order assembly: ``out[pos[i]] = i`` over int32
+    positions (targets outside [0, n_out) are dropped — callers route
+    invalid rows there). Returns [n_out] int32 with -1 holes. ``mode``
+    is the static :func:`converge_kernel_mode` decision."""
+    if mode == "jnp":
+        return stream_scatter_jnp(pos, n_out)
+    pos2 = _rows2d(pos.astype(jnp.int32))
+    opad = _pad_len(n_out, _SUBLANES * _LANES)
+    with enable_x64(False):
+        out = pl.pallas_call(
+            _stream_scatter_kernel,
+            out_shape=jax.ShapeDtypeStruct(
+                (opad // _LANES, _LANES), jnp.int32
+            ),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            interpret=(mode == "interpret"),
+        )(pos2)
+    return out.reshape(-1)[:n_out]

@@ -41,6 +41,15 @@ The split of labor is therefore:
          Wyllie list ranking. O(n log n) work in O(log n) gather
          rounds, independent of tree depth (the reference's scalar
          integrate is O(n) sequential per chain, crdt.js:294).
+
+Round 12 (the sort diet) narrowed where this full-width kernel runs:
+the staged cold replay now precomputes the sibling adjacency and
+first-child tables on the host (``ops.packed._stage``, shipped as
+staged sections) and ranks them sortlessly with the Pallas
+document-order scatter (``ops.pallas_kernels.stream_scatter``), so
+``order_sequences``/``tree_order_ranks`` remain the engine-mode
+merge path and the differential oracle the staged kernels are tested
+against — same semantics, two routes.
 """
 
 from __future__ import annotations

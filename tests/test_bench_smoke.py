@@ -19,6 +19,8 @@ import os
 import subprocess
 import sys
 
+import numpy as np
+
 # the hot-path span registry tier-1 pins (README "Observability"):
 # any rename or dropped hook fails here, not in a future postmortem
 HOT_PATH_SPANS = (
@@ -88,7 +90,18 @@ def test_bench_smoke_mode(tmp_path):
     assert out["xfer"]["h2d_bytes"] > 0
     assert out["xfer"]["d2h_bytes"] > 0
 
+    # the round-12 kernel-dispatch registry (sort diet): every fused
+    # converge counts its static kernel-mode decision
+    # (converge.pallas{mode=...}), so the ablation evidence and the
+    # metrics_diff gates always have data to read
+    assert out.get("kernel_registry_ok") is True
+    assert any(k.startswith('converge.pallas{mode=')
+               for k in report["counters"]), \
+        "converge.pallas mode counter missing from tracer report"
+
     # the guard-layer registry (README "Overload & failure policy"):
+    # (kernel_ablation_leg is pinned in-process below — the smoke
+    # subprocess stays on its <30s budget)
     # each degradation ladder fired once in the smoke and its
     # counters are live, so the robustness regression gate
     # (tools/metrics_diff.py GUARD_PREFIXES) always has data to read
@@ -100,3 +113,40 @@ def test_bench_smoke_mode(tmp_path):
         assert report["counters"].get(cname, 0) > 0, cname
     # degraded flipped on AND recovered during the leg
     assert report["gauges"].get("persist.degraded") == 0
+
+
+def test_kernel_ablation_leg_shape():
+    """The round-12 per-primitive ablation rig (bench.kernel_ablation_
+    leg) must keep producing the gated keys — sort_ms / map_winners_ms
+    / rank_ms with both paths, and the sort_map_speedup acceptance
+    number — on a tiny trace, so the evidence pipeline can't rot
+    between full bench runs."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    import bench
+    from crdt_tpu.compat import enable_x64
+
+    blobs = bench.build_trace(30, 20)
+    dec = bench.decode_stage(blobs)
+    cols, _ = bench.column_stage(dec)
+
+    def b2b(fn, reps=2, outer=1):
+        import jax
+        import time
+
+        jax.block_until_ready(fn())
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    with enable_x64(True):
+        out = bench.kernel_ablation_leg(cols, b2b, 0.0)
+    for prim in ("sort_ms", "map_winners_ms", "rank_ms"):
+        assert set(out[prim]) == {"jnp", "pallas"}, prim
+        assert out[prim]["jnp"] > 0 and out[prim]["pallas"] > 0
+    assert out["sort_map_speedup"] > 0
+    assert out["shape"] == int(np.count_nonzero(cols["valid"]))
+    assert out["mode"] in ("pallas", "interpret", "jnp")

@@ -245,6 +245,28 @@ def test_registry_drift_fixed_fleet_and_engine_names():
         )
 
 
+def test_registry_covers_converge_kernel_counters():
+    """Round 12 (the sort diet) added the `converge.*` namespace for
+    the Pallas kernel-dispatch evidence. Both directions must hold:
+    the emitted names stay documented, and an UNdocumented converge
+    name still fires CL201 — i.e. the namespace genuinely joined the
+    registry-checked pool rather than an allowlist."""
+    reg = _real_registry()
+    for name in ("converge.pallas", "converge.pallas_fallback",
+                 "converge.dispatch", "converge.fetch"):
+        assert name in reg.metrics, (
+            f"{name} dropped out of the README registry (round-12 "
+            f"sort-diet contract)"
+        )
+    result = _lint_snippet("crdt_tpu/ops/x.py", '''
+def f(tracer):
+    tracer.count("converge.bogus_kernel", 1)
+''', _reg("converge.pallas"))
+    assert any(f.code == "CL201" for f in result.findings), (
+        "an undocumented converge.* metric no longer fires CL201"
+    )
+
+
 def test_registry_drift_fixed_event_kinds():
     """First-run CL201 drift on flight-recorder event kinds from the
     guard/storage/device adversaries."""

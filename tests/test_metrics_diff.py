@@ -228,6 +228,54 @@ def test_overload_section_gated():
     assert "overload.peak_inbox_bytes" in regressed
 
 
+def test_kernel_sweep_and_ablation_gated():
+    """Round 12: kernel_sweep_net_ms per size and the ablation legs
+    are direction-aware gates — net ms lower-is-better (ms noise
+    floor), sort_map_speedup higher-is-better and never muted."""
+    old = copy.deepcopy(OLD)
+    old["kernel_sweep_net_ms"] = {"25000": 8.0, "100000": 31.0}
+    old["kernel_ablation"] = {
+        "sort_ms": {"jnp": 6.0, "pallas": 2.0},
+        "map_winners_ms": {"jnp": 8.0, "pallas": 3.0},
+        "rank_ms": {"jnp": 9.0, "pallas": 7.0},
+        "sort_map_speedup": 2.8,
+    }
+    new = copy.deepcopy(old)
+    rows, regressed = compare(old, new)
+    names = {r["metric"] for r in rows}
+    assert "kernel_sweep_net_ms.100000_ms" in names
+    assert "kernel_ablation.sort_ms.pallas_ms" in names
+    assert "kernel_ablation.sort_map_speedup" in names
+    assert regressed == []
+
+    # net-compute regression past the threshold fails the gate
+    new["kernel_sweep_net_ms"]["100000"] = 62.0
+    new["kernel_ablation"]["sort_ms"]["pallas"] = 5.0
+    rows, regressed = compare(old, new, threshold=0.2)
+    assert "kernel_sweep_net_ms.100000_ms" in regressed
+    assert "kernel_ablation.sort_ms.pallas_ms" in regressed
+
+    # the >=2x speedup claim eroding fails as a regression (higher is
+    # better), and a speedup IMPROVEMENT never does
+    new2 = copy.deepcopy(old)
+    new2["kernel_ablation"]["sort_map_speedup"] = 1.4
+    _, regressed = compare(old, new2, threshold=0.2)
+    assert "kernel_ablation.sort_map_speedup" in regressed
+    new3 = copy.deepcopy(old)
+    new3["kernel_ablation"]["sort_map_speedup"] = 9.0
+    _, regressed = compare(old, new3, threshold=0.2)
+    assert regressed == []
+
+    # sub-noise-floor ms stay reported but never fail
+    new4 = copy.deepcopy(old)
+    old["kernel_ablation"]["rank_ms"]["pallas"] = 0.002
+    new4["kernel_ablation"]["rank_ms"]["pallas"] = 0.004
+    rows, regressed = compare(old, new4, threshold=0.2)
+    assert "kernel_ablation.rank_ms.pallas_ms" not in regressed
+    assert any(r["metric"] == "kernel_ablation.rank_ms.pallas_ms"
+               and r["verdict"] == "noise" for r in rows)
+
+
 def test_lint_findings_gated_lower_is_better():
     """crdtlint satellite: a PR that grows the lint baseline (or
     sprinkles inline disables) moves lint.findings and fails the

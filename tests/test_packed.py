@@ -32,18 +32,20 @@ def _cols(n, *, clock_base=0, clients=None, seq=False):
 
 class TestStage:
     def test_staged_matrix(self):
-        # a tiny batch narrows to the int16 transfer-diet layout
+        # a tiny batch narrows to the int16 transfer-diet layout: one
+        # flat array of the eight layout sections
         plan = packed.stage(_cols(8))
         assert plan is not None
-        assert plan.narrow and plan.mat.dtype == np.int16
-        assert plan.mat.shape[0] == 5
+        assert plan.mat.dtype == np.int16 and plan.mat.ndim == 1
+        assert len(plan.encs) == len(packed.SECTION_NAMES)
+        assert all(e in ("i16", "d16", "hilo") for e in plan.encs)
         assert plan.n == 8
 
     def test_forced_wide_matrix(self):
         plan = packed.stage(_cols(8), wide=True)
         assert plan is not None
-        assert not plan.narrow and plan.mat.dtype == np.int32
-        assert plan.mat.shape[0] == 5
+        assert plan.mat.dtype == np.int32 and plan.mat.ndim == 1
+        assert all(e == "i32" for e in plan.encs)
 
     def test_wide_clock_stays_packed(self):
         # clocks below the shared pack_id bound stay on the packed path
@@ -71,14 +73,28 @@ class TestStage:
         plan = packed.stage(cols)
         assert plan.seq_bucket >= 200
 
+    def test_map_bucket_tracks_map_rows_not_padded_n(self):
+        # the round-12 satellite: the map chain runs at MAP-BUCKET
+        # width (mirroring the seq compact block), so a seq-heavy
+        # union must get a map bucket far below the padded kernel
+        # width — the ~100-180ms lever ROOFLINE round 5 priced
+        n = 600
+        cols = _cols(n, clients=np.ones(n), seq=True)
+        cols["key_id"][:8] = 0  # 8 map rows in a 600-row union
+        plan = packed.stage(cols)
+        assert plan.map_bucket <= 64  # bucket of 8, not of 600
+        assert plan.seq_bucket >= n - 8
+        assert len(plan.map_back) == plan.map_bucket
+
     def test_client_interning_order_preserving(self):
         cols = _cols(3, clients=np.array([900, 5, 37]))
         plan = packed.stage(cols)
         assert list(plan.clients) == [5, 37, 900]
-        # rows ship id-sorted: dense client ranks ascend, and the sort
-        # permutation maps each staged row back to its caller row
-        assert list(plan.mat[0, :3]) == [0, 1, 2]
+        # rows stage id-sorted: the sort permutation maps each staged
+        # row back to its caller row, and the grouped map block (one
+        # root run here) keeps that id order in its translation table
         assert list(plan.order[:3]) == [1, 2, 0]
+        assert list(plan.map_back[:3]) == [1, 2, 0]
 
 
 class TestConverge:

@@ -59,27 +59,17 @@ class TestNarrowEncodings:
 
         return np.asarray(fn(jnp.asarray(arr)))
 
-    def test_client_boundary(self):
-        ok = np.asarray([0, 1, (1 << 15) - 1], np.int32)
-        enc = packed._narrow_client(ok)
+    def test_ident_boundary(self):
+        # the identity sections (seq_seg/seg_off/map_key/map_root_end):
+        # values in [-1, 32767] ship as-is, anything past refuses
+        ok = np.asarray([-1, 0, 1, (1 << 15) - 1], np.int64)
+        enc = packed._narrow_ident(ok)
         assert enc is not None and enc.dtype == np.int16
-        assert (self._widen(packed._widen_client, enc) == ok).all()
-        assert packed._narrow_client(
-            np.asarray([1 << 15], np.int32)) is None
-
-    def test_seg_boundary_and_flag_fold(self):
-        # map segs, seq segs (flag folded into sign), dead rows
-        segs = np.asarray(
-            [0, 5, (1 << 15) - 3], np.int32)
-        r1 = np.concatenate([
-            segs,                          # map rows
-            segs | packed._SEQ_FLAG,       # seq rows
-            np.asarray([-1], np.int32),    # dead
-        ])
-        enc = packed._narrow_seg(r1, n_segs=(1 << 15) - 2)
-        assert enc is not None and enc.dtype == np.int16
-        assert (self._widen(packed._widen_seg, enc) == r1).all()
-        assert packed._narrow_seg(r1, n_segs=(1 << 15)) is None
+        assert (enc.astype(np.int64) == ok).all()  # identity widen
+        assert packed._narrow_ident(
+            np.asarray([1 << 15], np.int64)) is None
+        assert packed._narrow_ident(
+            np.asarray([-2], np.int64)) is None  # only -1 is a sentinel
 
     def test_delta_ref_boundaries(self):
         n = 10
@@ -98,21 +88,13 @@ class TestNarrowEncodings:
         far[-1] = 0  # delta = len-1 > 32767
         assert packed._narrow_delta_ref(far) is None
 
-    def test_ascending_boundaries(self):
-        rows = np.asarray([0, 1, 5, 40000, -1, -1], np.int64)
-        enc = packed._narrow_ascending(rows)
-        assert enc is None  # gap 5 -> 40000 overflows int16
-        rows2 = np.asarray([2, 3, (1 << 15) + 5, -1], np.int64)
-        # first delta = 3 <= int16, gap = 32770 -> refuse
-        assert packed._narrow_ascending(rows2) is None
-        ok = np.asarray([7, 8, 100, -1, -1], np.int64)
-        enc = packed._narrow_ascending(ok)
-        assert enc is not None
-        assert (self._widen(packed._widen_ascending, enc) == ok).all()
-        # empty prefix (no sequence rows) stays all-pad
-        empty = np.full(6, -1, np.int64)
-        enc = packed._narrow_ascending(empty)
-        assert (self._widen(packed._widen_ascending, enc) == empty).all()
+    def test_section_encoders_cover_every_section(self):
+        # every staged section has a registered preferred encoder, and
+        # the flat layout's static sizes align with the section table
+        assert set(packed._SECTION_NARROW) == set(packed.SECTION_NAMES)
+        sizes = packed._section_sizes(4, 8, 16)
+        assert len(sizes) == len(packed.SECTION_NAMES)
+        assert sizes == (8, 4, 8, 8, 12, 16, 16, 4)
 
 
 # ---------------------------------------------------------------------------
@@ -210,11 +192,12 @@ class TestBoundaryDifferentials:
         ]
         _routes_identical(blobs, monkeypatch)
 
-    def test_self_referential_origin_takes_hi_lo_rows(self):
-        """A row claiming itself as origin collides with the delta
-        encoding's sentinel: that COLUMN must fall back to the exact
-        hi/lo row pair (never decode wrong) and still converge like
-        the wide path."""
+    def test_self_referential_origin_takes_hi_lo_section(self):
+        """A row claiming itself as origin makes its chain-end slot
+        point at its own position — delta 0 collides with the d16
+        no-reference sentinel, so that SECTION must fall back to the
+        exact hi/lo stretch pair (never decode wrong) and still
+        converge like the wide path."""
         n = 6
         cols = {
             "client": np.full(n, 1, np.int64),
@@ -230,12 +213,12 @@ class TestBoundaryDifferentials:
         cols["origin_client"][3] = 1
         cols["origin_clock"][3] = 3  # row 3's origin is row 3
         plan = packed.stage(cols)
-        assert plan is not None and plan.narrow
-        # the origin column (index 2) degraded to hi/lo; others narrow
-        assert plan.narrow_cols[2] is False
-        assert all(plan.narrow_cols[i] for i in (0, 1, 3, 4))
-        assert plan.mat.dtype == np.int16
-        assert plan.mat.shape[0] == 6  # five columns + one extra row
+        assert plan is not None and plan.mat.dtype == np.int16
+        by_name = dict(zip(packed.SECTION_NAMES, plan.encs))
+        assert by_name["map_chain_end"] == "hilo"
+        # the other map sections stay narrow
+        assert by_name["map_key"] == "i16"
+        assert by_name["map_root_end"] == "i16"
         res = packed.converge(plan)
         wide = packed.converge(packed.stage(cols, wide=True))
         assert list(res.win_rows) == list(wide.win_rows)
@@ -270,9 +253,13 @@ class TestBoundaryDifferentials:
             "valid": np.ones(n, bool),
         }
         plan = packed.stage(cols)
-        assert plan.narrow and plan.mat.dtype == np.int16
-        assert plan.narrow_cols[1] is False  # seg -> hi/lo
-        assert plan.mat.shape[0] == 6
+        assert plan.mat.dtype == np.int16
+        by_name = dict(zip(packed.SECTION_NAMES, plan.encs))
+        # the grouped end positions overflow one int16 stretch past
+        # 32k map rows; everything else stays narrow
+        assert by_name["map_root_end"] == "hilo"
+        assert by_name["map_key"] == "i16"
+        assert "i32" not in plan.encs
         res = packed.converge(plan)
         wide = packed.converge(packed.stage(cols, wide=True))
         assert list(res.win_rows[res.win_rows >= 0]) == \
@@ -292,7 +279,8 @@ class TestBoundaryDifferentials:
             "valid": np.ones(8, bool),
         })
         assert plan is not None
-        assert not plan.narrow and plan.mat.dtype == np.int32
+        assert plan.mat.dtype == np.int32
+        assert all(e == "i32" for e in plan.encs)
 
     def test_eager_path_narrow_matches_matrix(self):
         """stage(put=...) ships per-array narrow encodings; results
@@ -306,7 +294,8 @@ class TestBoundaryDifferentials:
         cols, _ = rp.stage(dec)
         mat_res = packed.converge(packed.stage(cols))
         eager_plan = packed.stage(cols, put=xfer_put)
-        assert eager_plan.mat is None and any(eager_plan.dev_narrow)
+        assert eager_plan.mat is None and len(eager_plan.dev) == 3
+        assert any(e in ("i16", "d16") for e in eager_plan.encs)
         eager_res = packed.converge(eager_plan)
         assert list(mat_res.win_rows) == list(eager_res.win_rows)
         assert list(mat_res.stream_row) == list(eager_res.stream_row)
@@ -392,9 +381,21 @@ class TestByteAccounting:
             return tracer.counters("xfer.")["xfer.h2d_bytes"] - before
 
         wide_b = staged_bytes(True)
+        before_staged = tracer.counters("xfer.")["xfer.staged_bytes"]
+        before_saved = tracer.counters("xfer.")["xfer.h2d_bytes_saved"]
         narrow_b = staged_bytes(False)
         assert narrow_b * 2 == wide_b
-        assert tracer.report()["gauges"]["xfer.narrowed_ratio"] == 0.5
+        # the gauge reports shipped / PRE-diet (round-8) staging of the
+        # same union — the section re-cut counts as savings too, so the
+        # value is workload-shaped; pin it against the recorded
+        # counters instead of a constant
+        shipped = tracer.counters("xfer.")["xfer.staged_bytes"] \
+            - before_staged
+        saved = tracer.counters("xfer.")["xfer.h2d_bytes_saved"] \
+            - before_saved
+        assert shipped == narrow_b and saved > 0
+        ratio = tracer.report()["gauges"]["xfer.narrowed_ratio"]
+        assert ratio == round(shipped / (shipped + saved), 4)
 
     def test_resident_rounds_ship_delta_bytes_only(self, tracer):
         """Steady-state device rounds against the donated resident

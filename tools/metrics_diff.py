@@ -14,6 +14,13 @@ Compared (whatever of these both artifacts carry):
 - headline metrics: ``value`` (direction inferred from ``unit``),
   ``vs_baseline``, ``vs_python_oracle``, ``kernel_dispatch_ops_per_s``
   (higher = better), ``dispatch_floor_ms`` (lower = better);
+- the sort-diet kernel evidence (round 12): per-size
+  ``kernel_sweep_net_ms`` and the per-primitive
+  ``kernel_ablation.{sort,map_winners,rank}_ms.{pallas,jnp}`` legs
+  (lower = better, seconds noise floor), plus
+  ``kernel_ablation.sort_map_speedup`` (higher = better, never
+  muted) — so the ROADMAP item-3 >=2x claim is a regression-gated
+  artifact, not a doc sentence;
 - scale/section digests: ``scale_run.vs_baseline``,
   ``scale_run.stream_vs_oneshot``, ``scale_run.rounds.vs_cold_replay``;
 - tracer phase spans: per-span ``p50_s``/``p99_s``/``total_s`` from
@@ -121,6 +128,34 @@ def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
         a, b = _get_path(old, path), _get_path(new, path)
         if _both_numbers(a, b):
             yield ".".join(path), float(a), float(b), direction, False
+    # the fused-dispatch net-compute sweep (round 12, the sort diet's
+    # headline evidence): per-size ms, lower is better, seconds noise
+    # floor applies (the *_ms suffix scales it)
+    so = old.get("kernel_sweep_net_ms") or {}
+    sn = new.get("kernel_sweep_net_ms") or {}
+    for size in sorted(set(so) & set(sn)):
+        if _both_numbers(so[size], sn[size]):
+            yield f"kernel_sweep_net_ms.{size}_ms", float(so[size]), \
+                float(sn[size]), False, True
+    # the per-primitive kernel ablation (round 12): each primitive's
+    # per-path net ms lower-is-better; the sort+map speedup — the
+    # ROADMAP item-3 >=2x acceptance number — higher-is-better and
+    # never muted by the noise floor
+    ao = old.get("kernel_ablation") or {}
+    an = new.get("kernel_ablation") or {}
+    for prim in ("sort_ms", "map_winners_ms", "rank_ms"):
+        po, pn = ao.get(prim), an.get(prim)
+        if not (isinstance(po, dict) and isinstance(pn, dict)):
+            continue
+        for path_key in sorted(set(po) & set(pn)):
+            if _both_numbers(po[path_key], pn[path_key]):
+                yield f"kernel_ablation.{prim}.{path_key}_ms", \
+                    float(po[path_key]), float(pn[path_key]), False, True
+    if _both_numbers(ao.get("sort_map_speedup"),
+                     an.get("sort_map_speedup")):
+        yield "kernel_ablation.sort_map_speedup", \
+            float(ao["sort_map_speedup"]), \
+            float(an["sort_map_speedup"]), True, False
     spans_old = (old.get("tracer") or {}).get("spans", {})
     spans_new = (new.get("tracer") or {}).get("spans", {})
     for name in sorted(set(spans_old) & set(spans_new)):
