@@ -771,6 +771,258 @@ def fleet_mesh_child(argv):
     print(json.dumps(out))
 
 
+MULTICHIP_OUT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "MULTICHIP_r06.json"
+)
+
+
+def multichip_child(argv):
+    """Subprocess leg of ``--multichip``: the 100k-replica scale
+    replay on THIS process's forced device count (the parent pins
+    JAX_PLATFORMS/XLA_FLAGS before spawn). Times the staged converge
+    (the sharded piece) and the whole replay, digests the outputs for
+    the parent's cross-device byte-identity assert, and prints ONE
+    JSON line — a child that executed nothing prints nothing, which
+    the parent treats as a loud failure."""
+    import hashlib
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from crdt_tpu.models import replay as rp
+    from crdt_tpu.obs import Tracer, set_tracer
+    from crdt_tpu.ops import packed
+    from crdt_tpu.ops import shard as shard_ops
+
+    R, K = int(argv[0]), int(argv[1])
+    nd = len(jax.devices())
+    tracer = set_tracer(Tracer(enabled=True))
+    blobs = build_trace(R, K, seed=13)
+    dec = rp.decode(blobs)
+    cols, ds = rp.stage(dec)
+    n = len(cols["client"])
+
+    def one_stage():
+        if nd > 1:
+            splan = shard_ops.stage(cols, n_shards=nd)
+            assert splan is not None, "sharded staging refused"
+            return shard_ops, splan
+        plan = packed.stage(cols)
+        assert plan is not None, "packed staging refused"
+        return packed, plan
+
+    eng, plan = one_stage()
+    res = eng.converge(plan)  # compile (untimed)
+    # staging is HOST work, identical in total across device counts
+    # (each shard stages its slice) — itemized separately so
+    # converge_s carries the pure upload+dispatch+fetch the mesh
+    # actually divides, the same discipline as converge_detail
+    conv_runs = []
+    pack_runs = []
+    c_before = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        eng, plan = one_stage()
+        pack_runs.append(round(time.perf_counter() - t0, 3))
+        c_before = tracer.counters()
+        t0 = time.perf_counter()
+        res = eng.converge(plan)
+        conv_runs.append(round(time.perf_counter() - t0, 3))
+    c_after = tracer.counters()
+
+    def per_round(name):
+        return c_after.get(name, 0) - (c_before or {}).get(name, 0)
+    win_rows, win_vis, seq_orders = rp.gather(dec, ds, ("packed", res))
+    cache = rp.materialize(dec, ds, win_rows, win_vis, seq_orders)
+    snap = rp.compact(dec, ds)
+    # one timed end-to-end replay (decode..snapshot; the host phases
+    # are constant across device counts, so the scaling signal lives
+    # in converge_s — both are published)
+    t0 = time.perf_counter()
+    full = rp.replay_trace(blobs)
+    e2e_s = round(time.perf_counter() - t0, 3)
+    assert full.cache == cache and full.snapshot == snap, \
+        "replay route diverges from the explicit converge"
+    gauges = tracer.report()["gauges"]
+    digest = hashlib.sha256(
+        json.dumps(cache, sort_keys=True).encode()
+        + hashlib.sha256(snap).digest()
+    ).hexdigest()
+    sv_digest = None
+    if nd > 1 and getattr(res, "global_sv", None) is not None:
+        sv_digest = hashlib.sha256(
+            np.ascontiguousarray(res.global_sv).tobytes()
+        ).hexdigest()
+    print(json.dumps({
+        "n_devices": nd,
+        "replicas": R,
+        "ops": n,
+        "converge_s": min(conv_runs),
+        "converge_runs_s": conv_runs,
+        "pack_s": min(pack_runs),
+        "pack_runs_s": pack_runs,
+        "e2e_s": e2e_s,
+        "boundary_bytes": per_round("shard.boundary_bytes"),
+        "staged_bytes": per_round("xfer.staged_bytes"),
+        "wyllie_rounds": gauges.get("converge.wyllie_rounds"),
+        "seam_rows": per_round("shard.seam_rows"),
+        "digest": digest,
+        "sv_digest": sv_digest,
+    }))
+
+
+def multichip(argv=None) -> int:
+    """The ``--multichip`` harness (round 13): the scale replay
+    sharded over 1/2/4/8 virtual devices, one subprocess per device
+    count (XLA's forced host-platform device count is fixed at
+    backend init, so each count needs a fresh interpreter).
+
+    Publishes per-device-count scaling + the boundary-exchange bytes
+    into MULTICHIP_r06.json and merges a ``multichip`` section into
+    BENCH_OUT.json, both regression-gated by tools/metrics_diff.py.
+
+    FAILS LOUDLY: a child that prints no result line, exits non-zero,
+    or converges to a different document marks the run failed — the
+    artifact records the actual rc and output tail, and the process
+    exits non-zero. ``ok: true`` with an empty payload can no longer
+    happen (the r05 harness recorded only ``n_devices`` with an empty
+    tail and still passed)."""
+    import subprocess
+
+    # 100k replicas x 8 ops: >=100k replicas per the acceptance bar,
+    # with enough ops per replica that the staged upload dominates
+    # the SV handshake (the boundary wire scales with REPLICAS, the
+    # staged bytes with OPS — at 1 op/replica the two are comparable
+    # by construction and no sharding could make the exchange small)
+    R = int(os.environ.get("BENCH_MULTICHIP_REPLICAS", 100_000))
+    K = int(os.environ.get("BENCH_MULTICHIP_OPS", 8))
+    nds = [int(x) for x in os.environ.get(
+        "BENCH_MULTICHIP_DEVICES", "1,2,4,8"
+    ).split(",")]
+    if argv:
+        nds = [int(x) for x in argv]
+    here = os.path.dirname(os.path.abspath(__file__))
+    per_device = {}
+    failure = None
+    tail = ""
+    for nd in nds:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial a tunnel
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(f"--xla_force_host_platform_device_count={nd}")
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": " ".join(flags),
+            "CRDT_TPU_SHARDS": str(nd),
+            # the scale union must take the sharded route on every
+            # multi-device child regardless of the size gate
+            "CRDT_TPU_SHARD_MIN_ROWS": "1",
+        })
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--multichip-child", str(R), str(K)],
+            env=env, capture_output=True, text=True, timeout=1800,
+            cwd=here,
+        )
+        lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+        tail = (lines[-1] if lines else "")[:1500]
+        if proc.returncode != 0 or not lines:
+            failure = {
+                "n_devices": nd,
+                "rc": proc.returncode,
+                "stdout_tail": proc.stdout[-500:],
+                "stderr_tail": proc.stderr[-800:],
+            }
+            log(f"multichip child nd={nd} failed rc={proc.returncode}: "
+                f"{proc.stderr[-300:]}")
+            break
+        leg = json.loads(lines[-1])
+        per_device[str(nd)] = leg
+        log(f"multichip nd={nd}: converge {leg['converge_s']}s "
+            f"{leg['converge_runs_s']} e2e {leg['e2e_s']}s "
+            f"boundary {leg['boundary_bytes']}B")
+
+    payload = {
+        "replicas": R,
+        "ops_per_replica": K,
+        "device_counts": nds,
+        "per_device": per_device,
+    }
+    ok = failure is None and bool(per_device)
+    if ok:
+        digests = {leg["digest"] for leg in per_device.values()}
+        if len(digests) != 1:
+            ok = False
+            failure = {"divergence": {
+                nd: leg["digest"] for nd, leg in per_device.items()
+            }}
+        else:
+            payload["byte_identical"] = True
+    if ok and "1" in per_device:
+        t1 = per_device["1"]["converge_s"]
+        payload["scaling_efficiency"] = {
+            nd: round(t1 / max(leg["converge_s"], 1e-9), 2)
+            for nd, leg in per_device.items() if nd != "1"
+        }
+        big = per_device[str(max(
+            int(nd) for nd in per_device if nd != "1"
+        ))] if len(per_device) > 1 else None
+        if big:
+            payload["boundary_bytes"] = big["boundary_bytes"]
+            payload["staged_bytes"] = big["staged_bytes"]
+            payload["boundary_fraction"] = round(
+                big["boundary_bytes"] / max(big["staged_bytes"], 1), 4
+            )
+    if failure is not None:
+        payload["failure"] = failure
+    # LOUD: an empty per_device payload is a failed run, full stop —
+    # but scaling efficiency is only demanded when the run requested
+    # BOTH the nd=1 baseline and a multi-device leg (a custom `1`- or
+    # `2,4`-only run has no ratio to form and is still a success)
+    ok = ok and bool(per_device)
+    if 1 in nds and any(n != 1 for n in nds):
+        ok = ok and bool(payload.get("scaling_efficiency"))
+    artifact = {
+        "n_devices": max(nds) if nds else 0,
+        "rc": 0 if ok else 1,
+        "ok": ok,
+        "skipped": False,
+        "tail": tail,
+        "multichip": payload,
+    }
+    try:
+        with open(MULTICHIP_OUT, "w") as f:
+            json.dump(artifact, f, indent=1, sort_keys=True)
+            f.write("\n")
+    except OSError as exc:
+        log(f"{MULTICHIP_OUT} not written: {exc}")
+    # merge the gated section into the committed bench artifact
+    if ok:
+        try:
+            with open(BENCH_OUT) as f:
+                full = json.load(f)
+        except (OSError, ValueError):
+            full = {}
+        full["multichip"] = payload
+        try:
+            with open(BENCH_OUT, "w") as f:
+                json.dump(full, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError as exc:
+            log(f"{BENCH_OUT} not written: {exc}")
+    print(json.dumps({
+        "metric": "multichip_scaling",
+        "ok": ok,
+        "scaling_efficiency": payload.get("scaling_efficiency"),
+        "boundary_fraction": payload.get("boundary_fraction"),
+        "full_results": os.path.basename(MULTICHIP_OUT),
+    }))
+    return 0 if ok else 1
+
+
 def overload_leg(seed: int = 11) -> dict:
     """Seeded overload evidence (guard layer): flood one replica at 4x
     its inbox byte budget in a single delivery round, record the
@@ -1086,6 +1338,15 @@ def smoke():
     # hazard _ensure_live_backend guards the full bench against)
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # the round-13 shard-registry leg needs >=2 devices: force a
+    # 2-way virtual CPU mesh unless the env already forces a count
+    # (backend init reads the flag once, so this must precede any
+    # device use)
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=2"
+        ).strip()
     import jax
 
     # env alone is too late when jax was already imported via the
@@ -1256,6 +1517,33 @@ def smoke():
                    for k in report["counters"]), \
             "smoke: converge.pallas mode counter missing"
         out["kernel_registry_ok"] = True
+        # the round-13 sharded-converge registry: a 2-way sharded
+        # converge of the smoke trace must be byte-identical to the
+        # single-chip result AND light up every shard.* counter the
+        # multichip regression gate reads (README "Multi-chip
+        # sharding" registry)
+        if len(jax.devices()) >= 2:
+            from crdt_tpu.models import replay as _rp
+            from crdt_tpu.ops import shard as _shard
+
+            dec_s = decode_stage(blobs)
+            cols_s, ds_s = column_stage(dec_s)
+            splan = _shard.stage(cols_s, n_shards=2)
+            assert splan is not None, "smoke: sharded staging refused"
+            res_sh = _shard.converge(splan)
+            w_s, v_s, o_s = _rp.gather(dec_s, ds_s, ("packed", res_sh))
+            cache_sh = _rp.materialize(dec_s, ds_s, w_s, v_s, o_s)
+            assert cache_sh == cache_dev, \
+                "smoke: sharded converge diverges from single-chip"
+            report = tracer.report()
+            for cname in ("shard.dispatches", "shard.boundary_bytes"):
+                assert report["counters"].get(cname, 0) > 0, \
+                    f"smoke: {cname} missing from shard registry"
+            assert "shard.shards" in report["gauges"], \
+                "smoke: shard.shards gauge missing"
+            assert "converge.wyllie_rounds" in report["gauges"], \
+                "smoke: converge.wyllie_rounds gauge missing"
+            out["shard_registry_ok"] = True
         out["tracer_spans_ok"] = True
     smoke_out = os.environ.get("BENCH_SMOKE_OUT")
     if smoke_out and report is not None:
@@ -2293,6 +2581,15 @@ if __name__ == "__main__":
 
     if len(_sys_main.argv) > 1 and _sys_main.argv[1] == "--fleet-mesh-child":
         fleet_mesh_child(_sys_main.argv[2:])
+    elif (
+        len(_sys_main.argv) > 1
+        and _sys_main.argv[1] == "--multichip-child"
+    ):
+        multichip_child(_sys_main.argv[2:])
+    elif "--multichip" in _sys_main.argv[1:]:
+        _sys_main.exit(multichip(
+            [a for a in _sys_main.argv[2:] if not a.startswith("-")]
+        ))
     elif (
         "--smoke" in _sys_main.argv[1:]
         or os.environ.get("BENCH_SMOKE") == "1"

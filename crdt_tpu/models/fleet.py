@@ -270,6 +270,7 @@ def load_trace(
     *,
     replicas_multiple: int = 1,
     ops_bucket: Optional[int] = None,
+    dec: Optional[Dict] = None,
 ) -> FleetTrace:
     """Decode one v1 update blob PER REPLICA into the fleet's sharded
     column layout.
@@ -285,7 +286,9 @@ def load_trace(
     ``replicas_multiple`` pads the replica count (empty all-invalid
     replicas) so R divides over a mesh of that many devices;
     ``ops_bucket`` pins N (padded per-replica op capacity) so several
-    traces can share one compiled step.
+    traces can share one compiled step. ``dec`` (optional) reuses a
+    caller-decoded union (``replay.decode(blobs)``) instead of
+    decoding it again — the sharded-route fallback's seam.
 
     Known cost: each blob is wire-decoded twice (once in the union
     for one consistent root/key interning, once alone for row
@@ -297,7 +300,8 @@ def load_trace(
     from crdt_tpu.ops.device import bucket_pow2
 
     blobs = list(blobs)
-    dec = replay.decode(blobs)
+    if dec is None:
+        dec = replay.decode(blobs)
     kcols = native.kernel_columns(dec)
     ds = native.ds_from_triples(dec["ds"])
     n = len(dec["client"])
@@ -762,7 +766,7 @@ def fleet_replay(
     n_devices: Optional[int] = None,
     trace: Optional[FleetTrace] = None,
     fleet: Optional["ReplicaFleet"] = None,
-    shard: str = "replicas",
+    shard: str = "auto",
 ):
     """One-shot PRODUCT entry: per-replica update blobs in, converged
     cache + compacted snapshot out, convergence computed as ONE
@@ -773,18 +777,76 @@ def fleet_replay(
 
     ``shard`` picks the mesh mapping:
 
-    - ``"replicas"`` (default) — the reference's full-mesh shape:
+    - ``"auto"`` (default) — ``"sharded"`` when the mesh spans more
+      than one device (and no prebuilt ``trace``/``fleet`` pins the
+      replicated layout), else ``"replicas"``.
+    - ``"sharded"`` — round 13, the scale-out mode: the union's
+      staged PACKED layout partitions by whole segments over the
+      mesh and converges in ONE ``shard_map`` program
+      (:mod:`crdt_tpu.ops.shard` — sortless per-shard converge,
+      boundary-only exchange of per-shard state vectors on the
+      narrow wire). Byte-identical to the single-chip cold replay.
+    - ``"replicas"`` — the reference's full-mesh shape:
       replica-sharded columns, all-gather fan-in, REPLICATED converge
       (every device ends the round holding the whole result).
-    - ``"segments"`` — the scaling mode: the union partitions by
-      segment, each device converges only its shard (per-device work
-      ~1/nd), and only the SV handshake crosses the mesh."""
+    - ``"segments"`` — the work-divided mode over the GENERAL
+      kernels: the union partitions by segment, each device converges
+      only its shard, and only the SV handshake crosses the mesh."""
     from crdt_tpu.models.replay import ReplayResult, compact, materialize
 
     if mesh is None and fleet is not None:
         mesh = fleet.mesh
     if mesh is None:
         mesh = make_mesh(n_devices)
+    auto = shard == "auto"
+    if auto:
+        # a caller-prebuilt trace/fleet pins the replicated layout
+        # (compiled-step reuse is that path's whole point)
+        shard = (
+            "sharded"
+            if mesh.devices.size > 1 and fleet is None and trace is None
+            else "replicas"
+        )
+    shared_dec = None
+    if shard == "sharded":
+        from crdt_tpu.models import replay as rp
+        from crdt_tpu.ops import shard as shard_ops
+
+        # the sharded route needs only the decoded UNION — never the
+        # replicated [R, N] fleet layout load_trace builds (interned
+        # client tables, row maps, padded columns), which is exactly
+        # the staging cost this mapping exists to skip
+        dec = trace.dec if trace is not None else rp.decode(blobs)
+        # an auto-resolved mapping honors the size gate BEFORE paying
+        # the staging pass (the explicit shard="sharded" ask always
+        # shards); below the threshold the per-shard fixed costs beat
+        # the division, so auto falls back to the replicated round
+        splan = None
+        if not auto or shard_ops.active_for(
+                len(dec["client"]), mesh.devices.size):
+            cols, ds = rp.stage(dec)
+            splan = shard_ops.stage(cols, n_shards=mesh.devices.size)
+        if splan is not None:
+            res = shard_ops.converge(splan)
+            win_rows, win_vis, seq_orders = rp.gather(
+                dec, ds, ("packed", res)
+            )
+            cache = materialize(dec, ds, win_rows, win_vis, seq_orders)
+            return ReplayResult(
+                cache=cache,
+                snapshot=compact(dec, ds),
+                n_ops=len(dec["client"]),
+                path="fleet-sharded",
+            )
+        # too small (auto) or the union cannot take the packed
+        # sharded route (bounds): fall through to the replicated
+        # mapping, reusing the decoded union; its trace needs R
+        # padded to the mesh
+        shard = "replicas"
+        if trace is None:
+            shared_dec = dec
+        elif trace.n_replicas % mesh.devices.size:
+            trace = None
     if shard == "segments":
         if trace is None:
             trace = load_trace(blobs, replicas_multiple=1)
@@ -794,7 +856,10 @@ def fleet_replay(
         win_rows, win_vis, seq_orders = gather_sharded(sharded, out)
     elif shard == "replicas":
         if trace is None:
-            trace = load_trace(blobs, replicas_multiple=mesh.devices.size)
+            trace = load_trace(
+                blobs, replicas_multiple=mesh.devices.size,
+                dec=shared_dec,
+            )
         if fleet is None:
             fleet = fleet_for_trace(trace, mesh=mesh)
         elif (

@@ -69,8 +69,23 @@ def converge(cols: Dict[str, np.ndarray], *,
     any order-preserving table yields the identical document. Callers
     that need a fleet-shared table to be the one actually used (e.g.
     to reuse a resident store across batches) should route through
-    :class:`crdt_tpu.ops.resident.ResidentColumns` directly."""
+    :class:`crdt_tpu.ops.resident.ResidentColumns` directly.
+
+    Multi-chip (round 13): when more than one device is visible and
+    the union is big enough (``CRDT_TPU_SHARDS`` /
+    ``CRDT_TPU_SHARD_MIN_ROWS``; :func:`crdt_tpu.ops.shard.
+    active_for`), the union partitions by whole segments over the
+    mesh and converges in ONE ``shard_map`` program — byte-identical
+    outputs (tests/test_shard.py), only the per-shard state vectors
+    cross chips."""
     from crdt_tpu.ops import packed
+
+    from crdt_tpu.ops import shard as shard_ops
+
+    if shard_ops.active_for(len(cols["client"])):
+        splan = shard_ops.stage(cols)
+        if splan is not None:
+            return ("packed", shard_ops.converge(splan))
 
     # eager row shipping: each staged row starts its async upload as
     # soon as its layout pass completes, hiding transfer behind the

@@ -359,24 +359,38 @@ def stream_replay(
 
     def stager():
         try:
+            from crdt_tpu.ops import shard as shard_ops
+
             for g, rows_g in enumerate(shard_rows):
                 sub = {k: v[rows_g] for k, v in cols.items()}
-                # eager per-row shipping is gated on THIS shard's row
-                # count: a sub-threshold shard's extra per-put fixed
-                # latencies outweigh any staging/transfer overlap
-                # (same rationale as replay.converge's gate). Uploads
-                # route through the xfer seam (byte accounting), and
-                # each shard's staged buffers are DONATED to its
-                # dispatch — the double-buffered queue then recycles
-                # the same device memory across stream shards instead
-                # of growing a fresh allocation per shard.
-                from crdt_tpu.ops.device import xfer_put
+                # multi-chip route (round 13): a big enough stream
+                # shard converges sharded over the device mesh in one
+                # shard_map program — the pipeline shape (async
+                # enqueue, fetch in the consumer) is unchanged
+                plan = None
+                eng = packed
+                if shard_ops.active_for(len(rows_g)):
+                    plan = ph.timed("pack", shard_ops.stage, sub)
+                    if plan is not None:
+                        eng = shard_ops
+                if plan is None:
+                    # eager per-row shipping is gated on THIS shard's
+                    # row count: a sub-threshold shard's extra per-put
+                    # fixed latencies outweigh any staging/transfer
+                    # overlap (same rationale as replay.converge's
+                    # gate). Uploads route through the xfer seam (byte
+                    # accounting), and each shard's staged buffers are
+                    # DONATED to its dispatch — the double-buffered
+                    # queue then recycles the same device memory
+                    # across stream shards instead of growing a fresh
+                    # allocation per shard.
+                    from crdt_tpu.ops.device import xfer_put
 
-                eager = len(rows_g) >= packed.EAGER_PUT_MIN_ROWS
-                plan = ph.timed(
-                    "pack", packed.stage, sub,
-                    put=xfer_put if eager else None,
-                )
+                    eager = len(rows_g) >= packed.EAGER_PUT_MIN_ROWS
+                    plan = ph.timed(
+                        "pack", packed.stage, sub,
+                        put=xfer_put if eager else None,
+                    )
                 if plan is None:
                     q.put(("unstageable", None, None))
                     return
@@ -384,8 +398,12 @@ def stream_replay(
                 # dispatch annotation nests inside, so device captures
                 # attribute each fused kernel to its pipeline shard
                 with device_annotation(f"crdt.stream.shard{g}"):
-                    handle = packed.converge_async(plan)  # enqueue, no block
-                q.put(("shard", (handle, time.perf_counter()), rows_g))
+                    handle = eng.converge_async(plan)  # enqueue, no block
+                q.put((
+                    "shard",
+                    ((eng, handle), time.perf_counter()),
+                    rows_g,
+                ))
             # compact is pure decode-side work: it runs here, inside
             # the window where the consumer is fetching/materializing
             snap_box["snap"] = ph.timed("compact", rp.compact, dec, ds)
@@ -414,9 +432,9 @@ def stream_replay(
             if kind == "unstageable":
                 unstageable = True
                 break
-            handle, t_enq = payload
+            (eng, handle), t_enq = payload
             t0 = time.perf_counter()
-            res = packed.converge_fetch(handle)  # the shard's ONE sync
+            res = eng.converge_fetch(handle)  # the shard's ONE sync
             t1 = time.perf_counter()
             ph.add("converge_wait", t1 - t0)
             # device-lane occupancy: this shard's span, net of any

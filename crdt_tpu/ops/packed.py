@@ -86,6 +86,34 @@ _RIGHT_WALK_CAP = 1024
 # and the product replay always measure the same pipeline shape.
 EAGER_PUT_MIN_ROWS = 1 << 19
 
+# chain-split width (round 13, the post-sort-diet ROUNDS lever): a
+# sequence segment that is a pure bundle of append CHAINS (every node
+# has at most one child — the shape own-chain appends produce) and
+# larger than this many rows is re-cut at staging into bounded-length
+# chain segments. Each piece's Wyllie doubling then runs
+# ceil(log2(width)) rounds instead of ceil(log2(longest list)), and
+# the pieces are synthetic segments the multi-chip sharder can spread
+# across chips. The seams are host-stitched: pieces are numbered in
+# exact document order (sibling order of the chain heads x piece
+# depth), so concatenating the per-piece streams IS the unsplit
+# stream — byte-identical, tests/test_shard.py. CRDT_TPU_CHAIN_SPLIT
+# overrides (0 disables).
+_CHAIN_SPLIT_ENV = "CRDT_TPU_CHAIN_SPLIT"
+CHAIN_SPLIT_DEFAULT = 1 << 13
+
+
+def chain_split_width() -> int:
+    """The staging chain-split width (0 = disabled)."""
+    import os
+
+    raw = os.environ.get(_CHAIN_SPLIT_ENV, "")
+    if raw == "":
+        return CHAIN_SPLIT_DEFAULT
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return CHAIN_SPLIT_DEFAULT
+
 
 # ---------------------------------------------------------------------------
 # narrow-section staging: the transfer diet (round 9), re-cut for the
@@ -199,21 +227,35 @@ def _widen_delta_ref(v):
     return jnp.where(v == 0, NULLI, idx - v)
 
 
-def _encode_sections(named, wide: bool):
+def _encode_sections(named, wide: bool, force=None):
     """[(name, int-array)] -> (flat staged array, enc tuple, widths).
     Narrow: each section becomes one int16 stretch via its preferred
     encoder, or two exact hi/lo stretches when the encoder refuses.
-    Wide: one int32 stretch per section."""
+    Wide: one int32 stretch per section.
+
+    ``force`` (a per-section kind tuple aligned with ``named``) pins
+    each section's encoding — the multi-chip sharder uses it so every
+    shard of one sharded plan shares ONE static encoding tuple (the
+    shard_map program is compiled once for all shards). Forcing
+    'hilo' on a section that would have narrowed is exact, never
+    wrong — it only costs the narrow win on that section."""
     if wide:
         flat = np.concatenate([a.astype(np.int32) for _, a in named])
         return flat, tuple("i32" for _ in named), {
             name: 32 for name, _ in named
         }
     parts, encs, widths = [], [], {}
-    for name, arr in named:
-        kind = _SECTION_NARROW[name]
-        enc = (_narrow_ident(arr) if kind == "i16"
-               else _narrow_delta_ref(arr))
+    for i, (name, arr) in enumerate(named):
+        kind = force[i] if force is not None else _SECTION_NARROW[name]
+        enc = None
+        if kind != "hilo":
+            enc = (_narrow_ident(arr) if kind == "i16"
+                   else _narrow_delta_ref(arr))
+            if enc is None and force is not None:
+                raise ValueError(
+                    f"forced narrow encoding {kind!r} refused for "
+                    f"section {name!r}"
+                )
         if enc is not None:
             parts.append(enc)
             encs.append(kind)
@@ -306,7 +348,16 @@ class PackedPlan(NamedTuple):
     seg_counts: Optional[np.ndarray] = None
                               # [S] sequence-row count per segment
                               # (host-known; rebuilds stream_seg
-                              # without fetching a segment column)
+                              # without fetching a segment column).
+                              # With chain-split active, a split
+                              # segment's pieces accumulate onto its
+                              # first synthetic id, so the assembler
+                              # sees the UNSPLIT boundaries
+    seam_rows: tuple = ()     # caller-space rows opening a chain-split
+                              # piece (depth > 0): the host-stitched
+                              # seams; counted as converge.chain_seams
+                              # at staging and shard.seam_rows per
+                              # sharded dispatch
 
 
 def _even_up(x: int) -> int:
@@ -468,19 +519,149 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
     return client_s, hard_reps, max_rank
 
 
+def _chain_split(seg, seq_rows, c_parent, client_s, rr_s, width):
+    """Re-cut oversized pure-chain-bundle sequence segments into
+    bounded-length synthetic chain segments (the round-13 ROUNDS
+    lever — see the CHAIN_SPLIT_DEFAULT block).
+
+    A segment qualifies when it is larger than ``width`` rows and
+    every member has AT MOST ONE child in the origin tree (the shape
+    own-chain appends produce: disjoint chains hanging off the
+    virtual root), carries no right origins (their conflict-scan
+    ranks / hard fallback must see the original segment), and has no
+    origin cycles. Its DFS stream is then exactly: chains in sibling
+    order of their heads (client asc, clock desc — the same key the
+    staged sibling tables use), each chain in depth order. The re-cut
+    preserves that order bit-for-bit: short chains pack greedily into
+    <=width synthetic segments in head order; a chain longer than
+    ``width`` takes consecutive EXCLUSIVE synthetic segments, one per
+    depth-``width`` piece, its seam rows' parent links cut (the host
+    stitch is the synthetic numbering itself — concatenating the
+    per-piece streams in synthetic-segment order IS the unsplit
+    stream).
+
+    Returns ``(seg2, c_parent2, seam_compact_rows, synth_orig)`` —
+    the renumbered dense segment column, the cut compact parents, the
+    compact indices of the seam rows, and the synthetic->original
+    dense-id table — or None when nothing splits.
+    """
+    n_seq = len(seq_rows)
+    if width <= 0 or n_seq == 0:
+        return None
+    seg_q = seg[seq_rows]
+    n_segs = int(seg.max()) + 1
+    sizes = np.bincount(seg_q, minlength=n_segs)
+    big = sizes > width
+    if not big.any():
+        return None
+    excl = np.zeros(n_segs, bool)
+    rb = rr_s[seq_rows] >= 0
+    if rb.any():
+        excl[np.unique(seg_q[rb])] = True
+    cc = np.bincount(c_parent[c_parent >= 0], minlength=n_seq)
+    branch = cc > 1
+    if branch.any():
+        excl[np.unique(seg_q[branch])] = True
+    # host pointer doubling over the compact parents: chain head +
+    # depth per row (vectorized; log2(n_seq) gathers)
+    idx = np.arange(n_seq, dtype=np.int64)
+    f = np.where(c_parent >= 0, c_parent, idx)
+    d = (c_parent >= 0).astype(np.int64)
+    for _ in range(max(1, (max(n_seq, 2) - 1).bit_length() + 1)):
+        d = d + d[f]
+        f = f[f]
+    # hostile cyclic origins never reach a root; exclude their
+    # segments (the unsplit path already has defined semantics there)
+    incyc = c_parent[f] >= 0
+    if incyc.any():
+        excl[np.unique(seg_q[incyc])] = True
+    cand = big & ~excl
+    if not cand.any():
+        return None
+    clen = np.bincount(f, minlength=n_seq)
+    cl_q = client_s[seq_rows]
+    posd = (int(seq_rows.max()) if n_seq else 0) - seq_rows
+    sub = np.zeros(n_seq, np.int64)
+    seam_mask = np.zeros(n_seq, bool)
+    for s in np.flatnonzero(cand).tolist():
+        rows_s = np.flatnonzero(seg_q == s)
+        heads = rows_s[c_parent[rows_s] < 0]
+        horder = np.lexsort((posd[heads], cl_q[heads]))
+        heads_o = heads[horder]
+        # first synthetic id of each head's bin/piece run, aligned
+        # with heads_o — all scratch here is SEGMENT-local (a full
+        # n_seq-wide table per candidate would turn staging
+        # quadratic on many-list documents)
+        head_base = np.zeros(len(heads_o), np.int64)
+        cur = 0
+        fill = 0
+        started = False
+        for i, h in enumerate(heads_o.tolist()):
+            length = int(clen[h])
+            if length > width:
+                if started:
+                    cur += 1
+                    fill = 0
+                    started = False
+                head_base[i] = cur
+                cur += -(-length // width)
+            else:
+                if started and fill + length > width:
+                    cur += 1
+                    fill = 0
+                head_base[i] = cur
+                fill += length
+                started = True
+        # row -> its head's position in heads_o, by binary search
+        hsort = np.argsort(heads_o, kind="stable")
+        hs = heads_o[hsort]
+        r_root = f[rows_s]
+        hpos = hsort[np.searchsorted(hs, r_root)]
+        r_long = clen[r_root] > width
+        sub_s = head_base[hpos] + np.where(
+            r_long, d[rows_s] // width, 0
+        )
+        seam = r_long & (d[rows_s] % width == 0) & (d[rows_s] > 0)
+        seam_mask[rows_s[seam]] = True
+        sub[rows_s] = sub_s
+    maxsub = int(sub.max()) + 1
+    sub_full = np.zeros(len(seg), np.int64)
+    sub_full[seq_rows] = sub
+    live = seg >= 0
+    key = seg * maxsub + sub_full
+    uniq_k, inv = np.unique(key[live], return_inverse=True)
+    seg2 = np.full(len(seg), -1, np.int64)
+    seg2[live] = inv
+    c_parent2 = np.array(c_parent, copy=True)
+    c_parent2[seam_mask] = -1
+    return seg2, c_parent2, np.flatnonzero(seam_mask), uniq_k // maxsub
+
+
 def stage(cols: Dict[str, np.ndarray],
-          put=None, wide: Optional[bool] = None) -> Optional[PackedPlan]:
+          put=None, wide: Optional[bool] = None,
+          _sections: Optional[list] = None) -> Optional[PackedPlan]:
     """Pack kernel columns into the single-transfer matrix (the
     tracer's ``pack`` span — one per staged union/shard).
 
-    See :func:`_stage` for the layout contract."""
+    See :func:`_stage` for the layout contract (``_sections`` is the
+    multi-chip sharder's layout-only seam)."""
     with get_tracer().span("pack"):
-        return _stage(cols, put, wide)
+        return _stage(cols, put, wide, _sections=_sections)
 
 
 def _stage(cols: Dict[str, np.ndarray],
-           put=None, wide: Optional[bool] = None) -> Optional[PackedPlan]:
+           put=None, wide: Optional[bool] = None,
+           _sections: Optional[list] = None) -> Optional[PackedPlan]:
     """Pack kernel columns into the single-transfer matrix.
+
+    ``_sections`` (internal; the multi-chip sharder's seam): when a
+    list is passed, the layout work runs in full but the encode step
+    is SKIPPED — the named section arrays are appended to the list in
+    ``SECTION_NAMES`` order and the returned plan has
+    ``mat=None/encs=()``. The sharder pads every shard's sections to
+    common bucket sizes and encodes them with one shared encoding
+    tuple (:func:`_encode_sections` ``force=``), so one shard_map
+    program serves all shards.
 
     Returns None when the batch exceeds the packed path's bounds
     (callers fall back to the general kernels): >=2^25 distinct
@@ -591,22 +772,6 @@ def _stage(cols: Dict[str, np.ndarray],
     max_map = int(seg_counts[map_seg].max()) if map_seg.any() else 1
     max_seq = int(seg_counts[~map_seg].max()) if (~map_seg).any() else 1
 
-    # size buckets early: eager shipping needs the padded widths now,
-    # and the int32-index guard must run BEFORE the first put — an
-    # infeasible plan must not queue dead transfers through the
-    # tunnel only to fall back and re-ship via the general path.
-    # (The round-11 63-bit sibling-key prechecks are GONE: the sort
-    # diet builds the sibling order on the host with np.lexsort over
-    # separate keys, so no packed device key exists to overflow.)
-    kpad = bucket_grid(n, floor=6)
-    Sb = bucket_grid(max(n_segs, 1), floor=6)
-    n_seq_early = int(np.count_nonzero(uniq_valid & (kid_s < 0)))
-    n_map_early = int(np.count_nonzero(uniq_valid & (kid_s >= 0)))
-    B = min(kpad, bucket_grid(max(n_seq_early, 1), floor=6))
-    M = min(kpad, bucket_grid(max(n_map_early, 1), floor=6))
-    if max(kpad, B, M) + Sb >= (1 << 31) - 1:
-        return None
-
     # origin rows by binary search over the sorted ids (leftmost match
     # is the kept representative of any duplicate run)
     okey = np.where(
@@ -634,6 +799,43 @@ def _stage(cols: Dict[str, np.ndarray],
         )
     else:
         c_parent = np.empty(0, np.int64)
+
+    # chain split (round 13): re-cut oversized pure-chain segments
+    # into bounded-length synthetic chain segments, dropping the
+    # Wyllie doubling bound from ceil(log2(longest list)) to
+    # ceil(log2(split width)) — and giving the multi-chip sharder
+    # independent pieces to spread across chips
+    synth_orig = None
+    seam_compact = np.empty(0, np.int64)
+    w_split = chain_split_width()
+    if w_split and n_seq:
+        rr_all = (np.asarray(cols["right_client"], np.int64)[order]
+                  if "right_client" in cols
+                  else np.full(n, -1, np.int64))
+        split = _chain_split(
+            seg, seq_rows, c_parent, client_s, rr_all, w_split
+        )
+        if split is not None and len(split[3]) < _SEQ_FLAG:
+            seg, c_parent, seam_compact, synth_orig = split
+            n_segs = len(synth_orig)
+            bc2 = np.bincount(seg[seq_rows], minlength=1)
+            max_seq = int(bc2.max()) if len(bc2) else 1
+
+    # size buckets early: eager shipping needs the padded widths now,
+    # and the int32-index guard must run BEFORE the first put — an
+    # infeasible plan must not queue dead transfers through the
+    # tunnel only to fall back and re-ship via the general path.
+    # (The round-11 63-bit sibling-key prechecks are GONE: the sort
+    # diet builds the sibling order on the host with np.lexsort over
+    # separate keys, so no packed device key exists to overflow.)
+    kpad = bucket_grid(n, floor=6)
+    Sb = bucket_grid(max(n_segs, 1), floor=6)
+    n_seq_early = int(np.count_nonzero(uniq_valid & (kid_s < 0)))
+    n_map_early = int(np.count_nonzero(uniq_valid & (kid_s >= 0)))
+    B = min(kpad, bucket_grid(max(n_seq_early, 1), floor=6))
+    M = min(kpad, bucket_grid(max(n_map_early, 1), floor=6))
+    if max(kpad, B, M) + Sb >= (1 << 31) - 1:
+        return None
 
     # group 0 sections (complete now): segment ids + doc-order
     # offsets + compact parents. The offsets are the scatter targets:
@@ -756,11 +958,40 @@ def _stage(cols: Dict[str, np.ndarray],
         record_staged_widths(w_all, shipped, (3 * kpad + 2 * B) * 4)
     else:
         named = g0 + g1 + g2
-        mat, encs, w_all = _encode_sections(named, wide)
+        if _sections is not None:
+            # layout-only: the sharder pads + encodes across shards
+            _sections.extend(named)
+            mat, encs, w_all = None, (), {}
+        else:
+            mat, encs, w_all = _encode_sections(named, wide)
         dev = ()
         # NOT recorded here: a matrix plan may never cross the link
         # (converge_host, make_repeat_dispatch) — the width/savings
         # record fires at the plan's actual upload instead
+
+    # assembly counts: the host rebuilds the stream's per-segment
+    # boundaries from these. With chain-split active the counts of a
+    # split segment's pieces accumulate onto its FIRST synthetic id —
+    # pieces are consecutive in both numbering and stream order, so
+    # the merged run is exactly the unsplit segment's run and the
+    # assembler never sees a seam
+    counts_asm = counts
+    if synth_orig is not None:
+        counts_asm = np.zeros(Sb, np.int64)
+        _, first_idx, inv_o = np.unique(
+            synth_orig, return_index=True, return_inverse=True
+        )
+        np.add.at(counts_asm, first_idx[inv_o], counts[:n_segs])
+
+    rank_rounds_v = _even_up((max_seq + 2).bit_length() + 1)
+    tracer = get_tracer()
+    if tracer.enabled:
+        # the doubling-rounds bound this plan's dispatch will run —
+        # the chain-split lever's regression evidence (lower = fewer
+        # random-gather rounds on the device)
+        tracer.gauge("converge.wyllie_rounds", rank_rounds_v)
+        if len(seam_compact):
+            tracer.count("converge.chain_seams", len(seam_compact))
 
     map_back = np.full(M, NULLI, np.int32)
     if n_map:
@@ -776,14 +1007,17 @@ def _stage(cols: Dict[str, np.ndarray],
         map_bucket=M,
         order=order,
         clients=uniq,
-        rank_rounds=_even_up((max_seq + 2).bit_length() + 1),
+        rank_rounds=rank_rounds_v,
         map_rounds=_even_up((max_map + 2).bit_length() + 1),
         hard_rows=tuple(hard_rep_rows),
         staged_widths=tuple(sorted(w_all.items())),
         encs=encs,
         map_back=map_back,
         seq_back=seq_back,
-        seg_counts=counts,
+        seg_counts=counts_asm,
+        seam_rows=tuple(
+            np.asarray(order)[seq_rows[seam_compact]].tolist()
+        ) if len(seam_compact) else (),
     )
 
 

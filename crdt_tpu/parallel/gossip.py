@@ -377,6 +377,73 @@ def make_segment_sharded_step(mesh: Mesh, num_segments: int,
     return jax.jit(step, donate_argnums=(0,))
 
 
+def make_packed_shard_step(mesh: Mesh, *, num_segments: int,
+                           seq_bucket: int, map_bucket: int,
+                           rank_rounds: int, map_rounds: int,
+                           encs: tuple, mode: str, sv_len: int,
+                           sv_mode: str):
+    """ONE shard_map program carrying the whole multi-chip sharded
+    converge (round 13; staged by :mod:`crdt_tpu.ops.shard`): every
+    device widens ITS shard's narrow-encoded section block and runs
+    the full sortless fused converge
+    (:func:`crdt_tpu.ops.packed._converge_packed_body` — argmax scan,
+    pointer doubling, document-order scatter) on its own rows, with
+    NO collective inside the converge: segments never cross shards,
+    so the independent doubling loops overlap across chips.
+
+    The only inter-chip traffic is the BOUNDARY EXCHANGE: each shard
+    contributes one narrow wire row — its partial state vector,
+    narrow-encoded with the round-9 codec as the inter-chip wire
+    format (``sv_mode``: ``'i16'`` one identity int16 stretch when
+    every clock fits, ``'hilo'`` two exact int16 stretches below
+    2^31, ``'wide'`` int64) — all-gathered over the mesh axis and
+    max-merged into the swarm state vector on device.
+
+    Inputs: the [K, L] staged section block (sharded over the axis,
+    DONATED — one sharded plan, one dispatch) and the [K, W] wire
+    block (sharded). Outputs: the per-shard packed converge results
+    [K, S+B] (sharded) and the merged global SV [sv_len] int64
+    (replicated)."""
+    axis = mesh.axis_names[0]
+    from crdt_tpu.ops import packed as pk
+
+    sizes = pk._section_sizes(num_segments, seq_bucket, map_bucket)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None)),
+        out_specs=(P(axis, None), P()),
+        # the replicated SV derives only from all-gathered wires, but
+        # the vma checker cannot prove that through the converge
+        # body's while_loops (pointer doubling); the specs are correct
+        check_vma=False,
+    )
+    def step(flat_blk, wire_blk):
+        secs = pk._decode_sections(flat_blk[0], sizes, encs)
+        with jax.named_scope("crdt.shard.converge"):
+            out = pk._converge_packed_body(
+                *secs, num_segments=num_segments,
+                seq_bucket=seq_bucket, map_bucket=map_bucket,
+                rank_rounds=rank_rounds, map_rounds=map_rounds,
+                mode=mode,
+            )
+        with jax.named_scope("crdt.shard.boundary_exchange"):
+            wires = jax.lax.all_gather(wire_blk, axis)
+            wires = wires.reshape(-1, wire_blk.shape[-1])
+            if sv_mode == "hilo":
+                hi = wires[:, :sv_len].astype(jnp.int64)
+                lo = wires[:, sv_len:2 * sv_len].astype(jnp.int64)
+                svs = (hi << 16) | ((lo + 0x8000) & 0xFFFF)
+            else:  # 'i16' identity / 'wide' int64: plain widen
+                svs = wires[:, :sv_len].astype(jnp.int64)
+            gsv = svs.max(axis=0)
+        return out[None, :], gsv
+
+    # the staged section block is donated — see make_gossip_step
+    return jax.jit(step, donate_argnums=(0,))
+
+
 def segment_out_sizes(blk: int, R: int, N_d: int, S: int):
     """Static (name, size) layout of ONE device's block in the
     segment-sharded step's packed output."""

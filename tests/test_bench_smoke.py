@@ -99,6 +99,16 @@ def test_bench_smoke_mode(tmp_path):
                for k in report["counters"]), \
         "converge.pallas mode counter missing from tracer report"
 
+    # the round-13 sharded-converge registry: the smoke runs a 2-way
+    # sharded converge on its forced 2-device mesh, byte-identical to
+    # the single-chip leg, and the shard.* evidence the multichip
+    # regression gate reads must be live
+    assert out.get("shard_registry_ok") is True
+    for cname in ("shard.dispatches", "shard.boundary_bytes"):
+        assert report["counters"].get(cname, 0) > 0, cname
+    assert "shard.shards" in report["gauges"]
+    assert "converge.wyllie_rounds" in report["gauges"]
+
     # the guard-layer registry (README "Overload & failure policy"):
     # (kernel_ablation_leg is pinned in-process below — the smoke
     # subprocess stays on its <30s budget)

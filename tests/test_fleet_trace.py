@@ -141,9 +141,15 @@ class TestFleetReplay:
             want = oracle_cache(blobs)
             host = replay_trace(blobs, route="host")
             assert host.cache == want
+            # auto on a tiny trace: below CRDT_TPU_SHARD_MIN_ROWS the
+            # mapping falls back to the replicated round
             out = fleet_replay(blobs, mesh=mesh8)
             assert out.path == "fleet"
             assert out.cache == want, f"seed {seed} diverges"
+            # the explicit sharded mapping always shards — and agrees
+            sh = fleet_replay(blobs, mesh=mesh8, shard="sharded")
+            assert sh.path == "fleet-sharded"
+            assert sh.cache == want, f"seed {seed} sharded diverges"
 
     def test_overlapping_blobs_idempotent(self, mesh8):
         """Redelivered ops (one replica's blob carried twice, plus a
@@ -173,7 +179,20 @@ class TestFleetReplay:
         """The product seam: replay_trace(route='fleet')."""
         blobs = build_round_blobs(4, 5, seed=5)
         out = replay_trace(blobs, route="fleet")
+        # tiny trace: auto falls back to the replicated mapping
         assert out.path == "fleet"
+        assert out.cache == oracle_cache(blobs)
+
+    def test_route_fleet_auto_shards_past_gate(self, mesh8,
+                                               monkeypatch):
+        """With the size gate cleared, the 8-device mesh resolves the
+        auto mapping to the round-13 sharded converge."""
+        from crdt_tpu.ops import shard as shard_ops
+
+        monkeypatch.setenv(shard_ops.MIN_ROWS_ENV, "1")
+        blobs = build_round_blobs(4, 5, seed=5)
+        out = replay_trace(blobs, route="fleet")
+        assert out.path == "fleet-sharded"
         assert out.cache == oracle_cache(blobs)
 
     def test_trace_reuse_shares_compiled_step(self, mesh8):

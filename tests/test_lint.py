@@ -267,6 +267,28 @@ def f(tracer):
     )
 
 
+def test_registry_covers_shard_counters():
+    """Round 13 (multi-chip sharding) added the `shard.*` namespace
+    and the chain-split staging gauges. Both directions must hold:
+    the emitted names stay documented in the README registry, and an
+    UNdocumented shard name still fires CL201."""
+    reg = _real_registry()
+    for name in ("shard.dispatches", "shard.boundary_bytes",
+                 "shard.seam_rows", "shard.shards",
+                 "converge.wyllie_rounds", "converge.chain_seams"):
+        assert name in reg.metrics, (
+            f"{name} dropped out of the README registry (round-13 "
+            f"multi-chip contract)"
+        )
+    result = _lint_snippet("crdt_tpu/ops/x.py", '''
+def f(tracer):
+    tracer.count("shard.bogus_exchange", 1)
+''', _reg("shard.dispatches"))
+    assert any(f.code == "CL201" for f in result.findings), (
+        "an undocumented shard.* metric no longer fires CL201"
+    )
+
+
 def test_registry_drift_fixed_event_kinds():
     """First-run CL201 drift on flight-recorder event kinds from the
     guard/storage/device adversaries."""

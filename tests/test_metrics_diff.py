@@ -306,3 +306,53 @@ def test_lint_findings_gated_lower_is_better():
     one["lint"]["findings"] = 1
     rows, regressed = compare(zero, one)
     assert "lint.findings" in regressed
+
+
+def test_multichip_section_gated():
+    """Round 13: the multichip leg's scaling efficiency is
+    higher-is-better per device count; boundary bytes/fraction and
+    the shard/wyllie tracer evidence are lower-is-better counts the
+    seconds noise floor must never mute."""
+    old = copy.deepcopy(OLD)
+    old["multichip"] = {
+        "scaling_efficiency": {"2": 1.6, "8": 2.4},
+        "boundary_bytes": 400_000,
+        "boundary_fraction": 0.05,
+    }
+    old["tracer"]["counters"]["shard.boundary_bytes"] = 400_000
+    old["tracer"]["gauges"] = {"converge.wyllie_rounds": 14}
+    new = copy.deepcopy(old)
+    rows, regressed = compare(old, new)
+    names = {r["metric"] for r in rows}
+    assert "multichip.scaling_efficiency.2" in names
+    assert "multichip.boundary_bytes" in names
+    assert "tracer.shard.boundary_bytes" in names
+    assert "tracer.converge.wyllie_rounds" in names
+    assert regressed == []
+
+    # scaling efficiency eroding fails (higher is better)...
+    new["multichip"]["scaling_efficiency"]["2"] = 1.0
+    _, regressed = compare(old, new, threshold=0.2)
+    assert "multichip.scaling_efficiency.2" in regressed
+    # ...improving never does
+    new2 = copy.deepcopy(old)
+    new2["multichip"]["scaling_efficiency"]["2"] = 3.0
+    _, regressed = compare(old, new2, threshold=0.2)
+    assert regressed == []
+
+    # boundary bytes growing past the threshold fails — counts, so
+    # the seconds noise floor cannot mute them
+    new3 = copy.deepcopy(old)
+    new3["multichip"]["boundary_bytes"] = 900_000
+    new3["multichip"]["boundary_fraction"] = 0.12
+    new3["tracer"]["counters"]["shard.boundary_bytes"] = 900_000
+    rows, regressed = compare(old, new3, threshold=0.2)
+    assert "multichip.boundary_bytes" in regressed
+    assert "multichip.boundary_fraction" in regressed
+    assert "tracer.shard.boundary_bytes" in regressed
+
+    # a chain-split regression (rounds bound growing) fails too
+    new4 = copy.deepcopy(old)
+    new4["tracer"]["gauges"]["converge.wyllie_rounds"] = 18
+    _, regressed = compare(old, new4, threshold=0.2)
+    assert "tracer.converge.wyllie_rounds" in regressed

@@ -1,0 +1,390 @@
+"""Differential suite for the round-13 multi-chip sharded converge.
+
+The sharded route (:mod:`crdt_tpu.ops.shard` — whole-segment
+partition, ONE shard_map program over the 8-device virtual CPU mesh,
+boundary-only exchange) must be BYTE-identical to the single-chip
+packed oracle on every leg: caches, snapshots, and the exchanged
+state vectors — at 2/4/8-way, across one-shot/stream/fleet routes,
+including boundary-straddling segments, empty shards, delete-only
+updates, right origins, and the chain-split seam at every width. The
+chain-split ROUNDS reduction is pinned via the
+``converge.wyllie_rounds`` gauge on a deep-chain trace.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from crdt_tpu.codec import v1
+from crdt_tpu.core.engine import Engine
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.models import replay as rp
+from crdt_tpu.obs import Tracer, get_tracer, set_tracer
+from crdt_tpu.ops import packed
+from crdt_tpu.ops import shard
+
+
+@pytest.fixture(autouse=True)
+def _eight_devices():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_sharding(monkeypatch):
+    # each test opts in explicitly; the ambient env must not flip the
+    # oracle legs onto the route under test
+    monkeypatch.delenv(shard.SHARD_ENV, raising=False)
+    monkeypatch.delenv(shard.MIN_ROWS_ENV, raising=False)
+    monkeypatch.delenv(packed._CHAIN_SPLIT_ENV, raising=False)
+
+
+def chains_trace(n_chains=12, chain_len=120, n_maps=2, deletes=True,
+                 rights=False, seed=0):
+    """Per-replica blobs: own-chain appends over several lists (the
+    chain-split shape), map sets, optional tombstones and right
+    origins (mid-inserts)."""
+    rng = np.random.default_rng(seed)
+    blobs = []
+    for c in range(n_chains):
+        client = c + 1
+        recs = []
+        prev = None
+        chain = []
+        for k in range(chain_len):
+            if rights and chain and k % 17 == 5:
+                j = int(rng.integers(0, len(chain)))
+                recs.append(ItemRecord(
+                    client=client, clock=k, parent_root=f"l{c % 3}",
+                    origin=chain[j - 1] if j > 0 else None,
+                    right=chain[j], content=k,
+                ))
+                chain.insert(j, (client, k))
+            else:
+                recs.append(ItemRecord(
+                    client=client, clock=k, parent_root=f"l{c % 3}",
+                    origin=(client, prev) if prev is not None else None,
+                    content=int(c * chain_len + k),
+                ))
+                chain.append((client, k))
+            prev = k
+        for k in range(n_maps * 4):
+            recs.append(ItemRecord(
+                client=client, clock=chain_len + k,
+                parent_root=f"m{k % n_maps}", key=f"k{k % 7}",
+                content=k,
+            ))
+        ds = DeleteSet()
+        if deletes:
+            for k in rng.choice(chain_len, size=chain_len // 15,
+                                replace=False):
+                ds.add(client, int(k))
+        blobs.append(v1.encode_update(recs, ds))
+    return blobs
+
+
+def stage_all(blobs):
+    dec = rp.decode(blobs)
+    cols, ds = rp.stage(dec)
+    return dec, cols, ds
+
+
+def run_single(dec, cols, ds):
+    plan = packed.stage(cols)
+    assert plan is not None
+    res = packed.converge(plan)
+    w, v, o = rp.gather(dec, ds, ("packed", res))
+    return rp.materialize(dec, ds, w, v, o)
+
+
+def run_sharded(dec, cols, ds, K):
+    splan = shard.stage(cols, n_shards=K)
+    assert splan is not None, f"sharded staging refused at K={K}"
+    res = shard.converge(splan)
+    w, v, o = rp.gather(dec, ds, ("packed", res))
+    return rp.materialize(dec, ds, w, v, o), res
+
+
+def expected_sv(cols, res):
+    """The boundary exchange's merged SV vs the host ground truth."""
+    cl = np.asarray(cols["client"])[np.asarray(cols["valid"], bool)]
+    ck = np.asarray(cols["clock"])[np.asarray(cols["valid"], bool)]
+    for i, c in enumerate(res.sv_clients):
+        assert res.global_sv[i] == ck[cl == c].max() + 1, int(c)
+
+
+class TestShardedDifferential:
+    def test_matches_single_chip_2_4_8_way(self):
+        blobs = chains_trace(seed=1)
+        dec, cols, ds = stage_all(blobs)
+        want = run_single(dec, cols, ds)
+        for K in (2, 4, 8):
+            got, res = run_sharded(dec, cols, ds, K)
+            assert got == want, f"K={K} diverges"
+            expected_sv(cols, res)
+
+    def test_snapshot_and_replay_route_equality(self, monkeypatch):
+        """The product seam: replay_trace with the env knobs flipped
+        takes the sharded route and stays byte-identical, snapshot
+        included."""
+        blobs = chains_trace(n_chains=8, chain_len=80, seed=2)
+        base = rp.replay_trace(blobs)
+        monkeypatch.setenv(shard.SHARD_ENV, "4")
+        monkeypatch.setenv(shard.MIN_ROWS_ENV, "1")
+        sharded = rp.replay_trace(blobs)
+        assert sharded.cache == base.cache
+        assert sharded.snapshot == base.snapshot
+
+    def test_boundary_straddling_segments(self):
+        """One giant segment next to many small ones: the greedy
+        partition puts the giant alone and packs the rest — every
+        segment stays whole and the result is identical."""
+        recs = []
+        prev = None
+        for k in range(900):  # the giant: one list, one chain
+            recs.append(ItemRecord(
+                client=1, clock=k, parent_root="big",
+                origin=(1, prev) if prev is not None else None,
+                content=k,
+            ))
+            prev = k
+        for k in range(120):  # 40 tiny segments
+            recs.append(ItemRecord(
+                client=1, clock=900 + k, parent_root=f"s{k % 40}",
+                content=k,
+            ))
+        blobs = [v1.encode_update(recs, DeleteSet())]
+        dec, cols, ds = stage_all(blobs)
+        want = run_single(dec, cols, ds)
+        for K in (2, 8):
+            got, _ = run_sharded(dec, cols, ds, K)
+            assert got == want, f"K={K} diverges"
+
+    def test_empty_shards(self):
+        """Fewer segments than shards: the empty shards run the fused
+        body on pure padding and contribute nothing."""
+        recs = [
+            ItemRecord(client=1, clock=k, parent_root="only",
+                       origin=(1, k - 1) if k else None, content=k)
+            for k in range(64)
+        ]
+        recs += [ItemRecord(client=2, clock=k, parent_root="m",
+                            key=f"k{k % 3}", content=k)
+                 for k in range(16)]
+        blobs = [v1.encode_update(recs, DeleteSet())]
+        dec, cols, ds = stage_all(blobs)
+        want = run_single(dec, cols, ds)
+        got, res = run_sharded(dec, cols, ds, 8)
+        assert got == want
+        expected_sv(cols, res)
+
+    def test_delete_only_updates(self, monkeypatch):
+        """A delete-only tail blob (no item rows of its own) through
+        the sharded route; and a FULLY delete-only union falls back
+        to the single-chip path without diverging."""
+        blobs = chains_trace(n_chains=4, chain_len=40, seed=3)
+        ds_only = DeleteSet()
+        for k in range(5):
+            ds_only.add(1, k)
+        blobs.append(v1.encode_update([], ds_only))
+        dec, cols, ds = stage_all(blobs)
+        want = run_single(dec, cols, ds)
+        got, _ = run_sharded(dec, cols, ds, 4)
+        assert got == want
+        # fully delete-only: no valid rows -> stage refuses, the
+        # route falls back (replay path equality)
+        only = [v1.encode_update([], ds_only)]
+        dec2, cols2, _ = stage_all(only)
+        assert shard.stage(cols2, n_shards=4) is None
+        base = rp.replay_trace(only)
+        monkeypatch.setenv(shard.SHARD_ENV, "4")
+        monkeypatch.setenv(shard.MIN_ROWS_ENV, "1")
+        assert rp.replay_trace(only).cache == base.cache
+
+    def test_right_origins_exact(self):
+        """Mid-inserts with right origins: the sharded route must
+        take the identical exact host detours (hard rows are
+        shard-local, mapped back to union space)."""
+        blobs = chains_trace(n_chains=6, chain_len=60, rights=True,
+                             seed=4)
+        dec, cols, ds = stage_all(blobs)
+        want = run_single(dec, cols, ds)
+        for K in (2, 8):
+            got, _ = run_sharded(dec, cols, ds, K)
+            assert got == want, f"K={K} diverges"
+
+    def test_engine_oracle(self):
+        """Ground truth: the sharded converge reproduces the scalar
+        engine's document, not merely the packed path's."""
+        blobs = chains_trace(n_chains=5, chain_len=30, seed=5)
+        eng = Engine(999)
+        for b in blobs:
+            v1.apply_update(eng, b)
+        dec, cols, ds = stage_all(blobs)
+        got, _ = run_sharded(dec, cols, ds, 4)
+        assert got == eng.to_json()
+
+
+class TestChainSplit:
+    def test_seam_at_every_width(self, monkeypatch):
+        """The host-stitched seams are exact at every split width,
+        sharded and single-chip alike."""
+        blobs = chains_trace(n_chains=3, chain_len=257, seed=6)
+        dec, cols, ds = stage_all(blobs)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+        want = run_single(dec, cols, ds)
+        for width in (1, 2, 63, 64, 256, 257):
+            monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, str(width))
+            got = run_single(dec, cols, ds)
+            assert got == want, f"single-chip width={width}"
+            got_sh, _ = run_sharded(dec, cols, ds, 4)
+            assert got_sh == want, f"sharded width={width}"
+
+    def test_rounds_reduction_pinned(self, monkeypatch):
+        """The lever itself: on a deep-chain trace the chain split
+        must LOWER the staged doubling-rounds bound (the
+        converge.wyllie_rounds gauge) and cut real seams."""
+        blobs = chains_trace(n_chains=2, chain_len=600, n_maps=1,
+                             deletes=False, seed=7)
+        dec, cols, ds = stage_all(blobs)
+        prev = get_tracer()
+        tracer = set_tracer(Tracer(enabled=True))
+        try:
+            monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+            assert packed.stage(cols) is not None
+            rounds_before = tracer.report()["gauges"][
+                "converge.wyllie_rounds"]
+            monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "64")
+            plan = packed.stage(cols)
+            rounds_after = tracer.report()["gauges"][
+                "converge.wyllie_rounds"]
+            seams = tracer.counters().get("converge.chain_seams", 0)
+        finally:
+            set_tracer(prev)
+        assert rounds_after < rounds_before, (rounds_before,
+                                              rounds_after)
+        assert seams > 0
+        assert len(plan.seam_rows) == seams
+        # and the split plan still converges byte-identically
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+        want = run_single(dec, cols, ds)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "64")
+        assert run_single(dec, cols, ds) == want
+
+    def test_split_skips_branching_and_right_segments(self,
+                                                      monkeypatch):
+        """Shapes the split must refuse: branching trees (a node with
+        two children) and right-bearing segments stay unsplit — and
+        stay exact."""
+        recs = []
+        for k in range(200):  # wide star: every op anchors the root op
+            recs.append(ItemRecord(
+                client=1, clock=k, parent_root="star",
+                origin=(1, 0) if k else None, content=k,
+            ))
+        blobs = [v1.encode_update(recs, DeleteSet())]
+        dec, cols, ds = stage_all(blobs)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "16")
+        plan = packed.stage(cols)
+        assert plan.seam_rows == ()  # refused: branching
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+        assert run_single(dec, cols, ds) is not None
+
+
+class TestRoutes:
+    def test_stream_route_sharded(self, monkeypatch):
+        """The scale replay's executor: stream shards converge through
+        the mesh when >1 device is visible, byte-identical."""
+        from crdt_tpu.models.streaming import stream_replay
+
+        blobs = chains_trace(n_chains=10, chain_len=100, seed=8)
+        base = stream_replay(blobs, chunk_blobs=3, max_shards=3,
+                             min_shard_rows=1)
+        monkeypatch.setenv(shard.SHARD_ENV, "4")
+        monkeypatch.setenv(shard.MIN_ROWS_ENV, "1")
+        got = stream_replay(blobs, chunk_blobs=3, max_shards=3,
+                            min_shard_rows=1)
+        assert got.cache == base.cache
+        assert got.snapshot == base.snapshot
+        assert got.path == "stream"
+
+    def test_fleet_route_sharded(self):
+        """fleet_replay's sharded mapping vs the replicated mapping
+        and the scalar engine (cache + snapshot + SV)."""
+        from crdt_tpu.models.fleet import fleet_replay
+        from crdt_tpu.parallel.gossip import make_mesh
+
+        blobs = chains_trace(n_chains=8, chain_len=24, seed=9)
+        mesh = make_mesh(8)
+        sharded = fleet_replay(blobs, mesh=mesh, shard="sharded")
+        replicated = fleet_replay(blobs, mesh=mesh, shard="replicas")
+        assert sharded.path == "fleet-sharded"
+        assert sharded.cache == replicated.cache
+        assert sharded.snapshot == replicated.snapshot
+        eng = Engine(999)
+        for b in blobs:
+            v1.apply_update(eng, b)
+        assert sharded.cache == eng.to_json()
+
+    def test_shard_counters_live(self):
+        """The registry the multichip gate reads: dispatches,
+        boundary bytes, shards gauge — live on a sharded converge."""
+        blobs = chains_trace(n_chains=4, chain_len=40, seed=10)
+        dec, cols, ds = stage_all(blobs)
+        prev = get_tracer()
+        tracer = set_tracer(Tracer(enabled=True))
+        try:
+            run_sharded(dec, cols, ds, 2)
+            counters = tracer.counters()
+            gauges = tracer.report()["gauges"]
+        finally:
+            set_tracer(prev)
+        assert counters.get("shard.dispatches") == 1
+        assert counters.get("shard.boundary_bytes", 0) > 0
+        assert gauges.get("shard.shards") == 2
+        assert "converge.wyllie_rounds" in gauges
+
+    def test_duplicate_ids_across_segments_dedup_globally(self):
+        """Equal-id rows under DIFFERENT parents land in different
+        shards, where no shard-local dedup can see the pair — the
+        partition must drop duplicates globally (first caller row
+        wins, packed._stage's rule) or the sharded route diverges
+        from the single-chip oracle on crafted input."""
+        n = 64
+        cols = {
+            "client": np.full(n, 7, np.int64),
+            "clock": np.arange(n, dtype=np.int64) % (n // 2),
+            "parent_is_root": np.ones(n, bool),
+            # second half duplicates the first half's ids under a
+            # DIFFERENT root -> different segment -> different shard
+            "parent_a": np.r_[np.zeros(n // 2, np.int64),
+                              np.ones(n // 2, np.int64)],
+            "parent_b": np.full(n, -1, np.int64),
+            "key_id": np.full(n, -1, np.int64),
+            "origin_client": np.full(n, -1, np.int64),
+            "origin_clock": np.full(n, -1, np.int64),
+            "valid": np.ones(n, bool),
+        }
+        plan = packed.stage(cols)
+        want = packed.converge(plan)
+        splan = shard.stage(cols, n_shards=2)
+        got = shard.converge(splan)
+        keep = np.sort(want.stream_row[want.stream_row >= 0])
+        keep_sh = np.sort(got.stream_row[got.stream_row >= 0])
+        assert np.array_equal(keep, keep_sh), (
+            "duplicate ids survived the shard partition"
+        )
+
+    def test_boundary_audit_fails_loudly(self):
+        """A corrupted boundary wire must raise, never propagate a
+        silently wrong swarm SV."""
+        blobs = chains_trace(n_chains=4, chain_len=30, seed=11)
+        dec, cols, ds = stage_all(blobs)
+        splan = shard.stage(cols, n_shards=2)
+        bad_wire = np.array(splan.wire, copy=True)
+        bad_wire[0, 0] += 1  # clock corrupted on the wire
+        bad = splan._replace(wire=bad_wire)
+        with pytest.raises(RuntimeError, match="boundary exchange"):
+            shard.converge(bad)

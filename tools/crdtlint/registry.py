@@ -31,7 +31,7 @@ NAMESPACES = frozenset({
     "xfer", "guard", "persist", "engine", "device", "replica",
     "router", "sentinel", "fleet", "gossip", "update", "sync",
     "probe", "ae", "beacon", "dial", "relay", "envelope", "fault",
-    "overload", "lint", "converge",
+    "overload", "lint", "converge", "shard",
 })
 
 # backticked dotted names that share a namespace but are NOT metrics
@@ -44,6 +44,10 @@ NON_METRICS = frozenset({
     "overload.shed_bytes",
     "lint.findings",              # bench artifact key (this tool's own
     #                               gated metric), not a tracer name
+    "shard.mat",                  # xfer_put call-site labels, not
+    "shard.wire",                 # tracer names (they surface only as
+    "shard.out",                  # {path=...} label values on the
+    "shard.sv",                   # xfer byte counters)
 })
 
 # span names without a dot, pinned only by HOT_PATH_SPANS

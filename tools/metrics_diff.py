@@ -75,6 +75,12 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     # the noise floor never mutes it.
     (("lint", "findings"), False),
     (("lint", "baselined"), False),
+    # the multi-chip sharded converge (round 13, bench --multichip):
+    # the boundary exchange must stay a small fraction of the staged
+    # upload (bytes/fraction lower-is-better, counts so the noise
+    # floor never mutes them)
+    (("multichip", "boundary_bytes"), False),
+    (("multichip", "boundary_fraction"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 
@@ -156,6 +162,15 @@ def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
         yield "kernel_ablation.sort_map_speedup", \
             float(ao["sort_map_speedup"]), \
             float(an["sort_map_speedup"]), True, False
+    # multi-chip scaling (round 13): per-device-count converge
+    # speedup over the single-chip run — higher is better, never
+    # muted by the seconds noise floor (it is a ratio)
+    mo = (old.get("multichip") or {}).get("scaling_efficiency") or {}
+    mn = (new.get("multichip") or {}).get("scaling_efficiency") or {}
+    for nd in sorted(set(mo) & set(mn), key=str):
+        if _both_numbers(mo[nd], mn[nd]):
+            yield f"multichip.scaling_efficiency.{nd}", \
+                float(mo[nd]), float(mn[nd]), True, False
     spans_old = (old.get("tracer") or {}).get("spans", {})
     spans_new = (new.get("tracer") or {}).get("spans", {})
     for name in sorted(set(spans_old) & set(spans_new)):
@@ -193,6 +208,17 @@ def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
                 # MORE is better
                 yield f"tracer.{name}", float(xo[name]), \
                     float(xn[name]), name.endswith("_saved"), False
+    # the sharded converge's boundary traffic and the staging
+    # doubling-rounds bound (round 13): both lower-is-better, counts
+    # (never muted by the seconds floor). shard.dispatches/shards are
+    # deliberately ungated — how often the sharded route ran is a
+    # workload-mix fact, not a regression signal.
+    for section, name in (("counters", "shard.boundary_bytes"),
+                          ("gauges", "converge.wyllie_rounds")):
+        a = (old.get("tracer") or {}).get(section, {}).get(name)
+        b = (new.get("tracer") or {}).get(section, {}).get(name)
+        if _both_numbers(a, b):
+            yield f"tracer.{name}", float(a), float(b), False, False
     # guard-layer degradation counters/gauges: all lower-is-better
     # (persist.recovered_updates is deliberately NOT gated — it rises
     # and falls with degraded_writes, which already is), never seconds
