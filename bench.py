@@ -1023,6 +1023,214 @@ def multichip(argv=None) -> int:
     return 0 if ok else 1
 
 
+def build_doc_trace(n_small: int, ops_small: int, n_big: int,
+                    ops_big: int, seed: int = 17) -> dict:
+    """Mixed-tenant trace (round 14): ``n_small`` single-writer docs
+    of ``ops_small`` ops each (one map root + one list root + a few
+    tombstones — the idle-tenant shape that dominates a production
+    server's doc population) plus ``n_big`` multi-writer docs of
+    ``ops_big`` ops (the shared build_trace shape). Returns
+    ``{doc_id: [v1 update blobs]}``; doc ids sort small-docs-first."""
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.ids import DeleteSet
+    from crdt_tpu.core.records import ItemRecord
+
+    docs = {}
+    for i in range(n_small):
+        rng = np.random.default_rng(seed + i)
+        client = 1 + int(rng.integers(0, 1 << 20))
+        recs = []
+        chain: list = []
+        n_map = ops_small // 3
+        for k in range(n_map):
+            recs.append(ItemRecord(
+                client=client, clock=k, parent_root="m",
+                key=f"k{int(rng.integers(0, 24))}",
+                content=int(i * 31 + k),
+            ))
+        for k in range(n_map, ops_small):
+            recs.append(ItemRecord(
+                client=client, clock=k, parent_root="l",
+                origin=chain[-1] if chain else None,
+                content=int(i + k),
+            ))
+            chain.append((client, k))
+        ds = DeleteSet()
+        ds.add(client, n_map)
+        docs[f"t{i:05d}"] = [v1.encode_update(recs, ds)]
+    for j in range(n_big):
+        docs[f"zbig{j}"] = build_trace(
+            8, max(ops_big // 8, 1), seed=seed + 7000 + j
+        )
+    return docs
+
+
+def multitenant_leg() -> dict:
+    """The ``--multitenant`` evidence (round 14, ROADMAP item 2): a
+    heavy mixed-tenant trace (many small docs + a few large) through
+    :class:`crdt_tpu.models.multidoc.MultiDocServer` twice —
+
+    - **baseline**: ``pack_docs=False`` — one dispatch per doc
+      through the stock replay pipeline (the pre-round-14 serving
+      shape, and the per-doc ORACLE: every packed digest is asserted
+      against it);
+    - **packed**: doc-packed dispatch batches + the vectorized
+      unpack + the double-buffered async pipeline.
+
+    Publishes ``docs_converged_per_s`` (both modes), ``speedup``,
+    ``p99_per_doc_ms``, ``dispatches_per_tick``, and the flooding-
+    tenant chaos digest (shed counters + untouched-neighbor check),
+    all regression-gated in tools/metrics_diff.py. Decode/staging
+    runs on the ingest side (``prepare()``) for BOTH modes, so the
+    ratio isolates what the tentpole changes: dispatch amortization
+    and the unpack."""
+    from crdt_tpu.models import replay as _rp
+    from crdt_tpu.models.multidoc import MultiDocServer
+
+    D = int(os.environ.get("BENCH_MT_DOCS", 1000))
+    K = int(os.environ.get("BENCH_MT_OPS", 64))
+    n_big = int(os.environ.get("BENCH_MT_BIG", 4))
+    big_ops = int(os.environ.get("BENCH_MT_BIG_OPS", 4096))
+    max_rows = int(os.environ.get("BENCH_MT_MAX_ROWS", 1 << 14))
+    docs = build_doc_trace(D, K, n_big, big_ops)
+    n_docs = len(docs)
+
+    def run(pack: bool):
+        srv = MultiDocServer(pack_docs=pack,
+                             max_rows_per_dispatch=max_rows)
+        for d, bs in docs.items():
+            srv.submit_many(d, bs)
+        srv.prepare()  # ingest-side decode, untimed in both modes
+        t0 = time.perf_counter()
+        rep = srv.tick()
+        while srv.dirty_docs():
+            rep2 = srv.tick()
+            rep = rep._replace(
+                docs=rep.docs + rep2.docs,
+                dispatches=rep.dispatches + rep2.dispatches,
+            )
+        return time.perf_counter() - t0, rep, srv
+
+    run(True)   # warm (compile) — untimed, like every bench warmup
+    run(False)
+    t_packed, rep_p, packed_srv = run(True)
+    t_base, rep_b, base_srv = run(False)
+
+    mismatches = sum(
+        packed_srv.digest(d) != base_srv.digest(d) for d in docs
+    )
+    # independent oracle spot-check: replay_trace of a sample
+    sample = list(docs)[:3] + list(docs)[-1:]
+    for d in sample:
+        if docs[d] and packed_srv.cache(d) != _rp.replay_trace(
+                docs[d]).cache:
+            mismatches += 1
+
+    def p99_ms(srv):
+        lat = [srv.latency_s(d) for d in docs
+               if srv.latency_s(d) is not None]
+        return round(float(np.percentile(lat, 99)) * 1e3, 2) \
+            if lat else None
+
+    # flooding-tenant chaos: one tenant blows a tiny budget while
+    # neighbors converge; the flooder is shed ALONE — every other
+    # tenant's converged bytes match its unflooded baseline digest.
+    # Neighbors are SMALL docs (each a single under-budget blob), so
+    # the only tenant the tiny chaos budget can touch is the flooder
+    flood_docs = {d: docs[d] for d in list(docs)[:min(32, D)]}
+    chaos = MultiDocServer(max_rows_per_dispatch=max_rows,
+                           tenant_max_pending_bytes=2048,
+                           tenant_max_pending_updates=4)
+    for d, bs in flood_docs.items():
+        chaos.submit_many(d, bs)
+    flooder = "flood!"
+    for blob in build_doc_trace(24, K, 0, 0, seed=9090).values():
+        chaos.submit_many(flooder, blob)
+    chaos.prepare()
+    chaos.tick()
+    neighbors_ok = all(
+        chaos.digest(d) == base_srv.digest(d) for d in flood_docs
+    )
+
+    out = {
+        "docs": n_docs,
+        "small_docs": D,
+        "ops_per_small_doc": K,
+        "big_docs": n_big,
+        "ops_per_big_doc": big_ops,
+        "max_rows_per_dispatch": max_rows,
+        "baseline_s": round(t_base, 3),
+        "packed_s": round(t_packed, 3),
+        "docs_converged_per_s": round(n_docs / t_packed, 1),
+        "baseline_docs_per_s": round(n_docs / t_base, 1),
+        "speedup": round(t_base / t_packed, 2),
+        "p99_per_doc_ms": p99_ms(packed_srv),
+        "baseline_p99_per_doc_ms": p99_ms(base_srv),
+        "dispatches_per_tick": rep_p.dispatches,
+        "baseline_dispatches": rep_b.dispatches,
+        "digest_mismatches": mismatches,
+        "oracle_identical": mismatches == 0,
+        "flood": {
+            "shed_updates": chaos.shed_count,
+            "shed_bytes": chaos.shed_bytes,
+            "bounded": chaos.shed_count > 0,
+            "neighbors_unchanged": neighbors_ok,
+        },
+    }
+    return out
+
+
+def multitenant(argv=None) -> int:
+    """The ``--multitenant`` harness: run the leg, merge the gated
+    section into BENCH_OUT.json (like ``--multichip``), one summary
+    line on stdout. Exits non-zero on a divergent or unshed run —
+    a wrong document or an unbounded flood must never publish as
+    evidence."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from crdt_tpu.obs import Tracer, set_tracer
+
+    tracer = None
+    if os.environ.get("BENCH_TRACE", "1") != "0":
+        tracer = set_tracer(Tracer(enabled=True))
+    leg = multitenant_leg()
+    if tracer is not None:
+        counters = tracer.counters()
+        leg["docs_packed_counted"] = counters.get(
+            "converge.docs_packed", 0)
+        leg["tenant_shed_counted"] = counters.get("tenant.shed", 0)
+    ok = bool(leg.get("oracle_identical")) \
+        and bool(leg["flood"]["bounded"]) \
+        and bool(leg["flood"]["neighbors_unchanged"])
+    if ok:
+        try:
+            with open(BENCH_OUT) as f:
+                full = json.load(f)
+        except (OSError, ValueError):
+            full = {}
+        full["multitenant"] = leg
+        try:
+            with open(BENCH_OUT, "w") as f:
+                json.dump(full, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError as exc:
+            log(f"{BENCH_OUT} not written: {exc}")
+    print(json.dumps({
+        "metric": "multitenant_packing",
+        "ok": ok,
+        "docs_converged_per_s": leg["docs_converged_per_s"],
+        "baseline_docs_per_s": leg["baseline_docs_per_s"],
+        "speedup": leg["speedup"],
+        "p99_per_doc_ms": leg["p99_per_doc_ms"],
+        "dispatches_per_tick": leg["dispatches_per_tick"],
+        "full_results": os.path.basename(BENCH_OUT),
+    }))
+    return 0 if ok else 1
+
+
 def overload_leg(seed: int = 11) -> dict:
     """Seeded overload evidence (guard layer): flood one replica at 4x
     its inbox byte budget in a single delivery round, record the
@@ -1544,6 +1752,40 @@ def smoke():
             assert "converge.wyllie_rounds" in report["gauges"], \
                 "smoke: converge.wyllie_rounds gauge missing"
             out["shard_registry_ok"] = True
+        # the round-14 multi-tenant registry: a tiny mixed-tenant
+        # batch through MultiDocServer, digest-identical to the
+        # per-doc baseline, lighting up the tenant.* counters and
+        # publishing the gated keys so the packing evidence (and the
+        # metrics_diff gates reading it) can't rot between full runs
+        os.environ.setdefault("BENCH_MT_DOCS", "8")
+        os.environ.setdefault("BENCH_MT_OPS", "18")
+        os.environ.setdefault("BENCH_MT_BIG", "1")
+        os.environ.setdefault("BENCH_MT_BIG_OPS", "128")
+        mt = multitenant_leg()
+        assert mt["oracle_identical"], "smoke: multitenant diverges"
+        assert mt["flood"]["bounded"], "smoke: flood tenant not shed"
+        assert mt["flood"]["neighbors_unchanged"], \
+            "smoke: flood changed a neighbor tenant"
+        for key in ("docs_converged_per_s", "p99_per_doc_ms",
+                    "dispatches_per_tick", "speedup"):
+            assert mt.get(key) is not None, f"smoke: multitenant {key}"
+        out["multitenant"] = {
+            k: mt[k] for k in ("docs_converged_per_s",
+                               "p99_per_doc_ms",
+                               "dispatches_per_tick", "speedup",
+                               "oracle_identical")
+        }
+        report = tracer.report()
+        for cname in ("converge.docs_packed", "tenant.submitted",
+                      "tenant.docs_converged", "tenant.shed",
+                      "tenant.shed_bytes"):
+            assert report["counters"].get(cname, 0) > 0, \
+                f"smoke: {cname} missing from tenant registry"
+        assert "tenant.pending_bytes" in report["gauges"], \
+            "smoke: tenant.pending_bytes gauge missing"
+        assert "tenant.dispatch_docs" in report["gauges"], \
+            "smoke: tenant.dispatch_docs gauge missing"
+        out["multitenant_registry_ok"] = True
         out["tracer_spans_ok"] = True
     smoke_out = os.environ.get("BENCH_SMOKE_OUT")
     if smoke_out and report is not None:
@@ -2590,6 +2832,8 @@ if __name__ == "__main__":
         _sys_main.exit(multichip(
             [a for a in _sys_main.argv[2:] if not a.startswith("-")]
         ))
+    elif "--multitenant" in _sys_main.argv[1:]:
+        _sys_main.exit(multitenant())
     elif (
         "--smoke" in _sys_main.argv[1:]
         or os.environ.get("BENCH_SMOKE") == "1"

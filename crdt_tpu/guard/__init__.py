@@ -24,6 +24,11 @@ through the tracer and each exercisable by a seeded fault schedule:
   (:func:`crdt_tpu.guard.device.dispatch_guarded`), so a TPU OOM or
   transient XLA error yields a slower correct answer instead of an
   exception mid-merge (``device.retries``, ``device.fallback``).
+- **tenant**  — the round-14 multi-doc server's admission ladder
+  (:mod:`crdt_tpu.guard.tenant`): per-tenant pending-queue budgets
+  shed a flooding tenant's OWN oldest updates (keep-the-newest)
+  while neighbors stay untouched, plus the fairness ordering and
+  dispatch bin-packing (``tenant.shed``, ``tenant.shed_bytes``).
 
 The adversaries live in :mod:`crdt_tpu.guard.faults` (seeded
 ENOSPC/EIO/torn-batch disk schedules, crash points, scripted device
@@ -35,6 +40,7 @@ failure policy" for the knob table and counter registry.
 
 from crdt_tpu.guard.device import dispatch_guarded
 from crdt_tpu.guard.limits import evict_deepest
+from crdt_tpu.guard.tenant import TenantBudget, fair_order, pack_batches
 from crdt_tpu.guard.faults import (
     DeviceFaultPlan,
     DiskFaultSchedule,
@@ -48,7 +54,10 @@ __all__ = [
     "DiskFaultSchedule",
     "FaultyKv",
     "SimulatedCrash",
+    "TenantBudget",
     "WithholdDeps",
     "dispatch_guarded",
     "evict_deepest",
+    "fair_order",
+    "pack_batches",
 ]

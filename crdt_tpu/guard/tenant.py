@@ -1,0 +1,82 @@
+"""Per-tenant admission budgets + fairness for multi-doc serving.
+
+The round-10 ladders bound ONE replica's memory/disk/device exposure;
+a multi-tenant batch server (:class:`crdt_tpu.models.multidoc.
+MultiDocServer`) adds the cross-tenant failure mode: one flooding doc
+filling the shared admission queue until every other tenant's deltas
+wait behind it. Same discipline, tenant-scoped:
+
+- **budget** — each tenant's PENDING (admitted, not yet converged)
+  updates are bounded by bytes and count. Overflow sheds the
+  tenant's OWN oldest pending updates (keep-the-newest, the
+  round-10 inbox rule: a single over-budget repair blob still
+  lands whole). A flooding tenant therefore degrades alone — its
+  backlog is trimmed — while every other tenant's queue, and the
+  bytes they converge to, are untouched (tests/test_multidoc.py
+  chaos leg).
+- **fairness** — dispatch admission orders dirty docs by how long
+  ago they were last served (then doc id for determinism), so a
+  tenant that fills every tick's row budget cannot starve the rest:
+  the docs left out of this tick are FIRST in line for the next.
+
+Counters (README "Observability" registry): ``tenant.shed`` /
+``tenant.shed_bytes`` on every trimmed update, the
+``tenant.pending_bytes`` gauge for the queue's live total.
+"""
+
+from __future__ import annotations
+
+from typing import Deque, Dict, Iterable, List, Tuple
+
+
+class TenantBudget:
+    """Byte + count budget over one tenant's pending update queue."""
+
+    def __init__(self, max_bytes: int = 1 << 22,
+                 max_updates: int = 4096):
+        self.max_bytes = int(max_bytes)
+        self.max_updates = int(max_updates)
+
+    def trim(self, queue: Deque[bytes]) -> List[bytes]:
+        """Shed OLDEST pending updates until ``queue`` fits the
+        budget; the newest update is always kept (keep-the-newest).
+        Returns the shed blobs (callers count them)."""
+        shed: List[bytes] = []
+        size = sum(len(b) for b in queue)
+        while len(queue) > 1 and (
+            size > self.max_bytes or len(queue) > self.max_updates
+        ):
+            old = queue.popleft()
+            size -= len(old)
+            shed.append(old)
+        return shed
+
+
+def fair_order(doc_ids: Iterable,
+               last_served: Dict) -> List:
+    """Dirty docs in service order: least-recently-served first,
+    then doc id (deterministic). ``last_served`` maps doc id -> the
+    tick index it last converged in (absent = never served, which
+    sorts first)."""
+    return sorted(doc_ids, key=lambda d: (last_served.get(d, -1), d))
+
+
+def pack_batches(rows_of: List[Tuple[object, int]],
+                 max_rows: int) -> List[List[object]]:
+    """Greedy bin-pack of (doc, row_count) pairs — in the given
+    fairness order — into dispatch batches of at most ``max_rows``
+    rows. A doc larger than ``max_rows`` gets a batch of its own
+    (it cannot be split: segments never cross docs, and a doc's
+    converge is whole-history)."""
+    batches: List[List[object]] = []
+    cur: List[object] = []
+    cur_rows = 0
+    for doc_id, n in rows_of:
+        if cur and cur_rows + n > max_rows:
+            batches.append(cur)
+            cur, cur_rows = [], 0
+        cur.append(doc_id)
+        cur_rows += n
+    if cur:
+        batches.append(cur)
+    return batches

@@ -10,6 +10,7 @@ from crdt_tpu.models.fleet import (
     shard_trace,
 )
 from crdt_tpu.models.incremental import IncrementalReplay
+from crdt_tpu.models.multidoc import MultiDocServer, TickReport, cache_digest
 from crdt_tpu.models.replay import ReplayResult, replay_trace
 from crdt_tpu.models.streaming import stream_replay
 
@@ -17,6 +18,9 @@ __all__ = [
     "FleetStep",
     "FleetTrace",
     "IncrementalReplay",
+    "MultiDocServer",
+    "TickReport",
+    "cache_digest",
     "ReplayResult",
     "ReplicaFleet",
     "SegStep",

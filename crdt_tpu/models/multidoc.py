@@ -1,0 +1,608 @@
+"""Multi-tenant batched serving: one converge dispatch for many docs.
+
+ROOFLINE.md pins a fixed per-dispatch floor on the tunnelled platform
+(~6 ms on the v5e-class rig), so a server hosting thousands of SMALL
+independent docs pays almost pure overhead when each doc converges in
+its own dispatch — a 64-op doc costs the same floor as a 100k-op one.
+This module is ROADMAP open item 2: amortize the floor by packing many
+docs' deltas into ONE fused converge per tick.
+
+The engine is the round-14 staging tentpole: doc-id is a first-class
+segment column in :mod:`crdt_tpu.ops.packed` (client ids fold into
+doc-composite ids, parent refs intern doc-major), so a whole tenant
+batch converges in one program with per-doc outputs byte-identical to
+each doc converged alone (tests/test_multidoc.py pins {2, 3, 17} docs
+with mixed LWW/YATA ops, deletes, and empty docs on both the
+single-chip and forced-2-device sharded routes — the sharded
+partition places whole DOCS per chip first).
+
+:class:`MultiDocServer` is the tick loop on top:
+
+- **submit** — per-tenant admission queues under the
+  :class:`crdt_tpu.guard.tenant.TenantBudget` byte/count budget:
+  a flooding tenant's own backlog is trimmed oldest-first
+  (keep-the-newest), other tenants' queues and converged bytes are
+  untouched (the round-10 "degrade, don't die" rule, tenant-scoped).
+- **prepare** — the ingest-side work (wire decode + kernel-column
+  staging) runs per doc OFF the tick, the way the streaming executor
+  already overlaps decode against in-flight converges: a real
+  deployment decodes updates where they arrive; the tick spends its
+  time on the dispatch it exists to amortize. ``tick()`` prepares
+  any stale doc itself, so calling ``prepare()`` is an optimization,
+  never a correctness requirement.
+- **tick** — dirty docs order least-recently-served-first
+  (:func:`crdt_tpu.guard.tenant.fair_order`), bin-pack into dispatch
+  batches bounded by ``max_rows_per_dispatch`` rows
+  (:func:`~crdt_tpu.guard.tenant.pack_batches`; the staged buckets
+  round up to powers of two, so the cap IS the padded bucket
+  ceiling), and each batch converges in one dispatch — the sharded
+  multi-chip route when active (docs partition whole across chips),
+  the single-chip packed plan otherwise, with a per-doc fallback
+  when a batch exceeds the packed staging bounds.
+- **unpack** — the one fetched result splits back into per-doc
+  caches/digests. Plain docs (root-parented content rows, no right
+  origins, no nested types — the overwhelming small-tenant shape)
+  take a VECTORIZED unpack: one global visibility pass over the
+  whole batch (doc-composite delete ranges), one stable partition
+  of the winner/stream arrays by doc, then a tight per-doc cache
+  build. Anything else — nested collections, right origins, GC/
+  format rows, hard segments, the ``ix`` index root — routes that
+  doc's slice through the stock replay gather/materialize, so the
+  fast path can never change bytes (differential-pinned either way).
+
+Per-doc digests feed the multi-doc divergence sentinel
+(:class:`crdt_tpu.obs.sentinel.MultiDocSentinel`), which attributes
+a fork to the ONE doc that diverged.
+
+Evidence: ``converge.docs_packed`` (docs per staged plan, counted at
+the staging seam), ``tenant.*`` counters/gauges (README
+"Observability" registry), and the ``bench.py --multitenant`` leg
+publishing ``docs_converged_per_s`` / ``p99_per_doc_ms`` /
+``dispatches_per_tick`` against the one-dispatch-per-doc baseline
+(the same server with ``pack_docs=False``: the stock per-doc replay
+pipeline), regression-gated in ``tools/metrics_diff.py``.
+
+Env knobs: ``CRDT_TPU_MT_MAX_ROWS`` (dispatch row cap, default
+2^16), ``CRDT_TPU_MT_PENDING_BYTES`` / ``CRDT_TPU_MT_PENDING_UPDATES``
+(per-tenant admission budget defaults).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from crdt_tpu.guard.tenant import TenantBudget, fair_order, pack_batches
+from crdt_tpu.models import replay as rp
+from crdt_tpu.obs.tracer import get_tracer
+from crdt_tpu.ops import packed
+from crdt_tpu.ops.device import NULLI
+
+_MAX_ROWS_ENV = "CRDT_TPU_MT_MAX_ROWS"
+_PENDING_BYTES_ENV = "CRDT_TPU_MT_PENDING_BYTES"
+_PENDING_UPDATES_ENV = "CRDT_TPU_MT_PENDING_UPDATES"
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    if raw == "":
+        return default
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return default
+
+
+def cache_digest(cache: dict) -> str:
+    """Canonical digest of a converged cache: top-level root names
+    sorted, values repr'd (C-speed). Below the top level, equal
+    CONVERGED states hold equal structures in equal order — winner
+    and stream orders are deterministic functions of the union, the
+    tentpole's per-doc identity guarantee — so repr is canonical for
+    the comparison surfaces the digest serves (fast vs stock unpack,
+    packed vs per-doc baseline, server vs server over one topic)."""
+    body = ",".join(
+        "%r:%r" % (k, cache[k]) for k in sorted(cache, key=str)
+    )
+    return hashlib.sha1(body.encode()).hexdigest()[:16]
+
+
+def _fast_unpack_ok(dec) -> bool:
+    """May this doc take the vectorized unpack? Only the plain shape
+    the tight cache build reproduces bit-for-bit: every row a
+    root-parented content row (JSON/binary/string/any), no right
+    origins, no reserved ``ix`` index root. Everything else routes
+    through the stock replay gather/materialize."""
+    from crdt_tpu.core.store import K_ANY, K_BINARY, K_JSON, K_STRING
+
+    kind = np.asarray(dec["kind"])
+    if len(kind) == 0:
+        return True
+    if not np.isin(kind, (K_JSON, K_BINARY, K_STRING, K_ANY)).all():
+        return False
+    if (np.asarray(dec["right_client"]) >= 0).any():
+        return False
+    if not (np.asarray(dec["parent_root"]) >= 0).all():
+        return False
+    return "ix" not in dec["roots"]
+
+
+class _DocState:
+    __slots__ = ("blobs", "pending", "cache", "digest", "n_ops",
+                 "dirty_since", "latency_s", "served_tick",
+                 "dec", "cols", "ds", "fast_ok", "stale")
+
+    def __init__(self):
+        self.blobs: List[bytes] = []      # admitted, converged history
+        self.pending: deque = deque()     # admitted, awaiting a tick
+        self.cache: dict = {}
+        self.digest: str = cache_digest({})
+        self.n_ops: int = 0
+        self.dirty_since: Optional[float] = None
+        self.latency_s: Optional[float] = None
+        self.served_tick: int = -1
+        self.dec = None                   # prepared decode (full history)
+        self.cols = None                  # prepared kernel columns
+        self.ds = None                    # prepared delete set
+        self.fast_ok = False
+        self.stale = True                 # prepared state out of date
+
+
+class TickReport(NamedTuple):
+    docs: int              # docs converged this tick
+    dispatches: int        # converge dispatches issued
+    rows: int              # total staged rows
+    fallback_docs: int     # docs that fell back to per-doc dispatch
+    batches: tuple = ()    # docs per dispatch, in dispatch order
+
+
+class MultiDocServer:
+    """Tick-batched multi-tenant converge server (see module doc).
+
+    A tick re-converges each dirty doc's FULL admitted history (the
+    cold staged path — the same replay semantics every differential
+    suite oracles against), so per-doc outputs are exactly what
+    ``replay_trace`` of the same blobs yields. ``pack_docs=False``
+    degrades to one dispatch per doc through the stock replay
+    pipeline — the one-dispatch-per-doc baseline the bench leg
+    measures the packing win against."""
+
+    def __init__(self, *, max_rows_per_dispatch: Optional[int] = None,
+                 tenant_max_pending_bytes: Optional[int] = None,
+                 tenant_max_pending_updates: Optional[int] = None,
+                 shards: Optional[int] = None,
+                 pack_docs: bool = True):
+        self.max_rows = (max_rows_per_dispatch
+                         if max_rows_per_dispatch is not None
+                         else _env_int(_MAX_ROWS_ENV, 1 << 16))
+        self.budget = TenantBudget(
+            max_bytes=(tenant_max_pending_bytes
+                       if tenant_max_pending_bytes is not None
+                       else _env_int(_PENDING_BYTES_ENV, 1 << 22)),
+            max_updates=(tenant_max_pending_updates
+                         if tenant_max_pending_updates is not None
+                         else _env_int(_PENDING_UPDATES_ENV, 4096)),
+        )
+        self.shards = shards
+        self.pack_docs = pack_docs
+        self.ticks = 0
+        self.shed_count = 0
+        self.shed_bytes = 0
+        self._docs: Dict = {}
+        # running pending-queue byte total: the gauge (and the
+        # public accessor) must not re-scan every tenant's deque on
+        # each admitted blob — ingest stays O(1) per update
+        self._pending_total = 0
+
+    # ---- admission (the ingest side) ---------------------------------
+
+    def submit(self, doc_id, blob: bytes) -> int:
+        """Admit one update blob for ``doc_id``. Returns how many of
+        the tenant's pending updates were SHED to fit its budget (0 =
+        admitted with room)."""
+        st = self._docs.setdefault(doc_id, _DocState())
+        if st.dirty_since is None:
+            st.dirty_since = time.perf_counter()
+        st.pending.append(bytes(blob))
+        self._pending_total += len(blob)
+        st.stale = True
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.count("tenant.submitted")
+        shed = self.budget.trim(st.pending)
+        if shed:
+            nbytes = sum(len(b) for b in shed)
+            self.shed_count += len(shed)
+            self.shed_bytes += nbytes
+            self._pending_total -= nbytes
+            if tracer.enabled:
+                tracer.count("tenant.shed", len(shed))
+                tracer.count("tenant.shed_bytes", nbytes)
+        if tracer.enabled:
+            tracer.gauge("tenant.pending_bytes", self.pending_bytes())
+        return len(shed)
+
+    def submit_many(self, doc_id, blobs: Sequence[bytes]) -> int:
+        if not blobs:
+            # registering an empty doc: a NEW state, already settled
+            # (nothing to decode, cache/digest default to empty). An
+            # EXISTING doc is left completely untouched — clearing
+            # its stale flag here would make prepare() skip a dirty
+            # doc and tick() read outdated columns
+            if doc_id not in self._docs:
+                st = _DocState()
+                st.stale = False
+                self._docs[doc_id] = st
+            return 0
+        return sum(self.submit(doc_id, b) for b in blobs)
+
+    def prepare(self) -> int:
+        """Run the ingest-side decode + kernel-column staging for
+        every stale doc (full admitted history). Idempotent; the tick
+        calls it for anything the ingest thread has not covered.
+        Returns the number of docs prepared."""
+        n = 0
+        for st in self._docs.values():
+            if not st.stale:
+                continue
+            dec = rp.decode(st.blobs + list(st.pending))
+            st.cols, st.ds = rp.stage(dec)
+            st.dec = dec
+            st.fast_ok = _fast_unpack_ok(dec)
+            st.stale = False
+            n += 1
+        return n
+
+    def pending_bytes(self) -> int:
+        return self._pending_total
+
+    def dirty_docs(self) -> List:
+        return [d for d, st in self._docs.items() if st.pending]
+
+    # ---- results -----------------------------------------------------
+
+    def doc_ids(self) -> List:
+        return list(self._docs)
+
+    def cache(self, doc_id) -> dict:
+        return self._docs[doc_id].cache
+
+    def digest(self, doc_id) -> str:
+        return self._docs[doc_id].digest
+
+    def latency_s(self, doc_id) -> Optional[float]:
+        """Submit-to-converged latency of the doc's last service."""
+        return self._docs[doc_id].latency_s
+
+    def doc_digests(self) -> Dict:
+        """The multi-doc sentinel's beacon source: per-doc digest +
+        op count (the count is the lag guard — unequal counts are
+        propagation lag, not a fork)."""
+        return {
+            d: {"digest": st.digest, "ops": st.n_ops}
+            for d, st in self._docs.items()
+        }
+
+    # ---- the tick loop -----------------------------------------------
+
+    def tick(self) -> TickReport:
+        """Converge every dirty doc: fairness-ordered admission,
+        bin-packed dispatch batches, per-doc unpack (see module doc).
+        One tick fully drains the dirty set — fairness decides WHO
+        shares a dispatch, the row cap decides how many dispatches."""
+        self.ticks += 1
+        self.prepare()
+        dirty = fair_order(self.dirty_docs(),
+                           {d: self._docs[d].served_tick
+                            for d in self._docs})
+        if not dirty:
+            return TickReport(0, 0, 0, 0)
+        tracer = get_tracer()
+        staged = [(d, len(self._docs[d].dec["client"])) for d in dirty]
+        batches = (pack_batches(staged, self.max_rows)
+                   if self.pack_docs else [[d] for d, _ in staged])
+        dispatches = 0
+        fallback = 0
+        rows = 0
+        sizes = []
+        # double-buffered pipeline (the streaming executor's overlap
+        # pattern): while batch i executes on device, the host stages
+        # + dispatches batch i+1 and unpacks batch i-1 — the fetch is
+        # the only synchronization point
+        inflight: deque = deque()
+        for batch in batches:
+            n_disp, n_fb, handle = self._converge_batch(batch)
+            dispatches += n_disp
+            fallback += n_fb
+            rows += sum(len(self._docs[d].dec["client"]) for d in batch)
+            sizes.append(len(batch))
+            if handle is not None:
+                inflight.append((batch, handle))
+                if len(inflight) > 1:
+                    self._finish_batch(*inflight.popleft())
+            else:
+                self._settle(batch)
+        while inflight:
+            self._finish_batch(*inflight.popleft())
+        if tracer.enabled:
+            tracer.count("tenant.docs_converged", len(dirty))
+            tracer.gauge("tenant.dispatch_docs",
+                         max(sizes) if sizes else 0)
+            tracer.gauge("tenant.pending_bytes", self.pending_bytes())
+            if fallback:
+                tracer.count("tenant.fallback_docs", fallback)
+        return TickReport(len(dirty), dispatches, rows, fallback,
+                          tuple(sizes))
+
+    # ---- converge engines --------------------------------------------
+
+    def _finish_doc(self, doc_id, res) -> None:
+        """One doc's packed result through the STOCK replay gather +
+        materialize (res rows are local to the doc's decode) — the
+        exact path, used for the per-doc baseline and every shape
+        the vectorized unpack refuses."""
+        st = self._docs[doc_id]
+        dec, ds = st.dec, st.ds
+        w, v, o = rp.gather(dec, ds, ("packed", res))
+        st.cache = rp.materialize(dec, ds, w, v, o)
+        st.digest = cache_digest(st.cache)
+        st.n_ops = len(dec["client"])
+
+    def _converge_one(self, doc_id) -> None:
+        """Per-doc dispatch: the ordinary replay converge (packed /
+        sharded / resident routes, exactly the one-shot pipeline)."""
+        st = self._docs[doc_id]
+        if not len(st.dec["client"]):
+            self._finish_empty(doc_id)
+            return
+        handle = rp.converge(st.cols)
+        w, v, o = rp.gather(st.dec, st.ds, handle)
+        st.cache = rp.materialize(st.dec, st.ds, w, v, o)
+        st.digest = cache_digest(st.cache)
+        st.n_ops = len(st.dec["client"])
+
+    def _converge_batch(self, batch) -> tuple:
+        """Stage + (async) dispatch one batch. Returns (dispatches,
+        fallback_docs, in-flight handle or None when the batch was
+        settled synchronously)."""
+        live = [d for d in batch
+                if len(self._docs[d].dec["client"])]
+        live_set = set(live)
+        for d in batch:
+            if d not in live_set:
+                self._finish_empty(d)
+        if len(live) == 0:
+            return 0, 0, None
+        if len(live) == 1 or not self.pack_docs:
+            for d in live:
+                self._converge_one(d)
+            return len(live), 0, None
+        comb, row_off = _concat_cols(
+            [self._docs[d].cols for d in live]
+        )
+        handle = self._dispatch_async(comb)
+        if handle is None:
+            # the batch exceeded the packed staging bounds: degrade
+            # to per-doc dispatches (correct, just un-amortized),
+            # and say so in the evidence
+            for d in live:
+                self._converge_one(d)
+            return len(live), len(live), None
+        return 1, 0, (live, comb, row_off, handle)
+
+    def _finish_batch(self, batch, work) -> None:
+        """Fetch one in-flight batch dispatch, unpack per doc, stamp
+        latencies/service bookkeeping."""
+        from crdt_tpu.ops import shard as shard_ops
+
+        live, comb, row_off, (route, h) = work
+        fetch = (shard_ops.converge_fetch if route == "shard"
+                 else packed.converge_fetch)
+        self._unpack(live, comb, row_off, fetch(h))
+        self._settle(batch)
+
+    def _settle(self, batch) -> None:
+        done = time.perf_counter()
+        for d in batch:
+            st = self._docs[d]
+            self._pending_total -= sum(len(b) for b in st.pending)
+            st.blobs.extend(st.pending)
+            st.pending.clear()
+            if st.dirty_since is not None:
+                st.latency_s = done - st.dirty_since
+            st.dirty_since = None
+            st.served_tick = self.ticks
+
+    def _finish_empty(self, doc_id) -> None:
+        st = self._docs[doc_id]
+        st.cache, st.n_ops = {}, 0
+        st.digest = cache_digest({})
+
+    def _dispatch_async(self, comb):
+        """Enqueue one converge dispatch over the combined multi-doc
+        columns: sharded route when active (partitioned by whole
+        docs), the single-chip packed plan otherwise. Returns a
+        (route, handle) pair for :meth:`_finish_batch`, or None when
+        staging refused."""
+        from crdt_tpu.ops import shard as shard_ops
+
+        n = len(comb["client"])
+        if shard_ops.active_for(n, self.shards):
+            splan = shard_ops.stage(comb, n_shards=self.shards)
+            if splan is not None:
+                return ("shard", shard_ops.converge_async(splan))
+        plan = packed.stage(comb)
+        if plan is None:
+            return None
+        return ("packed", packed.converge_async(plan))
+
+    # ---- the multi-doc unpack ----------------------------------------
+
+    def _unpack(self, live, comb, row_off, res) -> None:
+        """Split one combined result into per-doc caches/digests.
+
+        The global work is vectorized ONCE for the whole batch: the
+        visibility of every row against its own doc's delete ranges
+        (doc-composite clients, one interval search), and a stable
+        partition of the winner/stream arrays by doc (segments never
+        cross docs, so each doc's slice keeps its oracle order; the
+        stable sort also covers the sharded route, where shards emit
+        docs out of submission order). Per doc, the plain shape gets
+        the tight cache build; anything else replays its slice
+        through the stock gather/materialize."""
+        win_all = np.asarray(res.win_rows)
+        win_all = win_all[win_all >= 0]
+        srow_all = np.asarray(res.stream_row)
+        sm = srow_all >= 0
+        srow_all = srow_all[sm]
+        sseg_all = np.asarray(res.stream_seg)[sm]
+        wdoc = np.searchsorted(row_off, win_all, side="right") - 1
+        worder = np.argsort(wdoc, kind="stable")
+        win_all, wdoc = win_all[worder], wdoc[worder]
+        sorder = np.argsort(sdoc := np.searchsorted(
+            row_off, srow_all, side="right") - 1, kind="stable")
+        srow_all, sseg_all, sdoc = (
+            srow_all[sorder], sseg_all[sorder], sdoc[sorder]
+        )
+        D = len(live)
+        wcut = np.searchsorted(wdoc, np.arange(D + 1))
+        scut = np.searchsorted(sdoc, np.arange(D + 1))
+        vis = _global_visibility(
+            comb, [self._docs[d].ds for d in live]
+        )
+        hard = sorted(int(r) for r in res.hard_rows)
+        hdocs = (set(
+            (np.searchsorted(row_off, hard, side="right") - 1).tolist()
+        ) if hard else frozenset())
+        for i, d in enumerate(live):
+            st = self._docs[d]
+            lo, hi = int(row_off[i]), int(row_off[i + 1])
+            has_hard = i in hdocs
+            if st.fast_ok and not has_hard:
+                st.cache = _fast_cache(
+                    st.dec, lo,
+                    win_all[wcut[i]:wcut[i + 1]],
+                    srow_all[scut[i]:scut[i + 1]],
+                    sseg_all[scut[i]:scut[i + 1]],
+                    vis,
+                )
+                st.digest = cache_digest(st.cache)
+                st.n_ops = len(st.dec["client"])
+            else:
+                self._finish_doc(d, packed.PackedResult(
+                    win_rows=win_all[wcut[i]:wcut[i + 1]] - lo,
+                    stream_seg=sseg_all[scut[i]:scut[i + 1]],
+                    stream_row=srow_all[scut[i]:scut[i + 1]] - lo,
+                    hard_rows=tuple(
+                        r - lo for r in hard if lo <= r < hi
+                    ),
+                ))
+
+
+def _concat_cols(cols_list):
+    """Concatenate per-doc kernel columns into one multi-doc column
+    set with the ``doc`` segment column, plus the caller-row offsets
+    of each doc (``row_off[i] .. row_off[i+1]`` is doc i's range)."""
+    comb = {
+        k: np.concatenate([np.asarray(c[k]) for c in cols_list])
+        for k in cols_list[0]
+    }
+    comb["doc"] = np.concatenate([
+        np.full(len(c["client"]), i, np.int64)
+        for i, c in enumerate(cols_list)
+    ])
+    row_off = np.cumsum(
+        [0] + [len(c["client"]) for c in cols_list]
+    )
+    return comb, row_off
+
+
+def _global_visibility(comb, ds_list):
+    """Tombstone visibility for EVERY row of a combined batch in one
+    interval search: clients compose with the doc column (one doc's
+    delete ranges can never touch another doc's rows), delete
+    triples from clients absent from the batch are dropped (they
+    cannot cover any row). Returns a bool mask over the combined
+    caller rows, or None when no doc carries tombstones (all
+    visible)."""
+    uniq = np.unique(np.asarray(comb["client"], np.int64))
+    C = len(uniq) + 1
+    dc: list = []
+    dstart: list = []
+    dend: list = []
+    for i, ds in enumerate(ds_list):
+        for c, s, n in ds.iter_all():
+            r = int(np.searchsorted(uniq, c))
+            if r < len(uniq) and uniq[r] == c:
+                dc.append(i * C + r)
+                dstart.append(s)
+                dend.append(s + n)
+    if not dc:
+        return None
+    comp = (
+        np.asarray(comb["doc"], np.int64) * C
+        + np.searchsorted(uniq, np.asarray(comb["client"], np.int64))
+    )
+    return rp.rows_visible(
+        comp, np.asarray(comb["clock"], np.int64),
+        np.asarray(dc, np.int64), np.asarray(dstart, np.int64),
+        np.asarray(dend, np.int64),
+    )
+
+
+def _fast_cache(dec, lo, win, srow, sseg, vis) -> dict:
+    """The tight cache build for a plain doc (see `_fast_unpack_ok`):
+    map winners keyed into their root dicts, sequence streams cut at
+    segment boundaries, tombstoned rows dropped — the exact cache the
+    stock materialize produces for this shape (differential-pinned in
+    tests/test_multidoc.py). ``win``/``srow`` are combined-space rows
+    (``lo`` rebases), ``vis`` the global visibility mask (None = all
+    visible)."""
+    roots = dec["roots"]
+    keys_t = dec["keys"]
+    pr = dec["parent_root"]
+    kid = dec["key_id"]
+    contents = dec["contents"]
+    cache: dict = {}
+    if vis is None:
+        for g in win.tolist():
+            r = g - lo
+            root = roots[pr[r]]
+            grp = cache.get(root)
+            if grp is None:
+                grp = cache[root] = {}
+            grp[keys_t[kid[r]]] = contents[r]
+    else:
+        for g, ok in zip(win.tolist(), vis[win].tolist()):
+            if not ok:
+                continue
+            r = g - lo
+            root = roots[pr[r]]
+            grp = cache.get(root)
+            if grp is None:
+                grp = cache[root] = {}
+            grp[keys_t[kid[r]]] = contents[r]
+    if len(srow):
+        edges = np.flatnonzero(sseg[1:] != sseg[:-1]) + 1
+        cuts = [0] + edges.tolist() + [len(sseg)]
+        for a, b in zip(cuts[:-1], cuts[1:]):
+            rows_g = srow[a:b]
+            first = int(rows_g[0]) - lo
+            root = roots[pr[first]]
+            if vis is None:
+                vals = [contents[r - lo] for r in rows_g.tolist()]
+            else:
+                vals = [
+                    contents[r - lo]
+                    for r, ok in zip(rows_g.tolist(),
+                                     vis[rows_g].tolist())
+                    if ok
+                ]
+            if root not in cache:
+                cache[root] = vals
+    return cache

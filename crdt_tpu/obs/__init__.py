@@ -32,6 +32,7 @@ from crdt_tpu.obs.recorder import (
 )
 from crdt_tpu.obs.sentinel import (
     DivergenceSentinel,
+    MultiDocSentinel,
     delete_set_digest,
     state_digest,
 )
@@ -39,6 +40,7 @@ from crdt_tpu.obs.tracer import Tracer, get_tracer, set_tracer
 
 __all__ = [
     "DivergenceSentinel",
+    "MultiDocSentinel",
     "FlightRecorder",
     "Tracer",
     "delete_set_digest",

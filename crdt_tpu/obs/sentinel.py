@@ -177,3 +177,105 @@ class DivergenceSentinel:
         if self.on_divergence is not None:
             self.on_divergence(event)
         return event
+
+
+class MultiDocSentinel:
+    """Divergence sentinel for multi-doc serving (round 14): beacons
+    carry PER-DOC digests, so a fork is attributed to the one doc
+    that diverged — on a server converging thousands of tenants in
+    one dispatch, "some doc forked" is not actionable, "doc X
+    forked" is.
+
+    ``source`` is anything with a ``doc_digests()`` returning
+    ``{doc_id: {"digest": str, "ops": int}}``
+    (:meth:`crdt_tpu.models.multidoc.MultiDocServer.doc_digests`).
+    The op count is the lag guard standing in for the single-doc
+    sentinel's state-vector equality: unequal counts mean one side
+    has not admitted the other's ops yet — propagation lag, silent
+    (``sentinel.doc_lag``). Equal counts with unequal digests is a
+    fork in THAT doc: one ``sentinel.doc_divergence`` count and one
+    event naming the doc, deduped per (peer, doc, digest pair) like
+    the single-doc sentinel's permanent-fork rule. Docs only the
+    peer serves are skipped (placement, not health)."""
+
+    def __init__(self, source, *, topic: str, replica: str,
+                 tracer: Optional[Tracer] = None,
+                 recorder: Optional[FlightRecorder] = None,
+                 on_divergence: Optional[
+                     Callable[[Dict[str, Any]], None]] = None):
+        self.source = source
+        self.topic = topic
+        self.replica = replica
+        self._tracer = tracer
+        self._recorder = recorder
+        self.on_divergence = on_divergence
+        self.events: List[Dict[str, Any]] = []
+        self.max_events = 64
+        self._raised: set = set()
+
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer if self._tracer is not None else get_tracer()
+
+    @property
+    def recorder(self) -> FlightRecorder:
+        return (
+            self._recorder if self._recorder is not None
+            else get_recorder()
+        )
+
+    def beacon_payload(self) -> Dict[str, Any]:
+        """The broadcastable multi-doc beacon body."""
+        self.tracer.count("sentinel.beacons_sent")
+        docs = self.source.doc_digests()
+        self.recorder.record(
+            "beacon.send", topic=self.topic, replica=self.replica,
+            size=len(docs),
+        )
+        return {"docs": docs}
+
+    def check(self, from_pk: str,
+              payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """Compare a received multi-doc beacon doc by doc. Returns
+        the divergence events that fired (possibly empty)."""
+        tracer = self.tracer
+        tracer.count("sentinel.beacons_checked")
+        mine = self.source.doc_digests()
+        fired: List[Dict[str, Any]] = []
+        for doc_id, theirs in (payload.get("docs") or {}).items():
+            ours = mine.get(doc_id)
+            if ours is None:
+                continue  # not served here: placement, not health
+            if ours["ops"] != theirs.get("ops"):
+                tracer.count("sentinel.doc_lag")
+                continue
+            if ours["digest"] == theirs.get("digest"):
+                tracer.count("sentinel.agree")
+                continue
+            tracer.count("sentinel.doc_divergence")
+            fork_key = (from_pk, doc_id, ours["digest"],
+                        theirs.get("digest"))
+            if fork_key in self._raised:
+                continue
+            self._raised.add(fork_key)
+            event = {
+                "kind": "divergence",
+                "topic": self.topic,
+                "replica": self.replica,
+                "peer": from_pk,
+                "doc": doc_id,
+                "local_digest": ours["digest"],
+                "peer_digest": theirs.get("digest"),
+                "flight_recorder": self.recorder.dump_jsonl(),
+            }
+            self.recorder.record(
+                "divergence", topic=self.topic, replica=self.replica,
+                peer=from_pk, local_digest=ours["digest"],
+                peer_digest=theirs.get("digest"),
+            )
+            if len(self.events) < self.max_events:
+                self.events.append(event)
+            if self.on_divergence is not None:
+                self.on_divergence(event)
+            fired.append(event)
+        return fired

@@ -649,6 +649,67 @@ def stage(cols: Dict[str, np.ndarray],
         return _stage(cols, put, wide, _sections=_sections)
 
 
+def _doc_column(cols, valid) -> Optional[np.ndarray]:
+    """The active multi-doc column, or None (absent / single doc).
+    Docs must be dense non-negative ints; only admitted rows decide
+    whether more than one doc is present."""
+    if "doc" not in cols:
+        return None
+    doc = np.asarray(cols["doc"], np.int64)
+    dv = doc[valid]
+    if not len(dv) or int(dv.max()) == int(dv.min()):
+        return None
+    # garbage in invalid / padding rows must not overflow the
+    # composite arithmetic (the admitted-rows-only rule every other
+    # staging bound follows)
+    return np.clip(doc, 0, int(dv.max()))
+
+
+def _compose_doc_ids(cols, doc, client, oc, valid, live_origin):
+    """Fold the doc column into the client-id space (round 14, the
+    tenant-packing tentpole): every client-bearing column remaps to
+    ``doc * stride + rank`` where rank is the row's client's position
+    in ONE shared raw-client table. The map is order-preserving
+    WITHIN each doc (rank is monotone in the raw id) and DISJOINT
+    across docs (stride > max rank), so everything downstream — the
+    id sort, duplicate drop, origin resolution, right-origin
+    attachment walks — stays doc-local with no further doc handling:
+    two docs' rows can never share an id key, so a row can never
+    dedup against, resolve an origin in, or anchor a right to another
+    doc. Sibling rules compare clients only through a monotone map
+    (the ResidentColumns rationale), so per-doc outputs are
+    byte-identical to each doc staged alone (tests/test_multidoc.py).
+
+    Returns ``(cols, client, oc)`` with ``cols`` shallow-copied when
+    the right-origin column needed remapping, or None when the
+    composite space would overflow the packable id range (callers
+    fall back, exactly like the other staging bounds)."""
+    rc_raw = (np.asarray(cols["right_client"], np.int64)
+              if "right_client" in cols else None)
+    pools = [client[valid], oc[live_origin]]
+    live_r = None
+    if rc_raw is not None:
+        live_r = valid & (rc_raw >= 0)
+        if live_r.any():
+            pools.append(rc_raw[live_r])
+    uniq_all = np.unique(np.concatenate(pools))
+    stride = np.int64(len(uniq_all) + 1)
+    if int(doc[valid].max()) >= (1 << 61) // int(stride):
+        return None
+    base = doc * stride
+
+    def comp(x, live):
+        r = np.searchsorted(uniq_all, np.clip(x, uniq_all[0], None))
+        return np.where(live, base + r, x)
+
+    client = comp(client, valid)
+    oc = comp(oc, oc >= 0)
+    if rc_raw is not None and live_r.any():
+        cols = dict(cols)
+        cols["right_client"] = comp(rc_raw, rc_raw >= 0)
+    return cols, client, oc
+
+
 def _stage(cols: Dict[str, np.ndarray],
            put=None, wide: Optional[bool] = None,
            _sections: Optional[list] = None) -> Optional[PackedPlan]:
@@ -712,6 +773,21 @@ def _stage(cols: Dict[str, np.ndarray],
     if live_origin.any() and int(ock[live_origin].max()) >= (1 << _CLOCK_BITS):
         return None
 
+    # multi-doc staging (round 14): doc-id becomes a first-class
+    # segment column — client ids fold into doc-composite ids (one
+    # doc's ids can never collide with another's) and the parent-ref
+    # interning below takes doc as its MAJOR key, so segments are
+    # doc-pure and numbered doc-major. One dispatch then converges a
+    # whole tenant batch with per-doc outputs byte-identical to each
+    # doc converged alone.
+    doc = _doc_column(cols, valid)
+    if doc is not None:
+        composed = _compose_doc_ids(cols, doc, client, oc, valid,
+                                    live_origin)
+        if composed is None:
+            return None
+        cols, client, oc = composed
+
     # dense order-preserving client ranks (origins share the table;
     # only admitted rows contribute — garbage in invalid rows must not
     # widen client_bits toward a spurious key-width fallback)
@@ -720,15 +796,26 @@ def _stage(cols: Dict[str, np.ndarray],
     client_d = np.where(valid, client_d, 0)
     oc_d = np.where(oc >= 0, np.searchsorted(uniq, np.clip(oc, uniq[0], None)), -1)
 
-    # dense parent refs: exact two-key unique via lexsort runs
-    porder = np.lexsort((pb, pa, pir))
+    # dense parent refs: exact two-key unique via lexsort runs. With
+    # docs active the doc column is the MAJOR sort key, so parent
+    # refs (and through segkey_of, segments) never merge across docs
+    # and number doc-major — within one doc the order is exactly the
+    # single-doc (pir, pa, pb) order, so a doc's slice of the packed
+    # stream is its own oracle stream
+    if doc is not None:
+        porder = np.lexsort((pb, pa, pir, doc))
+        doc_s = doc[porder]
+        doc_run = np.r_[False, doc_s[1:] != doc_s[:-1]]
+    else:
+        porder = np.lexsort((pb, pa, pir))
+        doc_run = False
     pir_s, pa_s, pb_s = pir[porder], pa[porder], pb[porder]
     new_run = np.r_[
         True,
         (pir_s[1:] != pir_s[:-1])
         | (pa_s[1:] != pa_s[:-1])
         | (pb_s[1:] != pb_s[:-1]),
-    ]
+    ] | doc_run
     ref_sorted = np.cumsum(new_run) - 1
     pref = np.empty(n, np.int64)
     pref[porder] = ref_sorted
@@ -992,6 +1079,12 @@ def _stage(cols: Dict[str, np.ndarray],
         tracer.gauge("converge.wyllie_rounds", rank_rounds_v)
         if len(seam_compact):
             tracer.count("converge.chain_seams", len(seam_compact))
+        if doc is not None:
+            # the tenant-packing evidence: how many independent docs
+            # this ONE staged plan carries (every dispatch of it
+            # amortizes the fixed floor across that many tenants)
+            tracer.count("converge.docs_packed",
+                         len(np.unique(doc[valid])))
 
     map_back = np.full(M, NULLI, np.int32)
     if n_map:

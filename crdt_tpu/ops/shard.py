@@ -153,20 +153,48 @@ def _partition(cols, K: int):
     row, packed._stage's rule): equal-id rows under different parents
     would land in different shards, where no shard-local dedup could
     see the pair — the single-chip oracle keeps only the leftmost, so
-    the sharded route must too."""
+    the sharded route must too.
+
+    Multi-doc unions (round 14: a ``doc`` column is present with >1
+    distinct doc) partition by DOC first: a doc's segments — and
+    therefore its whole converge — stay co-located on one chip, docs
+    greedy-balance across shards by row count, and the duplicate drop
+    and segment identity are doc-scoped (two docs legitimately reuse
+    the same (client, clock) ids and parent refs). Within a
+    single-doc union the whole-segment partition is unchanged."""
     valid = np.asarray(cols["valid"], bool)
     idx = np.flatnonzero(valid)
     if not len(idx):
         return None
+    dv = (np.asarray(cols["doc"], np.int64)[idx]
+          if "doc" in cols else np.zeros(len(idx), np.int64))
+    multi_doc = len(idx) > 0 and int(dv.max()) != int(dv.min())
     cl_v = np.asarray(cols["client"], np.int64)[idx]
     ck_v = np.asarray(cols["clock"], np.int64)[idx]
-    so = np.lexsort((np.arange(len(idx)), ck_v, cl_v))
+    so = np.lexsort((np.arange(len(idx)), ck_v, cl_v, dv))
     dup = np.r_[
         False,
-        (cl_v[so][1:] == cl_v[so][:-1]) & (ck_v[so][1:] == ck_v[so][:-1]),
+        (cl_v[so][1:] == cl_v[so][:-1]) & (ck_v[so][1:] == ck_v[so][:-1])
+        & (dv[so][1:] == dv[so][:-1]),
     ]
     if dup.any():
-        idx = idx[np.sort(so[~dup])]
+        keep = np.sort(so[~dup])
+        idx, dv = idx[keep], dv[keep]
+    if multi_doc:
+        # doc-first: greedy balance whole docs, largest first into
+        # the lightest bin (fewer docs than shards leaves shards
+        # empty — the all-padding shard body handles them)
+        docs_u, doc_inv, doc_counts = np.unique(
+            dv, return_inverse=True, return_counts=True
+        )
+        bins = np.zeros(len(docs_u), np.int64)
+        loads = np.zeros(K, np.int64)
+        for d in np.argsort(-doc_counts, kind="stable"):
+            b = int(np.argmin(loads))
+            bins[d] = b
+            loads[b] += int(doc_counts[d])
+        shard_of_row = bins[doc_inv]
+        return [idx[shard_of_row == k] for k in range(K)]
     pir = np.asarray(cols["parent_is_root"], bool)[idx]
     pa = np.asarray(cols["parent_a"], np.int64)[idx]
     pb = np.asarray(cols["parent_b"], np.int64)[idx]

@@ -109,6 +109,23 @@ def test_bench_smoke_mode(tmp_path):
     assert "shard.shards" in report["gauges"]
     assert "converge.wyllie_rounds" in report["gauges"]
 
+    # the round-14 multi-tenant registry: the smoke runs a tiny
+    # mixed-tenant batch through MultiDocServer, digest-identical to
+    # the per-doc baseline, and publishes the gated keys + tenant.*
+    # counters the multitenant regression gate reads
+    assert out.get("multitenant_registry_ok") is True
+    mt = out["multitenant"]
+    for key in ("docs_converged_per_s", "p99_per_doc_ms",
+                "dispatches_per_tick", "speedup"):
+        assert isinstance(mt.get(key), (int, float)), key
+    assert mt["oracle_identical"] is True
+    for cname in ("converge.docs_packed", "tenant.submitted",
+                  "tenant.docs_converged", "tenant.shed",
+                  "tenant.shed_bytes"):
+        assert report["counters"].get(cname, 0) > 0, cname
+    assert "tenant.pending_bytes" in report["gauges"]
+    assert "tenant.dispatch_docs" in report["gauges"]
+
     # the guard-layer registry (README "Overload & failure policy"):
     # (kernel_ablation_leg is pinned in-process below — the smoke
     # subprocess stays on its <30s budget)

@@ -289,6 +289,32 @@ def f(tracer):
     )
 
 
+def test_registry_covers_tenant_counters():
+    """Round 14 (tenant packing) added the `tenant.*` namespace, the
+    `converge.docs_packed` staging counter, and the multi-doc
+    sentinel rows. Both directions must hold: the emitted names stay
+    documented in the README registry, and an UNdocumented tenant
+    name still fires CL201 — the namespace genuinely joined the
+    registry-checked pool."""
+    reg = _real_registry()
+    for name in ("converge.docs_packed", "tenant.submitted",
+                 "tenant.docs_converged", "tenant.shed",
+                 "tenant.shed_bytes", "tenant.fallback_docs",
+                 "tenant.pending_bytes", "tenant.dispatch_docs",
+                 "sentinel.doc_divergence", "sentinel.doc_lag"):
+        assert name in reg.metrics, (
+            f"{name} dropped out of the README registry (round-14 "
+            f"tenant-packing contract)"
+        )
+    result = _lint_snippet("crdt_tpu/models/x.py", '''
+def f(tracer):
+    tracer.count("tenant.bogus_budget", 1)
+''', _reg("tenant.submitted"))
+    assert any(f.code == "CL201" for f in result.findings), (
+        "an undocumented tenant.* metric no longer fires CL201"
+    )
+
+
 def test_registry_drift_fixed_event_kinds():
     """First-run CL201 drift on flight-recorder event kinds from the
     guard/storage/device adversaries."""

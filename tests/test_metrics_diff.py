@@ -356,3 +356,64 @@ def test_multichip_section_gated():
     new4["tracer"]["gauges"]["converge.wyllie_rounds"] = 18
     _, regressed = compare(old, new4, threshold=0.2)
     assert "tracer.converge.wyllie_rounds" in regressed
+
+
+def test_multitenant_section_gated():
+    """Round 14: the multitenant leg's docs/s and packing speedup are
+    higher-is-better; per-doc p99 and dispatches-per-tick are
+    lower-is-better, and none of them is muted by the seconds noise
+    floor (they are section keys, not tracer timings). The
+    tenant-scoped shed counters gate lower-is-better like every
+    guard ladder."""
+    old = copy.deepcopy(OLD)
+    old["multitenant"] = {
+        "docs_converged_per_s": 4000.0,
+        "speedup": 12.0,
+        "p99_per_doc_ms": 250.0,
+        "dispatches_per_tick": 5,
+    }
+    old["tracer"]["counters"]["tenant.shed"] = 4
+    old["tracer"]["counters"]["tenant.shed_bytes"] = 4096
+    new = copy.deepcopy(old)
+    rows, regressed = compare(old, new)
+    names = {r["metric"] for r in rows}
+    assert "multitenant.docs_converged_per_s" in names
+    assert "multitenant.speedup" in names
+    assert "multitenant.p99_per_doc_ms" in names
+    assert "multitenant.dispatches_per_tick" in names
+    assert "tracer.tenant.shed" in names
+    assert regressed == []
+
+    # throughput / speedup eroding fails (higher is better)...
+    new["multitenant"]["docs_converged_per_s"] = 2500.0
+    new["multitenant"]["speedup"] = 7.0
+    _, regressed = compare(old, new, threshold=0.2)
+    assert "multitenant.docs_converged_per_s" in regressed
+    assert "multitenant.speedup" in regressed
+    # ...improving never does
+    new2 = copy.deepcopy(old)
+    new2["multitenant"]["docs_converged_per_s"] = 9000.0
+    _, regressed = compare(old, new2, threshold=0.2)
+    assert regressed == []
+
+    # tail latency and dispatch count growing fail — and p99 is a
+    # section key, so the ms noise floor cannot mute it even at
+    # sub-floor absolute values
+    new3 = copy.deepcopy(old)
+    new3["multitenant"]["p99_per_doc_ms"] = 400.0
+    new3["multitenant"]["dispatches_per_tick"] = 9
+    _, regressed = compare(old, new3, threshold=0.2)
+    assert "multitenant.p99_per_doc_ms" in regressed
+    assert "multitenant.dispatches_per_tick" in regressed
+    old4 = copy.deepcopy(old)
+    old4["multitenant"]["p99_per_doc_ms"] = 0.8  # below 5ms floor
+    new4 = copy.deepcopy(old4)
+    new4["multitenant"]["p99_per_doc_ms"] = 2.4
+    _, regressed = compare(old4, new4, threshold=0.2)
+    assert "multitenant.p99_per_doc_ms" in regressed
+
+    # tenant shedding rising past threshold fails (guard semantics)
+    new5 = copy.deepcopy(old)
+    new5["tracer"]["counters"]["tenant.shed"] = 9
+    _, regressed = compare(old, new5, threshold=0.2)
+    assert "tracer.tenant.shed" in regressed

@@ -31,7 +31,7 @@ NAMESPACES = frozenset({
     "xfer", "guard", "persist", "engine", "device", "replica",
     "router", "sentinel", "fleet", "gossip", "update", "sync",
     "probe", "ae", "beacon", "dial", "relay", "envelope", "fault",
-    "overload", "lint", "converge", "shard",
+    "overload", "lint", "converge", "shard", "tenant",
 })
 
 # backticked dotted names that share a namespace but are NOT metrics

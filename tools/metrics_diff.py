@@ -33,7 +33,12 @@ Compared (whatever of these both artifacts carry):
 - static analysis: ``lint.findings`` / ``lint.baselined`` from the
   embedded crdtlint digest (lower = better, no noise floor) — a PR
   that grows the crdtlint baseline or adds inline disables moves the
-  count and lands in this table, even though tier-1 still passes.
+  count and lands in this table, even though tier-1 still passes;
+- multi-tenant packing (round 14, ``bench --multitenant``):
+  ``multitenant.docs_converged_per_s`` / ``.speedup`` (higher =
+  better) and ``.p99_per_doc_ms`` / ``.dispatches_per_tick`` (lower
+  = better), plus the tenant-scoped shed counters from the tracer
+  report (lower = better, like every guard ladder).
 
 Prints a table (one row per metric: old, new, delta, verdict) and
 exits non-zero when any metric regressed past ``--threshold``
@@ -81,6 +86,16 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     # floor never mutes them)
     (("multichip", "boundary_bytes"), False),
     (("multichip", "boundary_fraction"), False),
+    # multi-tenant packing (round 14, bench --multitenant): docs
+    # converged per second and the packing speedup over the
+    # one-dispatch-per-doc baseline (higher = better), tail latency
+    # and dispatch count per tick (lower = better). Ratios/counts —
+    # the seconds noise floor never mutes them; p99_per_doc_ms is a
+    # SECTION key, so it is gated even below the ms floor.
+    (("multitenant", "docs_converged_per_s"), True),
+    (("multitenant", "speedup"), True),
+    (("multitenant", "p99_per_doc_ms"), False),
+    (("multitenant", "dispatches_per_tick"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 
@@ -102,6 +117,11 @@ GUARD_PREFIXES: Tuple[str, ...] = (
     "device.dispatch_errors",
     "replica.isolation_splits",
     "replica.malformed_updates",
+    # round 14: tenant-scoped shedding — a rise means the same trace
+    # leaned harder on the admission ladder (tenant.submitted /
+    # docs_converged are workload facts and stay ungated)
+    "tenant.shed",
+    "tenant.fallback_docs",
 )
 
 
