@@ -1180,12 +1180,213 @@ def multitenant_leg() -> dict:
     return out
 
 
+class _SteadyStream:
+    """Single-writer doc generator whose every delta continues the
+    client's clock contiguously — the SV-admissible steady-state
+    shape the round-15 delta ticks serve (one map root + one chained
+    list root, the small-tenant mix of build_doc_trace)."""
+
+    def __init__(self, i: int):
+        self.client = 1 + i
+        self.i = i
+        self.k = 0
+        self.chain = None
+        self.map_tail: dict = {}
+
+    def delta(self, n_ops: int) -> bytes:
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+
+        recs = []
+        for j in range(n_ops):
+            k = self.k
+            self.k += 1
+            if j % 4 == 0:
+                # a map set chains onto the key's previous value
+                # (origin = prior item), the Yjs Y.Map wire shape —
+                # and the O(1) tail-advance the incremental engine
+                # serves it with
+                key = f"k{(self.i + j) % 16}"
+                recs.append(ItemRecord(
+                    client=self.client, clock=k, parent_root="m",
+                    key=key, origin=self.map_tail.get(key),
+                    content=int(self.i * 31 + k),
+                ))
+                self.map_tail[key] = (self.client, k)
+            else:
+                recs.append(ItemRecord(
+                    client=self.client, clock=k, parent_root="l",
+                    origin=self.chain, content=int(self.i + k),
+                ))
+                self.chain = (self.client, k)
+        return v1.encode_update(recs, DeleteSet())
+
+
+def multitenant_steady_leg() -> dict:
+    """The round-15 steady-state evidence: N ticks of SMALL deltas on
+    LARGE resident docs through :class:`MultiDocServer` twice —
+
+    - **full replay** (``delta_ticks=False``): the round-14 tick —
+      every dirty doc re-decodes and re-converges its FULL history
+      (the pre-round-15 serving shape, and the per-doc ORACLE: every
+      steady digest is asserted against it);
+    - **delta ticks**: per-doc resident incremental engines — a tick
+      stages only the delta rows (history stays resident), so
+      steady-state throughput is bounded by delta size, not doc
+      size.
+
+    The timed window covers submit + prepare + tick (the full
+    per-tick serving cost, decode included — that is exactly what
+    the full-replay baseline pays per tick and the delta route
+    avoids). The cold ingest and the one-time promotion tick are
+    warmup, like every bench warm phase. FAILS LOUDLY (RuntimeError)
+    when the incremental route silently degrades every doc to cold
+    replay — the gated speedup must never rot into measuring the
+    fallback.
+
+    The eviction sub-leg floods 10x more docs than the resident
+    budget fits (``resident_max_bytes``) in rolling waves: committed
+    resident bytes stay <= budget (the ledger's peak), evictions
+    fire, and an evicted doc reconverges byte-identically on its
+    next touch."""
+    from crdt_tpu.models import replay as _rp
+    from crdt_tpu.models.incremental import IncrementalReplay
+    from crdt_tpu.models.multidoc import MultiDocServer
+
+    D = int(os.environ.get("BENCH_MT_STEADY_DOCS", 32))
+    K = int(os.environ.get("BENCH_MT_STEADY_OPS", 8192))
+    delta_ops = int(os.environ.get("BENCH_MT_STEADY_DELTA", 4))
+    ticks = int(os.environ.get("BENCH_MT_STEADY_TICKS", 4))
+
+    # one shared trace: both contenders replay the SAME blobs
+    streams = [_SteadyStream(i) for i in range(D)]
+    ids = [f"s{i:04d}" for i in range(D)]
+    history = [[s.delta(K)] for s in streams]
+    warm = [[s.delta(delta_ops) for s in streams] for _ in range(2)]
+    tick_deltas = [
+        [s.delta(delta_ops) for s in streams] for _ in range(ticks)
+    ]
+    full = [
+        history[i] + [w[i] for w in warm]
+        + [td[i] for td in tick_deltas]
+        for i in range(D)
+    ]
+
+    def run(delta_mode: bool):
+        srv = MultiDocServer(delta_ticks=delta_mode)
+        for i, d in enumerate(ids):
+            srv.submit(d, history[i][0])
+        srv.prepare()
+        srv.tick()                      # cold ingest — untimed
+        for w in warm:                  # two untimed warm ticks: the
+            for i, d in enumerate(ids):  # promotion build, then the
+                srv.submit(d, w[i])     # first delta (one-time chain
+            srv.prepare()               # link build) — the timed
+            srv.tick()                  # window is pure steady state
+        delta_serves = 0
+        t0 = time.perf_counter()
+        for t in range(ticks):
+            for i, d in enumerate(ids):
+                srv.submit(d, tick_deltas[t][i])
+            srv.prepare()
+            rep = srv.tick()
+            delta_serves += rep.delta_docs
+        return time.perf_counter() - t0, delta_serves, srv
+
+    run(True)                           # compile/calibration warmup
+    t_steady, delta_serves, steady_srv = run(True)
+    t_full, _, full_srv = run(False)
+
+    if delta_serves == 0:
+        # the loud-failure satellite: a silently degraded incremental
+        # route would leave the "speedup" measuring cold replay twice
+        raise RuntimeError(
+            "steady leg: tenant.delta_docs == 0 — the incremental "
+            "route degraded every doc to cold replay"
+        )
+
+    mismatches = sum(
+        steady_srv.digest(d) != full_srv.digest(d) for d in ids
+    )
+    for i in (0, D // 2, D - 1):        # independent oracle spot-check
+        if steady_srv.cache(ids[i]) != _rp.replay_trace(
+                full[i]).cache:
+            mismatches += 1
+    # beacon twice: the digest cache must skip the clean population
+    # (sentinel.doc_digest_skips — pinned by the smoke registry leg)
+    steady_srv.doc_digests()
+    steady_srv.doc_digests()
+
+    # ---- eviction sub-leg: bounded under a 10x doc-count flood ----
+    flood_D = int(os.environ.get("BENCH_MT_STEADY_FLOOD_DOCS", 40))
+    flood_K = int(os.environ.get("BENCH_MT_STEADY_FLOOD_OPS", 256))
+    fit = max(2, flood_D // 10)
+    budget = IncrementalReplay.estimate_resident_bytes(
+        flood_K + 4 * delta_ops
+    ) * fit
+    fstreams = [_SteadyStream(1000 + i) for i in range(flood_D)]
+    fids = [f"f{i:04d}" for i in range(flood_D)]
+    fhist = [[s.delta(flood_K)] for s in fstreams]
+    fsrv = MultiDocServer(delta_ticks=True,
+                          resident_max_bytes=budget)
+    for i, d in enumerate(fids):
+        fsrv.submit(d, fhist[i][0])
+    fsrv.tick()                         # cold ingest
+    for _pass in range(2):              # rolling promote waves: LRU
+        for start in range(0, flood_D, fit):
+            for i in range(start, min(start + fit, flood_D)):
+                b = fstreams[i].delta(delta_ops)
+                fhist[i].append(b)
+                fsrv.submit(fids[i], b)
+            fsrv.tick()
+    peak = fsrv.resident_peak_bytes()
+    evicted = [d for d in fids if not fsrv.is_resident(d)]
+    reconverge_ok = False
+    if evicted:
+        d = evicted[0]
+        i = fids.index(d)
+        b = fstreams[i].delta(delta_ops)
+        fhist[i].append(b)
+        fsrv.submit(d, b)
+        fsrv.tick()
+        reconverge_ok = (
+            fsrv.cache(d) == _rp.replay_trace(fhist[i]).cache
+        )
+
+    return {
+        "docs": D,
+        "ops_per_doc": K,
+        "delta_ops_per_doc": delta_ops,
+        "ticks": ticks,
+        "steady_s": round(t_steady, 4),
+        "full_replay_s": round(t_full, 4),
+        "docs_per_s": round(D * ticks / t_steady, 1),
+        "full_replay_docs_per_s": round(D * ticks / t_full, 1),
+        "speedup": round(t_full / t_steady, 2),
+        "delta_docs_per_tick": delta_serves / ticks,
+        "delta_rows_per_tick": delta_ops * D,
+        "digest_mismatches": mismatches,
+        "oracle_identical": mismatches == 0,
+        "eviction": {
+            "flood_docs": flood_D,
+            "ops_per_doc": flood_K,
+            "budget_bytes": int(budget),
+            "peak_bytes": int(peak),
+            "evictions": fsrv.eviction_count,
+            "bounded": peak <= budget and fsrv.eviction_count > 0,
+            "reconverge_identical": reconverge_ok,
+        },
+    }
+
+
 def multitenant(argv=None) -> int:
-    """The ``--multitenant`` harness: run the leg, merge the gated
-    section into BENCH_OUT.json (like ``--multichip``), one summary
-    line on stdout. Exits non-zero on a divergent or unshed run —
-    a wrong document or an unbounded flood must never publish as
-    evidence."""
+    """The ``--multitenant`` harness: run the round-14 packing leg
+    AND the round-15 steady-state leg, merge the gated section into
+    BENCH_OUT.json (like ``--multichip``), one summary line on
+    stdout. Exits non-zero on a divergent, unshed, unbounded, or
+    under-10x-steady run — a wrong document, an unbounded flood, or
+    a rotten delta route must never publish as evidence."""
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -1197,14 +1398,23 @@ def multitenant(argv=None) -> int:
     if os.environ.get("BENCH_TRACE", "1") != "0":
         tracer = set_tracer(Tracer(enabled=True))
     leg = multitenant_leg()
+    leg["steady"] = multitenant_steady_leg()
     if tracer is not None:
         counters = tracer.counters()
         leg["docs_packed_counted"] = counters.get(
             "converge.docs_packed", 0)
         leg["tenant_shed_counted"] = counters.get("tenant.shed", 0)
+        leg["steady"]["delta_docs_counted"] = counters.get(
+            "tenant.delta_docs", 0)
+        leg["steady"]["evictions_counted"] = counters.get(
+            "tenant.resident_evictions", 0)
     ok = bool(leg.get("oracle_identical")) \
         and bool(leg["flood"]["bounded"]) \
-        and bool(leg["flood"]["neighbors_unchanged"])
+        and bool(leg["flood"]["neighbors_unchanged"]) \
+        and bool(leg["steady"]["oracle_identical"]) \
+        and leg["steady"]["speedup"] >= 10 \
+        and bool(leg["steady"]["eviction"]["bounded"]) \
+        and bool(leg["steady"]["eviction"]["reconverge_identical"])
     if ok:
         try:
             with open(BENCH_OUT) as f:
@@ -1226,6 +1436,9 @@ def multitenant(argv=None) -> int:
         "speedup": leg["speedup"],
         "p99_per_doc_ms": leg["p99_per_doc_ms"],
         "dispatches_per_tick": leg["dispatches_per_tick"],
+        "steady_docs_per_s": leg["steady"]["docs_per_s"],
+        "steady_speedup": leg["steady"]["speedup"],
+        "steady_evictions": leg["steady"]["eviction"]["evictions"],
         "full_results": os.path.basename(BENCH_OUT),
     }))
     return 0 if ok else 1
@@ -1786,6 +1999,41 @@ def smoke():
         assert "tenant.dispatch_docs" in report["gauges"], \
             "smoke: tenant.dispatch_docs gauge missing"
         out["multitenant_registry_ok"] = True
+        # the round-15 delta-tick registry: a tiny steady-state leg
+        # (small deltas on resident docs + a rolling eviction flood),
+        # digest-identical to the full-replay oracle, lighting the
+        # tenant.delta_* / resident ledger / digest-skip evidence the
+        # steady regression gates read
+        os.environ.setdefault("BENCH_MT_STEADY_DOCS", "6")
+        os.environ.setdefault("BENCH_MT_STEADY_OPS", "96")
+        os.environ.setdefault("BENCH_MT_STEADY_DELTA", "3")
+        os.environ.setdefault("BENCH_MT_STEADY_TICKS", "2")
+        os.environ.setdefault("BENCH_MT_STEADY_FLOOD_DOCS", "20")
+        os.environ.setdefault("BENCH_MT_STEADY_FLOOD_OPS", "48")
+        mts = multitenant_steady_leg()
+        assert mts["oracle_identical"], \
+            "smoke: steady delta ticks diverge from full replay"
+        assert mts["eviction"]["bounded"], \
+            "smoke: resident budget unbounded or evictions missing"
+        assert mts["eviction"]["reconverge_identical"], \
+            "smoke: evicted doc did not reconverge"
+        out["multitenant"]["steady"] = {
+            k: mts[k] for k in ("docs_per_s", "speedup",
+                                "delta_docs_per_tick",
+                                "oracle_identical")
+        }
+        report = tracer.report()
+        for cname in ("tenant.delta_docs", "tenant.delta_rows",
+                      "tenant.promotions",
+                      "tenant.resident_evictions",
+                      "sentinel.doc_digest_skips"):
+            assert report["counters"].get(cname, 0) > 0, \
+                f"smoke: {cname} missing from delta-tick registry"
+        for gname in ("tenant.resident_bytes",
+                      "tenant.resident_docs"):
+            assert gname in report["gauges"], \
+                f"smoke: {gname} gauge missing"
+        out["mt_incremental_registry_ok"] = True
         out["tracer_spans_ok"] = True
     smoke_out = os.environ.get("BENCH_SMOKE_OUT")
     if smoke_out and report is not None:

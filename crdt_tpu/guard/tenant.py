@@ -18,15 +18,24 @@ wait behind it. Same discipline, tenant-scoped:
   ago they were last served (then doc id for determinism), so a
   tenant that fills every tick's row budget cannot starve the rest:
   the docs left out of this tick are FIRST in line for the next.
+- **resident budget** (round 15) — the delta-tick path keeps per-doc
+  RESIDENT state (device matrices + host caches) across ticks; that
+  memory is bounded by :class:`ResidentBudget`
+  (``CRDT_TPU_MT_RESIDENT_BYTES``). Overflow evicts the
+  least-recently-served docs' resident state back to cold replay
+  (``tenant.resident_evictions``) — eviction costs the evicted doc a
+  cold replay on its next touch, never bytes.
 
 Counters (README "Observability" registry): ``tenant.shed`` /
 ``tenant.shed_bytes`` on every trimmed update, the
-``tenant.pending_bytes`` gauge for the queue's live total.
+``tenant.pending_bytes`` gauge for the queue's live total,
+``tenant.resident_evictions`` + the ``tenant.resident_bytes`` /
+``tenant.resident_docs`` gauges for the resident-state ledger.
 """
 
 from __future__ import annotations
 
-from typing import Deque, Dict, Iterable, List, Tuple
+from typing import Callable, Deque, Dict, Iterable, List, Optional, Tuple
 
 
 class TenantBudget:
@@ -59,6 +68,81 @@ def fair_order(doc_ids: Iterable,
     tick index it last converged in (absent = never served, which
     sorts first)."""
     return sorted(doc_ids, key=lambda d: (last_served.get(d, -1), d))
+
+
+class ResidentBudget:
+    """Byte ledger over per-doc resident state (round 15).
+
+    Tracks one server's total resident bytes (each doc's device
+    matrix + host column store, :meth:`crdt_tpu.models.incremental.
+    IncrementalReplay.resident_bytes`) and answers the two questions
+    the tick loop asks:
+
+    - :meth:`fits` — may a doc of this (estimated) size be promoted
+      to resident, after evicting least-recently-served residents to
+      make room? Eviction happens eagerly inside the call via the
+      caller's ``evict`` callback, so the ledger NEVER exceeds the
+      budget: an over-budget promotion is refused before the engine
+      is built, not rolled back after.
+    - :meth:`set_doc` / :meth:`drop_doc` — commit a doc's measured
+      bytes (post-promotion, post-round growth) or clear them on
+      eviction/fallback.
+
+    ``max_bytes=None`` disables the bound (unbudgeted server).
+    ``peak`` tracks the ledger's high-water mark, noted only at
+    STABLE points (:meth:`note_peak` — post-enforcement commit, tick
+    end), so the published bound is the committed resident state,
+    never a mid-enforcement transient."""
+
+    def __init__(self, max_bytes: Optional[int] = None):
+        self.max_bytes = None if max_bytes is None else int(max_bytes)
+        self._bytes: Dict[object, int] = {}
+        self.total = 0
+        self.peak = 0
+
+    def doc_bytes(self, doc_id) -> int:
+        return self._bytes.get(doc_id, 0)
+
+    def has_doc(self, doc_id) -> bool:
+        return doc_id in self._bytes
+
+    def docs(self) -> int:
+        return len(self._bytes)
+
+    def note_peak(self) -> int:
+        self.peak = max(self.peak, self.total)
+        return self.peak
+
+    def set_doc(self, doc_id, nbytes: int) -> None:
+        self.total += int(nbytes) - self._bytes.get(doc_id, 0)
+        self._bytes[doc_id] = int(nbytes)
+
+    def drop_doc(self, doc_id) -> int:
+        """Clear a doc's ledger entry; returns the bytes released."""
+        freed = self._bytes.pop(doc_id, 0)
+        self.total -= freed
+        return freed
+
+    def fits(self, need: int, *,
+             lru: Iterable,
+             evict: Callable[[object], None]) -> bool:
+        """Can ``need`` more resident bytes be admitted? Evicts docs
+        from ``lru`` (least-recently-served first; ids without a
+        ledger entry are skipped) through the caller's ``evict``
+        callback — which must end up calling :meth:`drop_doc` — until
+        the admission fits or no evictable doc remains. The caller
+        counts evictions (its callback owns the observable side)."""
+        if self.max_bytes is None:
+            return True
+        if need > self.max_bytes:
+            return False  # one doc larger than the whole budget
+        for doc_id in lru:
+            if self.total + need <= self.max_bytes:
+                break
+            if doc_id not in self._bytes:
+                continue
+            evict(doc_id)
+        return self.total + need <= self.max_bytes
 
 
 def pack_batches(rows_of: List[Tuple[object, int]],

@@ -549,6 +549,100 @@ class IncrementalReplay:
             sk for sk in touched if sk in self._seg_rows
         )
 
+    # -- delta admissibility (the multi-doc server's probe) -----------
+    @staticmethod
+    def decode_delta(blobs) -> Dict:
+        """Decode an update batch into the engine's columnar format
+        WITHOUT touching replica state: the multi-doc server's
+        admissibility probe decodes once, then feeds the same dec to
+        :meth:`apply_decoded` (or discards it and cold-replays)."""
+        if isinstance(blobs, (bytes, bytearray)):
+            blobs = [bytes(blobs)]
+        return native.dedup_columns(
+            native.decode_updates_columns_any(list(blobs))
+        )
+
+    def delta_admissible(self, dec) -> bool:
+        """Would this decoded batch admit WHOLE — no row stashed — so
+        the incremental route stays byte-identical to a cold replay
+        of the same history? Mirrors :meth:`_admit`'s gate,
+        read-only and conservatively:
+
+        - no outstanding stash (pending rows or rootless segments:
+          only the full apply pass retries those);
+        - every fresh row's clock extends its client's admitted run
+          contiguously (offset clocks — a gap the cold replay would
+          admit but the engine would stash — refuse);
+        - every origin / right / explicit item-parent ref resolves to
+          a resident row or another row of this same batch.
+
+        A refusal costs the caller a cold replay, never bytes."""
+        if self._pending or self._rootless:
+            return False
+        n = len(dec["client"])
+        if n == 0:
+            return True  # delete-only / empty: visibility work only
+        client = np.asarray(dec["client"], np.int64)
+        clock = np.asarray(dec["clock"], np.int64)
+        fresh = np.fromiter(
+            (t not in self._id_row
+             for t in zip(client.tolist(), clock.tolist())),
+            bool, count=n,
+        )
+        idx = np.flatnonzero(fresh)
+        if len(idx) == 0:
+            return True  # pure redelivery: dedup drops every row
+        cl, ck = client[idx], clock[idx]
+        in_batch = set(zip(cl.tolist(), ck.tolist()))
+        order = np.lexsort((ck, cl))
+        cl_s, ck_s = cl[order], ck[order]
+        starts = np.flatnonzero(np.r_[True, cl_s[1:] != cl_s[:-1]])
+        ends = np.r_[starts[1:], len(cl_s)]
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            nxt = self._next_clock.get(int(cl_s[s]), 0)
+            # post-dedup clocks are distinct, so run-span equality IS
+            # contiguity from the resident watermark
+            if int(ck_s[s]) != nxt or \
+                    int(ck_s[e - 1]) - nxt != e - s - 1:
+                return False
+        for c_col, k_col in (
+            ("origin_client", "origin_clock"),
+            ("right_client", "right_clock"),
+            ("parent_client", "parent_clock"),
+        ):
+            c_a = np.asarray(dec[c_col], np.int64)[idx]
+            k_a = np.asarray(dec[k_col], np.int64)[idx]
+            for j in np.flatnonzero(c_a >= 0).tolist():
+                t = (int(c_a[j]), int(k_a[j]))
+                if t not in self._id_row and t not in in_batch:
+                    return False
+        return True
+
+    def resident_bytes(self) -> int:
+        """Budget-accounted footprint of this replica's resident
+        state: the device matrix (when materialized) plus the host
+        integer column store — the allocations that scale with doc
+        size and survive across rounds (content payloads live in the
+        caller's blobs either way). The multi-doc resident budget
+        (``CRDT_TPU_MT_RESIDENT_BYTES``) sums this per doc."""
+        dev = 0
+        if self._mat is not None:
+            dev = int(self._mat.shape[0]) * int(self._mat.shape[1]) * 8
+        return dev + self.cols._cap * len(_Cols.INT_COLS) * 8
+
+    @staticmethod
+    def estimate_resident_bytes(n_rows: int) -> int:
+        """Pre-promotion upper bound of :meth:`resident_bytes` for a
+        doc of ``n_rows`` ops — the budget gate must refuse BEFORE
+        building an over-budget engine, so it works from an estimate:
+        the pow2 host column capacity plus a worst-case device matrix
+        at the same bucket (host-path docs never allocate it; the
+        bound errs toward refusing)."""
+        cap = 1024
+        while cap < max(n_rows, 1):
+            cap *= 2
+        return cap * len(_Cols.INT_COLS) * 8 + 7 * bucket_pow2(cap) * 8
+
     # -- local-op fast path -------------------------------------------
     def admit_local(self, recs, ds: Optional[DeleteSet] = None) -> None:
         """Direct admission for locally-born records — the resident
@@ -1558,26 +1652,25 @@ class IncrementalReplay:
                 self._intern_clients(np.concatenate([
                     self.cols.col("client")[rows], oc_tail[oc_tail >= 0],
                 ]))
-                delta = np.zeros((8, kpad), np.int64)
-                delta[3:6, :] = -1
-                delta[7, :] = np.iinfo(np.int64).max
-                delta[7, : len(dev_segs)] = dev_segs
                 oc_raw = oc_tail
-                delta[0, :k] = self._dense_of(self.cols.col("client")[rows])
-                delta[1, :k] = self.cols.col("clock")[rows]
-                delta[2, :k] = np.maximum(self.cols.col("pref")[rows], 0)
-                delta[3, :k] = self.cols.col("kid")[rows]
-                delta[4, :k] = np.where(oc_raw >= 0, self._dense_of(
-                    np.clip(oc_raw,
-                            self._clients[0] if self._clients else 0,
-                            None)
-                ), -1)
-                delta[5, :k] = self.cols.col("ock")[rows]
-                delta[6, :k] = self.cols.col("pref")[rows] >= 0
+                # the shared resident-base delta staging (ops/packed):
                 # rows without a resolvable parent (incl. GC fillers)
-                # stay invalid on device: origin lookups that miss
+                # stay invalid on device — origin lookups that miss
                 # them fall back to root attachment, same convention
                 # as the cold path
+                delta = pk.stage_resident_delta(
+                    self._dense_of(self.cols.col("client")[rows]),
+                    self.cols.col("clock")[rows],
+                    self.cols.col("pref")[rows],
+                    self.cols.col("kid")[rows],
+                    np.where(oc_raw >= 0, self._dense_of(
+                        np.clip(oc_raw,
+                                self._clients[0] if self._clients else 0,
+                                None)
+                    ), -1),
+                    self.cols.col("ock")[rows],
+                    dev_segs, kpad,
+                )
                 self._ensure_mat()
                 need = self.n_dev + kpad
                 with enable_x64(True):

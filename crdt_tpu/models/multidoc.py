@@ -1,70 +1,103 @@
-"""Multi-tenant batched serving: one converge dispatch for many docs.
+"""Multi-tenant batched serving: one converge dispatch for many docs,
+and delta-cost ticks for the docs the server already holds.
 
 ROOFLINE.md pins a fixed per-dispatch floor on the tunnelled platform
 (~6 ms on the v5e-class rig), so a server hosting thousands of SMALL
 independent docs pays almost pure overhead when each doc converges in
 its own dispatch — a 64-op doc costs the same floor as a 100k-op one.
 This module is ROADMAP open item 2: amortize the floor by packing many
-docs' deltas into ONE fused converge per tick.
+docs' deltas into ONE fused converge per tick (round 14), and make the
+STEADY STATE — a 3-op delta landing on a 100k-op doc the server
+already converged — cost a delta, not a cold replay (round 15).
 
-The engine is the round-14 staging tentpole: doc-id is a first-class
-segment column in :mod:`crdt_tpu.ops.packed` (client ids fold into
-doc-composite ids, parent refs intern doc-major), so a whole tenant
-batch converges in one program with per-doc outputs byte-identical to
-each doc converged alone (tests/test_multidoc.py pins {2, 3, 17} docs
-with mixed LWW/YATA ops, deletes, and empty docs on both the
-single-chip and forced-2-device sharded routes — the sharded
-partition places whole DOCS per chip first).
+The cold engine is the round-14 staging tentpole: doc-id is a
+first-class segment column in :mod:`crdt_tpu.ops.packed` (client ids
+fold into doc-composite ids, parent refs intern doc-major), so a whole
+tenant batch converges in one program with per-doc outputs
+byte-identical to each doc converged alone (tests/test_multidoc.py
+pins {2, 3, 17} docs with mixed LWW/YATA ops, deletes, and empty docs
+on both the single-chip and forced-2-device sharded routes — the
+sharded partition places whole DOCS per chip first).
 
-:class:`MultiDocServer` is the tick loop on top:
+The warm engine is the round-15 tentpole — **delta ticks**: each doc
+the server keeps serving holds RESIDENT state across ticks (an
+:class:`crdt_tpu.models.incremental.IncrementalReplay`: device-side
+converged matrix above the crossover, host winner/order caches always)
+and a dirty doc whose new ops are **SV-admissible** to the incremental
+route (per-client clocks contiguous with the resident state vector,
+every origin/right/parent ref resolvable — the engine's own admission
+gate, probed read-only by :meth:`IncrementalReplay.delta_admissible`)
+stages ONLY its delta: the host path splices winners/orders in
+O(delta), the device path ships the delta block against the resident
+matrix (:func:`crdt_tpu.ops.packed.stage_resident_delta` +
+``_splice_select_converge`` — history never restages). Anything else
+falls back PER DOC to the stock cold replay through the round-14
+packed batch: offset clocks (a gap the cold oracle would admit but the
+engine would stash), an evicted resident, first sight. Fallbacks are
+conservative — they cost a cold replay, never bytes — and the two
+routes are digest-identical by construction (differential-pinned).
+
+Resident memory is bounded: :class:`crdt_tpu.guard.tenant.
+ResidentBudget` (``CRDT_TPU_MT_RESIDENT_BYTES``) ledgers each doc's
+resident bytes; overflow evicts the least-recently-served docs'
+resident state back to cold replay (``tenant.resident_evictions``),
+enforced at every commit so the ledger never exceeds the budget —
+evicted docs reconverge byte-identically on their next touch.
+
+Serving discipline per tick:
 
 - **submit** — per-tenant admission queues under the
   :class:`crdt_tpu.guard.tenant.TenantBudget` byte/count budget:
   a flooding tenant's own backlog is trimmed oldest-first
   (keep-the-newest), other tenants' queues and converged bytes are
   untouched (the round-10 "degrade, don't die" rule, tenant-scoped).
-- **prepare** — the ingest-side work (wire decode + kernel-column
-  staging) runs per doc OFF the tick, the way the streaming executor
-  already overlaps decode against in-flight converges: a real
-  deployment decodes updates where they arrive; the tick spends its
-  time on the dispatch it exists to amortize. ``tick()`` prepares
-  any stale doc itself, so calling ``prepare()`` is an optimization,
-  never a correctness requirement.
+- **prepare** — the ingest-side work runs per doc OFF the tick:
+  resident docs decode only their PENDING delta (plus the
+  admissibility probe); cold docs decode their full history and
+  stage kernel columns as before. ``tick()`` prepares any stale doc
+  itself, so calling ``prepare()`` is an optimization, never a
+  correctness requirement.
 - **tick** — dirty docs order least-recently-served-first
-  (:func:`crdt_tpu.guard.tenant.fair_order`), bin-pack into dispatch
-  batches bounded by ``max_rows_per_dispatch`` rows
-  (:func:`~crdt_tpu.guard.tenant.pack_batches`; the staged buckets
-  round up to powers of two, so the cap IS the padded bucket
-  ceiling), and each batch converges in one dispatch — the sharded
-  multi-chip route when active (docs partition whole across chips),
-  the single-chip packed plan otherwise, with a per-doc fallback
-  when a batch exceeds the packed staging bounds.
-- **unpack** — the one fetched result splits back into per-doc
-  caches/digests. Plain docs (root-parented content rows, no right
-  origins, no nested types — the overwhelming small-tenant shape)
-  take a VECTORIZED unpack: one global visibility pass over the
-  whole batch (doc-composite delete ranges), one stable partition
-  of the winner/stream arrays by doc, then a tight per-doc cache
-  build. Anything else — nested collections, right origins, GC/
-  format rows, hard segments, the ``ix`` index root — routes that
-  doc's slice through the stock replay gather/materialize, so the
-  fast path can never change bytes (differential-pinned either way).
+  (:func:`crdt_tpu.guard.tenant.fair_order`) and route: admissible
+  deltas splice into their resident engines (zero dispatches below
+  the host/device crossover); docs served before but not resident
+  PROMOTE (one engine build over the full history, budget
+  permitting — the one-time warm cost that buys every later delta
+  tick); the rest bin-pack into the round-14 cold dispatch batches
+  (``max_rows_per_dispatch``, double-buffered async dispatches,
+  vectorized unpack with the stock gather as exact fallback).
+- **serve** — the live-ingest scheduler (round 15): a bounded tick
+  loop over a STREAM of updates whose ingest hook drains the next
+  batches while a tick's converge dispatches are in flight, so
+  steady-state throughput is bounded by delta size, not doc size.
 
-Per-doc digests feed the multi-doc divergence sentinel
-(:class:`crdt_tpu.obs.sentinel.MultiDocSentinel`), which attributes
-a fork to the ONE doc that diverged.
+Per-doc digests are canonical (dict keys sorted at every depth — the
+delta route builds map dicts in integration order, the cold
+materialize in winner order) and LAZY: converging never digests;
+:meth:`MultiDocServer.digest` / :meth:`doc_digests` compute on read
+and cache per (op count, serve tick), so a beacon over a mostly-clean
+doc population costs digest work only for the docs that moved
+(``sentinel.doc_digest_skips``). They feed the multi-doc divergence
+sentinel (:class:`crdt_tpu.obs.sentinel.MultiDocSentinel`), which
+attributes a fork to the ONE doc that diverged.
 
 Evidence: ``converge.docs_packed`` (docs per staged plan, counted at
 the staging seam), ``tenant.*`` counters/gauges (README
-"Observability" registry), and the ``bench.py --multitenant`` leg
-publishing ``docs_converged_per_s`` / ``p99_per_doc_ms`` /
-``dispatches_per_tick`` against the one-dispatch-per-doc baseline
-(the same server with ``pack_docs=False``: the stock per-doc replay
-pipeline), regression-gated in ``tools/metrics_diff.py``.
+"Observability" registry — round 15 adds ``tenant.delta_docs`` /
+``delta_rows`` / ``promotions`` / ``delta_fallbacks`` /
+``resident_evictions`` and the ``tenant.resident_bytes`` /
+``resident_docs`` gauges), and the ``bench.py --multitenant`` legs:
+round-14 packing (``docs_converged_per_s`` vs the one-dispatch-per-doc
+baseline) plus the round-15 steady-state leg (N ticks of small deltas
+on large resident docs vs the full-replay tick, ``steady.speedup``),
+both digest-asserted against the cold oracle and regression-gated in
+``tools/metrics_diff.py``.
 
 Env knobs: ``CRDT_TPU_MT_MAX_ROWS`` (dispatch row cap, default
 2^16), ``CRDT_TPU_MT_PENDING_BYTES`` / ``CRDT_TPU_MT_PENDING_UPDATES``
-(per-tenant admission budget defaults).
+(per-tenant admission budget defaults), ``CRDT_TPU_MT_RESIDENT_BYTES``
+(resident-state budget; unset = unbounded), ``CRDT_TPU_MT_DELTA_TICKS``
+(``0`` pins every tick to the round-14 full-replay path).
 """
 
 from __future__ import annotations
@@ -73,19 +106,26 @@ import hashlib
 import os
 import time
 from collections import deque
-from typing import Dict, List, NamedTuple, Optional, Sequence
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
-from crdt_tpu.guard.tenant import TenantBudget, fair_order, pack_batches
+from crdt_tpu.guard.tenant import (
+    ResidentBudget,
+    TenantBudget,
+    fair_order,
+    pack_batches,
+)
 from crdt_tpu.models import replay as rp
+from crdt_tpu.models.incremental import IncrementalReplay
 from crdt_tpu.obs.tracer import get_tracer
 from crdt_tpu.ops import packed
-from crdt_tpu.ops.device import NULLI
 
 _MAX_ROWS_ENV = "CRDT_TPU_MT_MAX_ROWS"
 _PENDING_BYTES_ENV = "CRDT_TPU_MT_PENDING_BYTES"
 _PENDING_UPDATES_ENV = "CRDT_TPU_MT_PENDING_UPDATES"
+_RESIDENT_BYTES_ENV = "CRDT_TPU_MT_RESIDENT_BYTES"
+_DELTA_TICKS_ENV = "CRDT_TPU_MT_DELTA_TICKS"
 
 
 def _env_int(name: str, default: int) -> int:
@@ -98,18 +138,43 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _canon(v, out: List[str]) -> None:
+    if isinstance(v, dict):
+        out.append("{")
+        for k in sorted(v, key=str):
+            out.append("%r:" % (k,))
+            _canon(v[k], out)
+            out.append(",")
+        out.append("}")
+    elif isinstance(v, (list, tuple)):
+        # sequences of plain scalars (the overwhelming cache shape —
+        # a 100k-element text stream) repr whole at C speed; only a
+        # sequence that can reach a dict needs per-element recursion
+        # for the key sort
+        if not any(isinstance(x, (dict, list, tuple)) for x in v):
+            out.append(repr(list(v) if isinstance(v, tuple) else v))
+            return
+        out.append("[")
+        for x in v:
+            _canon(x, out)
+            out.append(",")
+        out.append("]")
+    else:
+        out.append(repr(v))
+
+
 def cache_digest(cache: dict) -> str:
-    """Canonical digest of a converged cache: top-level root names
-    sorted, values repr'd (C-speed). Below the top level, equal
-    CONVERGED states hold equal structures in equal order — winner
-    and stream orders are deterministic functions of the union, the
-    tentpole's per-doc identity guarantee — so repr is canonical for
-    the comparison surfaces the digest serves (fast vs stock unpack,
-    packed vs per-doc baseline, server vs server over one topic)."""
-    body = ",".join(
-        "%r:%r" % (k, cache[k]) for k in sorted(cache, key=str)
-    )
-    return hashlib.sha1(body.encode()).hexdigest()[:16]
+    """Canonical digest of a converged cache: dict keys sorted at
+    EVERY depth, sequence order preserved. Round 15 made the
+    canonicalization recursive — the delta route's incremental
+    engine builds its map dicts in integration order while the cold
+    materialize builds them in winner order, and equal converged
+    STATES must digest equal regardless of which route produced
+    them. Document order (lists) is itself the converged output, so
+    it stays order-sensitive."""
+    out: List[str] = []
+    _canon(cache, out)
+    return hashlib.sha1("".join(out).encode()).hexdigest()[:16]
 
 
 def _fast_unpack_ok(dec) -> bool:
@@ -133,15 +198,22 @@ def _fast_unpack_ok(dec) -> bool:
 
 
 class _DocState:
-    __slots__ = ("blobs", "pending", "cache", "digest", "n_ops",
+    __slots__ = ("blobs", "pending", "in_flight", "cache", "n_ops",
                  "dirty_since", "latency_s", "served_tick",
-                 "dec", "cols", "ds", "fast_ok", "stale")
+                 "dec", "cols", "ds", "fast_ok", "stale",
+                 "resident", "delta_dec", "delta_ok", "no_promote_len",
+                 "_digest", "_digest_key")
 
     def __init__(self):
         self.blobs: List[bytes] = []      # admitted, converged history
-        self.pending: deque = deque()     # admitted, awaiting a tick
+        self.pending: deque = deque()     # admitted, awaiting prepare
+        # admitted blobs a prepared decode COVERS, still unconverged.
+        # Live ingest (the serve() hook) can append to ``pending``
+        # while this tick's dispatches are in flight; settle moves
+        # exactly ``in_flight`` into history, so a mid-tick arrival
+        # can never be marked converged without being converged.
+        self.in_flight: List[bytes] = []
         self.cache: dict = {}
-        self.digest: str = cache_digest({})
         self.n_ops: int = 0
         self.dirty_since: Optional[float] = None
         self.latency_s: Optional[float] = None
@@ -151,32 +223,67 @@ class _DocState:
         self.ds = None                    # prepared delete set
         self.fast_ok = False
         self.stale = True                 # prepared state out of date
+        # round 15: the delta-tick route
+        self.resident: Optional[IncrementalReplay] = None
+        self.delta_dec = None             # prepared PENDING-only decode
+        self.delta_ok = False             # delta admissible this tick
+        # history length (blob count) at which the engine last
+        # refused this doc (stash leftovers / an inadmissible delta):
+        # promotion retries only once the history has GROWN past it —
+        # a later delta may fill the clock gap, so the pin is not
+        # permanent, but an unchanged history is never re-attempted
+        self.no_promote_len = -1
+        self._digest: Optional[str] = None
+        self._digest_key = None
+
+    def history_len(self) -> int:
+        return len(self.blobs) + len(self.in_flight) + \
+            len(self.pending)
 
 
 class TickReport(NamedTuple):
     docs: int              # docs converged this tick
     dispatches: int        # converge dispatches issued
-    rows: int              # total staged rows
+    rows: int              # total staged rows (cold history + deltas)
     fallback_docs: int     # docs that fell back to per-doc dispatch
     batches: tuple = ()    # docs per dispatch, in dispatch order
+    delta_docs: int = 0    # docs served via the resident delta route
+    delta_rows: int = 0    # delta rows those docs staged (their whole
+    #                        staging cost — history stayed resident)
+    promotions: int = 0    # docs promoted to resident this tick
+
+
+class ServeReport(NamedTuple):
+    ticks: int
+    docs: int              # doc-serves summed over all ticks
+    delta_docs: int
+    cold_docs: int         # cold-replay serves (incl. promotions)
+    promotions: int
+    dispatches: int
+    submitted: int         # updates admitted from the source
 
 
 class MultiDocServer:
     """Tick-batched multi-tenant converge server (see module doc).
 
-    A tick re-converges each dirty doc's FULL admitted history (the
-    cold staged path — the same replay semantics every differential
-    suite oracles against), so per-doc outputs are exactly what
-    ``replay_trace`` of the same blobs yields. ``pack_docs=False``
-    degrades to one dispatch per doc through the stock replay
-    pipeline — the one-dispatch-per-doc baseline the bench leg
-    measures the packing win against."""
+    A tick serves each dirty doc by the cheapest EXACT route: an
+    SV-admissible delta splices into the doc's resident incremental
+    engine (delta-cost — the steady state); otherwise the doc
+    re-converges its full admitted history through the round-14
+    packed cold path (the same replay semantics every differential
+    suite oracles against), so per-doc outputs are always exactly
+    what ``replay_trace`` of the same blobs yields.
+    ``delta_ticks=False`` (or ``pack_docs=False`` for the
+    one-dispatch-per-doc shape) degrades to the stock full-replay
+    tick — the baselines the bench legs measure against."""
 
     def __init__(self, *, max_rows_per_dispatch: Optional[int] = None,
                  tenant_max_pending_bytes: Optional[int] = None,
                  tenant_max_pending_updates: Optional[int] = None,
                  shards: Optional[int] = None,
-                 pack_docs: bool = True):
+                 pack_docs: bool = True,
+                 delta_ticks: Optional[bool] = None,
+                 resident_max_bytes: Optional[int] = None):
         self.max_rows = (max_rows_per_dispatch
                          if max_rows_per_dispatch is not None
                          else _env_int(_MAX_ROWS_ENV, 1 << 16))
@@ -188,12 +295,28 @@ class MultiDocServer:
                          if tenant_max_pending_updates is not None
                          else _env_int(_PENDING_UPDATES_ENV, 4096)),
         )
+        if delta_ticks is None:
+            delta_ticks = os.environ.get(_DELTA_TICKS_ENV, "1") != "0"
+        self.delta_ticks = bool(delta_ticks)
+        if resident_max_bytes is None:
+            env = os.environ.get(_RESIDENT_BYTES_ENV, "")
+            resident_max_bytes = int(env) if env else None
+        self.rbudget = ResidentBudget(resident_max_bytes)
         self.shards = shards
         self.pack_docs = pack_docs
         self.ticks = 0
         self.shed_count = 0
         self.shed_bytes = 0
+        self.eviction_count = 0
+        self.delta_fallback_count = 0
         self._docs: Dict = {}
+        # docs already served by the CURRENT tick (aliased to the
+        # tick loop's set): protected best-effort from budget sweeps
+        self._serving: set = set()
+        # live-ingest hook (serve()): called while a tick's converge
+        # dispatches are in flight, so the NEXT tick's decode overlaps
+        # this tick's device work
+        self._ingest_hook: Optional[Callable[[], int]] = None
         # running pending-queue byte total: the gauge (and the
         # public accessor) must not re-scan every tenant's deque on
         # each admitted blob — ingest stays O(1) per update
@@ -242,27 +365,68 @@ class MultiDocServer:
         return sum(self.submit(doc_id, b) for b in blobs)
 
     def prepare(self) -> int:
-        """Run the ingest-side decode + kernel-column staging for
-        every stale doc (full admitted history). Idempotent; the tick
-        calls it for anything the ingest thread has not covered.
-        Returns the number of docs prepared."""
+        """Run the ingest-side work for every stale doc: resident
+        docs decode only their PENDING delta and probe admissibility;
+        cold docs decode + stage their full admitted history. Docs
+        that will PROMOTE this tick are left to the tick (the engine
+        build decodes for itself — a throwaway cold staging would be
+        pure waste). Idempotent; the tick calls it for anything the
+        ingest thread has not covered. Returns the number of docs
+        prepared."""
         n = 0
-        for st in self._docs.values():
+        for d, st in list(self._docs.items()):
             if not st.stale:
                 continue
-            dec = rp.decode(st.blobs + list(st.pending))
-            st.cols, st.ds = rp.stage(dec)
-            st.dec = dec
-            st.fast_ok = _fast_unpack_ok(dec)
-            st.stale = False
+            st.delta_ok = False
+            if self.delta_ticks and (st.pending or st.in_flight):
+                if st.resident is not None:
+                    self._take_pending(st)
+                    dec = IncrementalReplay.decode_delta(st.in_flight)
+                    if st.resident.delta_admissible(dec):
+                        st.delta_dec = dec
+                        st.delta_ok = True
+                        st.stale = False
+                        n += 1
+                        continue
+                    # inadmissible (offset clocks, unresolvable
+                    # refs): the resident engine cannot absorb this
+                    # delta exactly — release it, cold-replay
+                    self._drop_resident(d)
+                if self._promotable(st):
+                    # leave stale: the tick's promotion decodes for
+                    # itself, or cold-prepares on a budget refusal
+                    continue
+            self._prepare_cold_one(st)
             n += 1
         return n
+
+    @staticmethod
+    def _take_pending(st) -> None:
+        """Move the admission queue into the in-flight window a
+        prepared decode will cover (see ``_DocState.in_flight``)."""
+        if st.pending:
+            st.in_flight.extend(st.pending)
+            st.pending.clear()
+
+    def _prepare_cold_one(self, st) -> None:
+        self._take_pending(st)
+        dec = rp.decode(st.blobs + st.in_flight)
+        st.cols, st.ds = rp.stage(dec)
+        st.dec = dec
+        st.fast_ok = _fast_unpack_ok(dec)
+        st.stale = False
+
+    def _promotable(self, st) -> bool:
+        return (self.delta_ticks and st.resident is None
+                and st.history_len() != st.no_promote_len
+                and st.served_tick >= 0)
 
     def pending_bytes(self) -> int:
         return self._pending_total
 
     def dirty_docs(self) -> List:
-        return [d for d, st in self._docs.items() if st.pending]
+        return [d for d, st in self._docs.items()
+                if st.pending or st.in_flight]
 
     # ---- results -----------------------------------------------------
 
@@ -270,31 +434,78 @@ class MultiDocServer:
         return list(self._docs)
 
     def cache(self, doc_id) -> dict:
-        return self._docs[doc_id].cache
+        return self._cache_of(self._docs[doc_id])
+
+    @staticmethod
+    def _cache_of(st) -> dict:
+        # resident docs serve the engine's LAZY view: a delta tick
+        # never materializes (the engine only marks touched segments
+        # dirty); the flush happens here, on read — the engine's own
+        # cache contract, surfaced through the server
+        return st.resident.cache if st.resident is not None \
+            else st.cache
 
     def digest(self, doc_id) -> str:
-        return self._docs[doc_id].digest
+        """Canonical digest of the doc's converged cache, computed
+        LAZILY and cached per (op count, serve tick): converging
+        never digests, and a clean doc re-beacons at zero digest
+        cost (round-15 satellite)."""
+        return self._digest_of(self._docs[doc_id])
+
+    def _digest_of(self, st) -> str:
+        key = (st.n_ops, st.served_tick)
+        if st._digest is None or st._digest_key != key:
+            st._digest = cache_digest(self._cache_of(st))
+            st._digest_key = key
+        return st._digest
 
     def latency_s(self, doc_id) -> Optional[float]:
         """Submit-to-converged latency of the doc's last service."""
         return self._docs[doc_id].latency_s
 
+    def is_resident(self, doc_id) -> bool:
+        """Does this doc currently hold resident incremental state
+        (vs. cold-replaying on its next touch)?"""
+        return self._docs[doc_id].resident is not None
+
+    def resident_doc_count(self) -> int:
+        return self.rbudget.docs()
+
+    def resident_bytes_total(self) -> int:
+        return self.rbudget.total
+
+    def resident_peak_bytes(self) -> int:
+        return self.rbudget.peak
+
     def doc_digests(self) -> Dict:
         """The multi-doc sentinel's beacon source: per-doc digest +
         op count (the count is the lag guard — unequal counts are
-        propagation lag, not a fork)."""
-        return {
-            d: {"digest": st.digest, "ops": st.n_ops}
-            for d, st in self._docs.items()
-        }
+        propagation lag, not a fork). Digests cached per (op count,
+        serve tick): docs untouched since the last beacon are
+        SKIPPED, counted as ``sentinel.doc_digest_skips`` — a beacon
+        over a mostly-clean population costs digest work only for
+        the docs that moved."""
+        tracer = get_tracer()
+        skips = 0
+        out = {}
+        for d, st in self._docs.items():
+            if (st._digest is not None
+                    and st._digest_key == (st.n_ops, st.served_tick)):
+                skips += 1
+            out[d] = {"digest": self._digest_of(st), "ops": st.n_ops}
+        if tracer.enabled and skips:
+            tracer.count("sentinel.doc_digest_skips", skips)
+        return out
 
     # ---- the tick loop -----------------------------------------------
 
     def tick(self) -> TickReport:
-        """Converge every dirty doc: fairness-ordered admission,
-        bin-packed dispatch batches, per-doc unpack (see module doc).
-        One tick fully drains the dirty set — fairness decides WHO
-        shares a dispatch, the row cap decides how many dispatches."""
+        """Converge every dirty doc: fairness-ordered admission, then
+        per doc the cheapest exact route — admissible deltas through
+        the resident engines, promotions for warm docs without one,
+        bin-packed cold dispatch batches for the rest (see module
+        doc). One tick fully drains the dirty set — fairness decides
+        WHO goes first, the row cap decides how many dispatches."""
         self.ticks += 1
         self.prepare()
         dirty = fair_order(self.dirty_docs(),
@@ -303,17 +514,50 @@ class MultiDocServer:
         if not dirty:
             return TickReport(0, 0, 0, 0)
         tracer = get_tracer()
-        staged = [(d, len(self._docs[d].dec["client"])) for d in dirty]
+        # route decision per dirty doc. Promotion-time eviction must
+        # not thrash docs ALREADY served this tick (their resident
+        # state is freshest), so those are protected from the
+        # budget's LRU sweep; docs still waiting their turn are fair
+        # game — they reroute to the cold path when it comes.
+        served_set: set = set()
+        self._serving = served_set
+        delta_served: List = []
+        cold: List = []
+        delta_rows = 0
+        promotions = 0
+        try:
+            for d in dirty:
+                st = self._docs[d]
+                if st.delta_ok and st.resident is not None:
+                    delta_rows += self._apply_delta(d)
+                    delta_served.append(d)
+                    served_set.add(d)
+                    continue
+                if st.stale:
+                    if self._try_promote(d, protect=served_set | {d}):
+                        promotions += 1
+                        served_set.add(d)
+                        continue
+                    self._prepare_cold_one(st)
+                cold.append(d)
+        finally:
+            self._serving = set()
+        for d in delta_served:
+            self._settle([d])
+        n_delta = len(delta_served)
+
+        staged = [(d, len(self._docs[d].dec["client"])) for d in cold]
         batches = (pack_batches(staged, self.max_rows)
                    if self.pack_docs else [[d] for d, _ in staged])
         dispatches = 0
         fallback = 0
-        rows = 0
+        rows = delta_rows
         sizes = []
         # double-buffered pipeline (the streaming executor's overlap
         # pattern): while batch i executes on device, the host stages
-        # + dispatches batch i+1 and unpacks batch i-1 — the fetch is
-        # the only synchronization point
+        # + dispatches batch i+1, unpacks batch i-1, and drains the
+        # live-ingest hook — the fetch is the only synchronization
+        # point
         inflight: deque = deque()
         for batch in batches:
             n_disp, n_fb, handle = self._converge_batch(batch)
@@ -323,23 +567,225 @@ class MultiDocServer:
             sizes.append(len(batch))
             if handle is not None:
                 inflight.append((batch, handle))
+                hook = self._ingest_hook
+                if hook is not None:
+                    hook()  # ingest overlaps the in-flight dispatch
                 if len(inflight) > 1:
                     self._finish_batch(*inflight.popleft())
             else:
                 self._settle(batch)
         while inflight:
             self._finish_batch(*inflight.popleft())
+        self.rbudget.note_peak()
         if tracer.enabled:
             tracer.count("tenant.docs_converged", len(dirty))
             tracer.gauge("tenant.dispatch_docs",
                          max(sizes) if sizes else 0)
             tracer.gauge("tenant.pending_bytes", self.pending_bytes())
+            tracer.gauge("tenant.resident_bytes", self.rbudget.total)
+            tracer.gauge("tenant.resident_docs", self.rbudget.docs())
+            if n_delta:
+                tracer.count("tenant.delta_docs", n_delta)
+            if delta_rows:
+                tracer.count("tenant.delta_rows", delta_rows)
+            if promotions:
+                tracer.count("tenant.promotions", promotions)
             if fallback:
                 tracer.count("tenant.fallback_docs", fallback)
         return TickReport(len(dirty), dispatches, rows, fallback,
-                          tuple(sizes))
+                          tuple(sizes), n_delta, delta_rows,
+                          promotions)
 
-    # ---- converge engines --------------------------------------------
+    # ---- the live-ingest scheduler -----------------------------------
+
+    def serve(self, source, *, max_ticks: Optional[int] = None,
+              idle_ticks: int = 1) -> ServeReport:
+        """Live-ingest tick loop (round 15): drive the server against
+        a STREAM of updates instead of a pre-drained backlog.
+        ``source`` is an iterator whose each ``next()`` yields an
+        iterable of ``(doc_id, blob)`` pairs (or None for an idle
+        poll); exhaustion means the stream drained. Each loop
+        iteration admits one batch and ticks; while a tick's converge
+        dispatches are IN FLIGHT the ingest hook drains further
+        batches into the admission queues, so the next tick's decode
+        overlaps this tick's device work (the streaming executor's
+        overlap discipline at the server level). The loop is bounded:
+        ``max_ticks`` caps it hard, and it stops after ``idle_ticks``
+        consecutive empty ticks (immediately, once the source is
+        exhausted and nothing is dirty)."""
+        it = iter(source)
+        state = {"exhausted": False, "submitted": 0}
+
+        def pull() -> int:
+            if state["exhausted"]:
+                return 0
+            try:
+                batch = next(it)
+            except StopIteration:
+                state["exhausted"] = True
+                return 0
+            n = 0
+            for doc_id, blob in (batch or ()):
+                self.submit(doc_id, blob)
+                n += 1
+            state["submitted"] += n
+            return n
+
+        ticks = docs = delta = promo = disp = idle = 0
+        while max_ticks is None or ticks < max_ticks:
+            pull()
+            self._ingest_hook = pull
+            try:
+                rep = self.tick()
+            finally:
+                self._ingest_hook = None
+            ticks += 1
+            docs += rep.docs
+            delta += rep.delta_docs
+            promo += rep.promotions
+            disp += rep.dispatches
+            if rep.docs == 0:
+                if state["exhausted"] and not self.dirty_docs():
+                    break
+                idle += 1
+                if idle >= idle_ticks:
+                    break
+            else:
+                idle = 0
+        return ServeReport(ticks, docs, delta, docs - delta, promo,
+                           disp, state["submitted"])
+
+    # ---- the delta route (round 15) ----------------------------------
+
+    def _apply_delta(self, d) -> int:
+        """One admissible delta through the doc's resident engine:
+        the delta rows are the only staging this doc pays — host-
+        exact splices below the crossover, a delta-only device
+        splice against the resident matrix above it."""
+        st = self._docs[d]
+        dec, st.delta_dec, st.delta_ok = st.delta_dec, None, False
+        k = int(len(dec["client"]))
+        st.resident.apply_decoded(dec)
+        self._adopt_engine(d)
+        return k
+
+    def _try_promote(self, d, *, protect=frozenset()) -> bool:
+        """Build a resident engine over the doc's full history (the
+        one-time warm cost that buys every later delta tick). Refused
+        when the budget cannot fit the ESTIMATED footprint even after
+        LRU eviction, or when the engine cannot settle the history
+        exactly (stashed/rootless leftovers — offset clocks, refs
+        that never arrive: such a doc stays cold until its history
+        GROWS again, when a retry may find the gap filled)."""
+        st = self._docs[d]
+        if not self._promotable(st):
+            return False
+        self._take_pending(st)
+        est_rows = (st.n_ops
+                    + sum(len(b) for b in st.in_flight) // 8 + 1)
+        est = IncrementalReplay.estimate_resident_bytes(est_rows)
+        if not self.rbudget.fits(
+            est, lru=self._lru_residents(protect),
+            evict=self._evict_resident,
+        ):
+            return False
+        eng = IncrementalReplay()
+        eng.apply(st.blobs + st.in_flight)
+        if eng._pending or eng._rootless:
+            st.no_promote_len = st.history_len()
+            return False
+        st.resident = eng
+        self._adopt_engine(d)
+        self._settle([d])
+        return True
+
+    def _adopt_engine(self, d) -> None:
+        """Commit a doc's engine-converged state: op count from the
+        engine, digest invalidated (the cache itself stays LAZY —
+        reads flush it through :meth:`_cache_of`, so a delta tick
+        pays zero materialization), resident bytes ledgered — and
+        the budget enforced at the commit, so the ledger NEVER
+        exceeds it (a doc that alone outgrows the whole budget is
+        evicted on the spot and stays cold until its history
+        grows)."""
+        st = self._docs[d]
+        st.n_ops = st.resident.cols.n
+        st._digest = None
+        self.rbudget.set_doc(d, st.resident.resident_bytes())
+        if self.rbudget.max_bytes is not None:
+            # protection is best-effort (docs already served this
+            # tick hold the freshest state — evicting one buys a
+            # full re-promotion on its next delta), the bound is
+            # hard: if the protected sweep cannot reach it, sweep
+            # again without protection, and a doc that ALONE
+            # outgrows the whole budget is evicted on the spot (and
+            # not re-attempted until its history grows)
+            self._enforce_budget(protect={d} | self._serving)
+            if self.rbudget.total > self.rbudget.max_bytes:
+                self._enforce_budget(protect={d})
+            if self.rbudget.total > self.rbudget.max_bytes:
+                self._evict_resident(d)
+                st.no_promote_len = st.history_len()
+        self.rbudget.note_peak()
+
+    def _lru_residents(self, protect=frozenset()) -> List:
+        return sorted(
+            (d for d, st in self._docs.items()
+             if st.resident is not None and d not in protect),
+            key=lambda d: (self._docs[d].served_tick, str(d)),
+        )
+
+    def _enforce_budget(self, protect=frozenset()) -> None:
+        for d in self._lru_residents(protect):
+            if self.rbudget.total <= self.rbudget.max_bytes:
+                break
+            self._evict_resident(d)
+
+    def _evict_resident(self, d) -> None:
+        """Budget pressure: release the doc's resident state back to
+        cold replay. Its converged cache stays served; only the
+        engine memory goes — the doc reconverges byte-identically
+        (cold, or via a fresh promotion) on its next touch."""
+        st = self._docs[d]
+        if st.resident is None:
+            return
+        st.cache = st.resident.cache  # materialize the lazy view
+        st.resident = None
+        st.delta_dec = None
+        st.delta_ok = False
+        if st.pending or st.in_flight:
+            st.stale = True  # re-route what was prepared as a delta
+        self.rbudget.drop_doc(d)
+        self.eviction_count += 1
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.count("tenant.resident_evictions")
+            tracer.gauge("tenant.resident_bytes", self.rbudget.total)
+            tracer.gauge("tenant.resident_docs", self.rbudget.docs())
+
+    def _drop_resident(self, d) -> None:
+        """Inadmissible delta: the resident engine cannot absorb it
+        exactly — release it and fall back to the cold route (the
+        conservative direction: a fallback costs a cold replay,
+        never bytes). The refusal also stamps ``no_promote_len``: a
+        promotion over this SAME history would stash the same rows
+        the probe just refused, so the guaranteed-futile full engine
+        build is skipped until new history arrives."""
+        st = self._docs[d]
+        if st.resident is None:
+            return
+        st.cache = st.resident.cache  # materialize the lazy view
+        st.resident = None
+        st.delta_dec = None
+        st.delta_ok = False
+        st.no_promote_len = st.history_len()
+        self.rbudget.drop_doc(d)
+        self.delta_fallback_count += 1
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.count("tenant.delta_fallbacks")
+
+    # ---- converge engines (the round-14 cold path) -------------------
 
     def _finish_doc(self, doc_id, res) -> None:
         """One doc's packed result through the STOCK replay gather +
@@ -350,7 +796,7 @@ class MultiDocServer:
         dec, ds = st.dec, st.ds
         w, v, o = rp.gather(dec, ds, ("packed", res))
         st.cache = rp.materialize(dec, ds, w, v, o)
-        st.digest = cache_digest(st.cache)
+        st._digest = None
         st.n_ops = len(dec["client"])
 
     def _converge_one(self, doc_id) -> None:
@@ -363,7 +809,7 @@ class MultiDocServer:
         handle = rp.converge(st.cols)
         w, v, o = rp.gather(st.dec, st.ds, handle)
         st.cache = rp.materialize(st.dec, st.ds, w, v, o)
-        st.digest = cache_digest(st.cache)
+        st._digest = None
         st.n_ops = len(st.dec["client"])
 
     def _converge_batch(self, batch) -> tuple:
@@ -410,18 +856,21 @@ class MultiDocServer:
         done = time.perf_counter()
         for d in batch:
             st = self._docs[d]
-            self._pending_total -= sum(len(b) for b in st.pending)
-            st.blobs.extend(st.pending)
-            st.pending.clear()
+            self._pending_total -= sum(len(b) for b in st.in_flight)
+            st.blobs.extend(st.in_flight)
+            st.in_flight.clear()
             if st.dirty_since is not None:
                 st.latency_s = done - st.dirty_since
-            st.dirty_since = None
             st.served_tick = self.ticks
+            # mid-tick arrivals (live ingest overlapping this tick's
+            # dispatches) stay pending: the doc remains dirty and its
+            # latency clock restarts at this serve
+            st.dirty_since = done if st.pending else None
 
     def _finish_empty(self, doc_id) -> None:
         st = self._docs[doc_id]
         st.cache, st.n_ops = {}, 0
-        st.digest = cache_digest({})
+        st._digest = None
 
     def _dispatch_async(self, comb):
         """Enqueue one converge dispatch over the combined multi-doc
@@ -491,7 +940,7 @@ class MultiDocServer:
                     sseg_all[scut[i]:scut[i + 1]],
                     vis,
                 )
-                st.digest = cache_digest(st.cache)
+                st._digest = None
                 st.n_ops = len(st.dec["client"])
             else:
                 self._finish_doc(d, packed.PackedResult(

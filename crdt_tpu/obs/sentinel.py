@@ -196,7 +196,14 @@ class MultiDocSentinel:
     fork in THAT doc: one ``sentinel.doc_divergence`` count and one
     event naming the doc, deduped per (peer, doc, digest pair) like
     the single-doc sentinel's permanent-fork rule. Docs only the
-    peer serves are skipped (placement, not health)."""
+    peer serves are skipped (placement, not health).
+
+    Digest cost (round 15): the server's ``doc_digests()`` caches
+    per-doc digests on (op count, serve tick), so every beacon this
+    sentinel sends or checks recomputes digests only for the docs
+    that moved since the last one — a clean doc costs zero digest
+    work (``sentinel.doc_digest_skips``, pinned in
+    tests/test_multidoc.py)."""
 
     def __init__(self, source, *, topic: str, replica: str,
                  tracer: Optional[Tracer] = None,

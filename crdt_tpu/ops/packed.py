@@ -1486,6 +1486,32 @@ def segkey_of(pref, kid):
     return base | (is_map << np.int64(62))
 
 
+def stage_resident_delta(client, clock, pref, kid, oc, ock,
+                         dev_segs, kpad: int) -> np.ndarray:
+    """Stage one incremental round's DELTA against a resident base:
+    the ``[8, kpad]`` int64 block :func:`_splice_select_converge`
+    consumes. Rows 0-6 are the packed delta columns (dense clients,
+    clocks, parent refs; ``valid`` = resolvable parent), row 7 the
+    touched-segment keys (ascending segkeys, int64-max padded). This
+    is the delta-tick staging seam — a warm round ships THIS block
+    only; the doc's history never restages (it is already resident in
+    the donated matrix the splice updates in place)."""
+    k = len(client)
+    delta = np.zeros((8, kpad), np.int64)
+    delta[3:6, :] = -1
+    delta[7, :] = np.iinfo(np.int64).max
+    delta[7, : len(dev_segs)] = dev_segs
+    pref = np.asarray(pref, np.int64)
+    delta[0, :k] = client
+    delta[1, :k] = clock
+    delta[2, :k] = np.maximum(pref, 0)
+    delta[3, :k] = kid
+    delta[4, :k] = oc
+    delta[5, :k] = ock
+    delta[6, :k] = pref >= 0
+    return delta
+
+
 @partial(
     jax.jit,
     donate_argnums=(0,),

@@ -145,6 +145,20 @@ class ResidentColumns:
     def capacity(self) -> int:
         return int(self._bufs[0].shape[0])
 
+    def device_bytes(self) -> int:
+        """Device-memory footprint of the resident buffers — the
+        firehose-path counterpart of :meth:`crdt_tpu.models.
+        incremental.IncrementalReplay.resident_bytes` (which is what
+        the multi-doc server's ``CRDT_TPU_MT_RESIDENT_BYTES`` budget
+        actually sums): a capacity planner sizing a fleet of
+        ResidentColumns stores reads it per store. Computed from
+        dtype itemsizes, so it tracks the column schema
+        automatically."""
+        cap = self.capacity
+        return sum(
+            cap * np.dtype(dt).itemsize for _, dt in COLUMNS
+        )
+
     # -- client interning ---------------------------------------------
     def _intern(self, raw_ids: np.ndarray) -> Optional[np.ndarray]:
         """Register raw ids. Returns an old-dense->new-dense permutation

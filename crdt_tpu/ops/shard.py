@@ -144,10 +144,33 @@ class ShardResult(NamedTuple):
     sv_clients: Optional[np.ndarray] = None
 
 
+def _chain_weights(counts: np.ndarray,
+                   origin_counts: np.ndarray) -> np.ndarray:
+    """Greedy-partition weights honoring CHAIN DEPTH, not just row
+    count (ROADMAP item 1 remainder): a shard's converge runs
+    ``ceil(log2(longest chain))`` pointer-doubling rounds — the
+    Wyllie bound — over ALL its rows, so a deep append chain costs
+    ``rows x log2(depth)`` where an equally-sized wide segment costs
+    ``rows x 1``. Depth is bounded above by the segment's
+    origin-bearing rows + 1 (every chain hop needs a live origin;
+    root-attached rows never deepen a chain), which is exact for
+    pure chains and errs toward over-weighting branchy segments —
+    the safe direction for balance. Returns per-segment integer
+    weights ``rows * max(1, ceil(log2(1 + origin_rows)))``."""
+    depth = np.maximum(origin_counts, 0) + 1
+    rounds = np.maximum(
+        1, np.ceil(np.log2(np.maximum(depth, 1))).astype(np.int64)
+    )
+    return np.asarray(counts, np.int64) * rounds
+
+
 def _partition(cols, K: int):
     """Whole-segment greedy partition of the union's valid rows into
-    K row-balanced shards. Returns a list of caller-row index arrays
-    (some possibly empty: fewer segments than shards).
+    K depth-weighted shards (:func:`_chain_weights` — segments weigh
+    ``rows x ceil(log2(chain_len))``, the Wyllie rounds bound, so a
+    deep chain and a wide segment of equal row count no longer read
+    as equal work). Returns a list of caller-row index arrays (some
+    possibly empty: fewer segments than shards).
 
     Duplicate ids are dropped GLOBALLY first (keep the first caller
     row, packed._stage's rule): equal-id rows under different parents
@@ -180,19 +203,26 @@ def _partition(cols, K: int):
     if dup.any():
         keep = np.sort(so[~dup])
         idx, dv = idx[keep], dv[keep]
+    oc_live = np.asarray(cols["origin_client"], np.int64)[idx] >= 0
     if multi_doc:
-        # doc-first: greedy balance whole docs, largest first into
+        # doc-first: greedy balance whole docs, heaviest first into
         # the lightest bin (fewer docs than shards leaves shards
-        # empty — the all-padding shard body handles them)
+        # empty — the all-padding shard body handles them). Doc
+        # weight honors chain depth like the segment cut: a doc's
+        # rounds bound is log2 of its chained rows.
         docs_u, doc_inv, doc_counts = np.unique(
             dv, return_inverse=True, return_counts=True
         )
+        doc_oc = np.bincount(
+            doc_inv, weights=oc_live, minlength=len(docs_u)
+        ).astype(np.int64)
+        weights = _chain_weights(doc_counts, doc_oc)
         bins = np.zeros(len(docs_u), np.int64)
         loads = np.zeros(K, np.int64)
-        for d in np.argsort(-doc_counts, kind="stable"):
+        for d in np.argsort(-weights, kind="stable"):
             b = int(np.argmin(loads))
             bins[d] = b
-            loads[b] += int(doc_counts[d])
+            loads[b] += int(weights[d])
         shard_of_row = bins[doc_inv]
         return [idx[shard_of_row == k] for k in range(K)]
     pir = np.asarray(cols["parent_is_root"], bool)[idx]
@@ -210,16 +240,20 @@ def _partition(cols, K: int):
     seg = np.empty(len(idx), np.int64)
     seg[order] = seg_sorted
     counts = np.bincount(seg)
-    # greedy balance, largest segments first into the lightest bin (a
-    # single huge segment still bounds one shard — the honest limit
-    # of segment parallelism; chain-split softens it by re-cutting
-    # pure append chains inside the shard)
+    seg_oc = np.bincount(
+        seg, weights=oc_live, minlength=len(counts)
+    ).astype(np.int64)
+    # greedy balance by DEPTH-WEIGHTED load, heaviest segments first
+    # into the lightest bin (a single huge segment still bounds one
+    # shard — the honest limit of segment parallelism; chain-split
+    # softens it by re-cutting pure append chains inside the shard)
+    weights = _chain_weights(counts, seg_oc)
     bins = np.zeros(len(counts), np.int64)
     loads = np.zeros(K, np.int64)
-    for s in np.argsort(-counts, kind="stable"):
+    for s in np.argsort(-weights, kind="stable"):
         b = int(np.argmin(loads))
         bins[s] = b
-        loads[b] += int(counts[s])
+        loads[b] += int(weights[s])
     shard_of_row = bins[seg]
     return [idx[shard_of_row == k] for k in range(K)]
 

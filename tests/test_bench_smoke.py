@@ -126,6 +126,23 @@ def test_bench_smoke_mode(tmp_path):
     assert "tenant.pending_bytes" in report["gauges"]
     assert "tenant.dispatch_docs" in report["gauges"]
 
+    # the round-15 delta-tick registry: the smoke runs a tiny
+    # steady-state leg (small deltas on resident docs + a rolling
+    # eviction flood) digest-identical to the full-replay oracle,
+    # and the delta-route / resident-ledger / digest-skip evidence
+    # the steady regression gates read must be live
+    assert out.get("mt_incremental_registry_ok") is True
+    mts = out["multitenant"]["steady"]
+    for key in ("docs_per_s", "speedup", "delta_docs_per_tick"):
+        assert isinstance(mts.get(key), (int, float)), key
+    assert mts["oracle_identical"] is True
+    for cname in ("tenant.delta_docs", "tenant.delta_rows",
+                  "tenant.promotions", "tenant.resident_evictions",
+                  "sentinel.doc_digest_skips"):
+        assert report["counters"].get(cname, 0) > 0, cname
+    assert "tenant.resident_bytes" in report["gauges"]
+    assert "tenant.resident_docs" in report["gauges"]
+
     # the guard-layer registry (README "Overload & failure policy"):
     # (kernel_ablation_leg is pinned in-process below — the smoke
     # subprocess stays on its <30s budget)

@@ -503,3 +503,32 @@ class TestLazyCache:
         assert inc.last_touched_roots == ["m"]
         assert inc.last_touched_keys == {"m": {"a"}}
         assert inc._dirty  # still unmaterialized
+
+
+class TestResidentAccounting:
+    """Round 15: the resident-bytes accessors the multi-doc budget
+    (and a fleet capacity planner) sum per store."""
+
+    def test_resident_bytes_tracks_growth_and_estimate_bounds(self):
+        inc = IncrementalReplay()
+        base = inc.resident_bytes()
+        assert base > 0  # host columns exist from construction
+        recs = [ItemRecord(client=1, clock=k, parent_root="m",
+                           key=f"k{k % 4}", content=k)
+                for k in range(3000)]
+        inc.apply(_blob(recs))
+        grown = inc.resident_bytes()
+        assert grown > base  # host column capacity doubled past 1024
+        # the pre-promotion estimate is a true upper bound of the
+        # post-build footprint (the budget gate refuses BEFORE
+        # building, so an under-estimate would breach the ledger)
+        assert IncrementalReplay.estimate_resident_bytes(3000) >= grown
+
+    def test_resident_columns_device_bytes(self):
+        from crdt_tpu.ops.resident import COLUMNS, ResidentColumns
+
+        rc = ResidentColumns(capacity=1 << 10)
+        want = sum(
+            rc.capacity * np.dtype(dt).itemsize for _, dt in COLUMNS
+        )
+        assert rc.device_bytes() == want

@@ -315,6 +315,31 @@ def f(tracer):
     )
 
 
+def test_registry_covers_delta_tick_counters():
+    """Round 15 (delta ticks) added the resident-state ledger rows
+    and the sentinel digest-cache row. Both directions must hold:
+    the emitted names stay documented in the README registry, and an
+    UNdocumented tenant/sentinel name still fires CL201 — the new
+    rows genuinely joined the registry-checked pool."""
+    reg = _real_registry()
+    for name in ("tenant.delta_docs", "tenant.delta_rows",
+                 "tenant.promotions", "tenant.delta_fallbacks",
+                 "tenant.resident_evictions",
+                 "tenant.resident_bytes", "tenant.resident_docs",
+                 "sentinel.doc_digest_skips"):
+        assert name in reg.metrics, (
+            f"{name} dropped out of the README registry (round-15 "
+            f"delta-tick contract)"
+        )
+    result = _lint_snippet("crdt_tpu/models/x.py", '''
+def f(tracer):
+    tracer.count("sentinel.bogus_digest_row", 1)
+''', _reg("sentinel.doc_digest_skips"))
+    assert any(f.code == "CL201" for f in result.findings), (
+        "an undocumented sentinel.* metric no longer fires CL201"
+    )
+
+
 def test_registry_drift_fixed_event_kinds():
     """First-run CL201 drift on flight-recorder event kinds from the
     guard/storage/device adversaries."""

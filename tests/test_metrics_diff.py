@@ -417,3 +417,57 @@ def test_multitenant_section_gated():
     new5["tracer"]["counters"]["tenant.shed"] = 9
     _, regressed = compare(old, new5, threshold=0.2)
     assert "tracer.tenant.shed" in regressed
+
+
+def test_multitenant_steady_section_gated():
+    """Round 15 (delta ticks): the steady-state leg's docs/s and its
+    speedup over the full-replay tick are higher-is-better; the
+    eviction flood's committed resident peak is lower-is-better
+    (bytes — a count, never muted by the seconds noise floor); the
+    resident-eviction and delta-fallback counters gate
+    lower-is-better like every guard ladder."""
+    old = copy.deepcopy(OLD)
+    old["multitenant"] = {
+        "steady": {
+            "docs_per_s": 5000.0,
+            "speedup": 40.0,
+            "eviction": {"peak_bytes": 1_000_000},
+        },
+    }
+    old["tracer"]["counters"]["tenant.resident_evictions"] = 10
+    old["tracer"]["counters"]["tenant.delta_fallbacks"] = 2
+    new = copy.deepcopy(old)
+    rows, regressed = compare(old, new)
+    names = {r["metric"] for r in rows}
+    assert "multitenant.steady.docs_per_s" in names
+    assert "multitenant.steady.speedup" in names
+    assert "multitenant.steady.eviction.peak_bytes" in names
+    assert "tracer.tenant.resident_evictions" in names
+    assert "tracer.tenant.delta_fallbacks" in names
+    assert regressed == []
+
+    # the >=10x steady bar eroding fails (higher is better)
+    new["multitenant"]["steady"]["docs_per_s"] = 2000.0
+    new["multitenant"]["steady"]["speedup"] = 12.0
+    _, regressed = compare(old, new, threshold=0.2)
+    assert "multitenant.steady.docs_per_s" in regressed
+    assert "multitenant.steady.speedup" in regressed
+
+    # resident peak growing past threshold fails; shrinking never
+    new2 = copy.deepcopy(old)
+    new2["multitenant"]["steady"]["eviction"]["peak_bytes"] = \
+        2_000_000
+    _, regressed = compare(old, new2, threshold=0.2)
+    assert "multitenant.steady.eviction.peak_bytes" in regressed
+    new3 = copy.deepcopy(old)
+    new3["multitenant"]["steady"]["eviction"]["peak_bytes"] = 500_000
+    _, regressed = compare(old, new3, threshold=0.2)
+    assert regressed == []
+
+    # eviction thrash / fallback churn gate like guard counters
+    new4 = copy.deepcopy(old)
+    new4["tracer"]["counters"]["tenant.resident_evictions"] = 30
+    new4["tracer"]["counters"]["tenant.delta_fallbacks"] = 9
+    _, regressed = compare(old, new4, threshold=0.2)
+    assert "tracer.tenant.resident_evictions" in regressed
+    assert "tracer.tenant.delta_fallbacks" in regressed

@@ -370,6 +370,294 @@ def test_cache_digest_canonical():
     assert cache_digest({"a": [1, 2]}) != cache_digest({"a": [2, 1]})
 
 
+# ------------------------------------------------------- round 15 --
+# Delta ticks: per-doc resident incremental engines inside the
+# multi-tenant server. Contract: a dirty doc whose delta is
+# SV-admissible converges at delta cost through its resident engine,
+# BYTE-identical (canonical digest and cache) to the cold full-replay
+# oracle; anything else falls back per doc to the round-14 cold path.
+
+
+class DocStream:
+    """Incremental doc generator whose deltas continue each client's
+    clock contiguously — the SV-admissible steady-state shape. Keeps
+    the YATA chain state so list ops anchor on real resident ids."""
+
+    def __init__(self, seed, n_clients=2):
+        self.seed = seed
+        self.clients = [10 + c for c in range(n_clients)]
+        self.clock = {c: 0 for c in self.clients}
+        self.chain: list = []
+
+    def delta(self, k_ops, *, deletes=False, mid_insert=False):
+        recs = []
+        for i in range(k_ops):
+            c = self.clients[i % len(self.clients)]
+            k = self.clock[c]
+            self.clock[c] = k + 1
+            if i % 3 == 0:
+                recs.append(ItemRecord(
+                    client=c, clock=k, parent_root="m",
+                    key=f"k{(self.seed + i) % 5}",
+                    content=self.seed * 1000 + k,
+                ))
+            elif mid_insert and len(self.chain) > 2 and i % 3 == 2:
+                j = len(self.chain) // 2
+                recs.append(ItemRecord(
+                    client=c, clock=k, parent_root="l",
+                    origin=self.chain[j - 1], right=self.chain[j],
+                    content=self.seed * 1000 + k,
+                ))
+                self.chain.insert(j, (c, k))
+            else:
+                recs.append(ItemRecord(
+                    client=c, clock=k, parent_root="l",
+                    origin=self.chain[-1] if self.chain else None,
+                    content=self.seed * 1000 + k,
+                ))
+                self.chain.append((c, k))
+        ds = DeleteSet()
+        if deletes and self.chain:
+            dc, dk = self.chain[0]
+            ds.add(dc, dk, 1)
+        return v1.encode_update(recs, ds)
+
+
+def test_delta_ticks_match_cold_oracle_every_tick():
+    """The tentpole differential: N ticks of small contiguous deltas
+    on resident docs — every tick's caches and canonical digests
+    byte-identical to the full-replay server AND the replay_trace
+    oracle, with the route evidence pinned (tick 0 cold, tick 1
+    promotions, tick 2+ pure delta serves)."""
+    streams = {f"d{i}": DocStream(i, n_clients=1 + i % 3)
+               for i in range(5)}
+    delta_srv = MultiDocServer()  # delta ticks on by default
+    cold_srv = MultiDocServer(delta_ticks=False)
+    history = {d: [] for d in streams}
+    reports = []
+    for t in range(4):
+        for d, s in streams.items():
+            blob = s.delta(12 if t == 0 else 3,
+                           deletes=(t == 2), mid_insert=(t == 3))
+            history[d].append(blob)
+            delta_srv.submit(d, blob)
+            cold_srv.submit(d, blob)
+        reports.append((delta_srv.tick(), cold_srv.tick()))
+        for d in streams:
+            assert delta_srv.cache(d) == oracle_cache(history[d]), \
+                (t, d)
+            assert delta_srv.digest(d) == cold_srv.digest(d), (t, d)
+            assert (delta_srv._docs[d].n_ops
+                    == cold_srv._docs[d].n_ops), (t, d)
+    assert reports[0][0].delta_docs == 0
+    assert reports[1][0].promotions == 5
+    for rep_d, rep_c in reports[2:]:
+        assert rep_d.delta_docs == 5
+        assert rep_d.delta_rows == 15  # the delta IS the staging cost
+        assert rep_d.promotions == 0
+        assert rep_c.delta_docs == 0   # the baseline stays cold
+
+
+def test_delta_tick_redelivery_idempotent_across_ticks():
+    """The same delta submitted in tick t and t+1 leaves resident
+    state, cache, and canonical digest byte-identical to single
+    delivery — redelivery rides the (still admissible) delta route,
+    dedups inside the engine, and never falls back."""
+    import copy
+
+    s = DocStream(3)
+    srv = MultiDocServer()
+    b0 = s.delta(10)
+    srv.submit("a", b0)
+    srv.tick()                      # cold (first sight)
+    b1 = s.delta(3)
+    srv.submit("a", b1)
+    rep1 = srv.tick()               # promotion
+    assert rep1.promotions == 1
+    b2 = s.delta(3)
+    srv.submit("a", b2)
+    rep2 = srv.tick()               # the delta route proper
+    assert rep2.delta_docs == 1 and rep2.delta_rows == 3
+    st = srv._docs["a"]
+    digest0 = srv.digest("a")
+    cache0 = copy.deepcopy(srv.cache("a"))
+    n0 = st.resident.cols.n
+    sv0 = dict(st.resident._next_clock)
+    srv.submit("a", b2)             # redelivered in the NEXT tick
+    rep3 = srv.tick()
+    assert rep3.delta_docs == 1     # still the delta route
+    assert srv.delta_fallback_count == 0
+    assert srv.digest("a") == digest0
+    assert srv.cache("a") == cache0
+    assert st.resident.cols.n == n0
+    assert dict(st.resident._next_clock) == sv0
+    assert srv.cache("a") == oracle_cache([b0, b1, b2])
+
+
+def test_offset_clock_delta_falls_back_to_cold():
+    """A clock gap is inadmissible to the incremental route (the
+    engine would stash what the cold oracle admits): the doc falls
+    back per-doc to the cold replay — bytes identical to the oracle
+    — and a history the engine cannot settle pins the doc cold."""
+    s = DocStream(5, n_clients=1)
+    srv = MultiDocServer()
+    blobs = [s.delta(8)]
+    srv.submit("a", blobs[0])
+    srv.tick()
+    blobs.append(s.delta(3))
+    srv.submit("a", blobs[1])
+    rep = srv.tick()
+    assert rep.promotions == 1
+    c = s.clients[0]
+    s.clock[c] += 5                 # the offset: a clock gap
+    blobs.append(s.delta(4))
+    srv.submit("a", blobs[2])
+    rep2 = srv.tick()
+    assert rep2.delta_docs == 0
+    assert srv.delta_fallback_count == 1
+    assert srv.cache("a") == oracle_cache(blobs)
+    blobs.append(s.delta(2))        # still past the gap
+    srv.submit("a", blobs[3])
+    rep3 = srv.tick()
+    assert rep3.delta_docs == 0 and rep3.promotions == 0
+    assert srv.cache("a") == oracle_cache(blobs)
+    # the pin is NOT permanent: once the missing clocks arrive the
+    # history settles, promotion succeeds on the next growth, and
+    # the doc returns to the delta route
+    gap = [v1.encode_update([ItemRecord(
+        client=c, clock=k, parent_root="m", key="gapfill", content=k,
+    ) for k in range(11, 16)], DeleteSet())]
+    blobs.extend(gap)
+    srv.submit("a", gap[0])
+    rep4 = srv.tick()               # retry: history grew + settles
+    assert rep4.promotions == 1
+    assert srv.cache("a") == oracle_cache(blobs)
+    blobs.append(s.delta(3))
+    srv.submit("a", blobs[-1])
+    rep5 = srv.tick()
+    assert rep5.delta_docs == 1     # back on the delta route
+    assert srv.cache("a") == oracle_cache(blobs)
+
+
+def test_resident_budget_evicts_lru_and_reconverges():
+    """The resident-memory budget: committed resident bytes never
+    exceed it (peak == ledger high-water mark), overflow evicts the
+    least-recently-served docs back to cold replay, and an evicted
+    doc reconverges byte-identically on its next touch."""
+    from crdt_tpu.models.incremental import IncrementalReplay
+
+    tracer = set_tracer(Tracer(enabled=True))
+    try:
+        streams = {f"w{i}": DocStream(i, n_clients=1)
+                   for i in range(6)}
+        history = {d: [] for d in streams}
+        budget = int(
+            IncrementalReplay.estimate_resident_bytes(64) * 2.5
+        )
+        srv = MultiDocServer(resident_max_bytes=budget)
+
+        def touch(docs, k):
+            for d in docs:
+                b = streams[d].delta(k)
+                history[d].append(b)
+                srv.submit(d, b)
+            srv.tick()
+
+        wave1 = ["w0", "w1", "w2"]
+        wave2 = ["w3", "w4", "w5"]
+        touch(wave1, 12)            # cold
+        touch(wave1, 3)             # promotions (to budget room)
+        touch(wave2, 12)            # cold, wave 1 idle
+        touch(wave2, 3)             # promotions evict wave-1 LRU
+        touch(wave2, 3)
+        assert srv.eviction_count > 0
+        assert srv.resident_peak_bytes() <= budget
+        assert srv.resident_bytes_total() <= budget
+        counters = get_tracer().counters()
+        assert counters.get("tenant.resident_evictions", 0) \
+            == srv.eviction_count
+        evicted = [d for d in wave1
+                   if srv._docs[d].resident is None]
+        assert evicted, "no wave-1 resident was evicted"
+        d = evicted[0]
+        b = streams[d].delta(3)     # the resubmit after eviction
+        history[d].append(b)
+        srv.submit(d, b)
+        srv.tick()
+        assert srv.cache(d) == oracle_cache(history[d])
+        assert srv.resident_peak_bytes() <= budget
+    finally:
+        set_tracer(Tracer(enabled=False))
+
+
+def test_serve_live_ingest_scheduler():
+    """The round-15 live-ingest loop: a stream of update batches is
+    drained across bounded ticks (ingest overlapping in-flight
+    dispatches via the tick hook), every doc converges to its full-
+    history oracle, and the settled history is exactly the submitted
+    blobs in order — a mid-tick arrival is never marked converged
+    without being converged."""
+    streams = {f"s{i}": DocStream(i) for i in range(4)}
+    history = {d: [] for d in streams}
+
+    def source():
+        for t in range(5):
+            batch = []
+            for d, s in streams.items():
+                b = s.delta(8 if t == 0 else 2)
+                history[d].append(b)
+                batch.append((d, b))
+            yield batch
+
+    srv = MultiDocServer()
+    rep = srv.serve(source(), max_ticks=12)
+    assert rep.submitted == 20
+    assert 0 < rep.ticks <= 12
+    assert not srv.dirty_docs()
+    assert rep.delta_docs > 0, "steady state never reached the " \
+        "delta route"
+    for d in streams:
+        assert srv.cache(d) == oracle_cache(history[d]), d
+        assert srv._docs[d].blobs == history[d], d
+
+
+def test_doc_digests_skip_clean_docs(monkeypatch):
+    """Digest caching (round-15 satellite): converging never
+    digests; the first beacon computes one digest per doc; a second
+    beacon over a clean population computes ZERO digests and counts
+    every skip; a touched doc re-digests while clean neighbors still
+    skip."""
+    import crdt_tpu.models.multidoc as md
+
+    tracer = set_tracer(Tracer(enabled=True))
+    try:
+        streams = {f"d{i}": DocStream(i) for i in range(4)}
+        srv = MultiDocServer()
+        for d, s in streams.items():
+            srv.submit(d, s.delta(6))
+        srv.tick()
+        calls = {"n": 0}
+        real = md.cache_digest
+
+        def counting(c):
+            calls["n"] += 1
+            return real(c)
+
+        monkeypatch.setattr(md, "cache_digest", counting)
+        srv.doc_digests()
+        assert calls["n"] == 4
+        srv.doc_digests()           # clean: zero digest work
+        assert calls["n"] == 4
+        assert get_tracer().counters().get(
+            "sentinel.doc_digest_skips") == 4
+        srv.submit("d0", streams["d0"].delta(3))
+        srv.tick()
+        srv.doc_digests()           # only the touched doc recomputes
+        assert calls["n"] == 5
+    finally:
+        set_tracer(Tracer(enabled=False))
+
+
 def test_multidoc_stage_counts_docs_packed():
     """The staging seam counts docs per multi-doc plan — the
     amortization evidence the bench publishes."""

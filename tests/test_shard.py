@@ -293,6 +293,78 @@ class TestChainSplit:
         assert run_single(dec, cols, ds) is not None
 
 
+class TestDepthWeightedPartition:
+    def _cols_three_segments(self, n=128):
+        """Three equal-ROW segments: one deep append chain (client 1,
+        root 0 — every row origin-chained to its predecessor) and two
+        wide root-attached segments (clients 2, 3 — no origins)."""
+        total = 3 * n
+        client = np.r_[np.full(n, 1), np.full(n, 2), np.full(n, 3)
+                       ].astype(np.int64)
+        clock = np.r_[np.arange(n), np.arange(n), np.arange(n)
+                      ].astype(np.int64)
+        oc = np.full(total, -1, np.int64)
+        ock = np.full(total, -1, np.int64)
+        oc[1:n] = 1
+        ock[1:n] = np.arange(n - 1)
+        return {
+            "client": client,
+            "clock": clock,
+            "parent_is_root": np.ones(total, bool),
+            "parent_a": np.r_[np.zeros(n, np.int64),
+                              np.ones(n, np.int64),
+                              np.full(n, 2, np.int64)],
+            "parent_b": np.full(total, -1, np.int64),
+            "key_id": np.full(total, -1, np.int64),
+            "origin_client": oc,
+            "origin_clock": ock,
+            "valid": np.ones(total, bool),
+        }
+
+    def test_deep_chain_vs_wide_balance(self):
+        """Chain-depth weighting (the Wyllie rounds bound): a deep
+        chain of N rows weighs N*ceil(log2(N)) where a wide segment
+        of N root-attached rows weighs N — the greedy cut puts the
+        deep chain ALONE on its shard and pairs the two wide
+        segments, where row-count-only balance would pair the deep
+        chain with a wide one."""
+        n = 128
+        cols = self._cols_three_segments(n)
+        parts = shard._partition(cols, 2)
+        assert parts is not None and len(parts) == 2
+        by_client = []
+        for rows in parts:
+            by_client.append(
+                set(np.asarray(cols["client"])[rows].tolist())
+            )
+        deep_shard = [cs for cs in by_client if 1 in cs]
+        assert deep_shard and deep_shard[0] == {1}, (
+            f"deep chain not isolated: {by_client}"
+        )
+        assert {2, 3} in by_client, (
+            f"wide segments not paired: {by_client}"
+        )
+
+    def test_chain_weights_formula(self):
+        """The weight helper itself: rows x max(1, ceil(log2(1 +
+        origin_rows))) — wide segments weigh their rows, pure chains
+        weigh rows x log2(depth)."""
+        counts = np.asarray([128, 128, 7, 1])
+        origins = np.asarray([127, 0, 6, 0])
+        w = shard._chain_weights(counts, origins)
+        assert w.tolist() == [128 * 7, 128, 7 * 3, 1]
+
+    def test_depth_weighted_partition_stays_byte_identical(self):
+        """Whatever the cut, the sharded converge must stay
+        byte-identical to the single-chip oracle on the deep-vs-wide
+        shape."""
+        blobs = chains_trace(n_chains=3, chain_len=96, seed=21)
+        dec, cols, ds = stage_all(blobs)
+        want = run_single(dec, cols, ds)
+        got, _ = run_sharded(dec, cols, ds, 2)
+        assert got == want
+
+
 class TestRoutes:
     def test_stream_route_sharded(self, monkeypatch):
         """The scale replay's executor: stream shards converge through
@@ -384,7 +456,10 @@ class TestRoutes:
         dec, cols, ds = stage_all(blobs)
         splan = shard.stage(cols, n_shards=2)
         bad_wire = np.array(splan.wire, copy=True)
-        bad_wire[0, 0] += 1  # clock corrupted on the wire
+        # corrupt the DOMINATING clock entry for client 0 (bumping a
+        # non-max entry would be masked by the SV max-merge — which
+        # shard dominates depends on the partition's weights)
+        bad_wire[int(np.argmax(bad_wire[:, 0])), 0] += 1
         bad = splan._replace(wire=bad_wire)
         with pytest.raises(RuntimeError, match="boundary exchange"):
             shard.converge(bad)

@@ -38,7 +38,13 @@ Compared (whatever of these both artifacts carry):
   ``multitenant.docs_converged_per_s`` / ``.speedup`` (higher =
   better) and ``.p99_per_doc_ms`` / ``.dispatches_per_tick`` (lower
   = better), plus the tenant-scoped shed counters from the tracer
-  report (lower = better, like every guard ladder).
+  report (lower = better, like every guard ladder);
+- delta ticks (round 15, the steady-state ``--multitenant`` leg):
+  ``multitenant.steady.docs_per_s`` / ``.speedup`` (higher = better
+  — the >=10x-over-full-replay bar is a gated artifact) and the
+  eviction flood's ``steady.eviction.peak_bytes`` (lower = better),
+  plus ``tenant.resident_evictions`` / ``tenant.delta_fallbacks``
+  under the guard prefixes.
 
 Prints a table (one row per metric: old, new, delta, verdict) and
 exits non-zero when any metric regressed past ``--threshold``
@@ -96,6 +102,16 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     (("multitenant", "speedup"), True),
     (("multitenant", "p99_per_doc_ms"), False),
     (("multitenant", "dispatches_per_tick"), False),
+    # delta ticks (round 15, the steady-state leg): docs served per
+    # second across N small-delta ticks on large resident docs, and
+    # the speedup over the round-14 full-replay tick (higher =
+    # better — the >=10x acceptance bar is a gated artifact, not a
+    # doc sentence); the eviction flood's committed resident peak
+    # must stay bounded (lower = better, bytes — the seconds noise
+    # floor never mutes it)
+    (("multitenant", "steady", "docs_per_s"), True),
+    (("multitenant", "steady", "speedup"), True),
+    (("multitenant", "steady", "eviction", "peak_bytes"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 
@@ -122,6 +138,13 @@ GUARD_PREFIXES: Tuple[str, ...] = (
     # docs_converged are workload facts and stay ungated)
     "tenant.shed",
     "tenant.fallback_docs",
+    # round 15: delta-tick degradations — more evictions means the
+    # same trace thrashed the resident budget harder, more fallbacks
+    # means more deltas were refused by the incremental route
+    # (tenant.delta_docs / delta_rows / promotions are workload
+    # facts and stay ungated)
+    "tenant.resident_evictions",
+    "tenant.delta_fallbacks",
 )
 
 
