@@ -88,12 +88,27 @@ def lint_digest() -> dict:
 
         mods = load_modules([os.path.join(repo, "crdt_tpu")], repo)
         res = run_lint(mods)
-        return {
+        digest = {
             "findings": res.total_raw,
             "open": len(res.findings),
             "baselined": len(res.baselined),
             "suppressed": len(res.suppressed),
+            # round 16: per-family OPEN counts for the three new code
+            # families — metrics_diff gates each lower-is-better with
+            # count semantics (the committed tree holds them at 0, so
+            # ANY new open CL7xx/CL8xx/CL9xx finding is a visible
+            # regression, not noise)
+            "open_by_family": {
+                k: v for k, v in res.open_by_family().items()
+                if k in ("cl7", "cl8", "cl9")
+            },
         }
+        # the memoized call graph's size stats ride the digest so
+        # graph growth/decay (functions, edges, guessed-edge share)
+        # is reviewable next to the finding counts
+        if res.stats.get("callgraph"):
+            digest["callgraph"] = res.stats["callgraph"]
+        return digest
     except Exception as exc:  # noqa: BLE001 — evidence, not control flow
         log(f"lint digest skipped: {exc}")
         return {}

@@ -39,6 +39,7 @@ import numpy as np
 from crdt_tpu.core.ids import DeleteSet
 from crdt_tpu.core.records import ItemRecord
 from crdt_tpu.core.store import K_GC, NO_KEY, NULL
+from crdt_tpu.ops import deleteset as ds_ops
 from crdt_tpu.ops.device import _CLOCK_BITS, NULLI, fetch_packed_i32
 
 
@@ -351,6 +352,7 @@ def _rebuild_kernel(engine, sids) -> None:
                 jnp.asarray(np.full(16, -1, np.int64)),
                 jnp.asarray(np.full(16, -1, np.int64)),
                 num_segments=pad,
+                ds_mode=ds_ops.mask_mode(),  # host static (CL702)
             )
         order_k, seg_sorted, winners = fetch_packed_i32(
             order_k, seg_k, winners

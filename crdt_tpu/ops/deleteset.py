@@ -8,9 +8,24 @@ O(N log D) fully vectorized, no per-range host loop.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 
 from crdt_tpu.ops.device import _CLOCK_BITS, pack_id
+
+
+def mask_mode() -> str:
+    """HOST-side static dispatch decision for :func:`apply_mask`:
+    ``"jnp"`` | ``"pallas"`` | ``"interpret"``. Round 16 (crdtlint
+    CL702): traced callers (``merge.converge_maps`` and everything
+    above it) must compute this on the host and thread it down as a
+    static argument — an env read inside the traced body bakes the
+    flag into the compiled artifact, so a later ``CRDT_TPU_PALLAS``
+    flip silently reuses the stale branch."""
+    from crdt_tpu.ops import pallas_kernels as _pk
+
+    return _pk.pallas_mode()
 
 
 def ranges_to_device(ds) -> tuple:
@@ -30,22 +45,48 @@ def apply_mask(
     d_client: jnp.ndarray,  # [D] range clients (sorted with starts)
     d_start: jnp.ndarray,  # [D]
     d_end: jnp.ndarray,  # [D]
+    mode: Optional[str] = None,
+) -> jnp.ndarray:
+    """HOST entry for :func:`apply_mask_static`: resolves the kernel
+    mode from the env when ``mode`` is None. Never call from a traced
+    body (crdtlint CL702) — traced callers use
+    :func:`apply_mask_static` with a host-computed :func:`mask_mode`.
+    """
+    return apply_mask_static(
+        client, clock, valid, d_client, d_start, d_end,
+        mode=mask_mode() if mode is None else mode,
+    )
+
+
+def apply_mask_static(
+    client: jnp.ndarray,  # [N]
+    clock: jnp.ndarray,  # [N]
+    valid: jnp.ndarray,  # [N]
+    d_client: jnp.ndarray,  # [D] range clients (sorted with starts)
+    d_start: jnp.ndarray,  # [D]
+    d_end: jnp.ndarray,  # [D]
+    mode: str = "jnp",
 ) -> jnp.ndarray:
     """True where item falls inside any delete range.
 
-    On TPU (or with CRDT_TPU_PALLAS=interpret) small range sets go
-    through the fused Pallas kernel — ranges in SMEM, one VMEM pass
-    over the item columns; the jnp binary search remains the path for
-    large D and non-TPU backends. The dispatch threshold is the
-    measured performance crossover (pallas_kernels._DS_PALLAS_CROSSOVER),
-    not the kernel's SMEM capacity cap.
+    With ``mode`` "pallas"/"interpret", small range sets go through
+    the fused Pallas kernel — ranges in SMEM, one VMEM pass over the
+    item columns; the jnp binary search remains the path for large D,
+    non-TPU backends, and ``mode="jnp"``. The dispatch threshold is
+    the measured performance crossover
+    (pallas_kernels._DS_PALLAS_CROSSOVER), not the kernel's SMEM
+    capacity cap. ``mode`` is a STATIC computed on the host
+    (:func:`mask_mode`) — this function is traced-safe.
     """
     if d_client.shape[0] == 0:
         return jnp.zeros_like(valid)
     from crdt_tpu.ops import pallas_kernels as _pk
 
-    if _pk.use_pallas() and d_client.shape[0] <= _pk._DS_PALLAS_CROSSOVER:
-        return _pk.ds_mask(client, clock, valid, d_client, d_start, d_end)
+    if mode != "jnp" and d_client.shape[0] <= _pk._DS_PALLAS_CROSSOVER:
+        return _pk.ds_mask_static(
+            client, clock, valid, d_client, d_start, d_end,
+            interpret=(mode == "interpret"),
+        )
     # pack range starts and item ids on one axis; ranges never cross a
     # client boundary so a single searchsorted suffices
     rkey = pack_id(d_client, d_start)

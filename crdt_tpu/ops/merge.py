@@ -46,7 +46,7 @@ from crdt_tpu.ops.device import (
 from crdt_tpu.ops.lww import map_winners
 
 
-@partial(jax.jit, static_argnames=("num_segments",))
+@partial(jax.jit, static_argnames=("num_segments", "ds_mode"))
 def converge_maps(
     client,  # [N] int32
     clock,  # [N] int64
@@ -61,11 +61,18 @@ def converge_maps(
     d_start,  # [D]
     d_end,  # [D]
     num_segments: Optional[int] = None,
+    ds_mode: Optional[str] = None,
 ):
     """Returns (order, seg, winners, winner_visible, del_mask, uniq_valid).
 
     All outputs except `order` live in id-sorted space; `order[i]` maps
     sorted position i back to the caller's row index.
+
+    ``ds_mode`` (static) is the delete-mask kernel dispatch decision
+    (``deleteset.mask_mode()``), computed by the HOST caller — this
+    body is traced, so reading CRDT_TPU_PALLAS here would bake the
+    flag into the compiled artifact (crdtlint CL702, round 16). None
+    degrades to the exact jnp path, never to an ambient read.
     """
     n = client.shape[0]
     if num_segments is None:
@@ -121,7 +128,10 @@ def converge_maps(
                           rows_id_ranked=True, client_bits=23)
 
     # -- 5. tombstones --------------------------------------------------
-    del_mask = ds_ops.apply_mask(client, clock, uniq_valid, d_client, d_start, d_end)
+    del_mask = ds_ops.apply_mask_static(
+        client, clock, uniq_valid, d_client, d_start, d_end,
+        mode=ds_mode or "jnp",
+    )
 
     # -- 6. winner visibility ------------------------------------------
     wc = jnp.clip(winners, 0, n - 1)
@@ -283,6 +293,7 @@ def merge_records(
             jnp.asarray(np.asarray(d_client, np.int32)),
             jnp.asarray(np.asarray(d_start, np.int64)),
             jnp.asarray(np.asarray(d_end, np.int64)),
+            ds_mode=ds_ops.mask_mode(),  # host-computed static (CL702)
         )
     order = np.asarray(order)
     winners = np.asarray(winners)

@@ -94,6 +94,21 @@ def _interpret() -> bool:
     return backend() != "tpu"
 
 
+def pallas_mode() -> str:
+    """HOST-side dispatch decision: ``"jnp"`` | ``"pallas"`` |
+    ``"interpret"``. Round 16: the env read must happen on the host,
+    once per call, and flow DOWN into traced bodies as a static
+    argument — ``use_pallas()``/``_interpret()`` called inside a
+    ``jax.jit``/``lax.cond`` body bake the flag into the compiled
+    artifact, so a later ``CRDT_TPU_PALLAS`` flip silently reuses the
+    stale branch until an unrelated shape change recompiles
+    (crdtlint CL702; :func:`converge_kernel_mode` is the same
+    discipline with the width guard added)."""
+    if not use_pallas():
+        return "jnp"
+    return "interpret" if _interpret() else "pallas"
+
+
 def _pad_len(n: int, mult: int) -> int:
     return max(mult, ((n + mult - 1) // mult) * mult)
 
@@ -173,12 +188,35 @@ def ds_mask(
     d_client: jnp.ndarray,  # [D] int32
     d_start: jnp.ndarray,  # [D] int64/int32
     d_end: jnp.ndarray,  # [D] int64/int32
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """HOST entry for :func:`ds_mask_static`: resolves the kernel
+    mode from the env when ``interpret`` is None. Never call from a
+    traced body — the env read would bake into the compiled artifact
+    (crdtlint CL702); traced callers use :func:`ds_mask_static` with
+    a host-computed static."""
+    return ds_mask_static(
+        client, clock, valid, d_client, d_start, d_end,
+        _interpret() if interpret is None else interpret,
+    )
+
+
+def ds_mask_static(
+    client: jnp.ndarray,  # [N] int32
+    clock: jnp.ndarray,  # [N] int64/int32
+    valid: jnp.ndarray,  # [N] bool
+    d_client: jnp.ndarray,  # [D] int32
+    d_start: jnp.ndarray,  # [D] int64/int32
+    d_end: jnp.ndarray,  # [D] int64/int32
+    interpret: bool = False,
 ) -> jnp.ndarray:
     """Pallas counterpart of :func:`crdt_tpu.ops.deleteset.apply_mask`.
 
     Returns the same [N] bool mask, exact over the framework's full
     clock range. Requires D <= _DS_MAX_RANGES; callers dispatch via
     :func:`use_pallas` and fall back to the jnp path otherwise.
+    ``interpret`` is a STATIC, host-computed on the other side of the
+    trace boundary — this function is traced-safe (no ambient reads).
     """
     n = client.shape[0]
     d = d_client.shape[0]
@@ -207,7 +245,7 @@ def ds_mask(
         dsl,
         deh,
         delo,
-        _interpret(),
+        interpret,
     )
     return out2.reshape(-1)[:n].astype(bool) & valid
 
@@ -269,7 +307,20 @@ def _sv_deficit_call(svs, interpret):
         )(svs, svs)
 
 
-def sv_deficit(svs: jnp.ndarray) -> jnp.ndarray:
+def sv_deficit(svs: jnp.ndarray,
+               interpret: Optional[bool] = None) -> jnp.ndarray:
+    """HOST entry for :func:`sv_deficit_static`: resolves the kernel
+    mode from the env when ``interpret`` is None. Never call from a
+    traced body (crdtlint CL702) — the read would bake into the
+    compiled artifact; traced callers use :func:`sv_deficit_static`
+    with a host-computed static."""
+    return sv_deficit_static(
+        svs, _interpret() if interpret is None else interpret
+    )
+
+
+def sv_deficit_static(svs: jnp.ndarray,
+                      interpret: bool = False) -> jnp.ndarray:
     """Pallas counterpart of :func:`crdt_tpu.ops.statevec.missing`.
 
     [R, C] state vectors -> [R, R] total clocks i holds that j lacks,
@@ -302,7 +353,10 @@ def sv_deficit(svs: jnp.ndarray) -> jnp.ndarray:
         # sliced away
         p = jnp.zeros((rpad, cpad), jnp.int32)
         p = p.at[:r, :c].set(cent.astype(jnp.int32))
-        out = _sv_deficit_call(p, _interpret())
+        # `interpret` is the host-computed static from the wrapper:
+        # an env read HERE would run at trace time inside the
+        # lax.cond branch and bake the flag (crdtlint CL702)
+        out = _sv_deficit_call(p, interpret)
         return out[:r, :r].astype(svs.dtype)
 
     def _exact(cent):

@@ -40,6 +40,7 @@ from crdt_tpu.compat import enable_x64
 import jax.numpy as jnp
 import numpy as np
 
+from crdt_tpu.ops import deleteset as ds_ops
 from crdt_tpu.ops.device import bucket_pow2 as _bucket  # shared policy
 
 # (name, dtype) in kernel argument order
@@ -68,17 +69,21 @@ _FILL = {
 }
 
 
-@partial(jax.jit, static_argnames=("num_segments",))
-def _converge_all(bufs, d_client, d_start, d_end, num_segments):
+@partial(jax.jit, static_argnames=("num_segments", "ds_mode"))
+def _converge_all(bufs, d_client, d_start, d_end, num_segments,
+                  ds_mode=None):
     """Map + sequence convergence as ONE XLA program: both kernels
     share the packed-id sort and dedup, which XLA CSEs when they are
     traced together — one dispatch instead of two (each dispatch costs
-    ~0.35s in the tunnelled platform's degraded state)."""
+    ~0.35s in the tunnelled platform's degraded state). ``ds_mode``
+    is the host-computed delete-mask kernel static (crdtlint CL702 —
+    never read CRDT_TPU_PALLAS in here)."""
     from crdt_tpu.ops.merge import converge_maps
     from crdt_tpu.ops.yata import converge_sequences
 
     maps_out = converge_maps(
-        *bufs, d_client, d_start, d_end, num_segments=num_segments
+        *bufs, d_client, d_start, d_end, num_segments=num_segments,
+        ds_mode=ds_mode,
     )
     seq_out = converge_sequences(*bufs, num_segments=num_segments)
     return maps_out, seq_out
@@ -92,18 +97,22 @@ def _splice(bufs, delta, n):
     )
 
 
-@partial(jax.jit, donate_argnums=(0,), static_argnames=("num_segments",))
+@partial(jax.jit, donate_argnums=(0,),
+         static_argnames=("num_segments", "ds_mode"))
 def _splice_and_converge(bufs, delta, n, d_client, d_start, d_end,
-                         num_segments):
+                         num_segments, ds_mode=None):
     """Append + full convergence as ONE program: the splice, the LWW
     map kernel, and the YATA sequence kernel trace together, so a
     single-delta replay pays one dispatch instead of two (each costs
-    ~0.35s in the tunnelled platform's degraded state)."""
+    ~0.35s in the tunnelled platform's degraded state). ``ds_mode``
+    threads through to the delete-mask kernel (host-computed static,
+    crdtlint CL702)."""
     bufs = tuple(
         jax.lax.dynamic_update_slice(b, d, (n,)) for b, d in zip(bufs, delta)
     )
     maps_out, seq_out = _converge_all(
-        bufs, d_client, d_start, d_end, num_segments=num_segments
+        bufs, d_client, d_start, d_end, num_segments=num_segments,
+        ds_mode=ds_mode,
     )
     return bufs, maps_out, seq_out
 
@@ -266,6 +275,7 @@ class ResidentColumns:
             self._bufs, maps_out, seq_out = _splice_and_converge(
                 self._bufs, delta, jnp.int32(self.n),
                 d_client, d_start, d_end, num_segments=segs,
+                ds_mode=ds_ops.mask_mode(),  # host static (CL702)
             )
         self.n += k
         return maps_out, seq_out
@@ -304,5 +314,7 @@ class ResidentColumns:
                 d_start = jnp.full(16, -1, jnp.int64)
                 d_end = jnp.full(16, -1, jnp.int64)
             return _converge_all(
-                self._bufs, d_client, d_start, d_end, num_segments=segs
+                self._bufs, d_client, d_start, d_end,
+                num_segments=segs,
+                ds_mode=ds_ops.mask_mode(),  # host static (CL702)
             )

@@ -78,19 +78,43 @@ def exact_missing(svs: jnp.ndarray) -> jnp.ndarray:
     return exact_missing_rows(svs, svs)
 
 
-def missing(svs: jnp.ndarray) -> jnp.ndarray:
+def deficit_mode() -> str:
+    """HOST-side static dispatch for :func:`missing`: ``"jnp"`` |
+    ``"pallas"`` | ``"interpret"``. Traced callers (the gossip/delta
+    step bodies) must compute this at factory-build time and call
+    :func:`missing_static` — an env read inside the traced step bakes
+    the flag into the compiled program (crdtlint CL702)."""
+    from crdt_tpu.ops import pallas_kernels as _pk
+
+    return _pk.pallas_mode()
+
+
+def missing(svs: jnp.ndarray, mode: "str | None" = None) -> jnp.ndarray:
+    """HOST entry for :func:`missing_static`: resolves the kernel
+    mode from the env when ``mode`` is None. Never call from a traced
+    body (crdtlint CL702)."""
+    return missing_static(
+        svs, deficit_mode() if mode is None else mode
+    )
+
+
+def missing_static(svs: jnp.ndarray, mode: str = "jnp") -> jnp.ndarray:
     """[R, C] -> [R, R] total clocks replica i has that j lacks.
 
     The full-mesh generalization of the per-peer handshake: entry
     (i, j) > 0 means i should send a delta to j.
 
-    On TPU this is the tiled Pallas kernel (streams C through VMEM,
-    HBM holds only the [R, R] result, with a traced-bound fallback to
-    :func:`exact_missing` when i32 tiles could wrap); elsewhere it is
-    the exact scan.
+    With ``mode`` "pallas"/"interpret" this is the tiled Pallas
+    kernel (streams C through VMEM, HBM holds only the [R, R]
+    result, with a traced-bound fallback to :func:`exact_missing`
+    when i32 tiles could wrap); ``"jnp"`` is the exact scan. ``mode``
+    is a STATIC computed on the host (:func:`deficit_mode`) — this
+    function is traced-safe.
     """
     from crdt_tpu.ops import pallas_kernels as _pk
 
-    if _pk.use_pallas():
-        return _pk.sv_deficit(svs)
+    if mode != "jnp":
+        return _pk.sv_deficit_static(
+            svs, interpret=(mode == "interpret")
+        )
     return exact_missing(svs)

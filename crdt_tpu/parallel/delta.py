@@ -78,6 +78,9 @@ def make_delta_gossip_step(mesh, num_clients: int, budget: int):
       resident state)
     """
     axis = mesh.axis_names[0]
+    # host-resolved kernel static at factory build: the traced step
+    # must not read CRDT_TPU_PALLAS (crdtlint CL702)
+    sv_deficit_mode = statevec.deficit_mode()
 
     @partial(
         shard_map,
@@ -93,7 +96,7 @@ def make_delta_gossip_step(mesh, num_clients: int, budget: int):
             lambda c, k, v: statevec.build(c, k, v, num_clients)
         )(client, clock, valid)
         svs = jax.lax.all_gather(sv_local, axis).reshape(-1, num_clients)
-        deficit = statevec.missing(svs)
+        deficit = statevec.missing_static(svs, sv_deficit_mode)
 
         # swarm floor: clocks EVERY replica holds; only rows above it
         # can be missing anywhere

@@ -34,6 +34,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from crdt_tpu.compat import shard_map
 
+from crdt_tpu.ops import deleteset as ds_ops
 from crdt_tpu.ops import statevec
 from crdt_tpu.ops.merge import converge_maps
 from crdt_tpu.ops.yata import converge_sequences
@@ -174,6 +175,11 @@ def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
       internal coincidence no assembler should couple to)
     """
     axis = mesh.axis_names[0]
+    # kernel-dispatch statics, resolved HERE on the host at factory
+    # build: the step body is traced, and an env read inside it would
+    # bake CRDT_TPU_PALLAS into the compiled program (crdtlint CL702)
+    ds_mode = ds_ops.mask_mode()
+    sv_deficit_mode = statevec.deficit_mode()
 
     @partial(
         shard_map,
@@ -199,7 +205,7 @@ def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
         # merged swarm vector and the pairwise anti-entropy plan
         svs = jax.lax.all_gather(sv_local, axis).reshape(-1, num_clients)
         global_sv = statevec.merge(svs)
-        deficit = statevec.missing(svs)
+        deficit = statevec.missing_static(svs, sv_deficit_mode)
 
         # gossip fan-in: all-gather the op columns into the union every
         # replica would hold after a full propagate round
@@ -218,7 +224,7 @@ def make_gossip_step(mesh: Mesh, num_segments: int, num_clients: int):
         with jax.named_scope("crdt.gossip.converge_maps"):
             map_order, _, winners, winner_visible, _, _ = converge_maps(
                 *union, d_client, d_start, d_end,
-                num_segments=num_segments
+                num_segments=num_segments, ds_mode=ds_mode,
             )
         # ... and orders every sequence in the same union (the YATA
         # half of applyUpdate; same id-sort, XLA CSEs the shared work)
@@ -257,6 +263,10 @@ def make_hierarchical_gossip_step(mesh: Mesh, num_segments: int,
     replicas); replicated packed deletes. Output as in
     :func:`make_gossip_step`."""
     host, rep = mesh.axis_names
+    # host-resolved kernel statics (crdtlint CL702, see
+    # make_gossip_step)
+    ds_mode = ds_ops.mask_mode()
+    sv_deficit_mode = statevec.deficit_mode()
 
     @partial(
         shard_map,
@@ -282,7 +292,7 @@ def make_hierarchical_gossip_step(mesh: Mesh, num_segments: int,
 
         svs = gather2(sv_local)  # [R, num_clients]
         global_sv = statevec.merge(svs)
-        deficit = statevec.missing(svs)
+        deficit = statevec.missing_static(svs, sv_deficit_mode)
 
         union = [
             gather2(x).reshape(-1)
@@ -290,7 +300,8 @@ def make_hierarchical_gossip_step(mesh: Mesh, num_segments: int,
                       key_id, origin_client, origin_clock, valid)
         ]
         map_order, _, winners, winner_visible, _, _ = converge_maps(
-            *union, d_client, d_start, d_end, num_segments=num_segments
+            *union, d_client, d_start, d_end,
+            num_segments=num_segments, ds_mode=ds_mode,
         )
         seq_order, seq_seg, seq_rank, seq_len = converge_sequences(
             *union, num_segments=num_segments
@@ -341,6 +352,10 @@ def make_segment_sharded_step(mesh: Mesh, num_segments: int,
     axis = mesh.axis_names[0]
     nd = mesh.devices.size
     blk = -(-n_replicas // nd)  # deficit rows per device
+    # host-resolved kernel static (crdtlint CL702, see
+    # make_gossip_step); the deficit here rides exact_missing_rows,
+    # so only the delete mask needs a mode
+    ds_mode = ds_ops.mask_mode()
 
     @partial(
         shard_map,
@@ -353,7 +368,8 @@ def make_segment_sharded_step(mesh: Mesh, num_segments: int,
         flat = [x.reshape(-1) for x in _unpack_cols(packed)]
         d_client, d_start, d_end = dels[0], dels[1], dels[2]
         map_order, _, winners, winner_visible, _, _ = converge_maps(
-            *flat, d_client, d_start, d_end, num_segments=num_segments
+            *flat, d_client, d_start, d_end,
+            num_segments=num_segments, ds_mode=ds_mode,
         )
         seq_order, seq_seg, seq_rank, seq_len = converge_sequences(
             *flat, num_segments=num_segments

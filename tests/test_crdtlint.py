@@ -997,3 +997,790 @@ def test_write_baseline_cli_merges_not_clobbers(tmp_path, capsys):
     justs = sorted(e["justification"] for e in remerged.values())
     assert justs == ["TODO: justify or fix", "hand-written reason"]
     capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# round 16: call-graph + CFG core
+
+
+def test_callgraph_resolution_and_thread_reachability():
+    """Cross-module strong resolution, weak method fan-out, thread
+    roots, and the reachability closure the CL803 discovery rides."""
+    from tools.crdtlint.callgraph import build_callgraph, STRONG
+    from tools.crdtlint.core import Module
+
+    mods = [
+        Module("crdt_tpu/a.py", textwrap.dedent('''
+            from crdt_tpu.b import helper
+            import threading
+
+            def start():
+                t = threading.Thread(target=entry)
+                t.start()
+
+            def entry():
+                helper()
+        ''')),
+        Module("crdt_tpu/b.py", textwrap.dedent('''
+            def helper():
+                pass
+        ''')),
+    ]
+    cg = build_callgraph(mods)
+    assert "crdt_tpu/a.py:entry" in cg.thread_roots
+    assert "crdt_tpu/b.py:helper" in cg.thread_reachable
+    edges = {(c.callee, c.confidence)
+             for c in cg.edges.get("crdt_tpu/a.py:entry", ())}
+    assert ("crdt_tpu/b.py:helper", STRONG) in edges
+
+
+def test_callgraph_collision_links_all_candidates():
+    """Two classes defining the same method name: an unresolvable
+    receiver fans out to BOTH (weak edges) and the collision is
+    counted — over-approximation is the right direction for
+    reachability, and the stats make the guessing visible."""
+    from tools.crdtlint.callgraph import build_callgraph, WEAK
+    from tools.crdtlint.core import Module
+
+    mods = [
+        Module("crdt_tpu/a.py", textwrap.dedent('''
+            class A:
+                def run(self):
+                    pass
+
+            class B:
+                def run(self):
+                    pass
+
+            def call(x):
+                x.run()
+        ''')),
+    ]
+    cg = build_callgraph(mods)
+    callees = {c.callee for c in cg.edges.get("crdt_tpu/a.py:call", ())}
+    assert callees == {"crdt_tpu/a.py:A.run", "crdt_tpu/a.py:B.run"}
+    assert all(
+        c.confidence == WEAK
+        for c in cg.edges["crdt_tpu/a.py:call"]
+    )
+    assert cg.collisions >= 1
+    assert cg.stats()["functions"] == 3
+
+
+def test_callgraph_local_def_shadows_import():
+    """A local def wins over a same-named def in another module —
+    the donate checker's shadowing rule, now shared machinery."""
+    from tools.crdtlint.callgraph import build_callgraph
+    from tools.crdtlint.core import Module
+
+    mods = [
+        Module("crdt_tpu/a.py", textwrap.dedent('''
+            def helper():
+                pass
+
+            def caller():
+                helper()
+        ''')),
+        Module("crdt_tpu/b.py", textwrap.dedent('''
+            def helper():
+                pass
+        ''')),
+    ]
+    cg = build_callgraph(mods)
+    callees = {c.callee
+               for c in cg.edges.get("crdt_tpu/a.py:caller", ())}
+    assert callees == {"crdt_tpu/a.py:helper"}
+
+
+def test_cfg_exception_edges():
+    """The lite CFG's exception edges: a statement inside try lands
+    in the handler; a finally is reached on both the normal and the
+    unwinding path."""
+    import ast as _ast
+
+    from tools.crdtlint.cfg import CFG, EXIT, RAISE, every_path_hits
+
+    fn = _ast.parse(textwrap.dedent('''
+        def f(work, cleanup):
+            try:
+                work()
+            finally:
+                cleanup()
+    ''')).body[0]
+    cfg = CFG(fn)
+
+    def hits_cleanup(st):
+        return any(
+            isinstance(n, _ast.Call) and getattr(n.func, "id", "")
+            == "cleanup"
+            for n in _ast.walk(st)
+        )
+
+    # every path — normal AND raising — passes through cleanup()
+    missing = every_path_hits(
+        cfg, cfg.entry, hits_cleanup, with_exc=True
+    )
+    assert missing is None
+
+    fn2 = _ast.parse(textwrap.dedent('''
+        def g(work, cleanup):
+            work()
+            cleanup()
+    ''')).body[0]
+    cfg2 = CFG(fn2)
+
+    # without try/finally, work()'s exception edge skips cleanup
+    missing = every_path_hits(
+        cfg2, cfg2.entry, hits_cleanup, with_exc=True
+    )
+    assert missing == RAISE
+    # ...but every NORMAL path still hits it
+    assert every_path_hits(cfg2, cfg2.entry, hits_cleanup) is None
+
+
+# ---------------------------------------------------------------------------
+# CL7xx trace purity
+
+
+def test_cl701_tracer_call_in_jitted_body():
+    r = lint({"crdt_tpu/ops/x.py": '''
+    import jax
+    from crdt_tpu.obs.tracer import get_tracer
+
+    @jax.jit
+    def step(x):
+        get_tracer().count("engine.ticks")
+        return x
+    '''})
+    assert "CL701" in codes(r)
+
+
+def test_cl701_interprocedural_through_helper():
+    """The side effect sits one call away from the jit root — only
+    the call-graph closure sees it."""
+    r = lint({"crdt_tpu/ops/x.py": '''
+    import jax
+
+    def note(x):
+        print("traced!", x)
+        return x
+
+    @jax.jit
+    def step(x):
+        return note(x)
+    '''})
+    assert "CL701" in codes(r)
+
+
+def test_cl701_host_dispatcher_clean():
+    """The same tracer call OUTSIDE any traced body is the sanctioned
+    dispatcher pattern."""
+    r = lint({"crdt_tpu/ops/x.py": '''
+    import jax
+    from crdt_tpu.obs.tracer import get_tracer
+
+    @jax.jit
+    def step(x):
+        return x
+
+    def dispatch(x):
+        get_tracer().count("engine.ticks")
+        return step(x)
+    '''})
+    assert "CL701" not in codes(r)
+
+
+def test_cl702_env_read_in_lax_cond_branch():
+    """The sv_deficit shape that motivated the fix: a nested def
+    passed to lax.cond reads the env at trace time."""
+    r = lint({"crdt_tpu/ops/x.py": '''
+    import os
+    import jax
+
+    def outer(x):
+        def a(v):
+            if os.environ.get("CRDT_TPU_PALLAS"):
+                return v
+            return v + 1
+
+        def b(v):
+            return v
+
+        return jax.lax.cond(x.sum() > 0, a, b, x)
+    '''})
+    assert "CL702" in codes(r)
+
+
+def test_cl702_host_env_read_clean():
+    r = lint({"crdt_tpu/ops/x.py": '''
+    import os
+
+    def mode():
+        return os.environ.get("CRDT_TPU_PALLAS", "auto")
+    '''})
+    assert "CL702" not in codes(r)
+
+
+def test_cl703_host_sync_in_traced_body():
+    r = lint({"crdt_tpu/ops/x.py": '''
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        h = np.asarray(x)
+        return h.sum()
+    '''})
+    assert "CL703" in codes(r)
+
+
+def test_cl704_captured_mutation_and_local_clean():
+    r = lint({"crdt_tpu/ops/x.py": '''
+    import jax
+
+    _MEMO = {}
+
+    @jax.jit
+    def bad(x):
+        _MEMO["x"] = x
+        return x
+
+    @jax.jit
+    def good(x):
+        local = {}
+        local["x"] = x
+        return x
+    '''})
+    found = [f for f in r.findings if f.code == "CL704"]
+    assert len(found) == 1 and "bad" in found[0].symbol
+
+
+def test_cl7xx_suppressed_and_baselined():
+    src = '''
+    import jax
+    from crdt_tpu.obs.tracer import get_tracer
+
+    @jax.jit
+    def step(x):
+        get_tracer().count("engine.ticks")  # crdtlint: disable=CL701
+        return x
+    '''
+    r = lint({"crdt_tpu/ops/x.py": src})
+    assert "CL701" not in codes(r)
+    assert any(f.code == "CL701" for f in r.suppressed)
+    # baselined: same snippet without the inline disable
+    src2 = src.replace("  # crdtlint: disable=CL701", "")
+    r2 = lint({"crdt_tpu/ops/x.py": src2})
+    fp = next(f for f in r2.findings if f.code == "CL701").fingerprint
+    r3 = lint({"crdt_tpu/ops/x.py": src2}, baseline={
+        fp: {"fingerprint": fp, "justification": "pinned by test"}
+    })
+    assert "CL701" not in codes(r3)
+    assert any(f.code == "CL701" for f in r3.baselined)
+
+
+# ---------------------------------------------------------------------------
+# CL8xx lock discipline
+
+
+def test_cl801_lock_order_cycle_fires_and_ordered_clean():
+    bad = {
+        "crdt_tpu/a.py": '''
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def ab():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def ba():
+        with LOCK_B:
+            with LOCK_A:
+                pass
+    '''}
+    assert "CL801" in codes(lint(bad))
+    good = {
+        "crdt_tpu/a.py": '''
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def ab():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def ab2():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+    '''}
+    assert "CL801" not in codes(lint(good))
+
+
+def test_cl801_interprocedural_cycle():
+    """The inversion hides behind a call: f holds A and calls g,
+    which takes B; h holds B and calls k, which takes A."""
+    r = lint({"crdt_tpu/a.py": '''
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def take_b():
+        with LOCK_B:
+            pass
+
+    def take_a():
+        with LOCK_A:
+            pass
+
+    def f():
+        with LOCK_A:
+            take_b()
+
+    def h():
+        with LOCK_B:
+            take_a()
+    '''})
+    assert "CL801" in codes(r)
+
+
+def test_cl801_lock_alias_suppresses_phantom_cycle():
+    """`self._lock = other._lock` aliases the two identities: the
+    apparent A->B / B->A inversion is one lock taken twice in one
+    direction — no cycle."""
+    r = lint({"crdt_tpu/a.py": '''
+    import threading
+
+    class Owner:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    class Borrower:
+        def __init__(self, owner):
+            self._lock = owner._lock
+
+        def locked_pair(self, owner):
+            with self._lock:
+                with owner._lock:
+                    pass
+
+        def locked_pair_rev(self, owner):
+            with owner._lock:
+                with self._lock:
+                    pass
+    '''})
+    assert "CL801" not in codes(r)
+
+
+def test_cl802_blocking_under_lock_and_outside_clean():
+    bad = {"crdt_tpu/a.py": '''
+    import subprocess
+    import threading
+
+    _BUILD_LOCK = threading.Lock()
+
+    def build():
+        with _BUILD_LOCK:
+            subprocess.run(["make"])
+    '''}
+    assert "CL802" in codes(lint(bad))
+    good = {"crdt_tpu/a.py": '''
+    import subprocess
+    import threading
+
+    _BUILD_LOCK = threading.Lock()
+
+    def build():
+        subprocess.run(["make"])
+        with _BUILD_LOCK:
+            done = True
+        return done
+    '''}
+    assert "CL802" not in codes(lint(good))
+
+
+def test_cl802_interprocedural_blocking_callee():
+    """The kv.py _load shape: the blocking call hides inside a
+    helper invoked under the lock."""
+    r = lint({"crdt_tpu/a.py": '''
+    import subprocess
+    import threading
+
+    _lib_lock = threading.Lock()
+
+    def _build_so():
+        subprocess.run(["g++"])
+
+    def _load():
+        with _lib_lock:
+            _build_so()
+    '''})
+    found = [f for f in r.findings if f.code == "CL802"]
+    assert found and "via `_build_so`" in found[0].message
+
+
+def test_cl803_thread_shared_guarded_field():
+    src = '''
+    import threading
+
+    class Shared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+
+        def reset(self):
+            self.n = 0
+
+    def worker():
+        Shared().bump()
+
+    def spawn():
+        return threading.Thread(target=worker)
+    '''
+    r = lint({"crdt_tpu/models/x.py": src})
+    found = [f for f in r.findings if f.code == "CL803"]
+    assert len(found) == 1
+    assert "reset" in found[0].symbol
+    # consistent locking is clean
+    src_good = src.replace(
+        "        def reset(self):\n            self.n = 0",
+        "        def reset(self):\n            with self._lock:\n"
+        "                self.n = 0",
+    )
+    assert src_good != src
+    r2 = lint({"crdt_tpu/models/x.py": src_good})
+    assert "CL803" not in codes(r2)
+
+
+def test_cl803_init_writes_exempt_and_unthreaded_clean():
+    """__init__ writes don't count (object unshared), and a class no
+    thread reaches is out of scope entirely."""
+    r = lint({"crdt_tpu/models/x.py": '''
+    import threading
+
+    class NotShared:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def bump(self):
+            with self._lock:
+                self.n += 1
+
+        def reset(self):
+            self.n = 0
+    '''})
+    assert "CL803" not in codes(r)
+
+
+# ---------------------------------------------------------------------------
+# CL9xx async-handle / paired-protocol discipline
+
+
+def test_cl901_dropped_handle_fires():
+    r = lint({"crdt_tpu/models/x.py": '''
+    from crdt_tpu.ops import packed
+
+    def leak(plan):
+        h = packed.converge_async(plan)
+        return 0
+    '''})
+    assert "CL901" in codes(r)
+
+
+def test_cl901_branch_without_fetch_fires():
+    r = lint({"crdt_tpu/models/x.py": '''
+    from crdt_tpu.ops import packed
+
+    def maybe(plan, flag):
+        h = packed.converge_async(plan)
+        if flag:
+            return packed.converge_fetch(h)
+        return None
+    '''})
+    assert "CL901" in codes(r)
+
+
+def test_cl901_all_paths_consumed_clean():
+    r = lint({"crdt_tpu/models/x.py": '''
+    from crdt_tpu.ops import packed
+
+    def both(plan, flag):
+        h = packed.converge_async(plan)
+        if flag:
+            return packed.converge_fetch(h)
+        return packed.converge_fetch(h)
+
+    def queued(plan, q):
+        h = packed.converge_async(plan)
+        q.put((h, 1))
+
+    def returned(plan):
+        return packed.converge_async(plan)
+    '''})
+    assert "CL901" not in codes(r)
+
+
+def test_cl901_loop_rebind_without_consume_fires():
+    r = lint({"crdt_tpu/models/x.py": '''
+    from crdt_tpu.ops import packed
+
+    def spin(plans):
+        for p in plans:
+            h = packed.converge_async(p)
+        return packed.converge_fetch(h)
+    '''})
+    assert "CL901" in codes(r)
+
+
+def test_cl901_bare_expression_drop_fires():
+    r = lint({"crdt_tpu/models/x.py": '''
+    from crdt_tpu.ops import packed
+
+    def fire_and_forget(plan):
+        packed.converge_async(plan)
+    '''})
+    found = [f for f in r.findings if f.code == "CL901"]
+    assert found and "drop" in found[0].symbol
+
+
+def test_cl902_exception_edge_skips_closer():
+    r = lint({"crdt_tpu/obs/x.py": '''
+    import jax
+
+    def capture(log_dir, work):
+        jax.profiler.start_trace(log_dir)
+        work()
+        jax.profiler.stop_trace()
+    '''})
+    found = [f for f in r.findings if f.code == "CL902"]
+    assert found and "exception" in found[0].symbol
+
+
+def test_cl902_finally_closes_clean():
+    r = lint({"crdt_tpu/obs/x.py": '''
+    import jax
+
+    def capture(log_dir, work):
+        jax.profiler.start_trace(log_dir)
+        try:
+            work()
+        finally:
+            jax.profiler.stop_trace()
+    '''})
+    assert "CL902" not in codes(r)
+
+
+def test_cl902_alias_resolution():
+    """The profiling.py shape: locally aliased opener/closer."""
+    r = lint({"crdt_tpu/obs/x.py": '''
+    import jax
+
+    def capture(log_dir, work):
+        start = jax.profiler.start_trace
+        stop = jax.profiler.stop_trace
+        start(log_dir)
+        work()
+        stop()
+    '''})
+    assert "CL902" in codes(r)
+    r2 = lint({"crdt_tpu/obs/x.py": '''
+    import jax
+
+    def capture(log_dir, work):
+        start = jax.profiler.start_trace
+        stop = jax.profiler.stop_trace
+        start(log_dir)
+        try:
+            work()
+        finally:
+            stop()
+    '''})
+    assert "CL902" not in codes(r2)
+
+
+def test_cl902_protocol_object_exempt():
+    """install/uninstall pairs on one class are the context-manager
+    discipline, not a leak."""
+    r = lint({"crdt_tpu/guard/x.py": '''
+    from crdt_tpu.ops.device import set_device_fault_hook
+
+    class Plan:
+        def install(self):
+            self._old = set_device_fault_hook(self)
+            return self
+
+        def uninstall(self):
+            set_device_fault_hook(self._old)
+    '''})
+    assert "CL902" not in codes(r)
+
+
+def test_cl902_bare_acquire_without_release_fires():
+    r = lint({"crdt_tpu/models/x.py": '''
+    def f(my_lock, work):
+        my_lock.acquire()
+        work()
+        my_lock.release()
+    '''})
+    found = [f for f in r.findings if f.code == "CL902"]
+    assert found
+    r2 = lint({"crdt_tpu/models/x.py": '''
+    def f(my_lock, work):
+        my_lock.acquire()
+        try:
+            work()
+        finally:
+            my_lock.release()
+    '''})
+    assert "CL902" not in codes(r2)
+
+
+# ---------------------------------------------------------------------------
+# round-16 review regressions (each was a demonstrated failure)
+
+
+def test_cl902_specific_except_with_finally_clean():
+    """Review finding: a raise inside an except handler must route
+    through the finally (which holds the closer) — the canonical
+    close-in-finally-with-specific-except pattern is NOT a leak."""
+    r = lint({"crdt_tpu/models/x.py": '''
+    def f(my_lock, work, handle):
+        my_lock.acquire()
+        try:
+            work()
+        except ValueError:
+            handle()
+        finally:
+            my_lock.release()
+    '''})
+    assert "CL902" not in codes(r)
+
+
+def test_cl7xx_partial_shard_map_body_is_traced():
+    """Review finding: @partial(shard_map, ...) — the repo's dominant
+    traced-step shape — must join the traced set like
+    @partial(jax.jit, ...)."""
+    r = lint({"crdt_tpu/parallel/x.py": '''
+    import os
+    from functools import partial
+
+    from crdt_tpu.compat import shard_map
+
+    @partial(shard_map, mesh=None)
+    def step(x):
+        if os.environ.get("CRDT_TPU_PALLAS"):
+            return x
+        return x + 1
+    '''})
+    assert "CL702" in codes(r)
+
+
+def test_reach_closure_complete_through_call_cycles():
+    """Review finding: mutually recursive helpers must not poison the
+    closure memo — A<->B with B->D has D in BOTH closures (a blocking
+    call in D behind the cycle must stay visible to CL801/CL802)."""
+    from tools.crdtlint.callgraph import build_callgraph, reach_closure
+    from tools.crdtlint.core import Module
+
+    mods = [Module("crdt_tpu/a.py", textwrap.dedent('''
+        def a():
+            b()
+
+        def b():
+            a()
+            d()
+
+        def d():
+            pass
+    '''))]
+    cg = build_callgraph(mods)
+    memo = {}
+    ca = reach_closure(cg, "crdt_tpu/a.py:a", strong_only=True,
+                       memo=memo)
+    cb = reach_closure(cg, "crdt_tpu/a.py:b", strong_only=True,
+                       memo=memo)
+    assert "crdt_tpu/a.py:d" in ca and "crdt_tpu/a.py:d" in cb
+    assert "crdt_tpu/a.py:a" in ca  # cyclic: members reach themselves
+
+
+def test_cl802_blocking_behind_mutual_recursion():
+    """End-to-end: the blocking primitive sits behind a recursive
+    helper pair under the lock — the SCC closure must surface it."""
+    r = lint({"crdt_tpu/a.py": '''
+    import subprocess
+    import threading
+
+    _build_lock = threading.Lock()
+
+    def ping(n):
+        if n:
+            pong(n - 1)
+
+    def pong(n):
+        ping(n)
+        subprocess.run(["make"])
+
+    def build():
+        with _build_lock:
+            ping(3)
+    '''})
+    assert "CL802" in codes(r)
+
+
+def test_cl902_return_inside_try_finally_clean():
+    """Review round 2: return/break inside the protected region must
+    route through the finally — `acquire(); try: return f() finally:
+    release()` is the RECOMMENDED pattern, not a leak."""
+    r = lint({"crdt_tpu/models/x.py": '''
+    def ret_form(my_lock, work):
+        my_lock.acquire()
+        try:
+            return work()
+        finally:
+            my_lock.release()
+
+    def brk_form(my_lock, items):
+        for it in items:
+            my_lock.acquire()
+            try:
+                if it:
+                    break
+            finally:
+                my_lock.release()
+    '''})
+    assert "CL902" not in codes(r)
+
+
+def test_callgraph_nested_class_does_not_shadow_toplevel():
+    """Review round 2: a class defined inside a function must keep
+    the enclosing qual prefix — previously its methods overwrote a
+    same-named top-level class's methods in the graph, a silent
+    blind spot for every downstream checker."""
+    from tools.crdtlint.callgraph import build_callgraph
+    from tools.crdtlint.core import Module
+
+    mods = [Module("crdt_tpu/x.py", textwrap.dedent('''
+        class A:
+            def f(self):
+                pass
+
+        def factory():
+            class A:
+                def f(self):
+                    pass
+            return A
+    '''))]
+    cg = build_callgraph(mods)
+    assert "crdt_tpu/x.py:A.f" in cg.funcs
+    assert "crdt_tpu/x.py:factory.<locals>.A.f" in cg.funcs

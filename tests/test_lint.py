@@ -61,14 +61,36 @@ def test_package_lints_clean_via_cli():
 
 
 def test_checker_suite_is_complete():
-    """≥6 checkers and every advertised code belongs to exactly one."""
-    assert len(ALL_CHECKERS) >= 6
+    """≥9 checkers (round 16 added CL7xx/CL8xx/CL9xx) and every
+    advertised code belongs to exactly one, with an --explain text."""
+    from tools.crdtlint.checkers import ALL_EXPLAIN
+
+    assert len(ALL_CHECKERS) >= 9
     seen = {}
     for cls in ALL_CHECKERS:
         for code in cls.codes:
             assert code not in seen, f"{code} registered twice"
             seen[code] = cls.name
-    assert len(seen) >= 10
+    assert len(seen) >= 20
+    for code in seen:
+        assert ALL_EXPLAIN.get(code), f"{code} has no --explain text"
+
+
+def test_cli_runs_without_importing_jax():
+    """The analysis layer is stdlib-only BY CONTRACT: the whole-tree
+    pass (call graph included) must never import jax — that is what
+    keeps it runnable in any environment and inside the <10 s
+    budget."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, '.');"
+         "from tools.crdtlint.__main__ import main;"
+         "rc = main(['crdt_tpu/']);"
+         "assert 'jax' not in sys.modules, 'crdtlint imported jax';"
+         "sys.exit(rc)"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 # ---------------------------------------------------------------------------
@@ -172,6 +194,105 @@ _state = dict()
 
 def put(k, v):
     _state[k] = v
+''', None),
+    "CL701": ("crdt_tpu/ops/x.py", '''
+import jax
+from crdt_tpu.obs.tracer import get_tracer
+
+@jax.jit
+def step(x):
+    get_tracer().count("engine.calls", 1)
+    return x
+''', None),
+    "CL702": ("crdt_tpu/ops/x.py", '''
+import os
+import jax
+
+@jax.jit
+def step(x):
+    if os.environ.get("CRDT_TPU_FLAG"):
+        return x
+    return x + 1
+''', None),
+    "CL703": ("crdt_tpu/ops/x.py", '''
+import jax
+
+@jax.jit
+def step(x):
+    jax.block_until_ready(x)
+    return x
+''', None),
+    "CL704": ("crdt_tpu/ops/x.py", '''
+import jax
+
+_CACHE = {}
+
+@jax.jit
+def step(x):
+    _CACHE["last"] = x
+    return x
+''', None),
+    "CL801": ("crdt_tpu/ops/x.py", '''
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+def ab():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+def ba():
+    with LOCK_B:
+        with LOCK_A:
+            pass
+''', None),
+    "CL802": ("crdt_tpu/ops/x.py", '''
+import subprocess
+import threading
+
+_BUILD_LOCK = threading.Lock()
+
+def build():
+    with _BUILD_LOCK:
+        subprocess.run(["make"])
+''', None),
+    "CL803": ("crdt_tpu/models/x.py", '''
+import threading
+
+class SharedState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def locked_bump(self):
+        with self._lock:
+            self.n += 1
+
+    def bare_reset(self):
+        self.n = 0
+
+def worker():
+    SharedState().locked_bump()
+
+def spawn():
+    return threading.Thread(target=worker)
+''', None),
+    "CL901": ("crdt_tpu/models/x.py", '''
+from crdt_tpu.ops import packed
+
+def leak(plan):
+    h = packed.converge_async(plan)
+    return 0
+''', None),
+    "CL902": ("crdt_tpu/obs/x.py", '''
+import jax
+
+def capture(log_dir, work):
+    jax.profiler.start_trace(log_dir)
+    work()
+    jax.profiler.stop_trace()
 ''', None),
 }
 
@@ -494,6 +615,194 @@ def test_smoke_emit_skips_lint_pass(monkeypatch, tmp_path, capsys):
     bench.emit_result(out2, path=str(tmp_path / "B.json"))
     assert calls and out2["lint"] == {"findings": 0, "open": 0}
     capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# round-16 CL8xx audit: the lock-discipline checkers cleared the
+# thread-shared surface (Tracer, FlightRecorder, the streaming
+# _Phases accumulator, the serve() in-flight window) — these seeded
+# storms pin the audited behavior so a refactor that drops a lock
+# fails HERE, not just in the lint
+
+
+def test_tracer_storm_conserves_counts():
+    """CL803 audit pin: every Tracer mutation path (count/gauge/
+    observe) under 8 racing threads loses nothing — the round-8 lock
+    is load-bearing, not decorative."""
+    from crdt_tpu.obs.tracer import Tracer
+
+    tr = Tracer(enabled=True)
+    n, rounds = 8, 400
+    barrier = threading.Barrier(n)
+
+    def storm(tid):
+        barrier.wait()
+        for i in range(rounds):
+            tr.count("storm.hits")
+            tr.count("storm.bytes", 3)
+            tr.observe("storm.lat", 0.001 * ((tid + i) % 7 + 1))
+            tr.gauge("storm.last", tid)
+
+    threads = [
+        threading.Thread(target=storm, args=(t,)) for t in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rep = tr.report()
+    assert rep["counters"]["storm.hits"] == n * rounds
+    assert rep["counters"]["storm.bytes"] == 3 * n * rounds
+    span = rep["spans"]["storm.lat"]
+    assert span["count"] == n * rounds
+    assert sum(span["buckets"].values()) == n * rounds
+    assert rep["gauges"]["storm.last"] in set(range(n))
+
+
+def test_recorder_storm_conserves_events():
+    """CL803 audit pin: FlightRecorder.record under racing producers
+    never loses an increment, and the ring never exceeds capacity."""
+    from crdt_tpu.obs.recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=256, enabled=True)
+    n, rounds = 8, 300
+    barrier = threading.Barrier(n)
+
+    def storm(tid):
+        barrier.wait()
+        for i in range(rounds):
+            rec.record("update.sent", tid=tid, seq=i)
+
+    threads = [
+        threading.Thread(target=storm, args=(t,)) for t in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert rec.recorded == n * rounds
+    assert len(rec) == 256  # ring clamped at capacity, oldest evicted
+
+
+def test_streaming_phase_accumulator_storm():
+    """CL803 audit pin: the stager thread and the decode pool both
+    charge busy seconds into one _Phases instance; racing adds must
+    sum exactly (integer-valued floats — fp64 exact far beyond this
+    count)."""
+    from crdt_tpu.models.streaming import _Phases
+
+    ph = _Phases()
+    n, rounds = 9, 500
+    barrier = threading.Barrier(n)
+
+    def storm(tid):
+        barrier.wait()
+        for _ in range(rounds):
+            ph.add("decode", 1.0)
+            ph.add(f"lane{tid % 3}", 1.0)
+
+    threads = [
+        threading.Thread(target=storm, args=(t,)) for t in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert ph.t["decode"] == float(n * rounds)
+    assert sum(
+        v for k, v in ph.t.items() if k.startswith("lane")
+    ) == float(n * rounds)
+
+
+def test_serve_inflight_window_ledger_exact():
+    """CL803 audit pin for the serve() in-flight window: mid-tick
+    arrivals (the live-ingest hook fires while a tick's dispatches
+    are in flight) must never be marked converged without being
+    converged, and the O(1) pending-byte ledger must land at exactly
+    zero once the stream drains — the window accounting is
+    single-thread-confined BY DESIGN (hook runs inside the tick),
+    and this pins that the bookkeeping stays exact under it."""
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.records import ItemRecord
+    from crdt_tpu.models import replay as rp
+    from crdt_tpu.models.multidoc import MultiDocServer, cache_digest
+
+    def blob(doc, b):
+        return v1.encode_update([ItemRecord(
+            client=1000 + doc, clock=b, parent_root=f"m{doc}",
+            key=f"k{b}", content=b * 10 + doc,
+        )])
+
+    # 3 docs, 4 batches: each serve tick admits one batch, and the
+    # ingest hook drains the next while dispatches are in flight
+    batches = [
+        [(d, blob(d, b)) for d in range(3)] for b in range(4)
+    ]
+    srv = MultiDocServer()
+    rep = srv.serve(iter(batches), max_ticks=16)
+    assert rep.submitted == 12
+    assert srv.pending_bytes() == 0
+    for d in range(3):
+        st = srv._docs[d]
+        assert not st.pending and not st.in_flight
+        assert len(st.blobs) == 4  # every admitted blob converged
+        # digest matches the cold oracle over the same history
+        oracle = rp.replay_trace(st.blobs).cache
+        assert cache_digest(srv.cache(d)) == cache_digest(oracle)
+
+
+# ---------------------------------------------------------------------------
+# round-16 CL702 regression: the Pallas dispatch decision is a
+# host-computed static, never an ambient read inside a traced body
+
+
+def test_pallas_mode_statics_thread_not_ambient(monkeypatch):
+    """The traced-safe entries (apply_mask_static / missing_static /
+    ds_mask_static / sv_deficit_static) must not read CRDT_TPU_PALLAS
+    at all — poison the env readers and drive them with explicit
+    modes. The first-run CL702 findings (env reads baked into
+    converge_maps' trace via lax.cond) stay fixed."""
+    import numpy as np
+
+    jnp = pytest.importorskip("jax.numpy")
+    from crdt_tpu.ops import deleteset, pallas_kernels as pk, statevec
+
+    def boom(*a, **kw):
+        raise AssertionError(
+            "traced-safe path read CRDT_TPU_PALLAS (CL702 regression)"
+        )
+
+    monkeypatch.setattr(pk, "use_pallas", boom)
+    monkeypatch.setattr(pk, "_interpret", boom)
+
+    client = jnp.asarray(np.array([1, 1, 2], np.int32))
+    clock = jnp.asarray(np.array([0, 5, 1], np.int64))
+    valid = jnp.asarray(np.array([True, True, True]))
+    dc = jnp.asarray(np.array([1], np.int32))
+    dstart = jnp.asarray(np.array([0], np.int64))
+    dend = jnp.asarray(np.array([1], np.int64))
+    for mode in ("jnp", "interpret"):
+        mask = deleteset.apply_mask_static(
+            client, clock, valid, dc, dstart, dend, mode=mode
+        )
+        assert np.asarray(mask).tolist() == [True, False, False]
+    svs = jnp.asarray(np.array([[3, 0], [1, 2]], np.int64))
+    ref = np.asarray(statevec.missing_static(svs, "jnp"))
+    got = np.asarray(statevec.missing_static(svs, "interpret"))
+    np.testing.assert_array_equal(ref, got)
+
+
+def test_mask_mode_reflects_env(monkeypatch):
+    """The HOST-side mode helpers keep honoring runtime env flips —
+    that is the contract the statics thread down."""
+    from crdt_tpu.ops import deleteset, statevec
+
+    monkeypatch.setenv("CRDT_TPU_PALLAS", "0")
+    assert deleteset.mask_mode() == "jnp"
+    assert statevec.deficit_mode() == "jnp"
+    monkeypatch.setenv("CRDT_TPU_PALLAS", "interpret")
+    assert deleteset.mask_mode() == "interpret"
+    assert statevec.deficit_mode() == "interpret"
 
 
 # ---------------------------------------------------------------------------

@@ -308,6 +308,55 @@ def test_lint_findings_gated_lower_is_better():
     assert "lint.findings" in regressed
 
 
+def test_lint_open_by_family_gated():
+    """Round 16: per-family OPEN counts for the new analysis
+    families (cl7 trace purity / cl8 lock discipline / cl9 async
+    handles) gate lower-is-better with count semantics — the
+    committed tree holds them at 0, so a single new open finding is
+    an infinite relative regression the noise floor can never
+    mute."""
+    old = copy.deepcopy(OLD)
+    old["lint"] = {
+        "findings": 23, "open": 0, "baselined": 23, "suppressed": 0,
+        "open_by_family": {"cl7": 0, "cl8": 0, "cl9": 0},
+    }
+    for fam in ("cl7", "cl8", "cl9"):
+        new = copy.deepcopy(old)
+        new["lint"]["open_by_family"][fam] = 1
+        rows, regressed = compare(old, new)
+        assert f"lint.open_by_family.{fam}" in regressed, fam
+    # all-zero stays clean, and fixing a finding is an improvement
+    rows, regressed = compare(old, copy.deepcopy(old))
+    assert regressed == []
+    was_one = copy.deepcopy(old)
+    was_one["lint"]["open_by_family"]["cl8"] = 1
+    rows, regressed = compare(was_one, old)
+    assert regressed == []
+
+
+def test_lint_digest_embeds_family_counts_and_callgraph():
+    """The bench digest (bench.lint_digest over the real tree) must
+    carry the per-family counts the gate reads AND the call-graph
+    size stats — the round-16 analysis layer's own evidence. The
+    committed tree holds every new family at 0 open."""
+    import bench
+
+    digest = bench.lint_digest()
+    assert digest, "lint_digest unexpectedly empty"
+    fams = digest["open_by_family"]
+    assert set(fams) == {"cl7", "cl8", "cl9"}
+    assert fams == {"cl7": 0, "cl8": 0, "cl9": 0}
+    cgs = digest["callgraph"]
+    for key in ("functions", "edges", "weak_edges", "collisions",
+                "thread_roots", "thread_reachable"):
+        assert isinstance(cgs[key], int), key
+    # the graph really covers the tree: hundreds of defs, and the
+    # streaming stager + decode pool give at least two thread roots
+    assert cgs["functions"] > 300
+    assert cgs["edges"] > cgs["functions"]
+    assert cgs["thread_roots"] >= 2
+
+
 def test_multichip_section_gated():
     """Round 13: the multichip leg's scaling efficiency is
     higher-is-better per device count; boundary bytes/fraction and
@@ -471,3 +520,21 @@ def test_multitenant_steady_section_gated():
     _, regressed = compare(old, new4, threshold=0.2)
     assert "tracer.tenant.resident_evictions" in regressed
     assert "tracer.tenant.delta_fallbacks" in regressed
+
+
+def test_lint_open_by_family_gates_against_pre_round16_artifact():
+    """Review round 2: an old artifact predating the round-16 digest
+    has no open_by_family key — that means 0 open findings (the
+    committed tree always lints clean), so the gate must treat the
+    absent side as zero instead of silently skipping the row."""
+    old = copy.deepcopy(OLD)
+    old["lint"] = {"findings": 23, "open": 0, "baselined": 23,
+                   "suppressed": 0}  # no open_by_family at all
+    new = copy.deepcopy(old)
+    new["lint"]["open_by_family"] = {"cl7": 0, "cl8": 1, "cl9": 0}
+    rows, regressed = compare(old, new)
+    assert "lint.open_by_family.cl8" in regressed
+    clean = copy.deepcopy(old)
+    clean["lint"]["open_by_family"] = {"cl7": 0, "cl8": 0, "cl9": 0}
+    rows, regressed = compare(old, clean)
+    assert regressed == []

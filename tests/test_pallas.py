@@ -217,8 +217,11 @@ def test_apply_mask_crossover_uses_jnp_for_large_d(monkeypatch):
     from crdt_tpu.ops import deleteset
 
     calls = []
-    real = pk.ds_mask
-    monkeypatch.setattr(pk, "ds_mask", lambda *a: calls.append(1) or real(*a))
+    real = pk.ds_mask_static
+    monkeypatch.setattr(
+        pk, "ds_mask_static",
+        lambda *a, **kw: calls.append(1) or real(*a, **kw),
+    )
     rng = np.random.default_rng(11)
     big = _random_ds_case(rng, 256, pk._DS_PALLAS_CROSSOVER + 1)
     small = _random_ds_case(rng, 256, pk._DS_PALLAS_CROSSOVER)

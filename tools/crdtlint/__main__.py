@@ -18,7 +18,8 @@ def main(argv=None) -> int:
         description="AST-based invariant checker for crdt_tpu "
                     "(donation safety, registry conformance, codec "
                     "exception discipline, transfer-seam accounting, "
-                    "determinism, thread-shared state)",
+                    "determinism, thread-shared state, trace purity, "
+                    "lock discipline, async-handle discipline)",
     )
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/dirs to lint (default: crdt_tpu/)")
@@ -32,6 +33,9 @@ def main(argv=None) -> int:
                          "baseline skeleton (justifications TODO) "
                          "and exit")
     ap.add_argument("--list-checkers", action="store_true")
+    ap.add_argument("--explain", metavar="CODE",
+                    help="print a code's rationale and fix recipe "
+                         "(e.g. --explain CL803) and exit")
     ap.add_argument("--statistics", action="store_true",
                     help="per-code counts incl. suppressed/baselined")
     args = ap.parse_args(argv)
@@ -42,7 +46,20 @@ def main(argv=None) -> int:
         BaselineError, LintConfig, load_baseline, load_modules,
         run_lint, write_baseline,
     )
-    from tools.crdtlint.checkers import ALL_CHECKERS, ALL_CODES
+    from tools.crdtlint.checkers import (
+        ALL_CHECKERS, ALL_CODES, ALL_EXPLAIN,
+    )
+
+    if args.explain:
+        code = args.explain.upper()
+        if code not in ALL_CODES:
+            print(f"crdtlint: unknown code {code!r} (known: "
+                  f"{', '.join(sorted(ALL_CODES))})", file=sys.stderr)
+            return 2
+        print(f"{code}  {ALL_CODES[code]}")
+        print()
+        print(ALL_EXPLAIN[code])
+        return 0
 
     if args.list_checkers:
         for cls in ALL_CHECKERS:

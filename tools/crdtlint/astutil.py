@@ -6,6 +6,16 @@ import ast
 from typing import Dict, Iterable, List, Optional, Tuple
 
 
+# in-place mutator method names on the stdlib containers — shared by
+# the thread-shared (CL601), lock-discipline (CL803), and
+# trace-purity (CL704) checkers, which all ask "does this call mutate
+# its receiver"
+MUTATOR_METHODS = frozenset({
+    "append", "update", "pop", "add", "extend", "remove", "clear",
+    "setdefault", "appendleft", "popleft", "discard", "insert",
+})
+
+
 def dotted(node: ast.AST) -> Optional[str]:
     """``a.b.c`` for Name/Attribute chains, else None."""
     parts: List[str] = []
@@ -105,6 +115,74 @@ def in_scope(path: str, prefixes: Iterable[str]) -> bool:
     idx = path.find("crdt_tpu/")
     tail = path[idx:] if idx >= 0 else path
     return any(tail.startswith(p) for p in prefixes)
+
+
+def make_module_resolver(
+    mod_path: str,
+    tree: Optional[ast.Module],
+    local_names: Iterable[str],
+    cands_by_name: Dict[str, List],
+    *,
+    fallback_first: bool = True,
+    imap: Optional[Dict[str, str]] = None,
+):
+    """Module-aware def lookup — the resolution machinery the donate
+    checker grew over rounds 9–11, generalized so the call graph (and
+    any other cross-module index) resolves names the same way.
+
+    ``cands_by_name`` maps a bare def name to ALL candidate objects
+    carrying a ``.module`` attribute (repo-relative path of the
+    defining module). The returned ``resolve(name)`` applies, in
+    order: the calling module's own defs win; a local non-candidate
+    def SHADOWS another module's same-named candidate; an explicit
+    ``from x import name`` picks the defining module; a
+    module-attribute spelling (``pk._step``) matches on the RECEIVER
+    module and refuses to guess when that module has no such def.
+    ``fallback_first`` keeps the historical first-def guess for
+    receivers that aren't imported modules (``self.x._step``); pass
+    False to get None instead — the call graph treats that case as a
+    low-confidence edge rather than a guess."""
+    if imap is None:
+        imap = import_map(tree) if tree is not None else {}
+    local_names = set(local_names)
+
+    def resolve(name: str):
+        tail = name.rsplit(".", 1)[-1]
+        cands = cands_by_name.get(tail)
+        if not cands:
+            return None
+        for d in cands:
+            if d.module == mod_path:
+                return d
+        if name == tail:
+            if tail in local_names:
+                return None  # local non-candidate def shadows it
+            qual = imap.get(tail)
+            if qual and "." in qual:
+                src = (qual.rsplit(".", 1)[0].replace(".", "/")
+                       + ".py")
+                for d in cands:
+                    if d.module.endswith(src):
+                        return d
+        else:
+            chain = name.split(".")[:-1]
+            qual = imap.get(chain[0])
+            if qual:
+                full = (
+                    ".".join(chain)
+                    if chain[0] == qual.split(".", 1)[0]
+                    else ".".join([qual] + chain[1:])
+                )
+                src = full.replace(".", "/") + ".py"
+                for d in cands:
+                    if d.module.endswith(src):
+                        return d
+                return None
+            # receiver isn't an imported module (`self.x._step`):
+            # can't localize
+        return cands[0] if fallback_first else None
+
+    return resolve
 
 
 def enclosing_function_map(tree: ast.Module) -> Dict[int, str]:

@@ -1,0 +1,233 @@
+"""Lite per-function control-flow graph for path-sensitive checkers.
+
+Round 16: the CL9xx async-handle and CL902 paired-protocol checkers
+need "does every path from HERE reach a consuming statement" — a
+question the structured walks the donate checker grew (branch merge,
+loop back-edge) cannot answer once exception edges matter. This CFG
+is deliberately small:
+
+- Nodes are **statements** (``ast.stmt``). Compound statements
+  contribute their header as a node (``if``/``while``/``for``/
+  ``with``) or no node at all (``try``). Nested function/class
+  definitions are leaf statements — their bodies are separate CFGs.
+- ``succ_norm[id(stmt)]`` lists normal-flow successors; ``succ_exc``
+  lists where control lands if the statement RAISES (the innermost
+  enclosing handler entries, the finally block, or the virtual
+  :data:`RAISE` exit). Every statement is conservatively assumed able
+  to raise.
+- Two virtual exits: :data:`EXIT` (normal return / fall-off) and
+  :data:`RAISE` (uncaught exception leaves the function).
+- ``finally`` is built ONCE with the union of its normal and
+  exceptional continuations as follow targets — a small
+  over-approximation of paths (standard for lite CFGs) that never
+  *loses* an edge, so "all paths hit X" verdicts stay sound for the
+  checkers (they may miss a violation, never invent one... the
+  conservative direction for a linter).
+
+The walk helpers (:func:`every_path_hits`) treat cycles as
+non-terminating paths: a loop that never exits cannot leak past the
+function, so it neither satisfies nor violates an "all paths" query.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+EXIT = "<exit>"    # normal function exit
+RAISE = "<raise>"  # uncaught-exception exit
+
+
+class CFG:
+    """succ_norm / succ_exc map ``id(stmt)`` to successor statements
+    (or the EXIT/RAISE sentinels); ``entry`` lists the function's
+    first statements."""
+
+    def __init__(self, fn: ast.FunctionDef):
+        self.fn = fn
+        self.succ_norm: Dict[int, List[object]] = {}
+        self.succ_exc: Dict[int, List[object]] = {}
+        self.stmts: List[ast.stmt] = []
+        self.entry: List[object] = self._block(
+            fn.body, [EXIT], [RAISE], None, None, [EXIT]
+        )
+
+    # -- construction ----------------------------------------------------
+
+    def _block(self, stmts: Sequence[ast.stmt], follow: List[object],
+               exc: List[object], brk: Optional[List[object]],
+               cont: Optional[List[object]],
+               ret: List[object]) -> List[object]:
+        """Wire a statement list; returns the block's entry targets.
+        ``follow`` is where control goes after the last statement,
+        ``exc`` where an exception lands, ``brk``/``cont`` the
+        targets of break/continue (None outside loops), ``ret`` the
+        target of a return (EXIT, or the enclosing finally)."""
+        entry = follow
+        # wire back-to-front so each statement knows its successor
+        for st in reversed(stmts):
+            entry = self._stmt(st, entry, exc, brk, cont, ret)
+        return entry
+
+    def _stmt(self, st: ast.stmt, follow: List[object],
+              exc: List[object], brk, cont,
+              ret: List[object]) -> List[object]:
+        if isinstance(st, ast.If):
+            body = self._block(st.body, follow, exc, brk, cont, ret)
+            orelse = (self._block(st.orelse, follow, exc, brk, cont,
+                                  ret)
+                      if st.orelse else follow)
+            self._add(st, list(body) + list(orelse), exc)
+            return [st]
+        if isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+            orelse = (self._block(st.orelse, follow, exc, brk, cont,
+                                  ret)
+                      if st.orelse else follow)
+            # loop header: enter the body or skip past (test false /
+            # iterator exhausted); body's last statement loops back
+            body = self._block(st.body, [st], exc, follow, [st], ret)
+            self._add(st, list(body) + list(orelse), exc)
+            return [st]
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            body = self._block(st.body, follow, exc, brk, cont, ret)
+            self._add(st, list(body), exc)
+            return [st]
+        if isinstance(st, ast.Try):
+            final_entry: Optional[List[object]] = None
+            inner_brk, inner_cont, inner_ret = brk, cont, ret
+            if st.finalbody:
+                # one finally block; its continuations are the union
+                # of every way control can LEAVE the protected region
+                # (see module doc) — but only the continuation kinds
+                # the region actually uses, so a try with no return
+                # inside never grows a phantom finally->EXIT edge
+                used = _continuations_used(
+                    st.body
+                    + [s for h in st.handlers for s in h.body]
+                    + st.orelse
+                )
+                final_follow = list(follow) + list(exc)
+                if "return" in used:
+                    final_follow += list(ret)
+                if "break" in used and brk is not None:
+                    final_follow += list(brk)
+                if "continue" in used and cont is not None:
+                    final_follow += list(cont)
+                final_entry = self._block(
+                    st.finalbody, final_follow, exc, brk, cont, ret
+                )
+                # return/break/continue inside the protected region
+                # must RUN the finally before leaving it — wiring
+                # them straight out is how a close-in-finally gets
+                # falsely flagged as skipped (CL902)
+                inner_ret = final_entry
+                if brk is not None:
+                    inner_brk = final_entry
+                if cont is not None:
+                    inner_cont = final_entry
+            after = final_entry if final_entry is not None else follow
+            # a raise INSIDE a handler (or orelse) propagates outward
+            # but must run the finally first — routing it straight to
+            # the outer exc would let CL902 claim a close-in-finally
+            # was skipped on the handler's exception edge
+            inner_exc = (final_entry if final_entry is not None
+                         else exc)
+            handler_entries: List[object] = []
+            for h in st.handlers:
+                handler_entries.extend(self._block(
+                    h.body, after, inner_exc, inner_brk, inner_cont,
+                    inner_ret,
+                ))
+            body_exc = handler_entries if st.handlers else inner_exc
+            orelse = (self._block(st.orelse, after, inner_exc,
+                                  inner_brk, inner_cont, inner_ret)
+                      if st.orelse else after)
+            return self._block(st.body, orelse, body_exc, inner_brk,
+                               inner_cont, inner_ret)
+        if isinstance(st, (ast.Return,)):
+            self._add(st, list(ret), exc)
+            return [st]
+        if isinstance(st, ast.Raise):
+            # deliberate raise: successors ARE the exception targets
+            self._add(st, [], exc)
+            return [st]
+        if isinstance(st, ast.Break) and brk is not None:
+            self._add(st, list(brk), exc)
+            return [st]
+        if isinstance(st, ast.Continue) and cont is not None:
+            self._add(st, list(cont), exc)
+            return [st]
+        # leaf statements (expressions, assignments, nested defs, ...)
+        self._add(st, list(follow), exc)
+        return [st]
+
+    def _add(self, st: ast.stmt, norm: List[object],
+             exc: List[object]) -> None:
+        self.stmts.append(st)
+        self.succ_norm[id(st)] = norm
+        self.succ_exc[id(st)] = list(exc)
+
+    # -- queries ---------------------------------------------------------
+
+    def successors(self, st: ast.stmt,
+                   *, with_exc: bool) -> Iterable[object]:
+        out = list(self.succ_norm.get(id(st), ()))
+        if with_exc:
+            out.extend(self.succ_exc.get(id(st), ()))
+        return out
+
+
+def _continuations_used(stmts: Sequence[ast.stmt]) -> set:
+    """Which of return/break/continue appear in a protected region
+    (nested function/class bodies excluded — their control flow
+    never reaches the enclosing finally)."""
+    kinds: set = set()
+    work = list(stmts)
+    while work:
+        n = work.pop()
+        if isinstance(n, ast.Return):
+            kinds.add("return")
+        elif isinstance(n, ast.Break):
+            kinds.add("break")
+        elif isinstance(n, ast.Continue):
+            kinds.add("continue")
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        work.extend(ast.iter_child_nodes(n))
+    return kinds
+
+
+def every_path_hits(
+    cfg: CFG,
+    start: Sequence[object],
+    hits: Callable[[ast.stmt], bool],
+    *,
+    with_exc: bool = False,
+    stop: Optional[Callable[[ast.stmt], bool]] = None,
+) -> Optional[object]:
+    """Walk every path from ``start``; return None when each one
+    passes a ``hits`` statement before reaching EXIT (RAISE too when
+    ``with_exc``), else the first offending exit sentinel. ``stop``
+    prunes a path as *failed immediately* (e.g. a rebind that drops a
+    handle) — the caller reports it at the stop site instead."""
+    seen = set()
+    work = list(start)
+    while work:
+        node = work.pop()
+        if node == EXIT:
+            return EXIT
+        if node == RAISE:
+            if with_exc:
+                return RAISE
+            continue
+        nid = id(node)
+        if nid in seen:
+            continue
+        seen.add(nid)
+        if hits(node):
+            continue  # this path is satisfied
+        if stop is not None and stop(node):
+            continue  # caller reports at the stop site
+        work.extend(cfg.successors(node, with_exc=with_exc))
+    return None

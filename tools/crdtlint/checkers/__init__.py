@@ -6,14 +6,18 @@ unused ``CLxxx`` range), and append the class to ``ALL_CHECKERS``.
 Add a violating + clean snippet pair to ``tests/test_lint.py``'s
 still-fires matrix (the tier-1 gate requires every registered code to
 fire on its synthetic violation — a checker that can't fire is dead
-weight) and a README "Static analysis" table row.
+weight), a README "Static analysis" table row, and an ``explain``
+entry (the ``--explain CODE`` CLI surface: rationale + fix recipe).
 """
 
-from tools.crdtlint.checkers.donate import DonateChecker
+from tools.crdtlint.checkers.asynchandle import AsyncHandleChecker
 from tools.crdtlint.checkers.determinism import DeterminismChecker
+from tools.crdtlint.checkers.donate import DonateChecker
 from tools.crdtlint.checkers.exceptions import ExceptionDisciplineChecker
+from tools.crdtlint.checkers.lockdiscipline import LockDisciplineChecker
 from tools.crdtlint.checkers.metrics import MetricsRegistryChecker
 from tools.crdtlint.checkers.threadshare import ThreadSharedStateChecker
+from tools.crdtlint.checkers.tracepurity import TracePurityChecker
 from tools.crdtlint.checkers.xfer import TransferSeamChecker
 
 ALL_CHECKERS = [
@@ -23,10 +27,22 @@ ALL_CHECKERS = [
     TransferSeamChecker,
     DeterminismChecker,
     ThreadSharedStateChecker,
+    TracePurityChecker,
+    LockDisciplineChecker,
+    AsyncHandleChecker,
 ]
 
 ALL_CODES = {
     code: desc
+    for cls in ALL_CHECKERS
+    for code, desc in cls.codes.items()
+}
+
+# --explain surface: every code maps to a rationale + fix recipe.
+# Checkers may provide an ``explain`` dict; codes without one fall
+# back to their one-line invariant.
+ALL_EXPLAIN = {
+    code: getattr(cls, "explain", {}).get(code, desc)
     for cls in ALL_CHECKERS
     for code, desc in cls.codes.items()
 }

@@ -1,0 +1,419 @@
+"""CL901/CL902: async-handle and paired-protocol discipline (r16).
+
+The overlap architecture (rounds 9–15) lives on two-step seams:
+``converge_async`` returns a handle whose staged buffers are DONATED
+to the in-flight dispatch, and only ``converge_fetch`` releases them.
+A handle that never reaches a fetch on some control-flow path pins a
+donated device buffer for the life of the process — the slow leak no
+test notices because the result was never needed on that path. The
+same shape governs paired start/stop protocols: a profiler trace left
+running corrupts the next capture, an installed fault hook left in
+place fails every later dispatch.
+
+Both checkers walk the round-16 lite CFG
+(:mod:`tools.crdtlint.cfg`):
+
+- **CL901** — a ``converge_async`` handle bound to a name must be
+  CONSUMED on every normal path before function exit: passed to a
+  call (``converge_fetch(h)``, ``q.put((h, ...))``), returned,
+  yielded, or stored into an attribute/container. A bare
+  ``converge_async(plan)`` expression statement drops the handle on
+  the spot; rebinding an unconsumed handle (the classic loop bug)
+  is reported at the rebind. Exception paths are exempt — an
+  unwinding process releases buffers with it.
+- **CL902** — after a SUCCESSFUL opener (``start_trace``,
+  an ``old = set_device_fault_hook(...)`` capture,
+  ``lock.acquire()``), the matching closer must be hit on every
+  path INCLUDING exception edges — i.e. the closer lives in a
+  ``finally`` or an except-all handler. Protocol objects whose
+  opener and closer live in paired methods (``install``/
+  ``uninstall``, ``__enter__``/``__exit__``) are exempt: the
+  context-manager seam is the discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.crdtlint.astutil import assigned_names, call_name, dotted
+from tools.crdtlint.cfg import CFG, EXIT, RAISE
+from tools.crdtlint.core import Checker, Finding, LintContext, Module
+
+_ASYNC_PRODUCERS = ("converge_async",)
+_CONSUMERS = ("converge_fetch",)
+
+# opener tail -> closer tail. acquire/release is shape-gated (the
+# opener must be a bare-expression or assigned call on a lock-like
+# receiver; `with lock:` never reaches here).
+_PAIRS = {
+    "start_trace": "stop_trace",
+    "set_device_fault_hook": "set_device_fault_hook",
+    "acquire": "release",
+}
+
+
+def _header_nodes(st) -> list:
+    """The AST actually evaluated AT a CFG node. Compound statements
+    are headers in the CFG — their bodies are separate nodes — so
+    dataflow predicates must scan only the header expressions, or an
+    `if` whose BODY consumes a handle would wrongly satisfy the path
+    through its else."""
+    if isinstance(st, ast.If) or isinstance(st, ast.While):
+        return [st.test]
+    if isinstance(st, (ast.For, ast.AsyncFor)):
+        return [st.iter]
+    if isinstance(st, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in st.items]
+    if isinstance(st, ast.Try):
+        return []
+    if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.ClassDef)):
+        return []
+    return [st]
+
+
+def _call_tail(node: ast.Call) -> str:
+    name = call_name(node) or ""
+    tail = name.rsplit(".", 1)[-1]
+    if not tail and isinstance(node.func, ast.Attribute):
+        tail = node.func.attr
+    return tail
+
+
+def _aliases(fn) -> Dict[str, str]:
+    """Local aliases of protocol callables: ``start =
+    profiler.start_trace`` maps ``start -> start_trace``."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and not isinstance(
+            node.value, ast.Call
+        ):
+            src = dotted(node.value)
+            if not src:
+                continue
+            tail = src.rsplit(".", 1)[-1]
+            if tail in _PAIRS or tail in set(_PAIRS.values()):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = tail
+    return out
+
+
+class AsyncHandleChecker(Checker):
+    name = "async-handle"
+    codes = {
+        "CL901": "converge_async handle dropped on some path "
+                 "(never reaches converge_fetch — pins a donated "
+                 "device buffer)",
+        "CL902": "paired start/stop protocol (profiler trace, fault "
+                 "hook, lock.acquire) not closed on exception edges",
+    }
+    explain = {
+        "CL901": (
+            "converge_async enqueues the dispatch and DONATES the "
+            "staged buffers; only converge_fetch (or handing the "
+            "handle to whoever will fetch it) releases them. A path "
+            "that returns without consuming the handle pins device "
+            "memory for the process lifetime — invisible until the "
+            "allocator OOMs a thousand ticks later.\n"
+            "Fix: fetch on every path (including early returns), or "
+            "push the handle into the in-flight queue/deque the "
+            "consumer drains; if a path genuinely abandons the "
+            "dispatch, fetch-and-discard so the buffers free."
+        ),
+        "CL902": (
+            "start_trace without stop_trace on the exception path "
+            "leaves the profiler running into (and corrupting) the "
+            "next capture; an installed device fault hook left "
+            "behind fails every later dispatch; a bare acquire() "
+            "without release() in a finally deadlocks the next "
+            "taker.\n"
+            "Fix: close in a `finally:` (or an except-all handler "
+            "that closes before re-raising), or wrap the pair in a "
+            "context manager — protocol objects with install/"
+            "uninstall or __enter__/__exit__ methods already are "
+            "the fix and are exempt."
+        ),
+    }
+
+    def check_module(self, mod: Module,
+                     ctx: LintContext) -> Iterable[Finding]:
+        if mod.tree is None:
+            return ()
+        findings: List[Finding] = []
+        # cheap text pre-filter FIRST: most modules never mention an
+        # async producer or a protocol opener, and everything below
+        # (class index, per-function tail scans, CFG builds) is cost
+        # paid for nothing on those
+        interesting = tuple(_ASYNC_PRODUCERS) + tuple(_PAIRS)
+        if not any(t in mod.source for t in interesting):
+            return findings
+        # class -> method names (for the protocol-object exemption)
+        class_methods: Dict[int, Set[str]] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                names = {
+                    n.name for n in node.body
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                }
+                for n in node.body:
+                    if isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                        class_methods[id(n)] = names
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            tails = {
+                _call_tail(n) for n in ast.walk(fn)
+                if isinstance(n, ast.Call)
+            }
+            has_producer = any(t in tails for t in _ASYNC_PRODUCERS)
+            has_opener = any(t in tails for t in _PAIRS) or any(
+                a in set(_PAIRS) | set(_PAIRS.values())
+                for a in _aliases(fn).values()
+            )
+            if not (has_producer or has_opener):
+                continue
+            cfg = CFG(fn)
+            if has_producer:
+                self._check_handles(fn, cfg, mod, findings)
+            if has_opener:
+                self._check_pairs(fn, cfg, mod, findings,
+                                  class_methods.get(id(fn), set()))
+        return findings
+
+    # ---- CL901 ---------------------------------------------------------
+
+    def _check_handles(self, fn, cfg: CFG, mod: Module,
+                       findings: List[Finding]) -> None:
+        for st in cfg.stmts:
+            # bare-expression producer: dropped immediately
+            if isinstance(st, ast.Expr) and isinstance(
+                st.value, ast.Call
+            ) and _call_tail(st.value) in _ASYNC_PRODUCERS:
+                findings.append(Finding(
+                    mod.path, st.lineno, "CL901",
+                    f"`{call_name(st.value) or 'converge_async'}"
+                    f"(...)` result discarded in `{fn.name}` — the "
+                    f"handle (and its donated buffers) is dropped "
+                    f"on the spot; fetch it or hand it to the "
+                    f"consumer",
+                    symbol=f"{fn.name}:drop:{st.lineno}",
+                ))
+                continue
+            if not isinstance(st, ast.Assign):
+                continue
+            if not (isinstance(st.value, ast.Call)
+                    and _call_tail(st.value) in _ASYNC_PRODUCERS):
+                continue
+            names = [t.id for t in st.targets
+                     if isinstance(t, ast.Name)]
+            for h in names:
+                bad = self._walk_handle(cfg, st, h)
+                if bad is not None:
+                    kind, line = bad
+                    msg = (
+                        f"handle `{h}` from `converge_async` is "
+                        + ("rebound before being consumed (line "
+                           f"{line}) — the in-flight dispatch and "
+                           f"its donated buffers leak"
+                           if kind == "rebind" else
+                           "not consumed on every path to return — "
+                           "a path exists where the donated "
+                           "buffers never free")
+                    )
+                    findings.append(Finding(
+                        mod.path,
+                        line if kind == "rebind" else st.lineno,
+                        "CL901", msg + f" (in `{fn.name}`)",
+                        symbol=f"{fn.name}:{kind}:{h}",
+                    ))
+
+    @staticmethod
+    def _walk_handle(cfg: CFG, producer: ast.Assign,
+                     h: str) -> Optional[Tuple[str, int]]:
+        """DFS normal edges from the producer. Returns ("exit", line)
+        when some path reaches EXIT unconsumed, ("rebind", line) when
+        the handle is overwritten unconsumed (incl. looping back to
+        the producer)."""
+        def consumes(st) -> bool:
+            for root in _header_nodes(st):
+                for node in ast.walk(root):
+                    if isinstance(node, ast.Name) and node.id == h \
+                            and isinstance(node.ctx, ast.Load):
+                        return True
+            return False
+
+        def rebinds(st) -> bool:
+            if isinstance(st, (ast.Assign, ast.AugAssign,
+                               ast.AnnAssign)):
+                targets = (st.targets if isinstance(st, ast.Assign)
+                           else [st.target])
+                for t in targets:
+                    if h in assigned_names(t):
+                        return True
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                return h in assigned_names(st.target)
+            return False
+
+        seen: Set[int] = set()
+        work = list(cfg.succ_norm.get(id(producer), ()))
+        while work:
+            node = work.pop()
+            if node == EXIT:
+                return ("exit", producer.lineno)
+            if node == RAISE:
+                continue  # unwinding frees with the process
+            if node is producer or (
+                isinstance(node, ast.stmt) and node is producer
+            ):
+                return ("rebind", producer.lineno)
+            nid = id(node)
+            if nid in seen:
+                continue
+            seen.add(nid)
+            if consumes(node):
+                continue
+            if rebinds(node):
+                return ("rebind", node.lineno)
+            work.extend(cfg.succ_norm.get(nid, ()))
+        return None
+
+    # ---- CL902 ---------------------------------------------------------
+
+    def _check_pairs(self, fn, cfg: CFG, mod: Module,
+                     findings: List[Finding],
+                     sibling_methods: Set[str]) -> None:
+        aliases = _aliases(fn)
+
+        def canon(tail: str) -> str:
+            return aliases.get(tail, tail)
+
+        # find opener statements
+        for st in cfg.stmts:
+            call = None
+            captured = False
+            if isinstance(st, ast.Expr) and isinstance(
+                st.value, ast.Call
+            ):
+                call = st.value
+            elif isinstance(st, ast.Assign) and isinstance(
+                st.value, ast.Call
+            ):
+                call = st.value
+                captured = True
+            if call is None:
+                continue
+            tail = canon(_call_tail(call))
+            closer = _PAIRS.get(tail)
+            if closer is None:
+                continue
+            if tail == "set_device_fault_hook" and not captured:
+                continue  # plain restore/uninstall call, not an open
+            if tail == "acquire" and not isinstance(
+                call.func, ast.Attribute
+            ):
+                continue
+            if tail == "acquire" and not _lockish_recv(call):
+                continue
+
+            def is_closer(st2, closer=closer, call=call):
+                return _stmt_closes(st2, closer, call, canon)
+
+            # same-function closer present?
+            has_local_closer = any(
+                is_closer(s) for s in cfg.stmts
+                if s is not st
+            )
+            if not has_local_closer:
+                # protocol-object exemption: closer in a sibling
+                # method (install/uninstall, __enter__/__exit__)
+                if self._sibling_closes(fn, closer, sibling_methods,
+                                        mod):
+                    continue
+                findings.append(Finding(
+                    mod.path, st.lineno, "CL902",
+                    f"`{tail}` opened in `{fn.name}` with no "
+                    f"matching `{closer}` anywhere in the function "
+                    f"or a paired method — the protocol never "
+                    f"closes",
+                    symbol=f"{fn.name}:{tail}:unclosed",
+                ))
+                continue
+            # closer exists: must be hit on every path incl.
+            # exception edges, starting AFTER the opener succeeded
+            seen: Set[int] = set()
+            work = list(cfg.succ_norm.get(id(st), ()))
+            leak = None
+            while work:
+                node = work.pop()
+                if node in (EXIT, RAISE):
+                    if node == RAISE:
+                        leak = "exception"
+                        break
+                    leak = "return"
+                    break
+                nid = id(node)
+                if nid in seen:
+                    continue
+                seen.add(nid)
+                if is_closer(node):
+                    continue
+                work.extend(cfg.succ_norm.get(nid, ()))
+                work.extend(cfg.succ_exc.get(nid, ()))
+            if leak is not None:
+                findings.append(Finding(
+                    mod.path, st.lineno, "CL902",
+                    f"`{tail}` in `{fn.name}`: a "
+                    f"{'raising' if leak == 'exception' else 'returning'} "
+                    f"path skips `{closer}` — close in a finally "
+                    f"(or an except-all that closes before "
+                    f"re-raising)",
+                    symbol=f"{fn.name}:{tail}:{leak}",
+                ))
+
+    @staticmethod
+    def _sibling_closes(fn, closer: str, sibling_methods: Set[str],
+                        mod: Module) -> bool:
+        if not sibling_methods:
+            return False
+        # the exemption needs the closer to actually appear in some
+        # sibling method body
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and node is not fn \
+                    and node.name in sibling_methods:
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) \
+                            and _call_tail(sub) == closer:
+                        return True
+        return False
+
+
+def _stmt_closes(st, closer: str, opener_call: ast.Call,
+                 canon) -> bool:
+    """Does statement ``st`` (header only — compound bodies are their
+    own CFG nodes) call the protocol's closer?"""
+    for root in _header_nodes(st):
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                t2 = canon(_call_tail(node))
+                if t2 == closer and node is not opener_call:
+                    if closer == "release":
+                        return _lockish_recv(node)
+                    return True
+    return False
+
+
+def _lockish_recv(call: ast.Call) -> bool:
+    recv = dotted(call.func.value) if isinstance(
+        call.func, ast.Attribute
+    ) else None
+    if not recv:
+        return False
+    low = recv.lower()
+    return any(s in low for s in ("lock", "mutex", "sem"))

@@ -64,6 +64,35 @@ class DeterminismChecker(Checker):
         "CL504": "unsorted set iteration feeding the packing core "
                  "(hash-salted order)",
     }
+    explain = {
+        "CL501": (
+            "time.time() in the packing core smuggles wall clock "
+            "into staged layouts, breaking byte-identical replay "
+            "under seeded fault schedules.\n"
+            "Fix: thread timestamps in as inputs; perf_counter/"
+            "monotonic are fine for spans (they time, they don't "
+            "decide)."
+        ),
+        "CL502": (
+            "Process-global unseeded RNGs make two runs of the same "
+            "trace diverge — the chaos harness's whole proof is "
+            "byte-identical convergence.\n"
+            "Fix: thread a seeded random.Random / "
+            "np.random.default_rng(seed) through the call chain."
+        ),
+        "CL503": (
+            "A fault schedule constructed without an explicit seed "
+            "cannot be replayed; the one failing chaos run you need "
+            "to debug is gone.\n"
+            "Fix: pass seed= explicitly at every net/faults.py "
+            "constructor call."
+        ),
+        "CL504": (
+            "Python set order is hash-salted per process; packing "
+            "fed by bare set iteration differs run to run.\n"
+            "Fix: wrap the iteration in sorted(...)."
+        ),
+    }
 
     def prepare(self, ctx: LintContext) -> None:
         """Collect ``net/faults.py`` classes whose __init__ takes a

@@ -35,9 +35,9 @@ from tools.crdtlint.astutil import (
     assigned_names,
     call_name,
     dotted,
-    import_map,
     int_tuple,
     kw,
+    make_module_resolver,
 )
 from tools.crdtlint.core import Checker, Finding, LintContext, Module
 
@@ -84,6 +84,30 @@ class DonateChecker(Checker):
         "CL102": "donating converge entry lacks an undonated twin "
                  "(`_nodonate` / make_repeat_dispatch pattern)",
     }
+    explain = {
+        "CL101": (
+            "donate_argnums hands the argument's device buffer to "
+            "the compiled program; the allocator reuses it for "
+            "outputs, so the caller's array is DEAD after the call. "
+            "Reading it later (or donating the same un-rebound "
+            "buffer on every loop iteration) works on CPU and "
+            "corrupts on donation-capable backends.\n"
+            "Fix: rebind the name from the dispatch's return value "
+            "before any further read, or call the `_nodonate` twin "
+            "when you genuinely need the input to survive."
+        ),
+        "CL102": (
+            "Repeat-dispatch consumers (bench probes, host routes) "
+            "re-feed the same buffer to a converge entry; if the "
+            "only entry donates, they consume a dead buffer on the "
+            "second call.\n"
+            "Fix: ship a `<name>_nodonate` twin (or a "
+            "make_repeat_dispatch factory) next to every donating "
+            "converge entry; in-place update kernels whose call "
+            "sites always rebind are baselined instead, keeping the "
+            "reasoning reviewable."
+        ),
+    }
 
     def prepare(self, ctx: LintContext) -> None:
         # name -> ALL donating defs with that name, one per defining
@@ -123,55 +147,16 @@ class DonateChecker(Checker):
     @staticmethod
     def _make_resolver(mod: Module, defs: Dict[str, List[_DonatingDef]],
                        module_defs: Dict[str, Set[str]]):
-        """Module-aware donating-def lookup: the calling module's own
-        defs win, a local non-donating def SHADOWS another module's
-        same-named donating def, and an explicit import picks the
-        defining module when several donate under one name."""
-        imap = import_map(mod.tree) if mod.tree is not None else {}
-        local_names = module_defs.get(mod.path, set())
-
-        def resolve(name: str) -> Optional[_DonatingDef]:
-            tail = name.rsplit(".", 1)[-1]
-            cands = defs.get(tail)
-            if not cands:
-                return None
-            for d in cands:
-                if d.module == mod.path:
-                    return d
-            if name == tail:
-                if tail in local_names:
-                    return None  # local non-donating def shadows it
-                qual = imap.get(tail)
-                if qual and "." in qual:
-                    src = (qual.rsplit(".", 1)[0].replace(".", "/")
-                           + ".py")
-                    for d in cands:
-                        if d.module.endswith(src):
-                            return d
-            else:
-                # module-attribute spelling (`pk._step`): the receiver
-                # names the defining module — match on IT, and refuse
-                # to guess when the receiver resolves to a module with
-                # no such donating def (same-named defs elsewhere must
-                # not lend their argnums)
-                chain = name.split(".")[:-1]
-                qual = imap.get(chain[0])
-                if qual:
-                    full = (
-                        ".".join(chain)
-                        if chain[0] == qual.split(".", 1)[0]
-                        else ".".join([qual] + chain[1:])
-                    )
-                    src = full.replace(".", "/") + ".py"
-                    for d in cands:
-                        if d.module.endswith(src):
-                            return d
-                    return None
-                # receiver isn't an imported module (`self.x._step`):
-                # can't localize — keep the historical first-def guess
-            return cands[0]
-
-        return resolve
+        """Module-aware donating-def lookup, built on the shared
+        :func:`tools.crdtlint.astutil.make_module_resolver` machinery
+        (round 16 moved it there so the call graph resolves names the
+        same way): the calling module's own defs win, a local
+        non-donating def SHADOWS another module's same-named donating
+        def, and an explicit import picks the defining module when
+        several donate under one name."""
+        return make_module_resolver(
+            mod.path, mod.tree, module_defs.get(mod.path, set()), defs,
+        )
 
     @staticmethod
     def _factory_argnums(fn: ast.FunctionDef) -> Optional[Tuple[int, ...]]:

@@ -60,6 +60,29 @@ class ExceptionDisciplineChecker(Checker):
         "CL303": "guard ladder catches SimulatedCrash/BaseException "
                  "(defeats the crash-point matrix)",
     }
+    explain = {
+        "CL301": (
+            "A bare `except:` in codec/storage/guard scope swallows "
+            "KeyboardInterrupt, SimulatedCrash, and every bug the "
+            "fuzzers exist to surface.\n"
+            "Fix: catch the narrowest concrete exception the seam "
+            "can actually raise (decoders: ValueError)."
+        ),
+        "CL302": (
+            "Decoders raise ValueError and nothing else — that is "
+            "the round-10 contract callers (and the fuzz suite) "
+            "rely on to distinguish malformed input from bugs.\n"
+            "Fix: wrap index/struct errors and re-raise as "
+            "ValueError with the offset context."
+        ),
+        "CL303": (
+            "The ALICE crash-point harness injects SimulatedCrash "
+            "to prove recovery; a guard ladder that catches it "
+            "reports a crash-safe path that was never exercised.\n"
+            "Fix: catch the concrete OSError/ValueError family and "
+            "let SimulatedCrash (a BaseException) propagate."
+        ),
+    }
 
     def check_module(self, mod: Module,
                      ctx: LintContext) -> Iterable[Finding]:

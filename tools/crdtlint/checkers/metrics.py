@@ -56,6 +56,33 @@ class MetricsRegistryChecker(Checker):
         "CL203": "computed (non-literal) metric name outside the "
                  "allowlist",
     }
+    explain = {
+        "CL201": (
+            "A metric emitted but missing from the README registry "
+            "tables is invisible to reviewers and ungated by "
+            "metrics_diff — exactly the round-8 drift crdtlint was "
+            "built to stop.\n"
+            "Fix: add the name to the README Observability/Failure "
+            "tables (backticked), or rename to an existing "
+            "documented name."
+        ),
+        "CL202": (
+            "A documented name nothing emits is a dead registry "
+            "entry: dashboards chart nothing and reviewers trust a "
+            "fiction.\n"
+            "Fix: delete the registry row, or wire the emission it "
+            "promised."
+        ),
+        "CL203": (
+            "A computed metric name defeats both registry "
+            "directions — the checker cannot see what will be "
+            "emitted.\n"
+            "Fix: declare the closed name set at the call site with "
+            "`# crdtlint: emits=a.b,a.c` (each declared name stays "
+            "registry-checked), or switch to a literal name with a "
+            "label dict."
+        ),
+    }
 
     def prepare(self, ctx: LintContext) -> None:
         reg = ctx.shared.get("metric_registry")

@@ -33,6 +33,7 @@ import ast
 import re
 from typing import Iterable, List, Set
 
+from tools.crdtlint.astutil import MUTATOR_METHODS as _MUTATORS
 from tools.crdtlint.astutil import dotted
 from tools.crdtlint.core import Checker, Finding, LintContext, Module
 
@@ -40,10 +41,6 @@ THREADED_SUFFIXES = (
     "models/streaming.py", "obs/tracer.py", "obs/recorder.py",
     "ops/device.py",
 )
-_MUTATORS = {
-    "append", "update", "pop", "add", "extend", "remove", "clear",
-    "setdefault", "appendleft", "popleft", "discard", "insert",
-}
 _MUTABLE_CTORS = {"dict", "list", "set", "deque", "defaultdict",
                   "OrderedDict", "Counter"}
 
@@ -122,6 +119,18 @@ class ThreadSharedStateChecker(Checker):
     codes = {
         "CL601": "module-level mutable state mutated without a lock "
                  "in a thread-pool-reachable module",
+    }
+    explain = {
+        "CL601": (
+            "The streaming decode pool reaches this module; a bare "
+            "mutation of module-level state from those threads is "
+            "the round-8 tracer race class — lost updates that "
+            "surface as missing metrics or a wedged memo cache.\n"
+            "Fix: take the module's lock around the read-modify-"
+            "write (the _CACHE_LOCK pattern in ops/device.py); "
+            "publish-only atomic rebinds are baselined with that "
+            "justification so the reasoning stays reviewable."
+        ),
     }
 
     def check_module(self, mod: Module,

@@ -1,0 +1,375 @@
+"""CL701–CL704: Python side effects inside traced bodies (round 16).
+
+``jax.jit`` / ``shard_map`` / ``pallas_call`` / ``lax`` control-flow
+trace their Python function ONCE per shape bucket and replay the
+compiled program forever after. A Python side effect inside a traced
+body therefore fires at trace time only — a tracer count that records
+1 instead of N, an ``os.environ`` read frozen into the compiled
+artifact until an unrelated recompile (the stale-recompile hazard), a
+host sync that silently de-pipelines every dispatch, a captured-dict
+mutation that happens once and never again. Each is wrong in a way no
+runtime test sees: the first call LOOKS right.
+
+The traced set is computed interprocedurally: roots are jit-decorated
+defs, function arguments of ``jit``/``shard_map``/``pallas_call`` and
+``lax.{while_loop,fori_loop,scan,cond,switch}`` calls, and the inner
+defs of donating factories; the closure over the project call graph
+(STRONG edges only — a guessed edge must not drag a host helper into
+the traced set) is what gets scanned.
+
+- **CL701** — tracer/recorder/print side effects: ``get_tracer`` /
+  ``get_recorder`` calls, ``.count/.gauge/.observe/.span/.record`` on
+  tracer/recorder-named receivers, bare ``print`` (use
+  ``jax.debug.print`` for traced debugging).
+- **CL702** — ``os.environ`` reads (``os.environ.get`` / subscript /
+  ``os.getenv``): the value is baked at trace time; flipping the env
+  knob later silently does nothing until a shape change recompiles.
+- **CL703** — host syncs: ``block_until_ready``, ``.item()``,
+  ``np.asarray`` (the CL401 fetch-dressed-as-cast shape),
+  ``xfer_put``/``xfer_fetch`` — each forces the async dispatch
+  pipeline to drain mid-trace.
+- **CL704** — mutation of captured state: stores through ``global``/
+  ``nonlocal``, mutator calls / subscript stores on names captured
+  from an enclosing scope, ``self.*`` stores in traced methods.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.crdtlint.astutil import MUTATOR_METHODS as _MUTATORS
+from tools.crdtlint.astutil import call_name, dotted
+from tools.crdtlint.callgraph import FuncInfo, get_callgraph
+from tools.crdtlint.core import Checker, Finding, LintContext, Module
+
+# call tails whose function-valued arguments are traced
+_TRACING_CALLS: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,),
+    "shard_map": (0,),
+    "pallas_call": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "scan": (0,),
+    "cond": (1, 2),
+    "switch": (1, 2, 3, 4),
+    "vmap": (0,),
+    "grad": (0,),
+    "checkpoint": (0,),
+}
+
+_TRACER_METHODS = {"count", "gauge", "observe", "span", "record"}
+_SYNC_TAILS = {"block_until_ready", "xfer_put", "xfer_fetch",
+               "device_get"}
+
+
+def _is_jit_decorated(fn) -> bool:
+    for dec in fn.decorator_list:
+        d = dec
+        if isinstance(d, ast.Call):
+            name = call_name(d) or ""
+            if name.rsplit(".", 1)[-1] in ("jit", "shard_map"):
+                return True
+            # @partial(jax.jit, ...) AND @partial(shard_map, ...) —
+            # the latter is the repo's dominant traced-step shape
+            # (every gossip/delta step factory body)
+            if name.rsplit(".", 1)[-1] == "partial" and any(
+                (dotted(a) or "").rsplit(".", 1)[-1]
+                in ("jit", "shard_map")
+                for a in d.args
+            ):
+                return True
+        else:
+            nm = dotted(d) or ""
+            if nm.rsplit(".", 1)[-1] in ("jit", "shard_map"):
+                return True
+    return False
+
+
+class TracePurityChecker(Checker):
+    name = "trace-purity"
+    codes = {
+        "CL701": "Python tracer/recorder/print side effect inside a "
+                 "jit/shard_map/Pallas-traced body (fires once at "
+                 "trace time, not per dispatch)",
+        "CL702": "os.environ read inside a traced body (value baked "
+                 "at trace time — stale-recompile hazard)",
+        "CL703": "host sync (block_until_ready / .item() / "
+                 "np.asarray / xfer seam) inside a traced body",
+        "CL704": "mutation of captured state (global/nonlocal/"
+                 "closure/self) inside a traced body",
+    }
+    explain = {
+        "CL701": (
+            "A traced function's Python body runs ONCE per compile; "
+            "a tracer.count/span/print inside it records a single "
+            "event no matter how many dispatches follow, so the "
+            "metric silently under-reports.\n"
+            "Fix: move the emission to the host-side dispatcher "
+            "(the converge_async/converge_fetch seam), or use "
+            "jax.debug.print/jax.debug.callback for genuinely "
+            "traced-side debugging."
+        ),
+        "CL702": (
+            "os.environ read at trace time freezes the value into "
+            "the compiled program: flipping the knob later changes "
+            "nothing until an unrelated shape change recompiles — "
+            "the worst kind of heisen-config.\n"
+            "Fix: read the env var at module import or call-site "
+            "level and pass it in as a static argument."
+        ),
+        "CL703": (
+            "block_until_ready/.item()/np.asarray inside a traced "
+            "body forces a host round-trip mid-trace (or fails "
+            "under jit); either way the async dispatch pipeline "
+            "drains and the overlap the streaming executor builds "
+            "is gone.\n"
+            "Fix: keep syncs at the fetch seam (xfer_fetch / "
+            "converge_fetch); traced code returns arrays, the host "
+            "decides when to wait."
+        ),
+        "CL704": (
+            "Mutating captured state (a global, a closure list, "
+            "self.*) inside a traced body happens once at trace "
+            "time; every later dispatch replays the compiled "
+            "program and the mutation never recurs — state drifts "
+            "apart from what the code reads as.\n"
+            "Fix: thread state through the function as explicit "
+            "inputs/outputs (the functional jax discipline), or "
+            "hoist the mutation to the host wrapper."
+        ),
+    }
+
+    def prepare(self, ctx: LintContext) -> None:
+        cg = get_callgraph(ctx)
+        roots: Set[str] = set()
+        lambdas: List[Tuple[Module, ast.Lambda]] = []
+        by_node: Dict[int, FuncInfo] = {
+            id(f.node): f for f in cg.funcs.values()
+        }
+        defs_by_module: Dict[str, Dict[str, FuncInfo]] = {}
+        for f in cg.funcs.values():
+            defs_by_module.setdefault(f.module, {})[f.name] = f
+        for mod in ctx.modules:
+            if mod.tree is None:
+                continue
+            local_defs = defs_by_module.get(mod.path, {})
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    if _is_jit_decorated(node):
+                        fi = by_node.get(id(node))
+                        if fi is not None:
+                            roots.add(fi.key)
+                elif isinstance(node, ast.Call):
+                    tail = (call_name(node) or "").rsplit(".", 1)[-1]
+                    if not tail and isinstance(node.func, ast.Attribute):
+                        tail = node.func.attr
+                    argnums = _TRACING_CALLS.get(tail)
+                    if argnums is None:
+                        continue
+                    for i in argnums:
+                        if i >= len(node.args):
+                            continue
+                        a = node.args[i]
+                        if isinstance(a, ast.Lambda):
+                            lambdas.append((mod, a))
+                            continue
+                        d = dotted(a)
+                        if not d:
+                            continue
+                        fi = local_defs.get(d.rsplit(".", 1)[-1])
+                        if fi is not None:
+                            roots.add(fi.key)
+        traced = set(roots)
+        work = list(roots)
+        while work:
+            k = work.pop()
+            for cs in cg.callees(k, strong_only=True):
+                if cs.callee not in traced:
+                    traced.add(cs.callee)
+                    work.append(cs.callee)
+        ctx.shared["traced_funcs"] = traced
+        ctx.shared["traced_lambdas"] = lambdas
+
+    def check_module(self, mod: Module,
+                     ctx: LintContext) -> Iterable[Finding]:
+        if mod.tree is None:
+            return ()
+        cg = get_callgraph(ctx)
+        traced: Set[str] = ctx.shared.get("traced_funcs", set())
+        findings: List[Finding] = []
+        for fi in cg.funcs.values():
+            if fi.module != mod.path or fi.key not in traced:
+                continue
+            self._scan(fi.node, fi.qual, mod, findings,
+                       is_method=fi.cls is not None)
+        for lmod, lam in ctx.shared.get("traced_lambdas", ()):
+            if lmod.path == mod.path:
+                self._scan(lam, "<lambda>", mod, findings,
+                           is_method=False)
+        return findings
+
+    def _scan(self, fn, qual: str, mod: Module,
+              findings: List[Finding], *, is_method: bool) -> None:
+        local: Set[str] = set()
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                local.add(a.arg)
+            if args.vararg:
+                local.add(args.vararg.arg)
+            if args.kwarg:
+                local.add(args.kwarg.arg)
+        declared: Set[str] = set()
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in _own_walk(body):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                declared.update(node.names)
+            elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, ast.Store
+            ):
+                local.add(node.id)
+            elif isinstance(node, ast.For):
+                for t in ast.walk(node.target):
+                    if isinstance(t, ast.Name):
+                        local.add(t.id)
+        for node in _own_walk(body):
+            self._scan_node(node, qual, mod, findings, local,
+                            declared, is_method)
+
+    def _scan_node(self, node, qual, mod, findings, local, declared,
+                   is_method) -> None:
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            tail = name.rsplit(".", 1)[-1]
+            attr = (node.func.attr
+                    if isinstance(node.func, ast.Attribute) else "")
+            # CL701
+            recv = name.rsplit(".", 1)[0].lower() if "." in name else ""
+            tracerish = ("tracer" in recv or "recorder" in recv
+                         or _is_get_tracer_recv(node.func))
+            # bare get_tracer()/get_recorder() is NOT reported on its
+            # own: real usage always chains a method call, and that
+            # method call is the one finding (double-reporting the
+            # receiver too made every site two findings)
+            if (name == "print"
+                    or ((attr in _TRACER_METHODS) and tracerish)):
+                findings.append(Finding(
+                    mod.path, node.lineno, "CL701",
+                    f"`{name or attr}` inside the traced body of "
+                    f"`{qual}` — the side effect fires once at trace "
+                    f"time, not per dispatch",
+                    symbol=f"{qual}:{tail or attr}",
+                ))
+            # CL702
+            if name in ("os.getenv", "os.environ.get", "environ.get"):
+                findings.append(Finding(
+                    mod.path, node.lineno, "CL702",
+                    f"`{name}` inside the traced body of `{qual}` — "
+                    f"the value is baked at trace time "
+                    f"(stale-recompile hazard); pass it in as a "
+                    f"static argument",
+                    symbol=f"{qual}:{name}",
+                ))
+            # CL703
+            sync = None
+            if tail in _SYNC_TAILS or attr in _SYNC_TAILS:
+                sync = tail or attr
+            elif attr == "item" and not node.args:
+                sync = "item"
+            elif tail == "asarray" and not name.startswith("jnp."):
+                sync = name
+            if sync:
+                findings.append(Finding(
+                    mod.path, node.lineno, "CL703",
+                    f"host sync `{sync}` inside the traced body of "
+                    f"`{qual}` — the dispatch pipeline drains "
+                    f"mid-trace; sync at the fetch seam instead",
+                    symbol=f"{qual}:{sync}",
+                ))
+            # CL704: mutator call on captured state
+            if attr in _MUTATORS and isinstance(
+                node.func, ast.Attribute
+            ):
+                base = dotted(node.func.value)
+                if base and self._captured(base, local):
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL704",
+                        f"`{base}.{attr}()` mutates captured state "
+                        f"inside the traced body of `{qual}` — the "
+                        f"mutation happens once at trace time",
+                        symbol=f"{qual}:{base}.{attr}",
+                    ))
+        elif isinstance(node, ast.Subscript) and isinstance(
+            node.ctx, ast.Load
+        ):
+            base = dotted(node.value)
+            if base == "os.environ":
+                findings.append(Finding(
+                    mod.path, node.lineno, "CL702",
+                    f"`os.environ[...]` read inside the traced body "
+                    f"of `{qual}` — baked at trace time; pass it in "
+                    f"as a static argument",
+                    symbol=f"{qual}:os.environ",
+                ))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                # global/nonlocal rebind
+                if isinstance(t, ast.Name) and t.id in declared:
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL704",
+                        f"`{t.id}` (declared global/nonlocal) "
+                        f"assigned inside the traced body of "
+                        f"`{qual}` — the store happens once at "
+                        f"trace time",
+                        symbol=f"{qual}:{t.id}",
+                    ))
+                    continue
+                # subscript store on captured container / self store
+                base = None
+                if isinstance(t, ast.Subscript):
+                    base = dotted(t.value)
+                elif isinstance(t, ast.Attribute):
+                    base = dotted(t)
+                if base and self._captured(base, local):
+                    findings.append(Finding(
+                        mod.path, node.lineno, "CL704",
+                        f"store through `{base}` mutates captured "
+                        f"state inside the traced body of `{qual}`",
+                        symbol=f"{qual}:{base}",
+                    ))
+
+    @staticmethod
+    def _captured(base: str, local: Set[str]) -> bool:
+        head = base.split(".", 1)[0]
+        if head == "self":
+            return True  # self.* stores/mutations in traced methods
+        return head not in local
+
+
+def _is_get_tracer_recv(func) -> bool:
+    """``get_tracer().count(...)`` — receiver is a get_tracer/
+    get_recorder call."""
+    if isinstance(func, ast.Attribute) and isinstance(
+        func.value, ast.Call
+    ):
+        n = call_name(func.value) or ""
+        return n.rsplit(".", 1)[-1] in ("get_tracer", "get_recorder")
+    return False
+
+
+def _own_walk(stmts) -> Iterable[ast.AST]:
+    """Walk statements without descending into nested def/class
+    bodies (nested defs are separate call-graph nodes; if traced,
+    they are scanned as their own roots)."""
+    work = list(stmts)
+    while work:
+        node = work.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        work.extend(ast.iter_child_nodes(node))

@@ -49,6 +49,18 @@ class TransferSeamChecker(Checker):
         "CL401": "H2D/D2H traffic outside the ops/device.py "
                  "xfer_put/xfer_fetch accounting seam",
     }
+    explain = {
+        "CL401": (
+            "xfer.h2d_bytes / xfer.d2h_bytes are regression-gated; "
+            "a raw device_put or an np.asarray of a dispatch result "
+            "ships bytes the gate never sees, and the transfer diet "
+            "silently rots.\n"
+            "Fix: route uploads through xfer_put and fetches "
+            "through xfer_fetch; a pure execution wait "
+            "(block_until_ready with no bytes moving) is baselined "
+            "with exactly that justification."
+        ),
+    }
 
     def check_module(self, mod: Module,
                      ctx: LintContext) -> Iterable[Finding]:

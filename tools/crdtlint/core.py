@@ -266,12 +266,30 @@ class LintResult:
     suppressed: List[Finding]          # inline-disabled
     baselined: List[Finding]
     stale_baseline: List[str]          # fingerprints with no live finding
+    # analysis-layer evidence (round 16): the memoized call graph's
+    # size stats, embedded in the bench digest so graph growth/decay
+    # is visible next to the finding counts
+    stats: Dict[str, object] = field(default_factory=dict)
 
     @property
     def total_raw(self) -> int:
         """Every violation the checkers saw, suppressed or not — the
         ``lint.findings`` bench metric (growing the baseline moves it)."""
         return len(self.findings) + len(self.suppressed) + len(self.baselined)
+
+    def open_by_family(self, families=("CL1", "CL2", "CL3", "CL4",
+                                       "CL5", "CL6", "CL7", "CL8",
+                                       "CL9")) -> Dict[str, int]:
+        """OPEN finding count per code family (``cl7`` counts every
+        CL7xx). The committed tree gates these at zero (tier-1), so
+        ``tools/metrics_diff.py`` sees any new open finding as a
+        regression with count semantics — no noise floor."""
+        out = {f.lower(): 0 for f in families}
+        for f in self.findings:
+            fam = f.code[:3].lower()
+            if fam in out:
+                out[fam] += 1
+        return out
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
@@ -360,4 +378,7 @@ def run_lint(
         else:
             open_f.append(f)
     stale = sorted(set(baseline) - seen_fps)
-    return LintResult(open_f, suppressed, baselined, stale)
+    stats = {}
+    if "callgraph_stats" in ctx.shared:
+        stats["callgraph"] = ctx.shared["callgraph_stats"]
+    return LintResult(open_f, suppressed, baselined, stale, stats)

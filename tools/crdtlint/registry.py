@@ -42,8 +42,10 @@ NON_METRICS = frozenset({
     "overload.peak_inbox_bytes",  # BENCH_OUT section keys, gated by
     "overload.shed_count",        # metrics_diff directly
     "overload.shed_bytes",
-    "lint.findings",              # bench artifact key (this tool's own
-    #                               gated metric), not a tracer name
+    "lint.findings",              # bench artifact keys (this tool's
+    "lint.open_by_family",        # own gated metrics and the round-16
+    "lint.callgraph",             # call-graph stats), not tracer names
+    "lint.callgraph.collisions",
     "shard.mat",                  # xfer_put call-site labels, not
     "shard.wire",                 # tracer names (they surface only as
     "shard.out",                  # {path=...} label values on the

@@ -86,6 +86,14 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     # the noise floor never mutes it.
     (("lint", "findings"), False),
     (("lint", "baselined"), False),
+    # round 16: per-family OPEN finding counts for the new analysis
+    # families (trace purity / lock discipline / async handles). The
+    # committed tree gates these at zero via tier-1, so a non-zero
+    # new value is a straight regression; counts, not seconds — the
+    # noise floor never mutes them.
+    (("lint", "open_by_family", "cl7"), False),
+    (("lint", "open_by_family", "cl8"), False),
+    (("lint", "open_by_family", "cl9"), False),
     # the multi-chip sharded converge (round 13, bench --multichip):
     # the boundary exchange must stay a small fraction of the staged
     # upload (bytes/fraction lower-is-better, counts so the noise
@@ -175,6 +183,16 @@ def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
         )
     for path, direction in SECTION_KEYS:
         a, b = _get_path(old, path), _get_path(new, path)
+        if "open_by_family" in path:
+            # count semantics with a zero default: an artifact
+            # predating the round-16 digest means "0 open findings"
+            # (the committed tree always lints clean), so the gate
+            # is live the moment the NEW side carries the key —
+            # not only after both artifacts were regenerated
+            if a is None and b is not None:
+                a = 0
+            if b is None and a is not None:
+                b = 0
         if _both_numbers(a, b):
             yield ".".join(path), float(a), float(b), direction, False
     # the fused-dispatch net-compute sweep (round 12, the sort diet's
