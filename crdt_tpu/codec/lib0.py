@@ -228,7 +228,13 @@ class Decoder:
     def read_var_uint8_array(self) -> bytes:
         return self.read_bytes(self.read_var_uint())
 
-    def read_bytes(self, n: int) -> bytes:
+    def read_bytes(self, n: int) -> bytes:  # crdtlint: sanitizes
+        # the pre-check fences the SIGN too: a negative count would
+        # pass the tail check, return a truncated slice, and silently
+        # REWIND the cursor (pos += n), letting a decoder re-read
+        # bytes forever (round-17 decode-allocation contract)
+        if n < 0:
+            raise ValueError("negative lib0 byte count")
         if self.pos + n > len(self.data):
             raise ValueError("unexpected end of lib0 buffer")
         out = self.data[self.pos : self.pos + n]

@@ -129,14 +129,22 @@ def encode_state_vector(sv: StateVector) -> bytes:
 
 
 def decode_state_vector(data: bytes) -> StateVector:
+    # round-17 wire-taint fix (crdtlint CL1001): state vectors arrive
+    # off the wire in sync probes/beacons too — client and clock ride
+    # the SAME bounds as update structs (_MAX_ID / _MAX_CLOCK).
+    # Before this fence, a hostile SV with a 2^63 clock decoded fine
+    # and overflowed int64 in device staging (statevec deficits,
+    # shard boundary exchange) instead of failing closed here.
     d = Decoder(data)
     n = d.read_var_uint()
     sv = StateVector()
     for _ in range(n):
-        client = d.read_var_uint()
-        clock = d.read_var_uint()
+        client = _read_client_id(d)
+        clock = _read_clock_val(d)
         if clock > 0:
             sv.clocks[client] = clock
+    if d.has_content():
+        raise ValueError("trailing bytes after state vector")
     return sv
 
 
@@ -145,14 +153,14 @@ def decode_state_vector(data: bytes) -> StateVector:
 # codec's Reader::field — see _MAX_ID / _MAX_CLOCK)
 # ---------------------------------------------------------------------------
 
-def _read_client_id(d: Decoder) -> int:
+def _read_client_id(d: Decoder) -> int:  # crdtlint: sanitizes
     v = d.read_var_uint()
     if v >= _MAX_ID:
         raise ValueError("client id exceeds wire bound")
     return v
 
 
-def _read_clock_val(d: Decoder) -> int:
+def _read_clock_val(d: Decoder) -> int:  # crdtlint: sanitizes
     v = d.read_var_uint()
     if v >= _MAX_CLOCK:
         raise ValueError("clock exceeds wire bound")

@@ -617,7 +617,37 @@ class Replica:
         return self.doc.encode_state_vector()
 
     def set_peer_state_vector(self, public_key: str, sv_bytes: bytes) -> None:
-        self.peer_state_vectors[public_key] = v1.decode_state_vector(sv_bytes)
+        # the router-cache sync-contract hook: peers' SV bytes arrive
+        # here too, so the same admission check applies (a hostile SV
+        # drops, it does not raise into the caller's loop)
+        sv = self._decode_peer_sv(sv_bytes, public_key)
+        if sv is not None:
+            self.peer_state_vectors[public_key] = sv
+
+    def _decode_peer_sv(self, blob, from_pk: str):
+        """Admission check for a peer-supplied state vector (round-17
+        wire-taint contract): a hostile SV — client/clock past the
+        wire bounds, truncated, trailing garbage, or not bytes at all
+        (lib0 `any` payloads can carry str/int/None here, and
+        ``bytes(2**40)`` would be the allocation bomb itself) —
+        degrades exactly like a malformed update (counted, recorded,
+        dropped) instead of raising out of the router's poll loop.
+        Returns None on reject; callers skip the protocol action."""
+        try:
+            if not isinstance(blob, (bytes, bytearray)):
+                raise ValueError("state vector is not bytes")
+            return v1.decode_state_vector(blob)
+        except ValueError:
+            get_tracer().count("replica.malformed_updates")
+            rec = get_recorder()
+            if rec.enabled:
+                rec.record(
+                    "update.malformed", topic=self.topic,
+                    replica=self.router.public_key, peer=from_pk,
+                    size=len(blob)
+                    if isinstance(blob, (bytes, bytearray)) else 0,
+                )
+            return None
 
     def peer_close(self, public_key: str) -> None:
         self.peer_state_vectors.pop(public_key, None)  # crdt.js:266-270
@@ -812,9 +842,17 @@ class Replica:
                     peer=msg.get("public_key", from_pk),
                     digest=msg.get("digest"),
                 )
+            # .get(): a key-less beacon is as attacker-shaped as a
+            # hostile SV — None rejects through the same admission
+            # check instead of a KeyError killing the poll loop
+            beacon_sv = self._decode_peer_sv(
+                msg.get("state_vector"), from_pk
+            )
+            if beacon_sv is None:
+                return
             self.sentinel.check(
                 msg.get("public_key", from_pk),
-                v1.decode_state_vector(msg["state_vector"]),
+                beacon_sv,
                 msg.get("digest", ""),
                 msg.get("ds_digest", ""),
             )
@@ -831,8 +869,10 @@ class Replica:
             # can return a back-diff — the reference's handshake is
             # one-way and silently strands the requester's surplus
             # state (e.g. ops replayed from its local log).
-            requester = msg["public_key"]
-            sv = v1.decode_state_vector(msg["state_vector"])
+            requester = msg.get("public_key", from_pk)
+            sv = self._decode_peer_sv(msg.get("state_vector"), from_pk)
+            if sv is None:
+                return
             diff = self.doc.encode_state_as_update(sv)
             rec = get_recorder()
             if rec.enabled:
@@ -982,7 +1022,11 @@ class Replica:
                     # strand tombstone-only surplus, since delete sets
                     # live outside state vectors (diffs always carry
                     # the full delete set, like Yjs)
-                    their_sv = v1.decode_state_vector(m["state_vector"])
+                    their_sv = self._decode_peer_sv(
+                        m["state_vector"], from_pk
+                    )
+                    if their_sv is None:
+                        continue
                     back = self.doc.encode_state_as_update(their_sv)
                     self._to_peer(from_pk, {"update": back})
                     # the syncer now holds everything we do (see the

@@ -297,7 +297,7 @@ class UdpEndpoint:
         processed."""
         return int(self._lib.udp_poll(self._handle))
 
-    def recv(self) -> Optional[Tuple[str, int, bytes]]:
+    def recv(self) -> Optional[Tuple[str, int, bytes]]:  # crdtlint: taints
         ip = ctypes.create_string_buffer(64)
         port = ctypes.c_int()
         out = _u8p()

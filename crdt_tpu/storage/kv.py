@@ -181,7 +181,7 @@ class KvLog:
         if self._lib.kv_put(self._handle, _as_u8p(key), len(key), _as_u8p(value), len(value)):
             raise OSError("kv_put failed")
 
-    def get(self, key: bytes) -> Optional[bytes]:
+    def get(self, key: bytes) -> Optional[bytes]:  # crdtlint: taints
         out = ctypes.POINTER(ctypes.c_uint8)()
         n = ctypes.c_uint32()
         rc = self._lib.kv_get(self._handle, _as_u8p(key), len(key), ctypes.byref(out), ctypes.byref(n))
@@ -204,6 +204,9 @@ class KvLog:
             raise OSError("kv_batch failed")
 
     # -- scans -------------------------------------------------------------
+    # stored bytes were written by a peer (or survived a torn tail);
+    # readers re-fence them like wire input
+    # crdtlint: taints
     def scan(self, start: bytes = b"", end: bytes = b"") -> Iterator[Tuple[bytes, bytes]]:
         """Ordered iteration over [start, end); empty end = to the last
         key. Snapshot semantics (writes during iteration don't appear):
@@ -235,11 +238,11 @@ class KvLog:
         finally:
             self._lib.kv_iter_close(it)
 
-    def scan_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
+    def scan_prefix(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:  # crdtlint: taints
         """The reference's gt/lt prefix range (crdt.js:115-118)."""
         return self.scan(prefix, prefix + b"\xff")
 
-    def keys(self, prefix: bytes = b"") -> List[bytes]:
+    def keys(self, prefix: bytes = b"") -> List[bytes]:  # crdtlint: taints
         return [k for k, _ in self.scan_prefix(prefix)] if prefix else [
             k for k, _ in self.scan()
         ]

@@ -20,8 +20,11 @@ content bytes); the contracts above are about the rejects.
 
 import random
 
+import pytest
+
 from crdt_tpu.api.doc import Crdt
 from crdt_tpu.codec import v1
+from crdt_tpu.codec.lib0 import Decoder, Encoder
 
 
 def _corpus():
@@ -116,3 +119,235 @@ def test_fuzzed_single_records_keep_engine_consistent():
         except ValueError:
             continue
         v1.decode_update(doc.encode_state_as_update())
+
+
+# ---------------------------------------------------------------------------
+# round-17 targeted mutants: one per CL10xx/CL11xx finding the
+# wire-taint pass surfaced and FIXED (crdtlint tentpole). Each pins
+# ValueError-only with byte-identical doc/SV/pending on reject.
+
+
+def _sv_blob(pairs):
+    """Hand-rolled state-vector wire blob (numClients, then
+    client/clock varuints) — bypasses encode_state_vector so hostile
+    values can be written at all."""
+    e = Encoder()
+    e.write_var_uint(len(pairs))
+    for client, clock in pairs:
+        e.write_var_uint(client)
+        e.write_var_uint(clock)
+    return e.to_bytes()
+
+
+def test_hostile_state_vector_bounds_rejected():
+    """CL1001 fix (oversized varint ids): decode_state_vector now
+    fences client (< 2^62, the int64-wrap band shared with the native
+    codec) and clock (< 2^40, the kernel clock-packing bound). Before
+    the round-17 fix these decoded cleanly and the huge ints flowed
+    into device staging (statevec deficits, shard boundary exchange),
+    where 2^63 overflows int64 — a crash vector no ValueError guard
+    ever saw."""
+    hostile = (
+        [(1 << 62, 5)],            # client at the rejection band
+        [(1 << 63, 5)],            # client that wraps int64 negative
+        [((1 << 64) - 1, 5)],      # the native codec's -1 sentinel
+        [(7, 1 << 40)],            # clock at the kernel packing bound
+        [(7, 1 << 62)],            # clock that overflows staging
+    )
+    for pairs in hostile:
+        with pytest.raises(ValueError):
+            v1.decode_state_vector(_sv_blob(pairs))
+    # honest boundary values stay decodable (off-by-one guard)
+    sv = v1.decode_state_vector(
+        _sv_blob([((1 << 62) - 1, (1 << 40) - 1)])
+    )
+    assert sv.clocks == {(1 << 62) - 1: (1 << 40) - 1}
+
+
+def test_state_vector_trailing_bytes_rejected():
+    """The SV decoder now mirrors decode_update's trailing-bytes
+    strictness: a valid SV with appended garbage fails closed."""
+    good = _sv_blob([(7, 3)])
+    assert v1.decode_state_vector(good).clocks == {7: 3}
+    with pytest.raises(ValueError):
+        v1.decode_state_vector(good + b"\x01")
+
+
+def test_negative_byte_count_cannot_rewind_decoder():
+    """CL1101-family fix (negative-after-sign-decode count): a
+    negative n passed the old `pos + n > len` pre-check, returned a
+    truncated slice, and REWOUND the cursor (pos += n) — a decoder
+    loop could re-read the same bytes forever. The pre-check now
+    fences the sign."""
+    d = Decoder(b"abcdef")
+    d.read_bytes(2)
+    with pytest.raises(ValueError):
+        d.read_bytes(-1)
+    assert d.pos == 2  # cursor did not move, let alone rewind
+
+
+def test_declared_string_length_past_buffer_rejected_atomically():
+    """Splice-offset-past-buffer mutant: a ContentString struct whose
+    varUint byte-length prefix declares more bytes than the blob
+    carries must raise ValueError and leave an applying doc
+    byte-identical (the round-10 all-or-nothing contract, re-pinned
+    for the length-prefix family the wire-taint checker fences)."""
+    e = Encoder()
+    e.write_var_uint(1)        # numClients
+    e.write_var_uint(1)        # numStructs
+    e.write_var_uint(5)        # client
+    e.write_var_uint(0)        # clock
+    e.write_uint8(v1.REF_STRING)  # no origin/right -> parent written
+    e.write_var_uint(1)        # parent is a root
+    e.write_var_string("m")
+    e.write_var_uint(1000)     # declared string length...
+    e.write_bytes(b"abc")      # ...but only 3 bytes follow
+    blob = e.to_bytes()
+    with pytest.raises(ValueError):
+        v1.decode_update(blob)
+
+    doc = Crdt(9)
+    doc.apply_update(_corpus()[0])
+    before = _doc_fingerprint(doc)
+    with pytest.raises(ValueError):
+        doc.apply_update(blob)
+    assert _doc_fingerprint(doc) == before
+
+
+def test_oversized_gc_run_length_bounded_by_budget():
+    """Oversized-varint-length mutant: a GC run declaring 2^39 units
+    (inside the clock bound, far past any honest compaction) must hit
+    the buffer-derived expansion budget — ValueError, no hang, no
+    multi-gigabyte record list (the CL1002/CL1101 discipline the
+    decode-allocation checker enforces statically)."""
+    e = Encoder()
+    e.write_var_uint(1)        # numClients
+    e.write_var_uint(1)        # numStructs
+    e.write_var_uint(5)        # client
+    e.write_var_uint(0)        # clock
+    e.write_uint8(v1.REF_GC)
+    e.write_var_uint(1 << 39)  # hostile run length
+    e.write_var_uint(0)        # empty delete set
+    blob = e.to_bytes()
+    with pytest.raises(ValueError):
+        v1.decode_update(blob)
+
+    doc = Crdt(9)
+    doc.apply_update(_corpus()[0])
+    before = _doc_fingerprint(doc)
+    with pytest.raises(ValueError):
+        doc.apply_update(blob)
+    assert _doc_fingerprint(doc) == before
+
+
+def test_replica_survives_hostile_peer_state_vector():
+    """The net-seam half of the CL1001 fix: a beacon / sync-ready
+    message carrying a hostile SV must degrade like a malformed
+    update (counted, recorded, dropped) — not raise out of the
+    router's poll loop. Pre-round-17 the hostile SV decoded cleanly
+    and poisoned peer_state_vectors instead."""
+    from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+    from crdt_tpu.net.replica import ypear_crdt
+    from crdt_tpu.obs.tracer import Tracer, get_tracer, set_tracer
+
+    old_tracer = get_tracer()
+    set_tracer(Tracer(enabled=True))
+    net = LoopbackNetwork()
+    a = ypear_crdt(LoopbackRouter(net, "a"), topic="t", client_id=1)
+    b = ypear_crdt(LoopbackRouter(net, "b"), topic="t", client_id=2)
+    net.run()
+    a.set("m", "k", 1)
+    net.run()
+    assert dict(b.c)["m"]["k"] == 1
+
+    try:
+        hostile = _sv_blob([(1 << 63, 5)])
+        # ready probe and beacon, both carrying the hostile SV: the
+        # handler must swallow (ValueError isolated), not propagate
+        a._on_data(
+            {"meta": "ready", "public_key": "b",
+             "state_vector": hostile},
+            "b",
+        )
+        a._on_data(
+            {"meta": "beacon", "public_key": "b",
+             "state_vector": hostile, "digest": "", "ds_digest": ""},
+            "b",
+        )
+        got = get_tracer().counters().get("replica.malformed_updates", 0)
+        assert got == 2
+        # the hostile SV never landed in the peer ledger
+        assert all(
+            c < (1 << 62)
+            for sv in a.peer_state_vectors.values() for c in sv.clocks
+        )
+        # the swarm still works
+        a.set("m", "k2", 2)
+        net.run()
+        assert dict(b.c)["m"]["k2"] == 2
+    finally:
+        set_tracer(old_tracer)
+
+
+def test_replica_rejects_non_bytes_state_vector_payloads():
+    """Review fix: lib0 `any` payloads can put str/int/None where SV
+    bytes belong. A non-bytes state_vector must degrade like a
+    malformed update — `bytes(2**40)` inside the decoder would BE the
+    allocation bomb, and a str raises TypeError, not ValueError."""
+    from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+    from crdt_tpu.net.replica import ypear_crdt
+    from crdt_tpu.obs.tracer import Tracer, get_tracer, set_tracer
+
+    old_tracer = get_tracer()
+    set_tracer(Tracer(enabled=True))
+    try:
+        net = LoopbackNetwork()
+        a = ypear_crdt(LoopbackRouter(net, "a"), topic="t", client_id=1)
+        b = ypear_crdt(LoopbackRouter(net, "b"), topic="t", client_id=2)
+        net.run()
+        for payload in ("abc", 1 << 40, None, 3.5, [1, 2]):
+            a._on_data(
+                {"meta": "ready", "public_key": "b",
+                 "state_vector": payload},
+                "b",
+            )
+        # the sync-contract hook is held to the same admission check
+        a.set_peer_state_vector("b", "not-bytes")
+        assert get_tracer().counters()[
+            "replica.malformed_updates"
+        ] == 6
+        a.set("m", "k", 1)
+        net.run()
+        assert dict(b.c)["m"]["k"] == 1
+    finally:
+        set_tracer(old_tracer)
+
+
+def test_replica_survives_keyless_protocol_messages():
+    """Review fix round 2: a wire-valid envelope missing the
+    state_vector (or public_key) key entirely must reject through the
+    same admission check — msg[...] KeyError would kill the poll loop
+    before the value fence ever ran."""
+    from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+    from crdt_tpu.net.replica import ypear_crdt
+    from crdt_tpu.obs.tracer import Tracer, get_tracer, set_tracer
+
+    old_tracer = get_tracer()
+    set_tracer(Tracer(enabled=True))
+    try:
+        net = LoopbackNetwork()
+        a = ypear_crdt(LoopbackRouter(net, "a"), topic="t", client_id=1)
+        b = ypear_crdt(LoopbackRouter(net, "b"), topic="t", client_id=2)
+        net.run()
+        a._on_data({"meta": "beacon"}, "b")
+        a._on_data({"meta": "ready"}, "b")
+        a._on_data({"meta": "beacon", "public_key": "b",
+                    "digest": "", "ds_digest": ""}, "b")
+        assert get_tracer().counters()[
+            "replica.malformed_updates"
+        ] == 3
+        a.set("m", "k", 1)
+        net.run()
+        assert dict(b.c)["m"]["k"] == 1
+    finally:
+        set_tracer(old_tracer)

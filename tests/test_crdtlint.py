@@ -1784,3 +1784,620 @@ def test_callgraph_nested_class_does_not_shadow_toplevel():
     cg = build_callgraph(mods)
     assert "crdt_tpu/x.py:A.f" in cg.funcs
     assert "crdt_tpu/x.py:factory.<locals>.A.f" in cg.funcs
+
+
+# ---------------------------------------------------------------------------
+# CL1001-CL1004 wire taint (round 17)
+
+
+def test_cl1001_tainted_index_fires():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        return d.data[n]
+    '''})
+    assert "CL1001" in codes(r)
+
+
+def test_cl1001_tainted_slice_bound_fires():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d, buf):
+        off = d.read_var_uint()
+        return buf[2:off]
+    '''})
+    assert "CL1001" in codes(r)
+
+
+def test_cl1001_comparison_guard_sanitizes():
+    """A comparison-guarded branch on the tainted value kills the
+    taint past the guard (the CFG-aware sanitization edge)."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d, buf):
+        n = d.read_var_uint()
+        if n >= len(buf):
+            raise ValueError("offset past buffer")
+        return buf[n]
+    '''})
+    assert codes(r) == []
+
+
+def test_cl1001_use_before_guard_still_fires():
+    """The guard kills taint only downstream: an index BEFORE the
+    comparison is still hostile."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d, buf):
+        n = d.read_var_uint()
+        first = buf[n]
+        if n >= len(buf):
+            raise ValueError("late")
+        return first
+    '''})
+    assert "CL1001" in codes(r)
+
+
+def test_cl1001_min_clamp_sanitizes():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d, buf):
+        n = min(d.read_var_uint(), len(buf) - 1)
+        return buf[n]
+    '''})
+    assert codes(r) == []
+
+
+def test_cl1001_declared_sanitizer_helper_kills_taint():
+    """A `# crdtlint: sanitizes` helper owns the admission check:
+    its result is clean at every caller."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _read_bounded(d):  # crdtlint: sanitizes
+        v = d.read_var_uint()
+        if v >= (1 << 31):
+            raise ValueError("bound")
+        return v
+
+    def decode_x(d, buf):
+        n = _read_bounded(d)
+        return buf[n]
+    '''})
+    assert "CL1001" not in codes(r)
+
+
+def test_cl1001_rebind_from_clean_value_kills_taint():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d, buf):
+        n = d.read_var_uint()
+        n = 3
+        return buf[n]
+    '''})
+    assert codes(r) == []
+
+
+def test_cl1001_out_of_scope_module_clean():
+    """The taint pass scopes to codec/storage/net — the same snippet
+    in ops/ is some kernel's business, not the wire fence's."""
+    r = lint({"crdt_tpu/ops/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        return d.data[n]
+    '''})
+    assert codes(r) == []
+
+
+def test_cl1001_suppressed_inline():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        return d.data[n]  # crdtlint: disable=CL1001
+    '''})
+    assert "CL1001" not in codes(r)
+    assert any(f.code == "CL1001" for f in r.suppressed)
+
+
+def test_cl1001_baselined():
+    files = {"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        return d.data[n]
+    '''}
+    first = lint(files)
+    (f,) = [f for f in first.findings if f.code == "CL1001"]
+    r = lint(files, baseline={f.fingerprint: {
+        "fingerprint": f.fingerprint,
+        "justification": "trusted test fixture path",
+    }})
+    assert "CL1001" not in codes(r)
+    assert any(f2.code == "CL1001" for f2 in r.baselined)
+
+
+def test_cl1002_tainted_allocation_fires():
+    for alloc in ("bytearray(n)", "np.zeros(n)", "list(range(n))",
+                  "b'x' * n", "[0] * n"):
+        r = lint({"crdt_tpu/codec/x.py": f'''
+    import numpy as np
+
+    def decode_x(d):
+        n = d.read_var_uint()
+        return {alloc}
+    '''})
+        assert "CL1002" in codes(r), alloc
+
+
+def test_cl1002_buffer_guard_sanitizes():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        if d.pos + n > len(d.data):
+            raise ValueError("tail")
+        return bytearray(n)
+    '''})
+    assert "CL1002" not in codes(r)
+
+
+def test_cl1002_tainted_attribute_store_propagates():
+    """Attribute stores on decoder objects carry taint (the
+    `self.declared_len = n` shape)."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    class D:
+        def read_header(self, d):
+            self.declared = d.read_var_uint()
+            return bytearray(self.declared)
+    '''})
+    assert "CL1002" in codes(r)
+
+
+def test_cl1003_unconsuming_loop_fires_and_reader_loop_clean():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        out = []
+        for _ in range(n):
+            out.append(1)
+        return out
+    '''})
+    assert "CL1003" in codes(r)
+    # a body that reads the wire each iteration is buffer-capped
+    r2 = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        out = []
+        for _ in range(n):
+            out.append(d.read_uint8())
+        return out
+    '''})
+    assert "CL1003" not in codes(r2)
+
+
+def test_cl1003_budget_check_in_body_sanitizes():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d, budget):
+        n = d.read_var_uint()
+        total = 0
+        out = []
+        for _ in range(n):
+            total += 1
+            if total > budget:
+                raise ValueError("budget")
+            out.append(1)
+        return out
+    '''})
+    assert "CL1003" not in codes(r)
+
+
+def test_cl1003_comprehension_bound_fires():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        return [0 for _ in range(n)]
+    '''})
+    assert "CL1003" in codes(r)
+    r2 = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        return [d.read_any() for _ in range(n)]
+    '''})
+    assert "CL1003" not in codes(r2)
+
+
+def test_cl1004_staging_crossing_fires_and_guarded_clean():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d, cols):
+        n = d.read_var_uint()
+        return stage(cols, rows=n)
+    '''})
+    assert "CL1004" in codes(r)
+    r2 = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d, cols):
+        n = d.read_var_uint()
+        if n >= (1 << 40):
+            raise ValueError("clock bound")
+        return stage(cols, rows=n)
+    '''})
+    assert "CL1004" not in codes(r2)
+
+
+def test_taints_directive_marks_custom_source():
+    """`# crdtlint: taints` on a def makes its result hostile at
+    every caller — the kv/udp seam annotation workflow."""
+    r = lint({"crdt_tpu/storage/x.py": '''
+    def fetch_blob(h):  # crdtlint: taints
+        return h.raw()
+
+    def index_of(h, table):
+        n = fetch_blob(h)
+        return table[n]
+    '''})
+    assert "CL1001" in codes(r)
+
+
+def test_return_taint_closes_over_wrappers():
+    """A wrapper returning a source's result is itself a source for
+    its callers (the interprocedural fixpoint over STRONG edges)."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _wrap(d):
+        return d.read_var_uint()
+
+    def _wrap2(d):
+        return _wrap(d) + 1
+
+    def decode_x(d, buf):
+        n = _wrap2(d)
+        return buf[n]
+    '''})
+    assert "CL1001" in codes(r)
+
+
+def test_kv_receiver_results_are_tainted():
+    """kv get/scan results taint without a directive when the
+    receiver spelling names the store."""
+    r = lint({"crdt_tpu/storage/x.py": '''
+    def last_seq(kv, table):
+        raw = kv.get(b"seq")
+        return table[raw]
+    '''})
+    assert "CL1001" in codes(r)
+    # a plain dict .get is NOT a kv source
+    r2 = lint({"crdt_tpu/storage/x.py": '''
+    def last_seq(cache, table):
+        raw = cache.get(b"seq")
+        return table[raw]
+    '''})
+    assert "CL1001" not in codes(r2)
+
+
+# ---------------------------------------------------------------------------
+# CL1101/CL1102 decode-allocation contracts (round 17)
+
+
+def test_cl1101_absolute_guard_fires_buffer_guard_clean():
+    weak = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        if n > (1 << 31):
+            raise ValueError("cap")
+        return bytearray(n)
+    '''})
+    assert "CL1101" in codes(weak)
+    assert "CL1002" not in codes(weak)  # the guard did kill the taint
+    anchored = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        if d.pos + n > len(d.data):
+            raise ValueError("tail")
+        return bytearray(n)
+    '''})
+    assert "CL1101" not in codes(anchored)
+
+
+def test_cl1101_budget_variable_counts_as_anchored():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d, data):
+        budget = max(1 << 20, 4096 * len(data))
+        n = d.read_var_uint()
+        if n > budget:
+            raise ValueError("budget")
+        return bytearray(n)
+    '''})
+    assert "CL1101" not in codes(r)
+
+
+def test_cl1101_only_on_decode_entries():
+    """A non-decode-named function with the same weak guard is
+    CL1002-country (when unguarded) or clean — the stricter
+    buffer-anchored standard applies to decode entries only."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def helper_alloc(d):
+        n = d.read_var_uint()
+        if n > (1 << 31):
+            raise ValueError("cap")
+        return bytearray(n)
+    '''})
+    assert "CL1101" not in codes(r)
+
+
+def test_cl1101_sanitizer_params_held_to_contract():
+    """A `# crdtlint: sanitizes` helper's PARAMETERS are treated as
+    hostile — the helper claims to own the admission check, so an
+    absolute-bound-only fence inside it is a contract violation."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def read_block(d, n):  # crdtlint: sanitizes
+        if n > (1 << 20):
+            raise ValueError("cap")
+        return bytearray(n)
+    '''})
+    assert "CL1101" in codes(r)
+
+
+def test_cl1101_suppressed_and_baselined():
+    src = {"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        if n > (1 << 31):
+            raise ValueError("cap")
+        # crdtlint: disable=CL1101
+        return bytearray(n)
+    '''}
+    r = lint(src)
+    assert "CL1101" not in codes(r)
+    assert any(f.code == "CL1101" for f in r.suppressed)
+    clean_src = {"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        if n > (1 << 31):
+            raise ValueError("cap")
+        return bytearray(n)
+    '''}
+    first = lint(clean_src)
+    (f,) = [f for f in first.findings if f.code == "CL1101"]
+    r2 = lint(clean_src, baseline={f.fingerprint: {
+        "fingerprint": f.fingerprint,
+        "justification": "absolute cap is the doc-level contract here",
+    }})
+    assert "CL1101" not in codes(r2)
+    assert any(f2.code == "CL1101" for f2 in r2.baselined)
+
+
+def test_cl1102_helper_raise_fires():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _helper(b):
+        raise KeyError("boom")
+
+    def decode_x(b):
+        return _helper(b)
+    '''})
+    assert "CL1102" in codes(r)
+
+
+def test_cl1102_valueerror_and_bare_reraise_clean():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _helper(b):
+        if not b:
+            raise ValueError("empty")
+        try:
+            return b[0]
+        except IndexError:
+            raise
+    '''  '''
+    def decode_x(b):
+        return _helper(b)
+    '''})
+    assert "CL1102" not in codes(r)
+
+
+def test_cl1102_decode_named_helper_left_to_cl302():
+    """A helper that is itself decode-named is CL302's lexical job —
+    CL1102 must not double-report it."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _read_part(b):
+        raise KeyError("boom")
+
+    def decode_x(b):
+        return _read_part(b)
+    '''})
+    assert "CL302" in codes(r)
+    assert "CL1102" not in codes(r)
+
+
+def test_cl1102_cross_module_strong_edge():
+    r = lint({
+        "crdt_tpu/codec/util.py": '''
+    def unpack_head(b):
+        raise AssertionError("no head")
+    ''',
+        "crdt_tpu/codec/x.py": '''
+    from crdt_tpu.codec.util import unpack_head
+
+    def decode_x(b):
+        return unpack_head(b)
+    ''',
+    })
+    found = [f for f in r.findings if f.code == "CL1102"]
+    assert found and found[0].path == "crdt_tpu/codec/util.py"
+
+
+def test_cl1102_weak_edge_never_convicts():
+    """A by-method-name (weak) edge must not drag a helper into the
+    decode closure — attribute calls on unknown receivers stay out."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    class Other:
+        def finish(self):
+            raise RuntimeError("not mine")
+
+    def decode_x(b, obj):
+        return obj.finish()
+    '''})
+    assert "CL1102" not in codes(r)
+
+
+def test_cl1102_two_entries_one_finding():
+    """Two decode entries reaching the same raise produce ONE
+    finding (stable fingerprint for the baseline ledger)."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _helper(b):
+        raise KeyError("boom")
+
+    def decode_x(b):
+        return _helper(b)
+
+    def decode_y(b):
+        return _helper(b)
+    '''})
+    assert [f.code for f in r.findings].count("CL1102") == 1
+
+
+def test_cl1102_suppressed_at_raise_site():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _helper(b):
+        raise KeyError("boom")  # crdtlint: disable=CL1102
+
+    def decode_x(b):
+        return _helper(b)
+    '''})
+    assert "CL1102" not in codes(r)
+    assert any(f.code == "CL1102" for f in r.suppressed)
+
+
+def test_open_by_family_buckets_four_digit_codes():
+    """CL1001 counts under cl10 (wire taint), never under the donate
+    family cl1 — the round-17 family split in LintResult."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        return d.data[n]
+    '''})
+    fams = r.open_by_family()
+    assert fams["cl10"] >= 1
+    assert fams["cl1"] == 0
+    assert "cl11" in fams
+
+
+# every round-17 code: the positive snippet, its inline-suppressed
+# twin, and a baseline round-trip — (code, clean lint must fire it;
+# the marked line carries the disable comment in the suppressed twin)
+_R17_POSITIVES = {
+    "CL1001": ("crdt_tpu/codec/x.py", '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        return d.data[n]{MARK}
+    '''),
+    "CL1002": ("crdt_tpu/codec/x.py", '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        return bytearray(n){MARK}
+    '''),
+    "CL1003": ("crdt_tpu/codec/x.py", '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        out = []
+        for _ in range(n):{MARK}
+            out.append(1)
+        return out
+    '''),
+    "CL1004": ("crdt_tpu/codec/x.py", '''
+    def decode_x(d, cols):
+        n = d.read_var_uint()
+        return stage(cols, rows=n){MARK}
+    '''),
+    "CL1101": ("crdt_tpu/codec/x.py", '''
+    def decode_x(d):
+        n = d.read_var_uint()
+        if n > (1 << 31):
+            raise ValueError("cap")
+        return bytearray(n){MARK}
+    '''),
+    "CL1102": ("crdt_tpu/codec/x.py", '''
+    def _helper(b):
+        raise KeyError("boom"){MARK}
+
+    def decode_x(b):
+        return _helper(b)
+    '''),
+}
+
+
+@pytest.mark.parametrize("code", sorted(_R17_POSITIVES))
+def test_r17_code_suppressed_and_baselined_roundtrip(code):
+    path, template = _R17_POSITIVES[code]
+    plain = template.replace("{MARK}", "")
+    r = lint({path: plain})
+    assert code in codes(r), f"{code} positive snippet does not fire"
+    # inline suppression on the finding's line
+    marked = template.replace(
+        "{MARK}", f"  # crdtlint: disable={code}"
+    )
+    r_supp = lint({path: marked})
+    assert code not in codes(r_supp)
+    assert any(f.code == code for f in r_supp.suppressed), code
+    # baseline round-trip on the plain variant's fingerprint
+    (f,) = [f for f in r.findings if f.code == code]
+    r_base = lint({path: plain}, baseline={f.fingerprint: {
+        "fingerprint": f.fingerprint,
+        "justification": "intentional for this synthetic case",
+    }})
+    assert code not in codes(r_base)
+    assert any(f2.code == code for f2 in r_base.baselined), code
+
+
+def test_cl1102_reraise_of_bound_valueerror_clean():
+    """Review fix: `except ValueError as e: raise e` preserves the
+    contract — the checker must report the HANDLER's type, never the
+    variable name."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _helper(b):
+        try:
+            return b[0]
+        except ValueError as e:
+            raise e
+
+    def decode_x(b):
+        return _helper(b)
+    '''})
+    assert "CL1102" not in codes(r)
+
+
+def test_cl1102_reraise_of_bound_foreign_type_fires():
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _helper(b):
+        try:
+            return b[0]
+        except KeyError as e:
+            raise e
+
+    def decode_x(b):
+        return _helper(b)
+    '''})
+    found = [f for f in r.findings if f.code == "CL1102"]
+    assert found and "KeyError" in found[0].message
+
+
+def test_cl1102_unresolvable_variable_raise_stays_silent():
+    """A constructed exception variable cannot be traced — the
+    conservative direction is silence, never invention."""
+    r = lint({"crdt_tpu/codec/x.py": '''
+    def _helper(kind):
+        exc = RuntimeError("x") if kind else ValueError("y")
+        raise exc
+
+    def decode_x(b):
+        return _helper(b)
+    '''})
+    assert "CL1102" not in codes(r)
+
+
+def test_cl1004_strong_resolved_ops_callee_fires():
+    """Review fix: a STRONG-resolved callee under crdt_tpu/ops/ is a
+    staging sink even when its name is not a hard-coded stage tail —
+    the ops candidate index makes the documented rule real."""
+    r = lint({
+        "crdt_tpu/ops/packer.py": '''
+    def pack_columns(rows, cols):
+        return rows
+    ''',
+        "crdt_tpu/codec/x.py": '''
+    from crdt_tpu.ops.packer import pack_columns
+
+    def decode_x(d, cols):
+        n = d.read_var_uint()
+        return pack_columns(n, cols)
+    ''',
+    })
+    found = [f for f in r.findings if f.code == "CL1004"]
+    assert found and found[0].path == "crdt_tpu/codec/x.py"

@@ -318,9 +318,10 @@ def test_lint_open_by_family_gated():
     old = copy.deepcopy(OLD)
     old["lint"] = {
         "findings": 23, "open": 0, "baselined": 23, "suppressed": 0,
-        "open_by_family": {"cl7": 0, "cl8": 0, "cl9": 0},
+        "open_by_family": {"cl7": 0, "cl8": 0, "cl9": 0,
+                           "cl10": 0, "cl11": 0},
     }
-    for fam in ("cl7", "cl8", "cl9"):
+    for fam in ("cl7", "cl8", "cl9", "cl10", "cl11"):
         new = copy.deepcopy(old)
         new["lint"]["open_by_family"][fam] = 1
         rows, regressed = compare(old, new)
@@ -344,8 +345,9 @@ def test_lint_digest_embeds_family_counts_and_callgraph():
     digest = bench.lint_digest()
     assert digest, "lint_digest unexpectedly empty"
     fams = digest["open_by_family"]
-    assert set(fams) == {"cl7", "cl8", "cl9"}
-    assert fams == {"cl7": 0, "cl8": 0, "cl9": 0}
+    assert set(fams) == {"cl7", "cl8", "cl9", "cl10", "cl11"}
+    assert fams == {"cl7": 0, "cl8": 0, "cl9": 0,
+                    "cl10": 0, "cl11": 0}
     cgs = digest["callgraph"]
     for key in ("functions", "edges", "weak_edges", "collisions",
                 "thread_roots", "thread_reachable"):
@@ -538,3 +540,10 @@ def test_lint_open_by_family_gates_against_pre_round16_artifact():
     clean["lint"]["open_by_family"] = {"cl7": 0, "cl8": 0, "cl9": 0}
     rows, regressed = compare(old, clean)
     assert regressed == []
+    # same zero-default for the round-17 families: an artifact that
+    # predates cl10/cl11 gates the moment the NEW side carries them
+    wired = copy.deepcopy(old)
+    wired["lint"]["open_by_family"] = {"cl10": 2, "cl11": 0}
+    rows, regressed = compare(old, wired)
+    assert "lint.open_by_family.cl10" in regressed
+    assert "lint.open_by_family.cl11" not in regressed

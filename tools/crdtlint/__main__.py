@@ -37,7 +37,19 @@ def main(argv=None) -> int:
                     help="print a code's rationale and fix recipe "
                          "(e.g. --explain CL803) and exit")
     ap.add_argument("--statistics", action="store_true",
-                    help="per-code counts incl. suppressed/baselined")
+                    help="per-code counts incl. suppressed/baselined, "
+                         "plus per-checker wall time")
+    ap.add_argument("--sarif", metavar="PATH",
+                    help="also write findings as SARIF 2.1.0 (one "
+                         "rule per code, --explain text as help; "
+                         "baselined/suppressed carried as SARIF "
+                         "suppressions). Exit-code semantics are "
+                         "unchanged")
+    ap.add_argument("--prune-stale", action="store_true",
+                    help="rewrite the baseline in place dropping "
+                         "entries whose fingerprint matches no live "
+                         "finding (surviving justifications kept "
+                         "verbatim), then report as usual")
     args = ap.parse_args(argv)
 
     # repo root = parent of tools/ — resolves default paths whether
@@ -111,6 +123,37 @@ def main(argv=None) -> int:
         )
         return 0
 
+    if args.sarif:
+        from tools.crdtlint.sarif import write_sarif
+
+        try:
+            ledger = load_baseline(config.baseline_path)
+        except BaselineError:
+            ledger = {}
+        write_sarif(args.sarif, result, ALL_CODES, ALL_EXPLAIN,
+                    ledger)
+        print(f"crdtlint: wrote SARIF to {args.sarif}",
+              file=sys.stderr)
+
+    if args.prune_stale and result.stale_baseline:
+        # mechanical ledger hygiene: drop entries no live finding
+        # matches, keep every surviving justification verbatim
+        try:
+            existing = load_baseline(config.baseline_path)
+        except BaselineError as e:
+            print(f"crdtlint: {e}", file=sys.stderr)
+            return 2
+        stale = set(result.stale_baseline)
+        kept = [e for fp, e in sorted(existing.items())
+                if fp not in stale]
+        write_baseline(config.baseline_path, [], kept)
+        print(
+            f"crdtlint: pruned {len(stale)} stale baseline "
+            f"entr(ies), {len(kept)} kept",
+            file=sys.stderr,
+        )
+        result.stale_baseline = []
+
     for f in result.findings:
         print(f.format())
     for fp in result.stale_baseline:
@@ -127,6 +170,10 @@ def main(argv=None) -> int:
             if n or b or s:
                 print(f"{code}: {n} open, {b} baselined, "
                       f"{s} suppressed")
+        # per-checker wall time: the <10 s tier-1 budget, itemized
+        timings = result.stats.get("checker_seconds", {})
+        for name in sorted(timings, key=timings.get, reverse=True):
+            print(f"time {name}: {timings[name]:.3f}s")
     summary = (
         f"crdtlint: {len(modules)} files, "
         f"{len(result.findings)} finding(s), "

@@ -11,6 +11,7 @@ entry (the ``--explain CODE`` CLI surface: rationale + fix recipe).
 """
 
 from tools.crdtlint.checkers.asynchandle import AsyncHandleChecker
+from tools.crdtlint.checkers.decodealloc import DecodeAllocChecker
 from tools.crdtlint.checkers.determinism import DeterminismChecker
 from tools.crdtlint.checkers.donate import DonateChecker
 from tools.crdtlint.checkers.exceptions import ExceptionDisciplineChecker
@@ -18,6 +19,7 @@ from tools.crdtlint.checkers.lockdiscipline import LockDisciplineChecker
 from tools.crdtlint.checkers.metrics import MetricsRegistryChecker
 from tools.crdtlint.checkers.threadshare import ThreadSharedStateChecker
 from tools.crdtlint.checkers.tracepurity import TracePurityChecker
+from tools.crdtlint.checkers.wiretaint import WireTaintChecker
 from tools.crdtlint.checkers.xfer import TransferSeamChecker
 
 ALL_CHECKERS = [
@@ -30,6 +32,8 @@ ALL_CHECKERS = [
     TracePurityChecker,
     LockDisciplineChecker,
     AsyncHandleChecker,
+    WireTaintChecker,
+    DecodeAllocChecker,
 ]
 
 ALL_CODES = {

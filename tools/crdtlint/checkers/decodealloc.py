@@ -1,0 +1,210 @@
+"""CL1101/CL1102: decode-allocation contracts (round 17).
+
+The torn-tail discipline the round-10 ALICE matrix proved dynamically,
+stated statically: a decode entry point that allocates from a
+DECLARED length must have compared that length against the actual
+buffer remaining (or an input-derived budget) first — an absolute
+constant bound is not enough, because a 2^30 length under a 2^31 cap
+still buys a gigabyte from a five-byte varint. And the round-10
+``ValueError``-only contract (CL302 checks it lexically, per
+decode-named function) must hold through every helper a decode entry
+reaches: the replica's malformed-blob isolation catches exactly
+``ValueError``, so a ``KeyError`` escaping a helper two calls down
+kills the poll loop just as dead as one raised inline.
+
+- **CL1101** — a decode entry point (``decode*`` / ``read_*`` /
+  ``parse*`` / ``loads`` / ``from_bytes`` in codec/kv scope) sizes an
+  allocation with a wire-read length whose only sanitization was a
+  non-buffer-anchored guard (the wire-taint pass marks those *weak*:
+  the comparison mentioned no ``len(...)``/``pos``/``remaining``/
+  ``budget``-like term).
+- **CL1102** — a non-``ValueError`` raise in a helper reachable from
+  a decode entry point over STRONG call-graph edges (the round-16
+  resolution rules; a guessed edge must never convict a helper).
+  Helpers that are themselves decode-named are CL302's lexical job
+  and excluded here, so each raise is reported exactly once.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from tools.crdtlint.astutil import dotted, in_scope
+from tools.crdtlint.callgraph import get_callgraph, reach_closure
+from tools.crdtlint.checkers.exceptions import _is_decode_path
+from tools.crdtlint.checkers.wiretaint import (
+    _TaintWalk,
+    get_taint_index,
+)
+from tools.crdtlint.core import Checker, Finding, LintContext, Module
+
+DECODE_SCOPE = ("crdt_tpu/codec/", "crdt_tpu/storage/kv.py")
+
+
+def _handler_bound_names(fn_node) -> Dict[str, Set[str]]:
+    """``except X as e`` bindings in a function: name -> the set of
+    caught type shortnames. A ``raise e`` of such a binding re-raises
+    one of THOSE types, not a type literally named ``e``."""
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(fn_node):
+        if not (isinstance(node, ast.ExceptHandler) and node.name):
+            continue
+        types = (
+            node.type.elts if isinstance(node.type, ast.Tuple)
+            else [node.type] if node.type is not None else []
+        )
+        shorts = {
+            (dotted(t) or "?").rsplit(".", 1)[-1] for t in types
+        } or {"<bare>"}
+        out.setdefault(node.name, set()).update(shorts)
+    return out
+
+
+def _raise_names(node: ast.Raise, bound: Dict[str, Set[str]]) -> Set[str]:
+    """Exception type shortname(s) a raise can produce. Empty set =
+    unresolvable or type-preserving (bare re-raise, a variable we
+    cannot trace) — the conservative direction is to stay silent, a
+    checker must never invent a conviction."""
+    if node.exc is None:
+        return set()  # bare re-raise: preserves the original type
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        name = dotted(exc.func)
+        return {name.rsplit(".", 1)[-1]} if name else set()
+    if isinstance(exc, ast.Name):
+        if exc.id in bound:
+            # `except ValueError as e: raise e` re-raises ValueError;
+            # report the HANDLER's types, not the variable name
+            return bound[exc.id]
+        return set()  # a constructed variable: cannot resolve
+    name = dotted(exc)
+    return {name.rsplit(".", 1)[-1]} if name else set()
+
+
+class DecodeAllocChecker(Checker):
+    name = "decode-alloc"
+    codes = {
+        "CL1101": "decode entry allocates from a declared length "
+                  "without a buffer-anchored pre-check",
+        "CL1102": "non-ValueError raise reachable from a decode "
+                  "entry point (interprocedural CL302)",
+    }
+    explain = {
+        "CL1101": (
+            "A length prefix is a claim, not a fact: before "
+            "allocating `n` of anything, a decoder must check `n` "
+            "against what the buffer can actually back — "
+            "`pos + n > len(data)` for raw bytes, or an "
+            "input-derived budget (decode_update's "
+            "`4096 * len(data)` expansion budget) for run "
+            "expansion. An absolute cap (`n < 2**31`) silences the "
+            "taint but still lets a 5-byte varint buy a gigabyte — "
+            "that is exactly the torn-tail/hostile-length family "
+            "the round-10 ALICE matrix and codec fuzz probe "
+            "dynamically.\n"
+            "Fix: make the guard mention the buffer (`len(data)`, "
+            "`self.pos`, a `budget` derived from the input size), "
+            "or route the length through a `# crdtlint: sanitizes` "
+            "helper that owns the buffer-anchored check."
+        ),
+        "CL1102": (
+            "The replica isolates a malformed blob by catching "
+            "exactly ValueError (round-10 contract, enforced "
+            "lexically by CL302). A helper that raises KeyError or "
+            "struct.error two STRONG calls below decode_update "
+            "breaks that contract just as hard as an inline raise — "
+            "the poll loop dies instead of bisecting the poisoned "
+            "batch.\n"
+            "Fix: wrap the helper's failure and re-raise as "
+            "ValueError with offset context at the decode seam; for "
+            "genuinely environmental errors (a missing native "
+            "toolchain), baseline with a justification naming the "
+            "gate that keeps wire input from reaching the raise."
+        ),
+    }
+
+    def prepare(self, ctx: LintContext) -> None:
+        ctx.shared.setdefault("cl1102_memo", {})
+
+    def check_module(self, mod: Module,
+                     ctx: LintContext) -> Iterable[Finding]:
+        if not in_scope(mod.path, DECODE_SCOPE) or mod.tree is None:
+            return ()
+        return list(self._check_allocs(mod, ctx))
+
+    def finalize(self, ctx: LintContext) -> Iterable[Finding]:
+        # CL1102 is a whole-graph question (an entry in one module
+        # can reach a helper in another); one pass with one dedupe
+        # set, so two entries sharing a helper report it once
+        return list(self._check_raises(ctx))
+
+    # -- CL1101 ----------------------------------------------------------
+
+    def _check_allocs(self, mod: Module,
+                      ctx: LintContext) -> Iterable[Finding]:
+        index = get_taint_index(ctx)
+        for qual, fn in index.defs.get(mod.path, {}).items():
+            cls = qual.rsplit(".", 1)[0] if "." in qual else ""
+            if not _is_decode_path(fn, cls):
+                continue
+            walker = _TaintWalk(
+                mod, fn, qual, index,
+                taint_params=f"{mod.path}:{qual}" in index.sanitizing,
+            )
+            walker.run()
+            for lineno, tail, name in walker.weak_allocs:
+                yield Finding(
+                    mod.path, lineno, "CL1101",
+                    f"decode entry `{qual}` allocates via `{tail}` "
+                    f"from wire length `{name}` guarded only by an "
+                    f"absolute bound — pre-check it against the "
+                    f"buffer remaining (or an input-derived budget) "
+                    f"before allocating",
+                    symbol=f"{qual}:{tail}:{name}",
+                )
+
+    # -- CL1102 ----------------------------------------------------------
+
+    def _check_raises(self, ctx: LintContext) -> Iterable[Finding]:
+        cg = get_callgraph(ctx)
+        memo: Dict[str, Set[str]] = ctx.shared["cl1102_memo"]
+        seen: Set[str] = set()
+        for fkey in sorted(cg.funcs):
+            fi = cg.funcs[fkey]
+            if not in_scope(fi.module, DECODE_SCOPE):
+                continue
+            if not _is_decode_path(fi.node, fi.cls or ""):
+                continue
+            closure = reach_closure(cg, fi.key, strong_only=True,
+                                    memo=memo)
+            for key in sorted(closure):
+                helper = cg.funcs.get(key)
+                if helper is None or not in_scope(
+                    helper.module, DECODE_SCOPE
+                ):
+                    continue
+                if _is_decode_path(helper.node, helper.cls or ""):
+                    continue  # CL302 covers it lexically
+                bound = _handler_bound_names(helper.node)
+                for node in ast.walk(helper.node):
+                    if not isinstance(node, ast.Raise):
+                        continue
+                    for short in sorted(_raise_names(node, bound)):
+                        if short == "ValueError":
+                            continue
+                        symbol = f"{helper.qual}:{short}"
+                        fp = f"{helper.module}|{symbol}"
+                        if fp in seen:
+                            continue
+                        seen.add(fp)
+                        yield Finding(
+                            helper.module, node.lineno, "CL1102",
+                            f"`{helper.qual}` raises `{short}` and "
+                            f"is reachable from decode entry "
+                            f"`{fi.qual}` — decode paths raise "
+                            f"ValueError only (the malformed-blob "
+                            f"isolation catches exactly that); wrap "
+                            f"and re-raise at the seam",
+                            symbol=symbol,
+                        )

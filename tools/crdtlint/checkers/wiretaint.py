@@ -1,0 +1,785 @@
+"""CL1001–CL1004: wire-taint bounds analysis for untrusted inputs
+(round 17).
+
+The wire-compatible gateway (ROADMAP item 1) will point the decode
+paths — ``codec/lib0.py``, ``codec/v1.py``, ``codec/native.py``, the
+kv/WAL readers, the udp frame handlers — at UNMODIFIED clients on the
+open internet. A hostile varint length or splice offset is the
+classic memory-amplification / crash vector in the Yjs binary update
+codec this repo is wire-compatible with. The round-10 fuzz suite
+defends those paths *dynamically* (540 seeded mutants); this checker
+is the static complement: every integer read off the wire must be
+bounds-fenced before it reaches an index, a slice, a ``range()``, or
+an allocation size.
+
+**Taint sources** — a call whose result is attacker-controlled:
+
+- the lib0/v1 varint/byte readers (``read_var_uint``, ``read_uint8``,
+  ``read_any``, ... — matched by tail, any receiver);
+- kv get/scan results (``get``/``scan``/``scan_prefix``/``keys`` on a
+  receiver spelling that names the kv store: on-disk bytes may have
+  been written by a peer or corrupted);
+- native udp receive frames (``recv_all``/``recv``/``udp_recv``);
+- any function carrying a ``# crdtlint: taints`` directive on its
+  ``def`` line (or the comment line directly above), plus —
+  interprocedurally — any scope function whose RETURN value derives
+  from a source through STRONG-resolved calls (the round-16
+  resolution machinery; a guessed edge must never lend a function
+  someone else's taint).
+
+**Propagation** — assignments, tuple unpacking, arithmetic,
+``int()``/abs()-style magnitude-preserving conversions, and attribute
+stores on decoder objects (``self.pos = tainted``).
+
+**Sanitization** (CFG-aware, on the guarded edges):
+
+- a comparison-guarded branch on the tainted value — ``if n > MAX:
+  raise ValueError`` kills the taint on the fall-through edge,
+  ``if n < bound: use(n)`` kills it inside the guarded branch;
+- an explicit ``min()``/``max()`` clamp;
+- a call to a helper declared ``# crdtlint: sanitizes`` (the helper
+  owns the admission check — e.g. v1's ``_read_client_id``);
+- guards that do NOT reference the input buffer (an absolute
+  constant bound) still kill the taint here but are remembered as
+  *weak* — the decode-allocation checker (CL1101) holds decode entry
+  points to the stricter buffer-anchored standard.
+
+**Sinks:**
+
+- **CL1001** — tainted index or slice bound (``buf[n]``,
+  ``data[a:b]`` with a tainted bound, tainted subscript-store key);
+- **CL1002** — tainted allocation size: ``range``/``bytearray``/
+  ``zeros``/``empty``/``full``/``frombuffer`` argument, or a
+  sequence-repetition ``[0] * n`` / ``b"x" * n``;
+- **CL1003** — tainted loop bound (``for _ in range(n)``) whose body
+  neither consumes wire bytes per iteration (a reader call raises on
+  exhaustion, so the trip count is buffer-capped) nor checks a
+  cumulative budget (a comparison + raise);
+- **CL1004** — a tainted value crossing into the staging layer
+  (``ops/packed`` column inputs — ``stage``/``stage_resident_delta``
+  or any STRONG-resolved callee under ``crdt_tpu/ops/``) without an
+  admission check.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.crdtlint.astutil import (
+    assigned_names,
+    call_name,
+    dotted,
+    import_map,
+    in_scope,
+    make_module_resolver,
+)
+from tools.crdtlint.core import Checker, Finding, LintContext, Module
+
+SCOPE = ("crdt_tpu/codec/", "crdt_tpu/storage/", "crdt_tpu/net/")
+
+# wire-reader call tails: distinctive enough to match on any receiver
+READER_TAILS = frozenset({
+    "read_uint8", "read_var_uint", "read_var_int", "read_var_string",
+    "read_var_uint8_array", "read_bytes", "read_float32",
+    "read_float64", "read_int64", "read_any",
+})
+# kv results are tainted only when the receiver spelling names the
+# store (`kv.get`, `self._kv.scan_prefix`, `self._require().keys`) —
+# `.get` alone is every dict in the package
+KV_TAILS = frozenset({"get", "scan", "scan_prefix", "keys"})
+UDP_TAILS = frozenset({"recv_all", "recv", "udp_recv"})
+ALLOC_TAILS = frozenset({
+    "range", "bytearray", "zeros", "empty", "full", "frombuffer",
+})
+STAGING_TAILS = frozenset({"stage", "stage_resident_delta"})
+# magnitude-preserving conversions: the result is as hostile as the
+# argument
+_PRESERVING = frozenset({"int", "abs", "float", "round"})
+# clean-result builtins: the value is a host fact, not wire content
+_CLEAN_CALLS = frozenset({"min", "max", "len", "bool", "isinstance",
+                          "sorted", "enumerate", "zip"})
+
+_TAINTS_RE = re.compile(r"#\s*crdtlint:\s*taints\b")
+_SANITIZES_RE = re.compile(r"#\s*crdtlint:\s*sanitizes\b")
+
+# names that anchor a guard to the input buffer: a comparison
+# mentioning one of these (or `len(...)`) bounds the tainted value
+# relative to what was actually received, which is the only bound
+# that makes a length-prefixed allocation safe
+_BUFFER_ANCHORS = ("len", "pos", "remaining", "budget", "data", "buf")
+
+
+def directive_funcs(mod: Module, directive_re) -> Set[str]:
+    """Qualnames of defs carrying ``directive_re`` on their def line
+    or the comment line directly above it."""
+    marked_lines = {
+        i for i, text in enumerate(mod.lines, start=1)
+        if "crdtlint" in text and directive_re.search(text)
+    }
+    if not marked_lines:
+        return set()
+    out: Set[str] = set()
+    for qual, fn in iter_defs(mod.tree):
+        cand = {fn.lineno, fn.lineno - 1}
+        # decorators shift lineno; accept the decorator line too
+        for dec in fn.decorator_list:
+            cand.add(dec.lineno - 1)
+        if cand & marked_lines:
+            out.add(qual)
+    return out
+
+
+def iter_defs(tree) -> Iterable[Tuple[str, ast.FunctionDef]]:
+    """(qualname, def) pairs — methods as ``Class.meth``, nested defs
+    as ``outer.<locals>.inner`` (matching the call graph's quals)."""
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield qual, child
+                yield from visit(child, f"{qual}.<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, f"{prefix}{child.name}.")
+            else:
+                yield from visit(child, prefix)
+
+    yield from visit(tree, "")
+
+
+class _FnRef:
+    """Candidate shape for make_module_resolver (needs ``.module``)."""
+
+    __slots__ = ("module", "name", "qual")
+
+    def __init__(self, module: str, name: str, qual: str):
+        self.module = module
+        self.name = name
+        self.qual = qual
+
+
+class TaintIndex:
+    """Cross-module taint facts for the scope modules, built once per
+    run and shared through ``ctx.shared`` by both wire-taint checkers.
+
+    ``tainting`` / ``sanitizing`` hold ``module:qual`` keys. The
+    tainting set starts from the ``# crdtlint: taints`` directives and
+    closes over returns: a function whose return value is tainted
+    under the current set joins it, to a fixpoint (STRONG resolution
+    only — same-module defs, explicit imports, ``self.`` methods)."""
+
+    def __init__(self, ctx: LintContext):
+        self.mods = [
+            m for m in ctx.modules
+            if m.tree is not None and in_scope(m.path, SCOPE)
+        ]
+        self.defs: Dict[str, Dict[str, ast.FunctionDef]] = {}
+        self.tainting: Set[str] = set()
+        self.sanitizing: Set[str] = set()
+        cands: Dict[str, List[_FnRef]] = {}
+        for m in self.mods:
+            self.defs[m.path] = {}
+            for qual, fn in iter_defs(m.tree):
+                self.defs[m.path][qual] = fn
+                cands.setdefault(fn.name, []).append(
+                    _FnRef(m.path, fn.name, qual)
+                )
+            for qual in directive_funcs(m, _TAINTS_RE):
+                self.tainting.add(f"{m.path}:{qual}")
+            for qual in directive_funcs(m, _SANITIZES_RE):
+                self.sanitizing.add(f"{m.path}:{qual}")
+        # staging-layer defs join the CANDIDATE index only (never
+        # walked, never in the fixpoint): a scope module's strong
+        # call into crdt_tpu/ops/ must resolve so CL1004 can see the
+        # crossing — without this, only the hard-coded stage tails
+        # would ever fire
+        for m in ctx.modules:
+            if m.tree is None or not in_scope(
+                m.path, ("crdt_tpu/ops/",)
+            ):
+                continue
+            for qual, fn in iter_defs(m.tree):
+                cands.setdefault(fn.name, []).append(
+                    _FnRef(m.path, fn.name, qual)
+                )
+        self._resolvers = {}
+        for m in self.mods:
+            top = {q for q in self.defs[m.path] if "." not in q}
+            self._resolvers[m.path] = make_module_resolver(
+                m.path, m.tree, top, cands, fallback_first=False,
+                imap=import_map(m.tree),
+            )
+        # return-taint fixpoint (bounded: the chain depth through
+        # wrapper helpers is tiny in practice)
+        for _ in range(5):
+            grew = False
+            for m in self.mods:
+                for qual, fn in self.defs[m.path].items():
+                    key = f"{m.path}:{qual}"
+                    if key in self.tainting or key in self.sanitizing:
+                        continue
+                    walker = _TaintWalk(m, fn, qual, self,
+                                        collect_findings=False)
+                    walker.run()
+                    if walker.returns_tainted:
+                        self.tainting.add(key)
+                        grew = True
+            if not grew:
+                break
+
+    def classify_call(self, call: ast.Call, mod: Module,
+                      self_quals: Dict[str, str]) -> str:
+        """-> "source" | "sanitizer" | "staging" | "clean" | "other"
+        for a call expression seen from ``mod``."""
+        name = call_name(call) or ""
+        tail = name.rsplit(".", 1)[-1] if name else (
+            call.func.attr if isinstance(call.func, ast.Attribute)
+            else ""
+        )
+        if not tail:
+            return "other"
+        if name in _CLEAN_CALLS:
+            return "clean"
+        key = self._resolve_key(name, tail, call, mod, self_quals)
+        if key is not None:
+            if key in self.sanitizing:
+                return "sanitizer"
+            if key in self.tainting:
+                return "source"
+            if key.split(":", 1)[0].find("crdt_tpu/ops/") >= 0:
+                return "staging"
+        if tail in READER_TAILS:
+            return "source"
+        if tail in UDP_TAILS:
+            return "source"
+        if tail in KV_TAILS and _kv_receiver(call):
+            return "source"
+        if tail in STAGING_TAILS:
+            return "staging"
+        return "other"
+
+    def _resolve_key(self, name: str, tail: str, call: ast.Call,
+                     mod: Module,
+                     self_quals: Dict[str, str]) -> Optional[str]:
+        # self.meth within the enclosing class
+        if name.startswith("self.") and "." not in name[5:]:
+            q = self_quals.get(name[5:])
+            if q is not None:
+                return f"{mod.path}:{q}"
+        if not name:
+            return None
+        # bare same-module def (incl. methods called unqualified is
+        # not a thing; top-level only)
+        if "." not in name and name in self.defs.get(mod.path, {}):
+            return f"{mod.path}:{name}"
+        hit = self._resolvers.get(mod.path, lambda n: None)(name)
+        if hit is not None:
+            return f"{hit.module}:{hit.qual}"
+        return None
+
+
+def _kv_receiver(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    recv = call.func.value
+    d = dotted(recv)
+    if d is not None:
+        return "kv" in d.lower() or "store" in d.lower()
+    # `self._require().scan_prefix(...)` — receiver is a call to the
+    # handle accessor
+    if isinstance(recv, ast.Call):
+        n = call_name(recv) or ""
+        return n.rsplit(".", 1)[-1] in ("_require", "_make_kv")
+    return False
+
+
+def get_taint_index(ctx: LintContext) -> TaintIndex:
+    idx = ctx.shared.get("taint_index")
+    if idx is None:
+        idx = TaintIndex(ctx)
+        ctx.shared["taint_index"] = idx
+    return idx
+
+
+class _TaintWalk:
+    """Source-ordered, branch-aware intraprocedural taint pass over
+    one function (the round-11 'lite walk' style: approximate where a
+    full dataflow would be heavy, conservative in the direction that
+    misses findings rather than inventing them).
+
+    Collected outputs:
+    - ``findings`` (when ``collect_findings``): CL1001/2/3/4 events as
+      (code, lineno, detail, symbol_hint) tuples — the checker wraps
+      them in Findings;
+    - ``returns_tainted``: any ``return`` whose value is tainted (the
+      TaintIndex fixpoint input);
+    - ``weak_allocs``: allocation sinks whose length was sanitized
+      only by a non-buffer-anchored guard — the CL1101 input.
+    """
+
+    def __init__(self, mod: Module, fn, qual: str, index: TaintIndex,
+                 *, collect_findings: bool = True,
+                 taint_params: bool = False):
+        self.mod = mod
+        self.fn = fn
+        self.qual = qual
+        self.index = index
+        self.collect = collect_findings
+        self.tainted: Set[str] = set()
+        self.weak: Set[str] = set()     # cleanly guarded, but not
+        #                                 against the buffer
+        self.findings: List[tuple] = []
+        self.weak_allocs: List[tuple] = []
+        self.returns_tainted = False
+        self._skip_calls: Set[int] = set()  # range() handled as loop
+        # methods of the enclosing class, for self.* resolution
+        cls = qual.rsplit(".", 2)[0] if "." in qual else None
+        self.self_quals: Dict[str, str] = {}
+        if cls and ".<locals>" not in cls:
+            for q in index.defs.get(mod.path, ()):
+                if q.startswith(f"{cls}.") and "." not in q[len(cls) + 1:]:
+                    self.self_quals[q.rsplit(".", 1)[-1]] = q
+        if taint_params:
+            args = fn.args
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if a.arg != "self":
+                    self.tainted.add(a.arg)
+
+    # -- driver ----------------------------------------------------------
+
+    def run(self) -> None:
+        self._block(self.fn.body)
+
+    def _block(self, stmts) -> None:
+        for st in stmts:
+            self._stmt(st)
+
+    # -- statements ------------------------------------------------------
+
+    def _stmt(self, st) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # separate scope; nested defs walked on their own
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = st.value
+            if value is not None:
+                self._expr(value)
+                hot = self._taint_of(value)
+            else:
+                hot = False
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            for t in targets:
+                # tainted index on a subscript-store is a sink too
+                if isinstance(t, ast.Subscript):
+                    self._check_subscript(t)
+            if isinstance(st, ast.AugAssign):
+                hot = hot or self._taint_of(st.target)
+            for t in targets:
+                for name in assigned_names(t):
+                    if hot:
+                        self.tainted.add(name)
+                        self.weak.discard(name)
+                    else:
+                        self.tainted.discard(name)
+                        self.weak.discard(name)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._expr(st.test)
+            self._apply_guard(st.test)
+            self._block(st.body)
+            self._block(st.orelse)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._for(st)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._expr(item.context_expr)
+            self._block(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self._block(st.body)
+            for h in st.handlers:
+                self._block(h.body)
+            self._block(st.orelse)
+            self._block(st.finalbody)
+            return
+        if isinstance(st, ast.Return):
+            if st.value is not None:
+                self._expr(st.value)
+                if self._taint_of(st.value):
+                    self.returns_tainted = True
+            return
+        if isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self._expr(st.exc)
+            return
+        if isinstance(st, ast.Expr):
+            self._expr(st.value)
+            return
+        if isinstance(st, (ast.Assert, ast.Delete)):
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+            return
+        # pass/break/continue/import/global/nonlocal: nothing to do
+
+    def _for(self, st) -> None:
+        it = st.iter
+        rng_arg = self._range_len_arg(it)
+        if (self.collect and rng_arg is not None
+                and self._taint_of(rng_arg)):
+            self._skip_calls.add(id(it))
+            if not self._loop_consumes(st.body):
+                self._emit("CL1003", st.lineno, ast.unparse(rng_arg)
+                           if hasattr(ast, "unparse") else "bound")
+        self._expr(it)
+        if rng_arg is None and self._taint_of(it):
+            for name in assigned_names(st.target):
+                self.tainted.add(name)
+        self._block(st.body)
+        self._block(st.orelse)
+
+    # -- expressions -----------------------------------------------------
+
+    def _expr(self, e) -> None:
+        """Walk an expression checking sinks (comprehension-aware).
+        Sink checks never change taint state, so the fixpoint's
+        fast passes (``collect_findings=False``) skip them — that
+        keeps the return-taint closure's cost a fraction of the
+        finding pass instead of a multiple of it."""
+        if not self.collect:
+            return
+        for node in ast.walk(e):
+            if isinstance(node, ast.Subscript):
+                self._check_subscript(node)
+            elif isinstance(node, ast.Call):
+                self._check_call(node)
+            elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.Mult
+            ):
+                self._check_repeat(node)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.DictComp, ast.GeneratorExp)):
+                self._check_comp(node)
+
+    def _check_subscript(self, node: ast.Subscript) -> None:
+        sl = node.slice
+        parts = (
+            [p for p in (sl.lower, sl.upper, sl.step) if p is not None]
+            if isinstance(sl, ast.Slice) else [sl]
+        )
+        for p in parts:
+            hot = sorted(self._names_in(p) & self.tainted)
+            if hot:
+                kind = ("slice bound"
+                        if isinstance(sl, ast.Slice) else "index")
+                self._emit("CL1001", node.lineno,
+                           f"{hot[0]} ({kind})", symbol=hot[0])
+                return
+
+    def _check_call(self, node: ast.Call) -> None:
+        if id(node) in self._skip_calls:
+            return
+        name = call_name(node) or ""
+        tail = name.rsplit(".", 1)[-1] if name else (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else ""
+        )
+        args = list(node.args) + [k.value for k in node.keywords]
+        if tail in ALLOC_TAILS:
+            for a in args:
+                hot = self._names_in(a) & self.tainted
+                if hot or self._taint_of(a):
+                    self._emit(
+                        "CL1002", node.lineno,
+                        f"`{tail}` sized by {sorted(hot)[0] if hot else 'a wire read'}",
+                        symbol=tail,
+                    )
+                    return
+                weak_hot = self._names_in(a) & self.weak
+                if weak_hot:
+                    self.weak_allocs.append(
+                        (node.lineno, tail, sorted(weak_hot)[0])
+                    )
+                    return
+            return
+        cls = self.index.classify_call(node, self.mod, self.self_quals)
+        if cls == "staging":
+            for a in args:
+                hot = self._names_in(a) & self.tainted
+                if hot or self._taint_of(a):
+                    self._emit(
+                        "CL1004", node.lineno,
+                        f"`{tail}` receives "
+                        f"{sorted(hot)[0] if hot else 'a wire read'}",
+                        symbol=tail,
+                    )
+                    return
+
+    def _check_repeat(self, node: ast.BinOp) -> None:
+        # [0] * n, b"\x00" * n — allocation by repetition
+        for side, other in ((node.left, node.right),
+                            (node.right, node.left)):
+            if not (isinstance(other, ast.List) or (
+                isinstance(other, ast.Constant)
+                and isinstance(other.value, (str, bytes))
+            )):
+                continue
+            hot = self._names_in(side) & self.tainted
+            if hot or (not self._names_in(side)
+                       and self._taint_of(side)):
+                self._emit(
+                    "CL1002", node.lineno,
+                    f"sequence repetition sized by "
+                    f"{sorted(hot)[0] if hot else 'a wire read'}",
+                    symbol="repeat",
+                )
+                return
+            weak_hot = self._names_in(side) & self.weak
+            if weak_hot:
+                self.weak_allocs.append(
+                    (node.lineno, "repeat", sorted(weak_hot)[0])
+                )
+                return
+
+    def _check_comp(self, node) -> None:
+        for gen in node.generators:
+            rng_arg = self._range_len_arg(gen.iter)
+            if rng_arg is None or not self._taint_of(rng_arg):
+                continue
+            self._skip_calls.add(id(gen.iter))
+            elts = ([node.elt] if hasattr(node, "elt")
+                    else [node.key, node.value])
+            if not any(self._has_reader(e) for e in elts):
+                self._emit("CL1003", node.lineno, "comprehension")
+
+    # -- helpers ---------------------------------------------------------
+
+    def _range_len_arg(self, it) -> Optional[ast.expr]:
+        if (isinstance(it, ast.Call)
+                and (call_name(it) or "").rsplit(".", 1)[-1] == "range"
+                and it.args):
+            return it.args[-1] if len(it.args) >= 2 else it.args[0]
+        return None
+
+    def _loop_consumes(self, body) -> bool:
+        """A loop body that reads wire bytes per iteration (the reader
+        raises on exhaustion → the trip count is buffer-capped) or
+        checks a cumulative budget (comparison + raise) is bounded."""
+        for st in body:
+            for node in ast.walk(st):
+                if isinstance(node, ast.Call) and self._is_reader(node):
+                    return True
+                if isinstance(node, ast.If) and any(
+                    isinstance(s, ast.Raise)
+                    for b in (node.body, node.orelse) for s in b
+                ) and self._names_in(node.test):
+                    return True
+        return False
+
+    def _has_reader(self, e) -> bool:
+        return any(
+            isinstance(n, ast.Call) and self._is_reader(n)
+            for n in ast.walk(e)
+        )
+
+    def _is_reader(self, call: ast.Call) -> bool:
+        # sanitizer helpers (`_read_client_id`) wrap readers: they
+        # consume wire bytes and raise at exhaustion just the same,
+        # so a loop whose body calls one is buffer-capped too
+        return self.index.classify_call(
+            call, self.mod, self.self_quals
+        ) in ("source", "sanitizer")
+
+    def _names_in(self, e) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(e):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                d = dotted(node)
+                if d:
+                    out.add(d)
+        return out
+
+    def _taint_of(self, e) -> bool:
+        """Is this expression's VALUE tainted under the current state?
+        Clean-wrapping calls (min/max clamps, declared sanitizers,
+        len) launder their result; source calls and calls to tainting
+        functions poison theirs; everything else propagates from the
+        mentioned names and nested calls."""
+        if isinstance(e, ast.Call):
+            cls = self.index.classify_call(e, self.mod, self.self_quals)
+            if cls == "source":
+                return True
+            if cls in ("sanitizer", "clean"):
+                return False
+            name = (call_name(e) or "").rsplit(".", 1)[-1]
+            if name in _CLEAN_CALLS:
+                return False
+            if name in _PRESERVING:
+                return any(self._taint_of(a) for a in e.args)
+            # generic call: tainted if any argument or the receiver is
+            # (str.rsplit / json.loads of tainted bytes stay tainted)
+            parts = list(e.args) + [k.value for k in e.keywords]
+            if isinstance(e.func, ast.Attribute):
+                parts.append(e.func.value)
+            return any(self._taint_of(a) for a in parts)
+        if isinstance(e, (ast.Name, ast.Attribute)):
+            d = dotted(e)
+            if d is None:
+                return any(
+                    self._taint_of(c) for c in ast.iter_child_nodes(e)
+                    if isinstance(c, ast.expr)
+                )
+            return d in self.tainted or d.split(".", 1)[0] in self.tainted
+        if isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp, ast.Lambda)):
+            return False  # contents checked as sinks, value shape new
+        return any(
+            self._taint_of(c) for c in ast.iter_child_nodes(e)
+            if isinstance(c, ast.expr)
+        )
+
+    def _apply_guard(self, test) -> None:
+        """A comparison in a branch test is the bounds fence: kill
+        the taint on the names it mentions (both branch edges — the
+        walk is edge-merged: a linter may miss a wrong-way guard,
+        never invent one). Buffer-anchored comparisons clear the
+        value entirely; absolute-constant ones leave a *weak* mark
+        that CL1101 holds decode entries accountable for."""
+        mentioned: Set[str] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare):
+                mentioned |= self._names_in(node)
+        if not mentioned:
+            return
+        anchored = self._buffer_anchored(test)
+        for name in mentioned & self.tainted:
+            self.tainted.discard(name)
+            if not anchored:
+                self.weak.add(name)
+        if anchored:
+            self.weak -= mentioned
+
+    def _buffer_anchored(self, test) -> bool:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Call):
+                if (call_name(node) or "") == "len":
+                    return True
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                d = (dotted(node) or "").lower()
+                if any(a in d for a in _BUFFER_ANCHORS):
+                    return True
+        return False
+
+    def _emit(self, code: str, lineno: int, detail: str,
+              symbol: str = "") -> None:
+        if self.collect:
+            self.findings.append((code, lineno, detail, symbol))
+
+
+_MESSAGES = {
+    "CL1001": "wire-tainted {detail} in `{qual}` — bound it against "
+              "the buffer (or clamp/guard it) before indexing",
+    "CL1002": "wire-tainted allocation in `{qual}`: {detail} — a "
+              "hostile declared length buys unbounded memory; fence "
+              "it against the buffer remaining or an input-derived "
+              "budget first",
+    "CL1003": "wire-tainted loop bound in `{qual}` ({detail}) with no "
+              "per-iteration wire read and no cumulative budget "
+              "check — a few declared bytes must never buy an "
+              "unbounded trip count",
+    "CL1004": "wire-tainted value crossing into the staging layer in "
+              "`{qual}`: {detail} without an admission check — "
+              "kernel column inputs must be bounds-fenced at the "
+              "decode seam",
+}
+
+
+class WireTaintChecker(Checker):
+    name = "wire-taint"
+    codes = {
+        "CL1001": "wire-tainted value used as an index or slice bound",
+        "CL1002": "wire-tainted value sizes an allocation "
+                  "(range/frombuffer/zeros/bytearray/repetition)",
+        "CL1003": "wire-tainted loop bound without a cumulative cap",
+        "CL1004": "wire-tainted value crosses into the staging layer "
+                  "without an admission check",
+    }
+    explain = {
+        "CL1001": (
+            "An integer read off the wire (varint, byte, kv value, "
+            "udp frame) used directly as an index or slice bound "
+            "lets a hostile blob address memory the sender never "
+            "shipped — the classic Yjs-codec splice-offset crash "
+            "vector the round-10 fuzz corpus probes dynamically.\n"
+            "Fix: guard it first (`if n > limit: raise ValueError`), "
+            "clamp it (`min(n, limit)`), or route it through a "
+            "helper declared with `# crdtlint: sanitizes` that owns "
+            "the admission check (see v1._read_client_id)."
+        ),
+        "CL1002": (
+            "A declared length is free for the sender and expensive "
+            "for you: `bytearray(n)` / `range(n)` / `np.zeros(n)` "
+            "sized by an unchecked wire read is memory amplification "
+            "— a 5-byte varint allocates gigabytes.\n"
+            "Fix: compare the length against the buffer remaining "
+            "(or a budget derived from len(data), like "
+            "decode_update's expansion budget) and raise ValueError "
+            "before allocating."
+        ),
+        "CL1003": (
+            "A loop bounded by a wire-read count with a body that "
+            "neither consumes wire bytes per iteration nor checks a "
+            "cumulative budget spins as long as the attacker asks. "
+            "Bodies that call a reader every iteration are exempt — "
+            "the reader raises at end-of-buffer, so the trip count "
+            "is capped by bytes actually received.\n"
+            "Fix: add a budget check inside the loop (`if total > "
+            "budget: raise ValueError`) or read something from the "
+            "wire each iteration."
+        ),
+        "CL1004": (
+            "The staging layer (`ops/packed` column inputs) trusts "
+            "its columns: clocks fit the 40-bit packing, ids fit the "
+            "int64 composites, lengths fit int32 buckets. A wire "
+            "value that reaches `stage()` / `stage_resident_delta()` "
+            "without passing an admission check can silently alias "
+            "rows on device, which no ValueError will ever surface.\n"
+            "Fix: fence the value at the decode seam (the _MAX_CLOCK "
+            "/ _MAX_ID bounds) or pass it through a `# crdtlint: "
+            "sanitizes` helper before it touches column staging."
+        ),
+    }
+
+    def check_module(self, mod: Module,
+                     ctx: LintContext) -> Iterable[Finding]:
+        if not in_scope(mod.path, SCOPE) or mod.tree is None:
+            return ()
+        index = get_taint_index(ctx)
+        findings: List[Finding] = []
+        for qual, fn in index.defs.get(mod.path, {}).items():
+            key = f"{mod.path}:{qual}"
+            walker = _TaintWalk(
+                mod, fn, qual, index,
+                taint_params=key in index.sanitizing,
+            )
+            walker.run()
+            counts: Dict[str, int] = {}
+            for code, lineno, detail, sym in walker.findings:
+                base = f"{qual}:{sym or code.lower()}"
+                counts[base] = counts.get(base, 0) + 1
+                symbol = (base if counts[base] == 1
+                          else f"{base}:{counts[base]}")
+                findings.append(Finding(
+                    mod.path, lineno, code,
+                    _MESSAGES[code].format(qual=qual, detail=detail),
+                    symbol=symbol,
+                ))
+        return findings

@@ -279,14 +279,19 @@ class LintResult:
 
     def open_by_family(self, families=("CL1", "CL2", "CL3", "CL4",
                                        "CL5", "CL6", "CL7", "CL8",
-                                       "CL9")) -> Dict[str, int]:
+                                       "CL9", "CL10",
+                                       "CL11")) -> Dict[str, int]:
         """OPEN finding count per code family (``cl7`` counts every
         CL7xx). The committed tree gates these at zero (tier-1), so
         ``tools/metrics_diff.py`` sees any new open finding as a
-        regression with count semantics — no noise floor."""
+        regression with count semantics — no noise floor. Codes are
+        ``CL`` + 3 digits (families cl1–cl9) or ``CL`` + 4 digits
+        (the round-17 cl10/cl11 wire-taint families) — a CL1001 must
+        count under ``cl10``, never under the donate family ``cl1``."""
         out = {f.lower(): 0 for f in families}
         for f in self.findings:
-            fam = f.code[:3].lower()
+            fam = (f.code[:4] if len(f.code) == 6
+                   else f.code[:3]).lower()
             if fam in out:
                 out[fam] += 1
         return out
@@ -349,15 +354,34 @@ def run_lint(
     for m in mods:
         if m.parse_error:
             raw.append(Finding(m.path, 1, "CL000", m.parse_error))
+    # per-checker wall time (prepare + check_module + finalize),
+    # surfaced by --statistics and asserted against the tier-1 <10 s
+    # whole-tree budget: a checker that quietly turns quadratic shows
+    # up as a named number, not as a mystery slowdown
+    import time as _time
+
+    checker_seconds: Dict[str, float] = {}
+
+    def _timed(ch, fn):
+        t0 = _time.perf_counter()
+        out = fn()
+        checker_seconds[ch.name] = (
+            checker_seconds.get(ch.name, 0.0)
+            + _time.perf_counter() - t0
+        )
+        return out
+
     for ch in checkers:
-        ch.prepare(ctx)
+        _timed(ch, lambda ch=ch: ch.prepare(ctx))
     for ch in checkers:
         for m in mods:
             if m.tree is None:
                 continue
-            raw.extend(ch.check_module(m, ctx))
+            raw.extend(_timed(ch, lambda ch=ch, m=m: list(
+                ch.check_module(m, ctx)
+            )))
     for ch in checkers:
-        raw.extend(ch.finalize(ctx))
+        raw.extend(_timed(ch, lambda ch=ch: list(ch.finalize(ctx))))
 
     by_path = {m.path: m for m in mods}
     if baseline is None and use_baseline:
@@ -378,7 +402,11 @@ def run_lint(
         else:
             open_f.append(f)
     stale = sorted(set(baseline) - seen_fps)
-    stats = {}
+    stats: Dict[str, object] = {
+        "checker_seconds": {
+            k: round(v, 4) for k, v in checker_seconds.items()
+        },
+    }
     if "callgraph_stats" in ctx.shared:
         stats["callgraph"] = ctx.shared["callgraph_stats"]
     return LintResult(open_f, suppressed, baselined, stale, stats)

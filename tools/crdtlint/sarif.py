@@ -1,0 +1,104 @@
+"""SARIF 2.1.0 export (round-17 satellite).
+
+One rule per registered code (the ``--explain`` text rides as the
+rule's full description / help), one result per finding. Open
+findings are ``error``-level results; baselined and inline-suppressed
+findings are included with SARIF ``suppressions`` entries (kind
+``external`` for the justified baseline ledger, ``inSource`` for
+``# crdtlint: disable``) so a PR-annotation consumer renders exactly
+the set that fails the build while the suppressed history stays
+inspectable. The export NEVER changes exit-code semantics — it is a
+serialization of the same LintResult the CLI prints.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _result(finding, *, suppression: Dict = None) -> Dict:
+    out = {
+        "ruleId": finding.code,
+        "level": "note" if suppression else "error",
+        "message": {"text": finding.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {
+                    "uri": finding.path,
+                    "uriBaseId": "SRCROOT",
+                },
+                "region": {"startLine": max(1, finding.line)},
+            },
+        }],
+        # the baseline ledger's stable identity, so annotations
+        # survive line moves the same way the ledger does
+        "partialFingerprints": {
+            "crdtlint/v1": finding.fingerprint,
+        },
+    }
+    if suppression:
+        out["suppressions"] = [suppression]
+    return out
+
+
+def to_sarif(result, codes: Dict[str, str],
+             explain: Dict[str, str],
+             baseline: Dict[str, dict]) -> Dict:
+    """Build the SARIF log dict from a
+    :class:`tools.crdtlint.core.LintResult`."""
+    rules: List[Dict] = [
+        {
+            "id": code,
+            "shortDescription": {"text": codes[code]},
+            "fullDescription": {"text": explain.get(code, codes[code])},
+            "help": {"text": explain.get(code, codes[code])},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for code in sorted(codes)
+    ]
+    results: List[Dict] = [_result(f) for f in result.findings]
+    for f in result.baselined:
+        entry = baseline.get(f.fingerprint, {})
+        results.append(_result(f, suppression={
+            "kind": "external",
+            "justification": str(
+                entry.get("justification", "")
+            )[:1000],
+        }))
+    for f in result.suppressed:
+        results.append(_result(f, suppression={
+            "kind": "inSource",
+            "justification": "inline `# crdtlint: disable=` comment",
+        }))
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "crdtlint",
+                    # informationUri is OMITTED on purpose: the spec
+                    # requires a valid absolute URI and ingesters
+                    # (github upload-sarif) reject nonconforming
+                    # logs — a repo-relative hint here would silently
+                    # kill the whole annotation lane. README's
+                    # "Static analysis" section is the reference.
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, result, codes, explain, baseline) -> None:
+    with open(path, "w") as fh:
+        json.dump(to_sarif(result, codes, explain, baseline), fh,
+                  indent=1, sort_keys=True)
+        fh.write("\n")

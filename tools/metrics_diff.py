@@ -94,6 +94,11 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     (("lint", "open_by_family", "cl7"), False),
     (("lint", "open_by_family", "cl8"), False),
     (("lint", "open_by_family", "cl9"), False),
+    # round 17: the wire-taint (cl10) and decode-allocation (cl11)
+    # families — same count semantics and zero-default as cl7-cl9
+    # (an artifact predating round 17 means "0 open findings")
+    (("lint", "open_by_family", "cl10"), False),
+    (("lint", "open_by_family", "cl11"), False),
     # the multi-chip sharded converge (round 13, bench --multichip):
     # the boundary exchange must stay a small fraction of the staged
     # upload (bytes/fraction lower-is-better, counts so the noise
