@@ -31,11 +31,20 @@ Counters (README "Observability" registry): ``tenant.shed`` /
 ``tenant.pending_bytes`` gauge for the queue's live total,
 ``tenant.resident_evictions`` + the ``tenant.resident_bytes`` /
 ``tenant.resident_docs`` gauges for the resident-state ledger.
+Round 18: a trim with a known ``tenant=`` additionally emits the
+labeled ``tenant.shed{tenant=}`` counter and a ``tenant.shed``
+flight-recorder event (``doc``/``count``/``bytes`` fields), so a
+shed shows up attributed in the SLO ledger's route mix, the
+``/events?doc=`` filter, and an ``obsq`` query — not just as an
+anonymous aggregate.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Deque, Dict, Iterable, List, Optional, Tuple
+
+from crdt_tpu.obs.recorder import get_recorder
+from crdt_tpu.obs.tracer import get_tracer
 
 
 class TenantBudget:
@@ -46,10 +55,15 @@ class TenantBudget:
         self.max_bytes = int(max_bytes)
         self.max_updates = int(max_updates)
 
-    def trim(self, queue: Deque[bytes]) -> List[bytes]:
+    def trim(self, queue: Deque[bytes],
+             tenant=None) -> List[bytes]:
         """Shed OLDEST pending updates until ``queue`` fits the
         budget; the newest update is always kept (keep-the-newest).
-        Returns the shed blobs (callers count them)."""
+        Returns the shed blobs (callers count them). ``tenant``,
+        when given, attributes the shed: the labeled
+        ``tenant.shed{tenant=}`` counter and a ``tenant.shed``
+        flight-recorder event carry it into the SLO route mix and
+        the ``/events`` filters."""
         shed: List[bytes] = []
         size = sum(len(b) for b in queue)
         while len(queue) > 1 and (
@@ -58,6 +72,16 @@ class TenantBudget:
             old = queue.popleft()
             size -= len(old)
             shed.append(old)
+        if shed and tenant is not None:
+            nbytes = sum(len(b) for b in shed)
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.count("tenant.shed", len(shed),
+                             labels={"tenant": tenant})
+            rec = get_recorder()
+            if rec.enabled:
+                rec.record("tenant.shed", doc=str(tenant),
+                           count=len(shed), bytes=nbytes)
         return shed
 
 

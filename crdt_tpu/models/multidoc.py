@@ -93,11 +93,24 @@ on large resident docs vs the full-replay tick, ``steady.speedup``),
 both digest-asserted against the cold oracle and regression-gated in
 ``tools/metrics_diff.py``.
 
+Observability v2 (round 18): every admitted blob is SLO-stamped at
+submit; the settle path closes its ingest-to-converged clock and the
+tick end its ingest-to-served clock into the per-tenant ledger at
+:attr:`MultiDocServer.slo` (:class:`crdt_tpu.obs.slo.SLOLedger` —
+breach counters against ``slo_ms=`` / ``CRDT_TPU_SLO_MS``, burn-rate
+gauges, delta/cold/fallback/shed route mix; a shed blob is a breach
+by definition). Each tick also records its phase intervals and
+dispatch in-flight windows into the process-global tick timeline
+(:mod:`crdt_tpu.obs.timeline` — per-tick ``overlap_efficiency`` /
+``stall_ms``, Perfetto export), and both are scrapeable live via
+:class:`crdt_tpu.obs.http.ObsHTTPServer` while ``serve()`` runs.
+
 Env knobs: ``CRDT_TPU_MT_MAX_ROWS`` (dispatch row cap, default
 2^16), ``CRDT_TPU_MT_PENDING_BYTES`` / ``CRDT_TPU_MT_PENDING_UPDATES``
 (per-tenant admission budget defaults), ``CRDT_TPU_MT_RESIDENT_BYTES``
 (resident-state budget; unset = unbounded), ``CRDT_TPU_MT_DELTA_TICKS``
-(``0`` pins every tick to the round-14 full-replay path).
+(``0`` pins every tick to the round-14 full-replay path),
+``CRDT_TPU_SLO_MS`` (ingest-to-served objective, default 250).
 """
 
 from __future__ import annotations
@@ -118,6 +131,8 @@ from crdt_tpu.guard.tenant import (
 )
 from crdt_tpu.models import replay as rp
 from crdt_tpu.models.incremental import IncrementalReplay
+from crdt_tpu.obs.slo import SLOLedger
+from crdt_tpu.obs.timeline import get_timeline
 from crdt_tpu.obs.tracer import get_tracer
 from crdt_tpu.ops import packed
 
@@ -202,11 +217,18 @@ class _DocState:
                  "dirty_since", "latency_s", "served_tick",
                  "dec", "cols", "ds", "fast_ok", "stale",
                  "resident", "delta_dec", "delta_ok", "no_promote_len",
+                 "pending_ts", "in_flight_ts",
                  "_digest", "_digest_key")
 
     def __init__(self):
         self.blobs: List[bytes] = []      # admitted, converged history
         self.pending: deque = deque()     # admitted, awaiting prepare
+        # SLO stamps (round 18): one submit timestamp per pending /
+        # in-flight blob, moved in lockstep with the blob queues so
+        # the settle path can close each blob's ingest-to-converged
+        # clock and the tick end its ingest-to-served clock
+        self.pending_ts: deque = deque()
+        self.in_flight_ts: List[float] = []
         # admitted blobs a prepared decode COVERS, still unconverged.
         # Live ingest (the serve() hook) can append to ``pending``
         # while this tick's dispatches are in flight; settle moves
@@ -283,7 +305,8 @@ class MultiDocServer:
                  shards: Optional[int] = None,
                  pack_docs: bool = True,
                  delta_ticks: Optional[bool] = None,
-                 resident_max_bytes: Optional[int] = None):
+                 resident_max_bytes: Optional[int] = None,
+                 slo_ms: Optional[float] = None):
         self.max_rows = (max_rows_per_dispatch
                          if max_rows_per_dispatch is not None
                          else _env_int(_MAX_ROWS_ENV, 1 << 16))
@@ -321,6 +344,14 @@ class MultiDocServer:
         # public accessor) must not re-scan every tenant's deque on
         # each admitted blob — ingest stays O(1) per update
         self._pending_total = 0
+        # per-tenant SLO ledger (round 18): submit stamps close at
+        # settle (ingest-to-converged) and tick end (ingest-to-
+        # served); sheds fold into the breach ledger. ``slo_ms=None``
+        # reads CRDT_TPU_SLO_MS (default 250 ms).
+        self.slo = SLOLedger(slo_ms)
+        # (tenant, submit stamps) settled this tick, awaiting the
+        # tick-end served stamp
+        self._served_buf: List = []
 
     # ---- admission (the ingest side) ---------------------------------
 
@@ -329,20 +360,28 @@ class MultiDocServer:
         the tenant's pending updates were SHED to fit its budget (0 =
         admitted with room)."""
         st = self._docs.setdefault(doc_id, _DocState())
+        now = time.perf_counter()
         if st.dirty_since is None:
-            st.dirty_since = time.perf_counter()
+            st.dirty_since = now
         st.pending.append(bytes(blob))
+        st.pending_ts.append(now)
         self._pending_total += len(blob)
         st.stale = True
         tracer = get_tracer()
         if tracer.enabled:
             tracer.count("tenant.submitted")
-        shed = self.budget.trim(st.pending)
+        shed = self.budget.trim(st.pending, tenant=doc_id)
         if shed:
             nbytes = sum(len(b) for b in shed)
             self.shed_count += len(shed)
             self.shed_bytes += nbytes
             self._pending_total -= nbytes
+            # trim pops oldest-first; the stamp queue follows in
+            # lockstep, and every shed blob is an SLO breach (it will
+            # never be served)
+            for _ in shed:
+                st.pending_ts.popleft()
+            self.slo.shed(doc_id, len(shed))
             if tracer.enabled:
                 tracer.count("tenant.shed", len(shed))
                 tracer.count("tenant.shed_bytes", nbytes)
@@ -407,6 +446,8 @@ class MultiDocServer:
         if st.pending:
             st.in_flight.extend(st.pending)
             st.pending.clear()
+            st.in_flight_ts.extend(st.pending_ts)
+            st.pending_ts.clear()
 
     def _prepare_cold_one(self, st) -> None:
         self._take_pending(st)
@@ -507,11 +548,16 @@ class MultiDocServer:
         doc). One tick fully drains the dirty set — fairness decides
         WHO goes first, the row cap decides how many dispatches."""
         self.ticks += 1
-        self.prepare()
-        dirty = fair_order(self.dirty_docs(),
-                           {d: self._docs[d].served_tick
-                            for d in self._docs})
+        tl = get_timeline()
+        tl.tick_begin(self.ticks)
+        with tl.phase("prepare"):
+            self.prepare()
+        with tl.phase("fair_order"):
+            dirty = fair_order(self.dirty_docs(),
+                               {d: self._docs[d].served_tick
+                                for d in self._docs})
         if not dirty:
+            tl.tick_end()
             return TickReport(0, 0, 0, 0)
         tracer = get_tracer()
         # route decision per dirty doc. Promotion-time eviction must
@@ -526,24 +572,28 @@ class MultiDocServer:
         delta_rows = 0
         promotions = 0
         try:
-            for d in dirty:
-                st = self._docs[d]
-                if st.delta_ok and st.resident is not None:
-                    delta_rows += self._apply_delta(d)
-                    delta_served.append(d)
-                    served_set.add(d)
-                    continue
-                if st.stale:
-                    if self._try_promote(d, protect=served_set | {d}):
-                        promotions += 1
+            with tl.phase("route"):
+                for d in dirty:
+                    st = self._docs[d]
+                    if st.delta_ok and st.resident is not None:
+                        delta_rows += self._apply_delta(d)
+                        delta_served.append(d)
                         served_set.add(d)
                         continue
-                    self._prepare_cold_one(st)
-                cold.append(d)
+                    if st.stale:
+                        if self._try_promote(
+                            d, protect=served_set | {d}
+                        ):
+                            promotions += 1
+                            served_set.add(d)
+                            continue
+                        self._prepare_cold_one(st)
+                    cold.append(d)
         finally:
             self._serving = set()
-        for d in delta_served:
-            self._settle([d])
+        with tl.phase("settle"):
+            for d in delta_served:
+                self._settle([d], route="delta")
         n_delta = len(delta_served)
 
         staged = [(d, len(self._docs[d].dec["client"])) for d in cold]
@@ -560,23 +610,41 @@ class MultiDocServer:
         # point
         inflight: deque = deque()
         for batch in batches:
-            n_disp, n_fb, handle = self._converge_batch(batch)
+            with tl.phase("pack"):
+                n_disp, n_fb, handle = self._converge_batch(batch)
             dispatches += n_disp
             fallback += n_fb
             rows += sum(len(self._docs[d].dec["client"]) for d in batch)
             sizes.append(len(batch))
             if handle is not None:
-                inflight.append((batch, handle))
+                inflight.append((batch, handle, tl.dispatch_begin()))
                 hook = self._ingest_hook
                 if hook is not None:
-                    hook()  # ingest overlaps the in-flight dispatch
+                    # ingest overlaps the in-flight dispatch
+                    with tl.phase("ingest"):
+                        hook()
                 if len(inflight) > 1:
                     self._finish_batch(*inflight.popleft())
             else:
-                self._settle(batch)
+                with tl.phase("settle"):
+                    self._settle(
+                        batch, route="fallback" if n_fb else "cold"
+                    )
         while inflight:
             self._finish_batch(*inflight.popleft())
         self.rbudget.note_peak()
+        # SLO: everything settled this tick became READABLE now —
+        # the ingest-to-served clock closes at the tick boundary,
+        # not at each batch's settle (a reader sees tick state)
+        if self._served_buf:
+            t_served = time.perf_counter()
+            for tenant, stamps in self._served_buf:
+                self.slo.served(
+                    tenant, (t_served - t for t in stamps)
+                )
+            self._served_buf.clear()
+            self.slo.publish_worst()
+        tl.tick_end()
         if tracer.enabled:
             tracer.count("tenant.docs_converged", len(dirty))
             tracer.gauge("tenant.dispatch_docs",
@@ -841,24 +909,41 @@ class MultiDocServer:
             return len(live), len(live), None
         return 1, 0, (live, comb, row_off, handle)
 
-    def _finish_batch(self, batch, work) -> None:
+    def _finish_batch(self, batch, work, tok=None) -> None:
         """Fetch one in-flight batch dispatch, unpack per doc, stamp
-        latencies/service bookkeeping."""
+        latencies/service bookkeeping. ``tok`` closes the dispatch's
+        timeline window; the fetch span is the tick's stall."""
         from crdt_tpu.ops import shard as shard_ops
 
         live, comb, row_off, (route, h) = work
         fetch = (shard_ops.converge_fetch if route == "shard"
                  else packed.converge_fetch)
-        self._unpack(live, comb, row_off, fetch(h))
-        self._settle(batch)
+        tl = get_timeline()
+        t0 = time.perf_counter()
+        res = fetch(h)
+        t1 = time.perf_counter()
+        tl.dispatch_end(tok, t0, t1)
+        with tl.phase("unpack"):
+            self._unpack(live, comb, row_off, res)
+        with tl.phase("settle"):
+            self._settle(batch)
 
-    def _settle(self, batch) -> None:
+    def _settle(self, batch, route: str = "cold") -> None:
         done = time.perf_counter()
         for d in batch:
             st = self._docs[d]
             self._pending_total -= sum(len(b) for b in st.in_flight)
             st.blobs.extend(st.in_flight)
             st.in_flight.clear()
+            if st.in_flight_ts:
+                # SLO: ingest-to-converged closes here per blob; the
+                # submit stamps park until the tick end stamps
+                # ingest-to-served (state readable)
+                self.slo.converged(
+                    d, (done - t for t in st.in_flight_ts), route,
+                )
+                self._served_buf.append((d, tuple(st.in_flight_ts)))
+                st.in_flight_ts.clear()
             if st.dirty_since is not None:
                 st.latency_s = done - st.dirty_since
             st.served_tick = self.ticks

@@ -59,6 +59,7 @@ from crdt_tpu.codec import native
 from crdt_tpu.models import replay as rp
 from crdt_tpu.models.replay import ReplayResult
 from crdt_tpu.obs.profiling import device_annotation
+from crdt_tpu.obs.timeline import get_timeline
 from crdt_tpu.obs.tracer import get_tracer
 
 # default pipeline depth targets: enough chunks that decode streams,
@@ -318,6 +319,12 @@ def stream_replay(
 
     t_wall0 = time.perf_counter()
     ph = _Phases()
+    # tick-timeline hook (round 18): a scale run renders on the same
+    # Perfetto timeline as a serve() run — one "stream" tick whose
+    # dispatch windows are the per-shard async converges, with the
+    # executor's per-stage busy sums as extra lanes at tick end
+    tl = get_timeline()
+    tl.tick_begin(0, label="stream")
     blobs = list(blobs)
     if chunk_blobs is None:
         chunk_blobs = max(1, -(-len(blobs) // _DECODE_CHUNKS))
@@ -433,9 +440,11 @@ def stream_replay(
                 unstageable = True
                 break
             (eng, handle), t_enq = payload
+            tok = tl.dispatch_begin(t=t_enq)
             t0 = time.perf_counter()
             res = eng.converge_fetch(handle)  # the shard's ONE sync
             t1 = time.perf_counter()
+            tl.dispatch_end(tok, t0, t1)
             ph.add("converge_wait", t1 - t0)
             # device-lane occupancy: this shard's span, net of any
             # part that overlapped the previous shard's execution
@@ -501,6 +510,7 @@ def stream_replay(
             phases.update({k: round(v, 4) for k, v in ph.t.items()})
             phases.update(overlap_stats(ph.t, wall))
             phases["fallback"] = True
+        tl.tick_end(extra_busy=_timeline_lanes(ph))
         return ReplayResult(
             cache=cache, snapshot=snap, n_ops=n,
             path="stream-fallback",
@@ -511,6 +521,19 @@ def stream_replay(
     if phases is not None:
         phases.update({k: round(v, 4) for k, v in ph.t.items()})
         phases.update(overlap_stats(ph.t, wall))
+    tl.tick_end(extra_busy=_timeline_lanes(ph))
     return ReplayResult(
         cache=cache, snapshot=snap_box["snap"], n_ops=n, path="stream"
     )
+
+
+def _timeline_lanes(ph: _Phases) -> Dict[str, float]:
+    """The executor's host-stage busy sums as timeline lanes. The
+    device lane is already covered exactly by the per-shard dispatch
+    windows the consumer recorded, so the wall-clock ``converge``
+    charge and the blocked-wait diagnostic are excluded (they would
+    double-count the device's occupancy into the busy sum)."""
+    return {
+        k: v for k, v in ph.t.items()
+        if k not in ("converge", *_IDLE_PHASES)
+    }

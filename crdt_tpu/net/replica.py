@@ -721,9 +721,19 @@ class Replica:
                 rec.record(
                     "update.send", topic=self.topic,
                     replica=self.router.public_key, size=len(update),
-                    digest=update_digest(update), tid=tid,
+                    digest=update_digest(update), tid=tid, hop=0,
                 )
-            self._propagate({"update": update, "tid": tid, **meta})
+            # hop count (round 18): 0 at the origin, so a direct
+            # receiver records hop=1. Server-generated frames (sync
+            # answers, AE deltas) are NEW diffs, not forwarded
+            # frames — they carry no tid/hop and record as
+            # "unknown". No in-tree tier forwards a frame verbatim
+            # yet; the field is the contract the ROADMAP item-2
+            # fleet relay increments when it does (obsq already
+            # reads the hop distribution off send/recv pairs).
+            self._propagate(
+                {"update": update, "tid": tid, "hop": 0, **meta}
+            )
             self._advance_topic_peer_svs()
             self._reset_ae_backoff()  # fresh writes: stay chatty
 
@@ -991,6 +1001,12 @@ class Replica:
             t_done = time.monotonic()
             for u, m, from_pk in items:
                 tid = m.get("tid")
+                # hop count (round 18): the frame's hop stamp + this
+                # delivery leg. Frames predating the stamp (an older
+                # peer) read as one unattributed hop — None, not a
+                # guessed 1, so obsq can tell "unknown" from "direct".
+                raw_hop = m.get("hop")
+                hop = raw_hop + 1 if isinstance(raw_hop, int) else None
                 if tracer.enabled and isinstance(tid, (list, tuple)) \
                         and len(tid) == 3:
                     t0 = float(tid[2])
@@ -1005,6 +1021,7 @@ class Replica:
                         "update.recv", topic=self.topic,
                         replica=self.router.public_key, peer=from_pk,
                         size=len(u), digest=update_digest(u), tid=tid,
+                        hop=hop,
                     )
         for u in updates:
             tracer.count("replica.updates_applied")

@@ -1,4 +1,6 @@
-"""Observability subsystem: tracer, flight recorder, sentinel, export.
+"""Observability subsystem: tracer, flight recorder, sentinel, export,
+and the round-18 serving surfaces (SLO ledger, tick timeline, HTTP
+scrape endpoint).
 
 The framework's evidence layer (ROADMAP north star: converging 1k
 replicas x 100k ops needs to be *seen*, not just claimed):
@@ -15,15 +17,29 @@ replicas x 100k ops needs to be *seen*, not just claimed):
   divergence (equal state vectors, unequal state) into an observable
   event carrying a flight-recorder dump.
 - :mod:`crdt_tpu.obs.export` — Prometheus text-format exposition and
-  the JSON snapshot (the same schema as ``Tracer.report()``).
+  the JSON snapshot (the same schema as ``Tracer.report()``), with
+  deterministic disambiguation of sanitization collisions.
+- :mod:`crdt_tpu.obs.slo` — per-tenant SLO accounting for the
+  serving path: ingest-to-converged / ingest-to-served latency
+  histograms, breach counters against a configurable objective
+  (``CRDT_TPU_SLO_MS``), burn-rate gauges, route-mix counters.
+- :mod:`crdt_tpu.obs.timeline` — the tick-timeline profiler: a
+  bounded ring of per-tick phase records with dispatch in-flight
+  windows, per-tick ``overlap_efficiency`` / ``stall_ms``, exported
+  as Chrome/Perfetto trace-event JSON.
+- :mod:`crdt_tpu.obs.http` — stdlib-only scrape endpoint
+  (``/metrics`` / ``/snapshot`` / ``/events`` / ``/timeline``).
 - :mod:`crdt_tpu.obs.profiling` — ``jax_profile`` (device trace
   capture that cannot leak a running profiler) and per-dispatch
   ``device_annotation`` XProf annotations.
 
-See README "Observability" for the metric/span/event name registry.
+See README "Observability" / "Observability v2" for the
+metric/span/event name registry; ``tools/obsq.py`` is the offline
+query CLI over flight-recorder dumps.
 """
 
 from crdt_tpu.obs.export import snapshot_json, to_prometheus
+from crdt_tpu.obs.http import ObsHTTPServer
 from crdt_tpu.obs.profiling import device_annotation, jax_profile
 from crdt_tpu.obs.recorder import (
     FlightRecorder,
@@ -36,19 +52,27 @@ from crdt_tpu.obs.sentinel import (
     delete_set_digest,
     state_digest,
 )
-from crdt_tpu.obs.tracer import Tracer, get_tracer, set_tracer
+from crdt_tpu.obs.slo import SLOLedger
+from crdt_tpu.obs.timeline import TickTimeline, get_timeline, set_timeline
+from crdt_tpu.obs.tracer import Histogram, Tracer, get_tracer, set_tracer
 
 __all__ = [
     "DivergenceSentinel",
     "MultiDocSentinel",
     "FlightRecorder",
+    "Histogram",
+    "ObsHTTPServer",
+    "SLOLedger",
+    "TickTimeline",
     "Tracer",
     "delete_set_digest",
     "device_annotation",
     "get_recorder",
+    "get_timeline",
     "get_tracer",
     "jax_profile",
     "set_recorder",
+    "set_timeline",
     "set_tracer",
     "snapshot_json",
     "state_digest",

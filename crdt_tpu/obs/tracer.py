@@ -51,7 +51,13 @@ def bucket_index(seconds: float) -> int:
     return bisect_left(BUCKET_EDGES_S, seconds)
 
 
-class _Span:
+class Histogram:
+    """Log2-bucketed duration aggregate (the span accumulator).
+
+    Public since round 18: the SLO ledger (:mod:`crdt_tpu.obs.slo`)
+    keeps per-tenant latency histograms on exactly these edges, so a
+    scrape and an SLO report bucket identically."""
+
     __slots__ = ("count", "total_s", "max_s", "min_s", "buckets")
 
     def __init__(self):
@@ -71,13 +77,38 @@ class _Span:
         b = bucket_index(dt)
         self.buckets[b] = self.buckets.get(b, 0) + 1
 
+    def summary(self) -> Dict[str, Any]:
+        """The per-span report dict (shared by ``Tracer.report()`` and
+        the SLO ledger's per-tenant summaries)."""
+        return {
+            "count": self.count,
+            "total_s": self.total_s,
+            "mean_s": self.total_s / self.count if self.count else 0.0,
+            "max_s": self.max_s,
+            "min_s": self.min_s if self.count else 0.0,
+            "p50_s": self.quantile(0.50),
+            "p90_s": self.quantile(0.90),
+            "p99_s": self.quantile(0.99),
+            "buckets": {
+                (
+                    f"{BUCKET_EDGES_S[b]:.9g}"
+                    if b < _OVERFLOW else "+Inf"
+                ): n
+                for b, n in sorted(self.buckets.items())
+            },
+        }
+
     def quantile(self, q: float) -> float:
         """Bucket-resolution quantile: the upper edge of the bucket
         holding the q-rank observation, clamped to the observed max
-        (so p99 never reports above the true maximum)."""
+        (so p99 never reports above the true maximum). Edge
+        semantics (pinned in test_obs): an empty histogram answers
+        0.0 for every q; ``q=0`` is the rank-1 (minimum-bucket)
+        estimate; ``q>=1`` is the observed max; a single observation
+        answers that observation at every q."""
         if not self.count:
             return 0.0
-        rank = max(1, int(q * self.count + 0.5))
+        rank = max(1, min(self.count, int(q * self.count + 0.5)))
         cum = 0
         for b in sorted(self.buckets):
             cum += self.buckets[b]
@@ -87,6 +118,11 @@ class _Span:
                 )
                 return min(edge, self.max_s)
         return self.max_s
+
+
+# legacy alias: subclassers of the round-8 tracer reached the span
+# accumulator under this name (MIGRATING "Tracer subclassers")
+_Span = Histogram
 
 
 # shared no-op context manager: the disabled-tracer span (stdlib
@@ -110,10 +146,26 @@ class _LiveSpan:
         return False
 
 
+def _esc_label(value: Any) -> str:
+    """Prometheus exposition label-value escaping (backslash, quote,
+    newline). Label values are caller-controlled since round 18 (doc
+    ids become ``tenant=`` labels) — an unescaped ``"`` or newline
+    would corrupt the whole /metrics scrape, and a newline could
+    inject arbitrary exposition lines."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _labeled(name: str, labels: Optional[Dict[str, Any]]) -> str:
     if not labels:
         return name
-    inner = ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+    inner = ",".join(
+        f'{k}="{_esc_label(labels[k])}"' for k in sorted(labels)
+    )
     return f"{name}{{{inner}}}"
 
 
@@ -143,8 +195,16 @@ class Tracer:
         with self._lock:
             s = self._spans.get(name)
             if s is None:
-                s = self._spans[name] = _Span()
+                s = self._spans[name] = Histogram()
             s.add(seconds)
+
+    def quantile(self, name: str, q: float) -> float:
+        """Bucket-resolution quantile of one span's histogram (0.0
+        for a span never observed — the always-on serving path must
+        be able to probe a quantile without try/except)."""
+        with self._lock:
+            s = self._spans.get(name)
+            return s.quantile(q) if s is not None else 0.0
 
     # -- counters / gauges ----------------------------------------------
     def count(self, name: str, n: int = 1,
@@ -184,24 +244,7 @@ class Tracer:
         embedded evidence all read."""
         with self._lock:
             spans = {
-                k: {
-                    "count": s.count,
-                    "total_s": s.total_s,
-                    "mean_s": s.total_s / s.count if s.count else 0.0,
-                    "max_s": s.max_s,
-                    "min_s": s.min_s if s.count else 0.0,
-                    "p50_s": s.quantile(0.50),
-                    "p90_s": s.quantile(0.90),
-                    "p99_s": s.quantile(0.99),
-                    "buckets": {
-                        (
-                            f"{BUCKET_EDGES_S[b]:.9g}"
-                            if b < _OVERFLOW else "+Inf"
-                        ): n
-                        for b, n in sorted(s.buckets.items())
-                    },
-                }
-                for k, s in sorted(self._spans.items())
+                k: s.summary() for k, s in sorted(self._spans.items())
             }
             return {
                 "spans": spans,

@@ -16,6 +16,7 @@ instrumentation cannot silently rot out of the hot path.
 
 import json
 import os
+import pathlib
 import subprocess
 import sys
 
@@ -30,7 +31,13 @@ HOT_PATH_SPANS = (
 
 
 def test_bench_smoke_mode(tmp_path):
-    art = tmp_path / "smoke_bench_out.json"
+    # CI points BENCH_SMOKE_ARTIFACT at the workspace so THIS run's
+    # obs snapshot uploads as the workflow artifact — the smoke is
+    # expensive enough that CI must not run it a second time just to
+    # place the file somewhere known
+    art = (pathlib.Path(os.environ["BENCH_SMOKE_ARTIFACT"])
+           if os.environ.get("BENCH_SMOKE_ARTIFACT")
+           else tmp_path / "smoke_bench_out.json")
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial a tunnel
     env["JAX_PLATFORMS"] = "cpu"
@@ -142,6 +149,29 @@ def test_bench_smoke_mode(tmp_path):
         assert report["counters"].get(cname, 0) > 0, cname
     assert "tenant.resident_bytes" in report["gauges"]
     assert "tenant.resident_docs" in report["gauges"]
+
+    # the round-18 observability-v2 registries: the SLO ledger lit
+    # breaches/burn-rate/route-mix (the chaos flood leg runs with
+    # slo_ms=0 and shed==breach is asserted inside the leg), the
+    # tick timeline recorded the multitenant ticks with live
+    # overlap/stall gauges and a schema-valid Perfetto export, and
+    # the disabled-tracer span cost stays pinned (obs is free when
+    # off — the measured bound is generous for CI boxes)
+    assert out.get("slo_registry_ok") is True
+    assert out.get("timeline_registry_ok") is True
+    assert report["counters"].get("slo.breaches", 0) > 0
+    assert "slo.burn_rate" in report["gauges"]
+    assert any(k.startswith("slo.route_shed{")
+               for k in report["counters"]), "route mix missing"
+    for sname in ("slo.ingest_to_converged", "slo.ingest_to_served"):
+        span = report["spans"].get(sname)
+        assert span is not None and span["count"] > 0, sname
+    assert report["counters"].get("timeline.ticks", 0) > 0
+    assert "timeline.overlap_efficiency" in report["gauges"]
+    assert "timeline.stall_ms" in report["gauges"]
+    assert isinstance(out.get("obs_disabled_span_ns"), (int, float))
+    assert out["obs_disabled_span_ns"] < 5000
+    assert out["multitenant"]["steady"]["slo_ms"] > 0
 
     # the guard-layer registry (README "Overload & failure policy"):
     # (kernel_ablation_leg is pinned in-process below — the smoke

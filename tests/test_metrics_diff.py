@@ -547,3 +547,85 @@ def test_lint_open_by_family_gates_against_pre_round16_artifact():
     rows, regressed = compare(old, wired)
     assert "lint.open_by_family.cl10" in regressed
     assert "lint.open_by_family.cl11" not in regressed
+
+
+def test_slo_and_timeline_gates_direction_aware():
+    """Round 18: slo.breaches and timeline.stall_ms regress when they
+    RISE; timeline.overlap_efficiency regresses when it FALLS (the
+    double-buffer re-serialized). All direction-aware, none muted by
+    the seconds noise floor except stall (ms-denominated)."""
+    old = {"tracer": {
+        "counters": {"slo.breaches": 10},
+        "gauges": {"timeline.stall_ms": 100.0,
+                   "timeline.overlap_efficiency": 0.8},
+    }}
+    good = copy.deepcopy(old)
+    rows, regressed = compare(old, good)
+    assert regressed == []
+    names = {r["metric"] for r in rows}
+    assert {"tracer.slo.breaches", "tracer.timeline.stall_ms",
+            "tracer.timeline.overlap_efficiency"} <= names
+
+    bad = copy.deepcopy(old)
+    bad["tracer"]["counters"]["slo.breaches"] = 20        # +100%
+    bad["tracer"]["gauges"]["timeline.stall_ms"] = 200.0  # +100%
+    bad["tracer"]["gauges"]["timeline.overlap_efficiency"] = 0.4
+    rows, regressed = compare(old, bad, threshold=0.2)
+    assert "tracer.slo.breaches" in regressed
+    assert "tracer.timeline.stall_ms" in regressed
+    assert "tracer.timeline.overlap_efficiency" in regressed
+
+    # the opposite directions are improvements, never failures
+    better = copy.deepcopy(old)
+    better["tracer"]["counters"]["slo.breaches"] = 0
+    better["tracer"]["gauges"]["timeline.stall_ms"] = 10.0
+    better["tracer"]["gauges"]["timeline.overlap_efficiency"] = 0.99
+    rows, regressed = compare(old, better)
+    assert regressed == []
+    by_name = {r["metric"]: r for r in rows}
+    assert by_name["tracer.timeline.overlap_efficiency"][
+        "verdict"] == "improved"
+
+
+def test_timeline_stall_respects_ms_noise_floor():
+    # a 3x jump on a sub-millisecond stall is scheduler noise; the
+    # same jump at tens of ms is a real pipeline regression
+    old = {"tracer": {"gauges": {"timeline.stall_ms": 0.5}}}
+    new = {"tracer": {"gauges": {"timeline.stall_ms": 1.5}}}
+    rows, regressed = compare(old, new)
+    assert regressed == []
+    assert any(r["verdict"] == "noise" for r in rows)
+    old = {"tracer": {"gauges": {"timeline.stall_ms": 50.0}}}
+    new = {"tracer": {"gauges": {"timeline.stall_ms": 150.0}}}
+    _, regressed = compare(old, new)
+    assert "tracer.timeline.stall_ms" in regressed
+
+
+def test_multitenant_obs_v2_section_keys_gated():
+    """The run-stable artifact keys the --multitenant harness embeds:
+    mean overlap (higher), total stall (lower, ms noise floor), and
+    the chaos flooder's DETERMINISTIC breach count (lower) — not the
+    default-objective legs' wall-clock totals, whose 0 baseline
+    would make one slow-machine miss an infinite-delta failure."""
+    old = {"multitenant": {
+        "timeline": {"mean_overlap_efficiency": 0.6,
+                     "stall_ms_total": 80.0},
+        "flood": {"slo_flooder": {"breaches": 19}},
+    }}
+    bad = {"multitenant": {
+        "timeline": {"mean_overlap_efficiency": 0.2,
+                     "stall_ms_total": 200.0},
+        "flood": {"slo_flooder": {"breaches": 40}},
+    }}
+    _, regressed = compare(old, bad, threshold=0.2)
+    assert "multitenant.timeline.mean_overlap_efficiency" in regressed
+    assert "multitenant.timeline.stall_ms_total_ms" in regressed
+    assert "multitenant.flood.slo_flooder.breaches" in regressed
+    _, regressed = compare(old, copy.deepcopy(old))
+    assert regressed == []
+    # stall_ms_total is wall-clock: a sub-floor wobble is noise
+    tiny_old = {"multitenant": {"timeline": {"stall_ms_total": 0.8}}}
+    tiny_bad = {"multitenant": {"timeline": {"stall_ms_total": 2.4}}}
+    rows, regressed = compare(tiny_old, tiny_bad)
+    assert regressed == []
+    assert any(r["verdict"] == "noise" for r in rows)

@@ -1,6 +1,10 @@
 """The observability layer (crdt_tpu/obs): histogram math, tracer
 thread-safety, flight recorder, Prometheus exposition, divergence
-sentinel, trace-id propagation, jax_profile hardening."""
+sentinel, trace-id propagation, jax_profile hardening — and the
+round-18 serving surfaces: per-tenant SLO ledger (breach exactness
+under the seeded flood), tick-timeline profiler (ring wraparound +
+Perfetto schema), the HTTP scrape endpoint (live during serve()),
+and the obsq CLI round-trip."""
 
 import json
 import sys
@@ -507,3 +511,693 @@ class TestTraceIdPropagation:
 
         run(forked=False)
         run(forked=True)
+
+
+# ---------------------------------------------------------------------------
+# round 18: tracer hardening (quantile edges, disabled-path freedom)
+# ---------------------------------------------------------------------------
+
+
+class TestTracerEdges:
+    def test_quantile_unknown_span_is_zero(self):
+        assert Tracer(enabled=True).quantile("nothing", 0.5) == 0.0
+
+    def test_quantile_edges_single_sample(self):
+        tr = Tracer(enabled=True)
+        tr.observe("x", 3e-3)
+        # one observation answers itself at EVERY q, 0 and 1 included
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert tr.quantile("x", q) == 3e-3
+
+    def test_quantile_q0_and_q1(self):
+        tr = Tracer(enabled=True)
+        for v in (1e-6, 1e-3, 1.0):
+            tr.observe("x", v)
+        # q=0 is the rank-1 (minimum-bucket) estimate: the first
+        # bucket's upper edge, never above the min's bucket edge
+        assert tr.quantile("x", 0.0) <= 2e-6
+        # q=1 (and beyond) is the observed max exactly
+        assert tr.quantile("x", 1.0) == 1.0
+        assert tr.quantile("x", 2.0) == 1.0
+
+    def test_observe_disabled_records_nothing(self):
+        tr = Tracer(enabled=False)
+        tr.observe("x", 1.0)
+        tr.count("c")
+        tr.gauge("g", 2.0)
+        rep = tr.report()
+        assert rep["spans"] == {} and rep["counters"] == {} \
+            and rep["gauges"] == {}
+
+    def test_disabled_span_is_shared_object(self):
+        tr = Tracer(enabled=False)
+        assert tr.span("a") is tr.span("b")
+
+    def test_histogram_summary_matches_report(self):
+        from crdt_tpu.obs.tracer import Histogram
+
+        tr = Tracer(enabled=True)
+        h = Histogram()
+        for v in (1e-5, 2e-4, 3e-3):
+            tr.observe("x", v)
+            h.add(v)
+        assert tr.report()["spans"]["x"] == h.summary()
+
+
+# ---------------------------------------------------------------------------
+# round 18: Prometheus sanitization-collision disambiguation
+# ---------------------------------------------------------------------------
+
+
+class TestPrometheusCollisions:
+    def test_distinct_keys_never_merge(self):
+        import zlib
+
+        tr = Tracer(enabled=True)
+        tr.count("guard.a-b", 3)
+        tr.count("guard.a_b", 4)
+        text = to_prometheus(tr.report())
+        # both raw keys sanitize to crdt_guard_a_b: each colliding
+        # member gets its deterministic crc32 suffix, no silent merge
+        tag1 = zlib.crc32(b"counters:guard.a-b") & 0xFFFFFFFF
+        tag2 = zlib.crc32(b"counters:guard.a_b") & 0xFFFFFFFF
+        assert f"crdt_guard_a_b_{tag1:08x} 3" in text
+        assert f"crdt_guard_a_b_{tag2:08x} 4" in text
+        assert "\ncrdt_guard_a_b 3" not in text
+        assert "\ncrdt_guard_a_b 4" not in text
+        # deterministic: a fresh render is byte-identical
+        assert to_prometheus(tr.report()) == text
+
+    def test_counter_gauge_name_clash_disambiguated(self):
+        tr = Tracer(enabled=True)
+        tr.count("depth", 1)
+        tr.gauge("depth", 2.0)
+        text = to_prometheus(tr.report())
+        # pre-fix this emitted TWO TYPE lines for crdt_depth (a fatal
+        # exposition parse error); now each section owns its series
+        names = [
+            ln.split()[2] for ln in text.splitlines()
+            if ln.startswith("# TYPE")
+        ]
+        assert len(names) == len(set(names))
+        assert any(n.startswith("crdt_depth_") for n in names)
+
+    def test_collision_free_names_unchanged(self):
+        tr = Tracer(enabled=True)
+        tr.count("tenant.shed", 5)
+        tr.gauge("tenant.pending_bytes", 7)
+        with tr.span("converge.dispatch"):
+            pass
+        text = to_prometheus(tr.report())
+        assert "crdt_tenant_shed 5" in text
+        assert "crdt_tenant_pending_bytes 7" in text
+        assert "crdt_converge_dispatch_seconds_count 1" in text
+
+    def test_labeled_variants_share_one_series(self):
+        tr = Tracer(enabled=True)
+        tr.count("slo.breaches", 1, labels={"tenant": "a"})
+        tr.count("slo.breaches", 2, labels={"tenant": "b"})
+        text = to_prometheus(tr.report())
+        assert text.count("# TYPE crdt_slo_breaches counter") == 1
+        assert 'crdt_slo_breaches{tenant="a"} 1' in text
+        assert 'crdt_slo_breaches{tenant="b"} 2' in text
+
+
+# ---------------------------------------------------------------------------
+# round 18: per-tenant SLO ledger
+# ---------------------------------------------------------------------------
+
+
+class TestSLOLedger:
+    def test_breach_counting_and_routes(self, installed):
+        from crdt_tpu.obs.slo import SLOLedger
+
+        led = SLOLedger(slo_ms=10.0)
+        led.converged("t1", [0.001, 0.020], "delta")
+        led.served("t1", [0.001, 0.020])  # one over the 10ms bar
+        led.shed("t1", 3)                 # sheds are breaches
+        rep = led.report()
+        t1 = rep["tenants"]["t1"]
+        assert t1["breaches"] == 1 + 3
+        assert t1["routes"] == {"delta": 1, "cold": 0,
+                                "fallback": 0, "shed": 3}
+        assert t1["ingest_to_served"]["count"] == 2
+        assert t1["ingest_to_converged"]["count"] == 2
+        # window: [F, T, T, T, T] -> burn 0.8
+        assert t1["burn_rate"] == 0.8
+        assert rep["total_breaches"] == 4
+        tr, _ = installed
+        assert tr.counters()["slo.breaches"] == 4
+        assert tr.counters()['slo.breaches{tenant="t1"}'] == 4
+        assert tr.counters()['slo.route_shed{tenant="t1"}'] == 3
+
+    def test_env_objective(self, monkeypatch):
+        from crdt_tpu.obs.slo import SLOLedger
+
+        monkeypatch.setenv("CRDT_TPU_SLO_MS", "5")
+        assert SLOLedger().slo_ms == 5.0
+        monkeypatch.setenv("CRDT_TPU_SLO_MS", "garbage")
+        assert SLOLedger().slo_ms == 250.0
+
+    def test_zero_objective_breaches_everything(self):
+        from crdt_tpu.obs.slo import SLOLedger
+
+        led = SLOLedger(slo_ms=0.0)
+        led.served("t", [1e-9, 1e-6])
+        assert led.breaches("t") == 2
+
+    def test_flood_breaches_pin_admission_oracle(self, installed):
+        """The acceptance exactness pin: under the seeded round-14
+        flood, the flooding tenant's breach count equals its shed
+        count equals the admission oracle (submitted minus the
+        admitted suffix the budget kept), while every neighbor shows
+        ZERO breaches — diagnosable from the ledger alone."""
+        from crdt_tpu.models.multidoc import MultiDocServer
+
+        from crdt_tpu.codec import v1
+        from crdt_tpu.core.ids import DeleteSet
+        from crdt_tpu.core.records import ItemRecord
+
+        def blob(c, k0, n=4):
+            return v1.encode_update(
+                [ItemRecord(client=c, clock=k0 + i, parent_root="m",
+                            key=f"k{i}", content=k0 + i)
+                 for i in range(n)],
+                DeleteSet(),
+            )
+
+        # a generous objective: nothing served on time breaches, so
+        # EVERY breach is a shed — exactly countable
+        srv = MultiDocServer(tenant_max_pending_bytes=1 << 20,
+                             tenant_max_pending_updates=4,
+                             slo_ms=1e9)
+        neighbors = [f"n{i}" for i in range(3)]
+        for i, d in enumerate(neighbors):
+            assert srv.submit(d, blob(10 + i, 0)) == 0
+        flooder = "flood!"
+        submitted, shed_oracle = 0, 0
+        for j in range(23):  # 23 blobs into a 4-update budget
+            shed_oracle += srv.submit(flooder, blob(99, j * 4))
+            submitted += 1
+        assert shed_oracle == submitted - 4  # keep-the-newest suffix
+        srv.tick()
+        assert not srv.dirty_docs()
+        assert srv.slo.breaches(flooder) == shed_oracle
+        routes = srv.slo.route_counts(flooder)
+        assert routes["shed"] == shed_oracle
+        rep = srv.slo.report()
+        ften = rep["tenants"][flooder]
+        # the admitted suffix (4 blobs) was served, late never
+        assert ften["ingest_to_served"]["count"] == 4
+        for d in neighbors:
+            assert srv.slo.breaches(d) == 0
+            assert rep["tenants"][d]["breaches"] == 0
+            assert rep["tenants"][d]["ingest_to_served"]["count"] == 1
+        # the labeled shed attribution rode the guard layer too
+        tr, rec = installed
+        key = 'tenant.shed{tenant=%s}' % '"flood!"'
+        assert tr.counters()[key] == shed_oracle
+        shed_events = rec.events("tenant.shed")
+        assert shed_events and all(
+            e["doc"] == flooder for e in shed_events
+        )
+        assert sum(e["count"] for e in shed_events) == shed_oracle
+
+
+# ---------------------------------------------------------------------------
+# round 18: tick-timeline profiler
+# ---------------------------------------------------------------------------
+
+
+def _fake_tick(tl, base, i):
+    """One synthetic tick with two overlapping dispatch windows."""
+    tl.tick_begin(i)
+    t0 = tl._cur["t0"]
+    tl.add_phase("pack", t0, t0 + 0.010)
+    a = tl.dispatch_begin(t=t0 + 0.010)
+    tl.add_phase("pack", t0 + 0.012, t0 + 0.020)
+    b = tl.dispatch_begin(t=t0 + 0.020)
+    tl.dispatch_end(a, t0 + 0.022, t0 + 0.030)
+    tl.dispatch_end(b, t0 + 0.030, t0 + 0.041)
+    return tl.tick_end()
+
+
+class TestTickTimeline:
+    def test_disabled_is_noop(self):
+        from crdt_tpu.obs.timeline import TickTimeline
+
+        tl = TickTimeline(enabled=False)
+        tl.tick_begin(1)
+        with tl.phase("x"):
+            pass
+        assert tl.dispatch_begin() is None
+        assert tl.tick_end() is None
+        assert len(tl) == 0
+        # the disabled phase() is one shared object — no allocation
+        assert tl.phase("a") is tl.phase("b")
+
+    def test_ring_wraparound_keeps_newest(self):
+        from crdt_tpu.obs.timeline import TickTimeline
+
+        tl = TickTimeline(capacity=4, enabled=True)
+        for i in range(10):
+            tl.tick_begin(i)
+            with tl.phase("p"):
+                pass
+            tl.tick_end()
+        assert len(tl) == 4
+        assert tl.recorded == 10
+        assert [r["tick"] for r in tl.records()] == [6, 7, 8, 9]
+
+    def test_overlap_and_stall_accounting(self):
+        from crdt_tpu.obs.timeline import TickTimeline
+
+        tl = TickTimeline(enabled=True)
+        rec = _fake_tick(tl, 0.0, 1)
+        # stall = the two fetch waits: 8ms + 11ms
+        assert rec["stall_ms"] == pytest.approx(19.0, abs=0.2)
+        # lanes: pack 18ms + merged dispatch window [10,41]=31ms;
+        # busy 49ms over a ~41ms wall -> efficiency strictly > 0
+        assert rec["lanes"]["pack"] == pytest.approx(0.018, abs=1e-6)
+        assert rec["lanes"]["dispatch"] == pytest.approx(
+            0.031, abs=1e-6
+        )
+        assert rec["overlap_efficiency"] > 0.0
+        assert len(rec["dispatches"]) == 2
+
+    def test_overlap_of_bounds(self):
+        from crdt_tpu.obs.timeline import overlap_of
+
+        # fully serial: wall == busy sum
+        assert overlap_of({"a": 1.0, "b": 1.0}, 2.0) == 0.0
+        # fully hidden: wall == longest lane
+        assert overlap_of({"a": 1.0, "b": 1.0}, 1.0) == 1.0
+        # degenerate single lane: clamped, not divide-by-zero
+        assert overlap_of({"a": 1.0}, 1.0) == 1.0
+        assert overlap_of({}, 0.5) == 0.0
+
+    def test_perfetto_schema(self):
+        from crdt_tpu.obs.timeline import TickTimeline
+
+        tl = TickTimeline(enabled=True)
+        for i in range(3):
+            _fake_tick(tl, 0.0, i)
+        pf = tl.to_perfetto()
+        assert set(pf) == {"traceEvents", "displayTimeUnit"}
+        evs = pf["traceEvents"]
+        assert evs, "no events exported"
+        for ev in evs:
+            for k in ("name", "ph", "ts", "pid", "tid"):
+                assert k in ev, (ev, k)
+            assert ev["ph"] in ("X", "M", "C")
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0
+        # dispatch windows live on the device track (tid 2)
+        disp = [e for e in evs
+                if e["ph"] == "X" and e["name"].startswith("dispatch")]
+        assert disp and all(e["tid"] == 2 for e in disp)
+        # the whole thing is valid JSON end to end
+        assert json.loads(tl.perfetto_json())["traceEvents"]
+
+    def test_perfetto_json_writes_file(self, tmp_path):
+        from crdt_tpu.obs.timeline import TickTimeline
+
+        tl = TickTimeline(enabled=True)
+        _fake_tick(tl, 0.0, 0)
+        p = tmp_path / "trace.json"
+        text = tl.perfetto_json(str(p))
+        assert p.read_text() == text
+
+
+# ---------------------------------------------------------------------------
+# round 18: HTTP scrape endpoint + the serve() acceptance run
+# ---------------------------------------------------------------------------
+
+
+def _mt_blob(c, k0, n=4):
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.ids import DeleteSet
+    from crdt_tpu.core.records import ItemRecord
+
+    return v1.encode_update(
+        [ItemRecord(client=c, clock=k0 + i, parent_root="m",
+                    key=f"k{i}", content=k0 + i)
+         for i in range(n)],
+        DeleteSet(),
+    )
+
+
+@pytest.fixture
+def timeline_installed():
+    from crdt_tpu.obs import TickTimeline, get_timeline, set_timeline
+
+    old = get_timeline()
+    tl = set_timeline(TickTimeline(enabled=True))
+    try:
+        yield tl
+    finally:
+        set_timeline(old)
+
+
+class TestObsHTTP:
+    def _get(self, url):
+        from urllib.request import urlopen
+
+        with urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+
+    def test_endpoints_smoke(self, installed, timeline_installed):
+        from crdt_tpu.obs import ObsHTTPServer
+
+        tr, rec = installed
+        tr.count("tenant.submitted", 3)
+        tr.gauge("tenant.pending_bytes", 64)
+        with tr.span("converge.dispatch"):
+            pass
+        rec.record("update.send", topic="room", digest="aa",
+                   tid=[1, 1, 0.0], hop=0)
+        rec.record("update.recv", topic="room", digest="aa",
+                   tid=[1, 1, 0.0], hop=1, peer="p1")
+        rec.record("tenant.shed", doc="flood!", count=2, bytes=99)
+        with ObsHTTPServer(port=0, snapshot_extra=lambda: {
+            "slo": {"slo_ms": 250.0},
+        }) as obs:
+            st, metrics = self._get(obs.url + "/metrics")
+            assert st == 200
+            assert "crdt_tenant_submitted 3" in metrics
+            assert "crdt_converge_dispatch_seconds_count 1" in metrics
+
+            st, snap = self._get(obs.url + "/snapshot")
+            assert st == 200
+            data = json.loads(snap)
+            assert data["tracer"]["counters"]["tenant.submitted"] == 3
+            assert data["slo"]["slo_ms"] == 250.0
+
+            # filters: kind, doc (matches doc OR topic), peer, limit
+            st, ev = self._get(obs.url + "/events?kind=tenant.shed")
+            assert st == 200
+            lines = [json.loads(ln) for ln in ev.splitlines()]
+            assert [e["kind"] for e in lines] == ["tenant.shed"]
+            assert lines[0]["doc"] == "flood!"
+            st, ev = self._get(obs.url + "/events?doc=room")
+            kinds = {json.loads(ln)["kind"]
+                     for ln in ev.splitlines()}
+            assert kinds == {"update.send", "update.recv"}
+            st, ev = self._get(obs.url + "/events?peer=p1")
+            assert len(ev.splitlines()) == 1
+            st, ev = self._get(
+                obs.url + "/events?doc=room&limit=1"
+            )
+            assert len(ev.splitlines()) == 1
+
+            st, tl_text = self._get(obs.url + "/timeline")
+            assert st == 200
+            assert "traceEvents" in json.loads(tl_text)
+
+        # unknown path: 404 with the route list, not a crash
+        obs2 = ObsHTTPServer(port=0).start()
+        try:
+            from urllib.error import HTTPError
+
+            with pytest.raises(HTTPError) as ei:
+                self._get(obs2.url + "/nope")
+            assert ei.value.code == 404
+        finally:
+            obs2.stop()
+
+    def test_serve_flood_slo_timeline_scrapeable_live(
+            self, installed, timeline_installed):
+        """The round-18 acceptance pin: a seeded serve() run under
+        the round-14 flood scenario yields (a) a per-tenant SLO
+        report whose flooding-tenant breach/shed counts equal the
+        admission oracle while neighbors hold zero, (b) a
+        schema-valid Perfetto timeline whose double-buffered ticks
+        show overlap_efficiency > 0, (c) all of it scraped LIVE from
+        the HTTP endpoint while serve() is mid-run."""
+        from urllib.request import urlopen
+
+        from crdt_tpu.models.multidoc import MultiDocServer
+        from crdt_tpu.obs import ObsHTTPServer
+
+        tl = timeline_installed
+        neighbors = [f"n{i}" for i in range(6)]
+        flooder = "flood!"
+        srv = MultiDocServer(
+            # small dispatches: >=3 async batches per cold tick, so
+            # the double-buffer has windows to overlap
+            max_rows_per_dispatch=60,
+            tenant_max_pending_bytes=1 << 20,
+            tenant_max_pending_updates=4,
+            slo_ms=1e9,  # served-on-time never breaches: breach==shed
+        )
+        obs = ObsHTTPServer(port=0, snapshot_extra=lambda: {
+            "slo": srv.slo.report(),
+        }).start()
+        live: dict = {}
+        oracle = {"submitted": 0, "shed": 0}
+
+        def source():
+            # batch 1: neighbors' histories (30 ops each)
+            yield [(d, _mt_blob(10 + i, 0, 30))
+                   for i, d in enumerate(neighbors)]
+            # mid-run scrape: serve()'s ingest hook pulls this batch
+            # while tick 1's dispatches are still in flight
+            with urlopen(obs.url + "/metrics", timeout=10) as r:
+                live["metrics"] = (r.status, r.read().decode())
+            with urlopen(obs.url + "/snapshot", timeout=10) as r:
+                live["snapshot"] = json.loads(r.read().decode())
+            # batch 2: the flood — 23 blobs into a 4-update budget
+            yield [(flooder, _mt_blob(99, j * 4)) for j in range(23)]
+            yield [(d, _mt_blob(10 + i, 30, 2))
+                   for i, d in enumerate(neighbors)]
+
+        class _Counting:
+            def __init__(self, it):
+                self.it = it
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                batch = next(self.it)
+                oracle["submitted"] += len(batch)
+                return batch
+
+        rep = srv.serve(_Counting(source()), max_ticks=10)
+        obs_stop_exc = None
+        try:
+            # (c) live scrape happened mid-run and carried real data
+            assert live["metrics"][0] == 200
+            assert "crdt_tenant_submitted" in live["metrics"][1]
+            assert "slo" in live["snapshot"]
+            # (a) SLO exactness against the admission oracle
+            shed_oracle = srv.shed_count
+            assert shed_oracle == 23 - 4  # keep-the-newest suffix
+            assert srv.slo.breaches(flooder) == shed_oracle
+            assert srv.slo.route_counts(flooder)["shed"] == \
+                shed_oracle
+            for d in neighbors:
+                assert srv.slo.breaches(d) == 0
+            sr = srv.slo.report()
+            assert sr["total_breaches"] == shed_oracle
+            assert sr["tenants"][flooder]["burn_rate"] > 0.5
+            # every tenant's serves are in the route mix
+            assert rep.docs == sum(
+                sum(t["routes"][r] for r in
+                    ("delta", "cold", "fallback"))
+                for t in sr["tenants"].values()
+            )
+            # (b) the double-buffered legs overlapped, measurably
+            recs = tl.records()
+            dbl = [r for r in recs if len(r["dispatches"]) > 1]
+            assert dbl, "no double-buffered tick recorded"
+            for r in dbl:
+                assert r["overlap_efficiency"] > 0.0, r
+            pf = tl.to_perfetto()
+            for ev in pf["traceEvents"]:
+                for k in ("name", "ph", "ts", "pid", "tid"):
+                    assert k in ev
+                if ev["ph"] == "X":
+                    assert ev["dur"] >= 0
+            names = {e["name"] for e in pf["traceEvents"]}
+            assert any(n.startswith("dispatch(") for n in names)
+            assert "prepare" in names and "settle" in names
+            # the endpoint serves the SAME timeline
+            with urlopen(obs.url + "/timeline", timeout=10) as r:
+                served_pf = json.loads(r.read().decode())
+            assert len(served_pf["traceEvents"]) >= len(
+                pf["traceEvents"]
+            )
+        finally:
+            try:
+                obs.stop()
+            except Exception as exc:  # pragma: no cover
+                obs_stop_exc = exc
+        assert obs_stop_exc is None
+
+
+# ---------------------------------------------------------------------------
+# round 18: obsq CLI round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestObsqCLI:
+    @pytest.fixture
+    def dumps(self, tmp_path):
+        a = FlightRecorder(enabled=True)
+        b = FlightRecorder(enabled=True)
+        # process A originates two updates; B receives them one hop
+        # later (ts offsets are synthetic but monotone per ring)
+        a.record("update.send", topic="room", replica="A", size=10,
+                 digest="d1", tid=[1, 1, 100.0], hop=0)
+        a.record("update.send", topic="room", replica="A", size=12,
+                 digest="d2", tid=[1, 2, 100.5], hop=0)
+        b.record("update.recv", topic="room", replica="B", peer="A",
+                 size=10, digest="d1", tid=[1, 1, 100.0], hop=1)
+        b.record("update.recv", topic="room", replica="B", peer="A",
+                 size=12, digest="d2", tid=[1, 2, 100.5], hop=1)
+        b.record("divergence", topic="room", replica="B", peer="A",
+                 local_digest="xx", peer_digest="yy")
+        pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        a.dump_jsonl(str(pa))
+        b.dump_jsonl(str(pb))
+        return str(pa), str(pb)
+
+    def _run(self, capsys, *argv):
+        import obsq_under_test as obsq
+
+        rc = obsq.main(list(argv))
+        out = capsys.readouterr().out
+        return rc, out
+
+    @pytest.fixture(autouse=True)
+    def _import_obsq(self, monkeypatch):
+        import importlib
+        import os
+        import sys as _sys
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        monkeypatch.syspath_prepend(os.path.join(repo, "tools"))
+        mod = importlib.import_module("obsq")
+        _sys.modules.setdefault("obsq_under_test", mod)
+
+    def test_filter_by_kind_doc_tid(self, capsys, dumps):
+        pa, pb = dumps
+        rc, out = self._run(capsys, "filter", pa, pb,
+                            "--kind", "update.recv")
+        assert rc == 0
+        evs = [json.loads(ln) for ln in out.splitlines()]
+        assert len(evs) == 2
+        assert all(e["kind"] == "update.recv" for e in evs)
+        assert all(e["_src"] == "b.jsonl" for e in evs)
+        # --doc matches the topic field; --tid is a client:seq prefix
+        rc, out = self._run(capsys, "filter", pa, pb,
+                            "--doc", "room", "--tid", "1:2")
+        evs = [json.loads(ln) for ln in out.splitlines()]
+        assert {e["kind"] for e in evs} == \
+            {"update.send", "update.recv"}
+        assert all(e["tid"][:2] == [1, 2] for e in evs)
+        rc, out = self._run(capsys, "filter", pa,
+                            "--doc", "elsewhere")
+        assert rc == 0 and out.strip() == ""
+
+    def test_summary(self, capsys, dumps):
+        rc, out = self._run(capsys, "summary", *dumps)
+        assert rc == 0
+        s = json.loads(out)
+        assert s["events"] == 5
+        assert s["kinds"]["update.send"] == 2
+        assert s["kinds"]["divergence"] == 1
+        assert s["sources"] == {"a.jsonl": 2, "b.jsonl": 3}
+
+    def test_latency_pairs_across_dumps(self, capsys, dumps):
+        rc, out = self._run(capsys, "latency", *dumps)
+        assert rc == 0
+        lat = json.loads(out)
+        assert lat["sends"] == 2
+        assert lat["pairs"] == 2
+        assert lat["unmatched_recv"] == 0
+        assert lat["propagation"]["count"] == 2
+        assert lat["hops"] == {"1": 2}
+
+    def test_diverge_correlates(self, capsys, dumps):
+        rc, out = self._run(capsys, "diverge", *dumps,
+                            "--context", "2")
+        assert rc == 0
+        dv = json.loads(out)
+        assert dv["divergences"] == 1
+        ev = dv["events"][0]
+        assert ev["divergence"]["local_digest"] == "xx"
+        assert set(ev["context"]) == {"a.jsonl", "b.jsonl"}
+        assert all(len(c) <= 2 for c in ev["context"].values())
+        # both sides saw d1/d2 before the fork — the common tail
+        assert "d2" in ev["last_common_digests"]
+
+    def test_unreadable_input_exits_2(self, capsys, tmp_path):
+        import obsq_under_test as obsq
+
+        rc = obsq.main(["summary", str(tmp_path / "missing.jsonl")])
+        assert rc == 2
+
+
+class TestLabelEscaping:
+    def test_hostile_label_values_cannot_corrupt_exposition(self):
+        """Round 18 made label values caller-controlled (doc ids ->
+        tenant= labels): quotes, backslashes and newlines must escape
+        per the exposition spec, never inject lines or break parse."""
+        tr = Tracer(enabled=True)
+        tr.count("tenant.shed", 1, labels={"tenant": 'a"b'})
+        tr.count("tenant.shed", 2, labels={"tenant": "c\\d"})
+        tr.count("tenant.shed", 3,
+                 labels={"tenant": "evil\nfake_metric 9"})
+        text = to_prometheus(tr.report())
+        assert 'crdt_tenant_shed{tenant="a\\"b"} 1' in text
+        assert 'crdt_tenant_shed{tenant="c\\\\d"} 2' in text
+        # the newline is escaped INTO the value: no injected line
+        assert "\nfake_metric 9" not in text
+        assert 'tenant="evil\\nfake_metric 9"' in text
+        # every non-comment line still parses as `name{...} value`
+        for ln in text.splitlines():
+            if ln.startswith("#"):
+                continue
+            float(ln.rsplit(" ", 1)[1])  # the value field parses
+            assert ln.count("{") <= 1
+
+    def test_slo_ledger_with_hostile_doc_id(self, installed):
+        from crdt_tpu.obs.slo import SLOLedger
+
+        led = SLOLedger(slo_ms=0.0)
+        led.served('doc"with"quotes', [1e-3])
+        text = to_prometheus()
+        assert 'tenant="doc\\"with\\"quotes"' in text
+
+
+class TestEventsLimitSemantics:
+    def test_limit_zero_returns_nothing(self):
+        from crdt_tpu.obs.http import _filter_events
+
+        evs = [{"kind": "a"}, {"kind": "b"}, {"kind": "c"}]
+        assert _filter_events(evs, {"limit": ["0"]}) == []
+        assert _filter_events(evs, {"limit": ["2"]}) == evs[-2:]
+        # over-large and garbage limits degrade to "all"
+        assert _filter_events(evs, {"limit": ["99"]}) == evs
+        assert _filter_events(evs, {"limit": ["x"]}) == evs
+
+
+class TestObsqExitCodes:
+    def test_malformed_jsonl_exits_2(self, tmp_path, capsys,
+                                     monkeypatch):
+        import importlib
+        import os
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        monkeypatch.syspath_prepend(os.path.join(repo, "tools"))
+        obsq = importlib.import_module("obsq")
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text('{"kind": "a"}\nnot json at all\n')
+        rc = obsq.main(["summary", str(bad)])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert "not JSONL" in err

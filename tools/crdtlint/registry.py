@@ -32,6 +32,9 @@ NAMESPACES = frozenset({
     "router", "sentinel", "fleet", "gossip", "update", "sync",
     "probe", "ae", "beacon", "dial", "relay", "envelope", "fault",
     "overload", "lint", "converge", "shard", "tenant",
+    # round 18 (observability v2): the SLO ledger and the
+    # tick-timeline profiler
+    "slo", "timeline",
 })
 
 # backticked dotted names that share a namespace but are NOT metrics

@@ -44,7 +44,17 @@ Compared (whatever of these both artifacts carry):
   — the >=10x-over-full-replay bar is a gated artifact) and the
   eviction flood's ``steady.eviction.peak_bytes`` (lower = better),
   plus ``tenant.resident_evictions`` / ``tenant.delta_fallbacks``
-  under the guard prefixes.
+  under the guard prefixes;
+- observability v2 (round 18): ``slo.breaches`` (total objective
+  misses, shed included — lower = better, counts),
+  ``timeline.stall_ms`` (blocked-fetch time per tick, lower) and
+  ``timeline.overlap_efficiency`` (HIGHER = better: a drop means the
+  double-buffered dispatch pipeline re-serialized), from the embedded
+  tracer report; plus the run-stable ``--multitenant`` digests —
+  ``multitenant.timeline.mean_overlap_efficiency`` (higher),
+  ``multitenant.timeline.stall_ms_total`` (lower, ms noise floor),
+  and the chaos flooder's deterministic
+  ``multitenant.flood.slo_flooder.breaches`` (lower).
 
 Prints a table (one row per metric: old, new, delta, verdict) and
 exits non-zero when any metric regressed past ``--threshold``
@@ -125,6 +135,18 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     (("multitenant", "steady", "docs_per_s"), True),
     (("multitenant", "steady", "speedup"), True),
     (("multitenant", "steady", "eviction", "peak_bytes"), False),
+    # observability v2 (round 18): the run-stable timeline/SLO
+    # digests the --multitenant harness embeds — the mean overlap of
+    # the double-buffered ticks (higher = better; the per-tick gauge
+    # is also gated below but carries only the LAST tick), and the
+    # chaos flooder's breach count, which is DETERMINISTIC (the leg
+    # runs at slo_ms=0, so breaches = shed + served counts — not a
+    # wall-clock artifact like the default-objective legs' totals,
+    # whose baseline of 0 would turn one slow-machine miss into an
+    # infinite-delta failure). stall_ms_total rides the seconds
+    # loop below, where the ms noise floor applies.
+    (("multitenant", "timeline", "mean_overlap_efficiency"), True),
+    (("multitenant", "flood", "slo_flooder", "breaches"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 
@@ -285,6 +307,31 @@ def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
         b = (new.get("tracer") or {}).get(section, {}).get(name)
         if _both_numbers(a, b):
             yield f"tracer.{name}", float(a), float(b), False, False
+    # serving SLO + tick timeline (round 18): breaches are the SLO
+    # ledger's total objective misses on the same workload (lower is
+    # better, counts — never muted); timeline.stall_ms is the tick
+    # loop's blocked-fetch time (lower, ms noise floor applies);
+    # timeline.overlap_efficiency is the double-buffer's measured
+    # overlap (HIGHER is better — the one gauge whose drop means the
+    # pipeline re-serialized; a ratio, never muted)
+    for section, name, hib, is_seconds in (
+        ("counters", "slo.breaches", False, False),
+        ("gauges", "timeline.stall_ms", False, True),
+        ("gauges", "timeline.overlap_efficiency", True, False),
+    ):
+        a = (old.get("tracer") or {}).get(section, {}).get(name)
+        b = (new.get("tracer") or {}).get(section, {}).get(name)
+        if _both_numbers(a, b):
+            yield f"tracer.{name}", float(a), float(b), hib, \
+                is_seconds
+    # the multitenant timeline's total blocked-fetch time: wall-clock
+    # ms, so the seconds noise floor applies (a 1ms wobble is
+    # scheduler noise, a 100ms jump is a re-serialized pipeline)
+    a = _get_path(old, ("multitenant", "timeline", "stall_ms_total"))
+    b = _get_path(new, ("multitenant", "timeline", "stall_ms_total"))
+    if _both_numbers(a, b):
+        yield "multitenant.timeline.stall_ms_total_ms", float(a), \
+            float(b), False, True
     # guard-layer degradation counters/gauges: all lower-is-better
     # (persist.recovered_updates is deliberately NOT gated — it rises
     # and falls with degraded_writes, which already is), never seconds

@@ -1,0 +1,261 @@
+#!/usr/bin/env python
+"""obsq — query flight-recorder JSONL dumps offline.
+
+The flight recorder answers "what happened at the sync seams" one
+process at a time; the ROADMAP item-2 fleet will dump one ring per
+server process, and the questions the divergence sentinel raises are
+CROSS-dump questions ("which doc forked, and what did each side see
+right before?"). This CLI loads one or more dumps (each line one
+event, as ``FlightRecorder.dump_jsonl`` writes them), merges them on
+the shared monotonic timebase, and answers the recurring postmortem
+queries without a notebook:
+
+    python tools/obsq.py summary  dump_a.jsonl dump_b.jsonl
+    python tools/obsq.py filter   dump.jsonl --kind update.recv --doc room
+    python tools/obsq.py filter   dump.jsonl --tid 7:3
+    python tools/obsq.py latency  dump_a.jsonl dump_b.jsonl
+    python tools/obsq.py diverge  dump_a.jsonl dump_b.jsonl
+
+- ``summary``  — event counts per kind and per source file, time range.
+- ``filter``   — events matching ``--kind`` (exact), ``--doc``
+  (matches an event's ``doc`` or ``topic``), ``--peer`` (``peer`` or
+  ``replica``), ``--tid`` (``client:seq`` prefix of the origin trace
+  id), printed as JSONL oldest-first with a ``_src`` field naming the
+  dump each event came from.
+- ``latency``  — pairs ``update.send``/``update.recv`` events by
+  trace id ACROSS dumps and prints propagation-latency percentiles
+  (p50/p90/p99/max) plus the hop-count distribution (round 18: recv
+  events carry ``hop``).
+- ``diverge``  — finds ``divergence`` events and correlates the two
+  dumps around each: the last ``--context`` events from every source
+  before the divergence timestamp, filtered to its topic, digests
+  compared side by side — the "what did each side see" question.
+
+Exit code: 0 on success (even when nothing matches), 2 on unreadable
+input. Stdlib-only (the analysis lane must not import jax).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_events(paths: List[str]) -> List[Dict[str, Any]]:
+    """All events of all dumps, oldest-first on the shared monotonic
+    timebase, each tagged with ``_src`` (basename of its dump)."""
+    import os
+
+    events: List[Dict[str, Any]] = []
+    for path in paths:
+        src = os.path.basename(path)
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, start=1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError as exc:
+                    # surfaces as exit 2 in main() — same unreadable-
+                    # input class as a missing file
+                    raise ValueError(
+                        f"{path}:{lineno}: not JSONL ({exc})"
+                    ) from None
+                ev["_src"] = src
+                events.append(ev)
+    events.sort(key=lambda e: (e.get("ts", 0.0), e["_src"]))
+    return events
+
+
+def match(ev: Dict[str, Any], *, kind: Optional[str] = None,
+          doc: Optional[str] = None, peer: Optional[str] = None,
+          tid: Optional[str] = None) -> bool:
+    if kind is not None and ev.get("kind") != kind:
+        return False
+    if doc is not None and \
+            str(ev.get("doc", ev.get("topic"))) != doc:
+        return False
+    if peer is not None and \
+            str(ev.get("peer", ev.get("replica"))) != peer:
+        return False
+    if tid is not None:
+        t = ev.get("tid")
+        if not isinstance(t, (list, tuple)) or len(t) < 2:
+            return False
+        want = tid.split(":")
+        if [str(x) for x in t[:len(want)]] != want:
+            return False
+    return True
+
+
+def _percentiles(sorted_vals: List[float]) -> Dict[str, float]:
+    def q(p: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        i = min(len(sorted_vals) - 1,
+                max(0, int(p * len(sorted_vals) + 0.5) - 1))
+        return sorted_vals[i]
+
+    return {
+        "count": len(sorted_vals),
+        "p50_s": q(0.50),
+        "p90_s": q(0.90),
+        "p99_s": q(0.99),
+        "max_s": sorted_vals[-1] if sorted_vals else 0.0,
+    }
+
+
+def cmd_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    kinds: Dict[str, int] = {}
+    srcs: Dict[str, int] = {}
+    for e in events:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+        srcs[e["_src"]] = srcs.get(e["_src"], 0) + 1
+    ts = [e["ts"] for e in events if isinstance(
+        e.get("ts"), (int, float))]
+    return {
+        "events": len(events),
+        "kinds": dict(sorted(kinds.items())),
+        "sources": dict(sorted(srcs.items())),
+        "ts_range_s": (
+            round(max(ts) - min(ts), 6) if len(ts) > 1 else 0.0
+        ),
+    }
+
+
+def cmd_latency(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """send/recv pairing by trace id across every loaded dump: the
+    cross-process propagation story. One send may fan out to many
+    receivers; every (send, recv) pair contributes one latency."""
+    sends: Dict[tuple, float] = {}
+    for e in events:
+        t = e.get("tid")
+        if e.get("kind") == "update.send" and isinstance(
+                t, (list, tuple)) and len(t) >= 3:
+            sends.setdefault((t[0], t[1]), float(t[2]))
+    lats: List[float] = []
+    unmatched_recv = 0
+    hops: Dict[str, int] = {}
+    for e in events:
+        if e.get("kind") != "update.recv":
+            continue
+        t = e.get("tid")
+        key = (t[0], t[1]) if isinstance(
+            t, (list, tuple)) and len(t) >= 2 else None
+        if key is not None and key in sends and isinstance(
+                e.get("ts"), (int, float)):
+            lats.append(max(0.0, e["ts"] - sends[key]))
+        else:
+            unmatched_recv += 1
+        h = e.get("hop")
+        hkey = str(h) if isinstance(h, int) else "unknown"
+        hops[hkey] = hops.get(hkey, 0) + 1
+    lats.sort()
+    return {
+        "sends": len(sends),
+        "pairs": len(lats),
+        "unmatched_recv": unmatched_recv,
+        "propagation": _percentiles(lats),
+        "hops": dict(sorted(hops.items())),
+    }
+
+
+def cmd_diverge(events: List[Dict[str, Any]],
+                context: int = 8) -> Dict[str, Any]:
+    """Correlate divergence events across the loaded dumps: for each,
+    the trailing ``context`` events per source on the same topic
+    before the divergence, with digests surfaced for eyeballing which
+    update the two sides last disagreed on."""
+    out: List[Dict[str, Any]] = []
+    divs = [e for e in events if e.get("kind") == "divergence"]
+    for div in divs:
+        topic = div.get("topic")
+        ts = div.get("ts", float("inf"))
+        per_src: Dict[str, List[Dict[str, Any]]] = {}
+        for e in events:
+            if e is div or e.get("ts", 0.0) > ts:
+                continue
+            if topic is not None and \
+                    e.get("topic") not in (None, topic):
+                continue
+            per_src.setdefault(e["_src"], []).append(e)
+        ctx = {
+            src: [
+                {k: ev.get(k) for k in
+                 ("ts", "kind", "peer", "replica", "digest", "tid",
+                  "hop", "size") if k in ev}
+                for ev in evs[-context:]
+            ]
+            for src, evs in sorted(per_src.items())
+        }
+        digests = {
+            src: [e.get("digest") for e in evs if e.get("digest")]
+            for src, evs in ctx.items()
+        }
+        common = set.intersection(
+            *(set(d) for d in digests.values())
+        ) if len(digests) > 1 else set()
+        out.append({
+            "divergence": {
+                k: div.get(k) for k in
+                ("ts", "topic", "peer", "replica", "local_digest",
+                 "peer_digest", "doc") if k in div
+            },
+            "src": div["_src"],
+            "context": ctx,
+            "last_common_digests": sorted(common),
+        })
+    return {"divergences": len(divs), "events": out}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="obsq",
+        description="query flight-recorder JSONL dumps",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("summary", "filter", "latency", "diverge"):
+        p = sub.add_parser(name)
+        p.add_argument("dumps", nargs="+",
+                       help="flight-recorder JSONL dump file(s)")
+        if name == "filter":
+            p.add_argument("--kind")
+            p.add_argument("--doc")
+            p.add_argument("--peer")
+            p.add_argument("--tid",
+                           help="client:seq prefix of the trace id")
+        if name == "diverge":
+            p.add_argument("--context", type=int, default=8)
+    args = ap.parse_args(argv)
+    try:
+        events = load_events(args.dumps)
+    except (OSError, ValueError) as exc:
+        print(f"obsq: {exc}", file=sys.stderr)
+        return 2
+
+    if args.cmd == "filter":
+        for e in events:
+            if match(e, kind=args.kind, doc=args.doc,
+                     peer=args.peer, tid=args.tid):
+                print(json.dumps(e, sort_keys=True, default=str))
+        return 0
+    if args.cmd == "summary":
+        print(json.dumps(cmd_summary(events), indent=1,
+                         sort_keys=True))
+        return 0
+    if args.cmd == "latency":
+        print(json.dumps(cmd_latency(events), indent=1,
+                         sort_keys=True))
+        return 0
+    if args.cmd == "diverge":
+        print(json.dumps(cmd_diverge(events, args.context),
+                         indent=1, sort_keys=True))
+        return 0
+    return 2  # unreachable (argparse enforces the subcommand)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
