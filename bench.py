@@ -1826,6 +1826,370 @@ def kernel_ablation_leg(cols, b2b_ms, null_floor_ms) -> dict:
     return out
 
 
+def fleet_trace_child(argv) -> int:
+    """One subprocess replica of the ``--fleet-trace`` leg: a real
+    UdpRouter peer under the seeded round-7 fault schedule, tracing +
+    recording enabled, serving its obs surfaces over HTTP while it
+    edits and converges. Children 1 and 2 are PERMANENTLY partitioned
+    from each other at the router seam, so their traffic crosses only
+    through the rendezvous relay — the forced multi-hop path whose
+    full reconstruction the parent asserts."""
+    cfg = json.loads(argv[0])
+    idx = int(cfg["idx"])
+    ports = cfg["ports"]
+    outdir = cfg["outdir"]
+    K = int(cfg["ops"])
+    val_bytes = int(cfg["val_bytes"])
+
+    from crdt_tpu.net.faults import (
+        FaultSchedule,
+        Partition,
+        install_faults,
+    )
+    from crdt_tpu.net.replica import Replica
+    from crdt_tpu.net.udp_router import UdpRouter
+    from crdt_tpu.obs import (
+        FlightRecorder,
+        ObsHTTPServer,
+        PropagationLedger,
+        TickTimeline,
+        Tracer,
+        get_propagation,
+        get_timeline,
+        set_propagation,
+        set_recorder,
+        set_timeline,
+        set_tracer,
+        state_digest,
+    )
+
+    tracer = set_tracer(Tracer(enabled=True))
+    set_recorder(FlightRecorder(enabled=True, capacity=16384))
+    set_propagation(PropagationLedger())
+    set_timeline(TickTimeline(enabled=True))
+
+    router = UdpRouter(
+        port=int(ports[idx]),
+        seed=bytes([int(cfg["seed"]) % 200 + 1 + idx]) * 32,
+        rendezvous=(idx == 0),
+        bootstrap=([] if idx == 0
+                   else [("127.0.0.1", int(ports[0]))]),
+        relay_after_s=0.25,
+        dial_retry_s=0.1,
+        dial_retry_max_s=0.5,
+        # fast announce refresh: under the fault schedule a dropped
+        # (one-shot, relay-routed) announce is repaired on the next
+        # ttl/3 cadence instead of the 20s default
+        announce_ttl=1.0,
+    )
+    part = None
+    if idx in (1, 2):
+        # the relay forcer: children 1<->2 never hear each other
+        # directly (a never-healing partition at the router seam);
+        # the introduction dial escalates to the rendezvous relay
+        part = Partition({int(ports[1])}, {int(ports[2])})
+    install_faults(router, FaultSchedule(
+        int(cfg["seed"]), drop=float(cfg["drop"]), duplicate=0.02,
+        delay=float(cfg["delay"]), delay_polls=(1, 3),
+        partition=part,
+    ))
+    rep = Replica(router, topic="fleet", client_id=101 + idx,
+                  anti_entropy_s=0.2, batch_incoming=True)
+    obs = ObsHTTPServer(port=int(cfg["obs_ports"][idx]),
+                        snapshot_extra=lambda: {
+                            "propagation": get_propagation().report(),
+                        }).start()
+
+    def pump_for(seconds: float) -> None:
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            router.poll()
+            time.sleep(0.002)
+
+    # phase 1: join — both other peers visible on the topic (child
+    # 1/2 reach each other only via the relay) and synced. The
+    # bootstrap hello is an app-level one-shot the fault schedule can
+    # eat, so it is re-dialed on a coarse cadence until the router
+    # hears ANYONE (the reference re-dials its bootstrap DHT too).
+    deadline = time.monotonic() + 30.0
+    next_redial = time.monotonic() + 1.0
+    while time.monotonic() < deadline:
+        router.poll()
+        if len(router.peers_on("fleet")) >= 2 and rep.synced:
+            break
+        now = time.monotonic()
+        if idx != 0 and not router.peers and now >= next_redial:
+            router.add_peer("127.0.0.1", int(ports[0]))
+            next_redial = now + 1.0
+        time.sleep(0.002)
+    else:
+        print(json.dumps({"child": idx, "error": "join timeout",
+                          "peers": router.peers_on("fleet")}),
+              file=sys.stderr)
+        return 3
+
+    # phase 2: seeded edits, one tick-timeline record per op window
+    # (the merged-Perfetto evidence: per-process op phases)
+    rng = np.random.default_rng(int(cfg["seed"]) * 31 + idx)
+    tl = get_timeline()
+    for j in range(K):
+        tl.tick_begin(j, label=f"ops[{idx}]")
+        with tl.phase("edit"):
+            payload = "".join(
+                chr(97 + int(c)) for c in rng.integers(0, 26,
+                                                       val_bytes)
+            )
+            rep.set("m", f"{idx}:{j}", payload)
+        with tl.phase("pump"):
+            pump_for(0.02)
+        tl.tick_end()
+
+    # phase 3: converge — every client's K ops visible everywhere
+    # (drops + the partition are repaired by probe retries, the AE
+    # cadence, and the relay path; bounded by the deadline)
+    cids = [101, 102, 103]
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        router.poll()
+        sv = rep.doc.state_vector()
+        if all(sv.get(c) >= K for c in cids):
+            break
+        time.sleep(0.002)
+    else:
+        print(json.dumps({
+            "child": idx, "error": "converge timeout",
+            "sv": {c: rep.doc.state_vector().get(c) for c in cids},
+        }), file=sys.stderr)
+        return 4
+    # settle: stop ORIGINATING repair frames (the AE cadence would
+    # mint new traced frames forever, and the parent's scrape of this
+    # process could then race a frame still in flight toward a peer
+    # it scrapes later), then drain what is in flight — the fault
+    # schedule's held delays release within a few polls
+    rep._next_ae_at = None
+    rep._resync_at = None
+    pump_for(0.5)
+    rep.flush_incoming()
+
+    from crdt_tpu.obs.recorder import get_recorder
+
+    get_recorder().dump_jsonl(
+        os.path.join(outdir, f"dump_{idx}.jsonl")
+    )
+    led = get_propagation().report()
+    done = {
+        "idx": idx,
+        "digest": state_digest(rep.doc),
+        "ledger": led,
+        "relay": {k: v for k, v in router.stats.items()
+                  if k.startswith("relay")},
+        "counters": tracer.report()["counters"],
+    }
+    done_path = os.path.join(outdir, f"done_{idx}.json")
+    with open(done_path + ".tmp", "w") as f:
+        json.dump(done, f)
+    os.replace(done_path + ".tmp", done_path)
+
+    # phase 4: stay scrapeable until the parent finishes its live
+    # collector pass (stop file), then exit clean
+    stop = os.path.join(outdir, "stop")
+    deadline = time.monotonic() + 60.0
+    while not os.path.exists(stop) and time.monotonic() < deadline:
+        router.poll()
+        time.sleep(0.01)
+    obs.stop()
+    router.close()
+    return 0
+
+
+def _free_ports(n: int, *, udp: bool) -> list:
+    """Pre-allocate n distinct free ports (bind-then-release; the
+    children re-bind them — the tiny race is acceptable for a bench
+    leg on loopback)."""
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket(
+            socket.AF_INET,
+            socket.SOCK_DGRAM if udp else socket.SOCK_STREAM,
+        )
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def fleet_trace(argv=None) -> int:
+    """``bench.py --fleet-trace``: the seeded multi-process tracing
+    leg. Three subprocess replicas gossip over real UDP routers under
+    a round-7 fault schedule (drops, dups, delays) with children 1/2
+    force-relayed through the rendezvous; a live FleetCollector
+    scrapes their ObsHTTPServers mid-run and the acceptance numbers
+    are asserted, not eyeballed:
+
+    - every traced receive's FULL path reconstructs across processes
+      (``pair_rate == 1.0``), with direct, relayed, sync_answer and
+      anti_entropy legs all present;
+    - all three documents converge to one digest despite the faults;
+    - the trace-context wire overhead stays < 5% of traced update
+      bytes (the gated ratio);
+    - the collector-merged Perfetto timeline carries all three
+      processes under distinct pids.
+
+    One JSON line out; BENCH_FLEET_OUT= writes the full artifact
+    (the CI-uploaded evidence). Stdlib + the package's net/obs layers
+    only — the leg never touches a device."""
+    import subprocess
+    import tempfile
+
+    from crdt_tpu.obs import FleetCollector, Tracer, set_tracer
+
+    t_start = time.perf_counter()
+    seed = int(os.environ.get("BENCH_FLEET_SEED", 7))
+    ops = int(os.environ.get("BENCH_FLEET_OPS", 10))
+    val_bytes = int(os.environ.get("BENCH_FLEET_VAL_BYTES", 1024))
+    drop = float(os.environ.get("BENCH_FLEET_DROP", 0.04))
+    delay = float(os.environ.get("BENCH_FLEET_DELAY", 0.08))
+    n_procs = 3
+
+    tracer = set_tracer(Tracer(enabled=True))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as outdir:
+        ports = _free_ports(n_procs, udp=True)
+        obs_ports = _free_ports(n_procs, udp=False)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        procs = []
+        for idx in range(n_procs):
+            cfg = {
+                "idx": idx, "seed": seed, "ports": ports,
+                "obs_ports": obs_ports, "outdir": outdir,
+                "ops": ops, "val_bytes": val_bytes,
+                "drop": drop, "delay": delay,
+            }
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(repo, "bench.py"),
+                 "--fleet-trace-child", json.dumps(cfg)],
+                env=env, cwd=repo,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            ))
+        try:
+            done_paths = [os.path.join(outdir, f"done_{i}.json")
+                          for i in range(n_procs)]
+            deadline = time.monotonic() + 150.0
+            while time.monotonic() < deadline:
+                if all(os.path.exists(p) for p in done_paths):
+                    break
+                dead = [p for p in procs if p.poll() not in (None, 0)]
+                if dead:
+                    break
+                time.sleep(0.05)
+            missing = [p for p in done_paths
+                       if not os.path.exists(p)]
+            if missing:
+                for p in procs:
+                    p.kill()
+                tails = [p.communicate()[1][-800:] for p in procs]
+                raise RuntimeError(
+                    f"fleet-trace children incomplete: {missing} "
+                    f"stderr={tails}"
+                )
+
+            # the LIVE half: children are still polling + serving;
+            # scrape them mid-run through the collector
+            col = FleetCollector(events_limit=16384)
+            for idx in range(n_procs):
+                col.add_proc(
+                    f"p{idx}", f"http://127.0.0.1:{obs_ports[idx]}"
+                )
+            ok = col.scrape()
+            assert all(ok.values()), f"live scrape failed: {ok}"
+            report = col.fleet_report()
+            merged = col.merged_perfetto()
+        finally:
+            with open(os.path.join(outdir, "stop"), "w") as f:
+                f.write("done")
+            for p in procs:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+        dones = []
+        for p in done_paths:
+            with open(p) as f:
+                dones.append(json.load(f))
+
+    # -- acceptance ----------------------------------------------------
+    digests = {d["digest"] for d in dones}
+    assert len(digests) == 1, \
+        f"fleet-trace: documents diverged under faults: {digests}"
+    paths = report["paths"]
+    assert paths["traced_recvs"] > 0, "fleet-trace: nothing traced"
+    assert paths["pair_rate"] == 1.0, (
+        f"fleet-trace: only {paths['complete']}/"
+        f"{paths['traced_recvs']} paths reconstructed "
+        f"(sample: {paths['incomplete_sample']})"
+    )
+    routes = set(paths["routes"])
+    assert {"direct", "relayed", "sync_answer"} <= routes, \
+        f"fleet-trace: route coverage incomplete: {routes}"
+    assert sorted(paths["origin_procs"]) == ["p0", "p1", "p2"], \
+        f"fleet-trace: origin procs {paths['origin_procs']}"
+    ctx_bytes = sum(d["ledger"]["context_bytes"] for d in dones)
+    upd_bytes = sum(d["ledger"]["traced_update_bytes"]
+                    for d in dones)
+    overhead = ctx_bytes / upd_bytes if upd_bytes else 0.0
+    assert overhead < 0.05, \
+        f"fleet-trace: context overhead {overhead:.3f} >= 5%"
+    relay_forwards = sum(
+        d["relay"].get("relay_frames_forwarded", 0) for d in dones
+    )
+    assert relay_forwards > 0, "fleet-trace: no frames were relayed"
+    pids = {e.get("pid") for e in merged["traceEvents"]
+            if isinstance(e, dict)}
+    assert len(pids) >= n_procs, \
+        f"fleet-trace: merged timeline pids collided: {pids}"
+
+    out = {
+        "metric": "fleet_trace",
+        "fleet_trace": {
+            "procs": len(report["procs"]),
+            "pair_rate": paths["pair_rate"],
+            "traced_recvs": paths["traced_recvs"],
+            "wire_overhead_ratio": overhead,
+            "routes": paths["routes"],
+            "hops": report["latency"]["hops"],
+            "relay_frames_forwarded": relay_forwards,
+            "converged": True,
+            "wall_s": round(time.perf_counter() - t_start, 2),
+        },
+        "tracer": tracer.report(),
+        "ok": True,
+    }
+    fleet_out = os.environ.get("BENCH_FLEET_OUT")
+    if fleet_out:
+        with open(fleet_out, "w") as f:
+            json.dump({
+                **out,
+                "latency": report["latency"],
+                "fleet_metrics_sums": report["metrics"]["sums"],
+                "perfetto_pids": sorted(
+                    p for p in pids if isinstance(p, int)
+                ),
+            }, f, indent=1, sort_keys=True, default=str)
+            f.write("\n")
+    line = dict(out)
+    line.pop("tracer", None)
+    print(json.dumps(line, sort_keys=True, default=str))
+    return 0
+
+
 def smoke():
     """Fast pipeline-accounting smoke: a tiny trace through all three
     contenders (numpy, one-shot device pipeline, streaming executor)
@@ -2165,6 +2529,119 @@ def smoke():
             if ev["ph"] == "X":
                 assert ev["dur"] >= 0, "smoke: negative duration"
         out["timeline_registry_ok"] = True
+        # the round-19 propagation registry: a tiny traced loopback
+        # swarm (broadcast + late-join sync answer + one forced AE
+        # round) must light the wire-trace-context evidence — the
+        # per-route hop-lag histograms, the birth-to-visibility
+        # span, the context byte accounting and overhead gauge —
+        # and a hostile context must degrade (counted) without
+        # touching the update it rode on
+        from crdt_tpu.obs import (
+            FleetCollector,
+            FlightRecorder,
+            ObsHTTPServer,
+            PropagationLedger,
+            get_propagation,
+            set_propagation,
+            set_recorder,
+        )
+
+        set_recorder(FlightRecorder(enabled=True))
+        set_propagation(PropagationLedger())
+        pnet = LoopbackNetwork()
+        pa = Replica(LoopbackRouter(pnet, "pa"), topic="ptrace",
+                     client_id=11)
+        pb = Replica(LoopbackRouter(pnet, "pb"), topic="ptrace",
+                     client_id=12)
+        pa.set("m", "k0", "v" * 256)
+        pnet.run()
+        pc = Replica(LoopbackRouter(pnet, "pc"), topic="ptrace",
+                     client_id=13)  # late joiner: sync_answer route
+        pnet.run()
+        pb.set("m", "k1", "w" * 256)
+        pnet.run()
+        # force one anti-entropy round with a REAL deficit: blank
+        # pa's recorded SV for pb so the delta actually ships (route
+        # anti_entropy; redelivery is idempotent)
+        from crdt_tpu.core.ids import StateVector as _SV
+
+        pa.peer_state_vectors["pb"] = _SV()
+        pa.anti_entropy_s = 0.5
+        pa._ae_interval = 0.5
+        pa._next_ae_at = time.monotonic() - 1
+        pa.tick()
+        pnet.run()
+        # hostile context on a valid update: the update applies, the
+        # context rejects (counted), the poll loop survives
+        before_applied = tracer.report()["counters"].get(
+            "replica.updates_applied", 0)
+        pb_update = pa.doc.encode_state_as_update()
+        pc._on_data({"update": pb_update, "tid": [11, 999, 0.0],
+                     "hop": 0, "tc": b"\xff\x01hostile"}, "pa")
+        pc.flush_incoming()
+        report = tracer.report()
+        assert report["counters"].get(
+            "replica.updates_applied", 0) > before_applied, \
+            "smoke: update with hostile context did not apply"
+        assert report["counters"].get(
+            "propagation.malformed_contexts", 0) > 0, \
+            "smoke: hostile context not counted"
+        for cname in ("propagation.contexts_sent",
+                      "propagation.contexts_received",
+                      "propagation.context_bytes",
+                      "propagation.traced_update_bytes"):
+            assert report["counters"].get(cname, 0) > 0, \
+                f"smoke: {cname} missing from propagation registry"
+        assert "propagation.wire_overhead_ratio" in \
+            report["gauges"], "smoke: overhead gauge missing"
+        for sname in ('replica.hop_lag{route="direct"}',
+                      'replica.hop_lag{route="sync_answer"}',
+                      'replica.hop_lag{route="anti_entropy"}',
+                      "replica.birth_to_visibility"):
+            sp = report["spans"].get(sname)
+            assert sp and sp["count"] > 0, \
+                f"smoke: {sname} histogram missing"
+        led = get_propagation().report()
+        assert led["hop_lag_by_route"].get("direct", {}).get(
+            "count", 0) > 0, "smoke: ledger route histogram empty"
+        out["propagation_registry_ok"] = True
+        # the round-19 collector registry: scrape THIS process's own
+        # obs endpoint through a FleetCollector, serve the /fleet
+        # surfaces, and require full path reconstruction over the
+        # traced loopback swarm above
+        obs_self = ObsHTTPServer(port=0).start()
+        col = FleetCollector()
+        col.add_proc("self", obs_self.url)
+        ok_scrape = col.scrape()
+        assert ok_scrape.get("self"), "smoke: self-scrape failed"
+        fleet = col.fleet_report()
+        assert fleet["paths"]["traced_recvs"] > 0, \
+            "smoke: collector saw no traced receives"
+        assert fleet["paths"]["pair_rate"] == 1.0, \
+            f"smoke: collector pair_rate {fleet['paths']}"
+        assert any(k.endswith('{proc="self"}') for k in
+                   fleet["metrics"]["counters"]), \
+            "smoke: proc= labels missing from fleet registries"
+        obs_fleet = ObsHTTPServer(port=0, collector=col).start()
+        import urllib.request as _rq
+
+        body = json.loads(_rq.urlopen(
+            obs_fleet.url + "/fleet?scrape=0").read())
+        assert body["procs"] == ["self"], "smoke: /fleet endpoint"
+        mt_body = json.loads(_rq.urlopen(
+            obs_fleet.url + "/fleet/timeline").read())
+        assert "traceEvents" in mt_body, "smoke: /fleet/timeline"
+        obs_fleet.stop()
+        obs_self.stop()
+        report = tracer.report()
+        for cname in ("collector.scrapes",):
+            assert report["counters"].get(cname, 0) > 0, \
+                f"smoke: {cname} missing from collector registry"
+        assert report["gauges"].get("collector.procs") == 1, \
+            "smoke: collector.procs gauge missing"
+        assert report["gauges"].get("collector.pair_rate") == 1.0, \
+            "smoke: collector.pair_rate gauge missing"
+        out["collector_registry_ok"] = True
         out["tracer_spans_ok"] = True
     # obs-off overhead pin (round 18 satellite): a DISABLED tracer's
     # span hook must stay one attribute check + one shared no-op
@@ -3227,6 +3704,13 @@ if __name__ == "__main__":
 
     if len(_sys_main.argv) > 1 and _sys_main.argv[1] == "--fleet-mesh-child":
         fleet_mesh_child(_sys_main.argv[2:])
+    elif (
+        len(_sys_main.argv) > 1
+        and _sys_main.argv[1] == "--fleet-trace-child"
+    ):
+        _sys_main.exit(fleet_trace_child(_sys_main.argv[2:]))
+    elif "--fleet-trace" in _sys_main.argv[1:]:
+        _sys_main.exit(fleet_trace(_sys_main.argv[2:]))
     elif (
         len(_sys_main.argv) > 1
         and _sys_main.argv[1] == "--multichip-child"

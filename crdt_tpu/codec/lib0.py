@@ -175,6 +175,12 @@ class Decoder:
     def has_content(self) -> bool:
         return self.pos < len(self.data)
 
+    def remaining(self) -> int:
+        """Bytes left to read — the buffer-anchored bound defensive
+        decoders (state vectors, trace contexts) fence declared
+        counts against before trusting them."""
+        return len(self.data) - self.pos
+
     def read_uint8(self) -> int:
         if self.pos >= len(self.data):
             raise ValueError("unexpected end of lib0 buffer")

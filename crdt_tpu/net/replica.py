@@ -25,6 +25,7 @@ created remotely appear in the cache (D3).
 
 from __future__ import annotations
 
+import math
 import random
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -32,6 +33,8 @@ from typing import Any, Callable, Dict, List, Optional
 from crdt_tpu.api.doc import Crdt
 from crdt_tpu.codec import v1
 from crdt_tpu.core.ids import StateVector
+from crdt_tpu.obs import propagation
+from crdt_tpu.obs.propagation import get_propagation
 from crdt_tpu.obs.recorder import get_recorder, update_digest
 from crdt_tpu.obs.sentinel import DivergenceSentinel
 from crdt_tpu.utils.backoff import jitter
@@ -310,8 +313,13 @@ class Replica:
         )
         # per-origin trace-id sequence: sync frames are stamped with
         # (client, seq, monotonic ts) so per-peer propagation and
-        # convergence lag become measurable gauges downstream
+        # convergence lag become measurable gauges downstream.
+        # Round 19: sampled origin frames additionally carry a wire
+        # trace context (origin tid + per-leg path records) so the
+        # path reconstructs ACROSS processes — see obs/propagation
         self._tid_seq = 0
+        self._trace_sample = propagation.sample_rate()
+        self._pk8 = str(router.public_key)[:8]
 
         # load from the update log (crdt.js:193-217): the whole log
         # replays as ONE batched merge (one observer flush; in device
@@ -689,13 +697,18 @@ class Replica:
             if sv.diff_dominates(mine):
                 continue  # no record deficit
             update = self.doc.encode_state_as_update(sv)
-            self._to_peer(pk, {"update": update})
+            # each AE delta is its own origin frame (per-peer diffs
+            # differ); the anti_entropy route tag makes repair
+            # traffic separable from first-delivery lag downstream
+            trace, path = self._trace_fields(update, "anti_entropy")
+            self._to_peer(pk, {"update": update, **trace})
             sent[pk] = len(update)
             if rec.enabled:
                 rec.record(
                     "ae.delta", topic=self.topic,
                     replica=self.router.public_key, peer=pk,
                     size=len(update), digest=update_digest(update),
+                    tid=trace["tid"], path=path,
                 )
             self.peer_state_vectors[pk] = sv.merge(mine)
         if sent:
@@ -706,6 +719,40 @@ class Replica:
     # ------------------------------------------------------------------
     # local update tail: persist + broadcast (crdt.js:442-446)
     # ------------------------------------------------------------------
+    def _trace_fields(self, update: bytes, route: str) -> tuple:
+        """The wire trace fields for one ORIGIN frame: the round-18
+        trace id + hop count, and (for sampled tids) the round-19
+        wire trace context whose first path record tags this frame's
+        semantic route (``direct`` broadcasts, ``anti_entropy``
+        deltas, ``sync_answer`` diffs — the transport seam may
+        retag a direct leg ``predicted``/``relayed``, and forward
+        seams append further records). Returns ``(fields, path)`` —
+        the dict to splice into the outbound message, plus the
+        recorder-shape path (None when the tid was not sampled)."""
+        self._tid_seq += 1
+        tid = [self.doc.engine.client_id, self._tid_seq,
+               time.monotonic()]
+        fields: dict = {"tid": tid, "hop": 0}
+        path = None
+        # contexts ship only while observability is on in THIS
+        # process (tracer or recorder): with both off, the origin
+        # frame pays nothing beyond the two attribute checks — the
+        # same free-when-off contract as every obs hook. Within an
+        # observed process the sampling knob scales the tax.
+        if (
+            (get_tracer().enabled or get_recorder().enabled)
+            and propagation.sampled(tid[0], tid[1],
+                                    self._trace_sample)
+        ):
+            ctx = propagation.start_context(
+                tid[0], tid[1], self._pk8, route, ts=tid[2]
+            )
+            tc = propagation.encode_context(ctx)
+            fields["tc"] = tc
+            path = ctx.path_json()
+            get_propagation().record_send(tc, len(update))
+        return fields, path
+
     def _on_local_update(self, update: bytes, meta: dict) -> None:
         self._persist(update)
         if not self.closed:
@@ -713,27 +760,24 @@ class Replica:
             # Receivers subtract the stamp from their clock to gauge
             # propagation/convergence lag (exact in-process and on a
             # shared clock; cross-host offsets shift it uniformly).
-            self._tid_seq += 1
-            tid = [self.doc.engine.client_id, self._tid_seq,
-                   time.monotonic()]
+            trace, path = self._trace_fields(update, "direct")
             rec = get_recorder()
             if rec.enabled:
                 rec.record(
                     "update.send", topic=self.topic,
                     replica=self.router.public_key, size=len(update),
-                    digest=update_digest(update), tid=tid, hop=0,
+                    digest=update_digest(update), tid=trace["tid"],
+                    hop=0, path=path,
                 )
-            # hop count (round 18): 0 at the origin, so a direct
-            # receiver records hop=1. Server-generated frames (sync
-            # answers, AE deltas) are NEW diffs, not forwarded
-            # frames — they carry no tid/hop and record as
-            # "unknown". No in-tree tier forwards a frame verbatim
-            # yet; the field is the contract the ROADMAP item-2
-            # fleet relay increments when it does (obsq already
-            # reads the hop distribution off send/recv pairs).
-            self._propagate(
-                {"update": update, "tid": tid, "hop": 0, **meta}
-            )
+            # hop count: 0 at the origin, so a direct receiver
+            # records hop=1. Since round 19 every origin frame —
+            # broadcasts here, sync answers and AE deltas at their
+            # seams — carries tid/hop plus (sampled) the wire trace
+            # context, and the relay forward seam in udp_router
+            # actually increments both (closing the round-18
+            # caveat): a relayed delivery records hop=2 with the
+            # relay's own path record.
+            self._propagate({"update": update, **trace, **meta})
             self._advance_topic_peer_svs()
             self._reset_ae_backoff()  # fresh writes: stay chatty
 
@@ -884,12 +928,18 @@ class Replica:
             if sv is None:
                 return
             diff = self.doc.encode_state_as_update(sv)
+            # a sync answer is an ORIGIN frame (a fresh diff, not a
+            # forward): it gets its own tid + trace context, route
+            # tagged sync_answer — the round-18 "unknown" hop class
+            # becomes attributable
+            trace, path = self._trace_fields(diff, "sync_answer")
             rec = get_recorder()
             if rec.enabled:
                 rec.record(
                     "sync.answer", topic=self.topic,
                     replica=self.router.public_key, peer=requester,
                     size=len(diff), digest=update_digest(diff),
+                    tid=trace["tid"], path=path,
                 )
             self._to_peer(
                 requester,
@@ -897,6 +947,7 @@ class Replica:
                     "update": diff,
                     "meta": "sync",
                     "state_vector": self.doc.encode_state_vector(),
+                    **trace,
                 },
             )
             # record the requester's SV ADVANCED by the diff just sent,
@@ -1007,8 +1058,40 @@ class Replica:
                 # guessed 1, so obsq can tell "unknown" from "direct".
                 raw_hop = m.get("hop")
                 hop = raw_hop + 1 if isinstance(raw_hop, int) else None
+                # round 19: a carried trace context decomposes the
+                # lag per route-tagged leg (obs/propagation ledger:
+                # replica.hop_lag{route=} + birth_to_visibility) and
+                # supplies the authoritative hop count / path. A
+                # hostile context is counted + recorded and dropped
+                # — the update it rode on is untouched.
+                ctx = path = None
+                tc = m.get("tc")
+                if tc is not None:
+                    ctx = propagation.decode_or_none(tc)
+                    if ctx is None:
+                        if rec.enabled:
+                            rec.record(
+                                "update.bad_context",
+                                topic=self.topic,
+                                replica=self.router.public_key,
+                                peer=from_pk,
+                                size=len(tc) if isinstance(
+                                    tc, (bytes, bytearray)) else 0,
+                            )
+                    else:
+                        hop = get_propagation().record_receipt(
+                            ctx, recv_ts=t_done
+                        )
+                        path = ctx.path_json()
+                # the tid rides the same untrusted frame as tc: a
+                # non-numeric (or non-finite) origin stamp must
+                # degrade to "no lag observed", never raise out of
+                # the flush/poll loop
                 if tracer.enabled and isinstance(tid, (list, tuple)) \
-                        and len(tid) == 3:
+                        and len(tid) == 3 \
+                        and isinstance(tid[2], (int, float)) \
+                        and not isinstance(tid[2], bool) \
+                        and math.isfinite(tid[2]):
                     t0 = float(tid[2])
                     lag = t_apply - t0
                     tracer.observe("replica.propagation_lag", lag)
@@ -1021,7 +1104,7 @@ class Replica:
                         "update.recv", topic=self.topic,
                         replica=self.router.public_key, peer=from_pk,
                         size=len(u), digest=update_digest(u), tid=tid,
-                        hop=hop,
+                        hop=hop, path=path,
                     )
         for u in updates:
             tracer.count("replica.updates_applied")
@@ -1045,7 +1128,18 @@ class Replica:
                     if their_sv is None:
                         continue
                     back = self.doc.encode_state_as_update(their_sv)
-                    self._to_peer(from_pk, {"update": back})
+                    trace, path = self._trace_fields(
+                        back, "sync_answer"
+                    )
+                    if rec.enabled:
+                        rec.record(
+                            "sync.answer", topic=self.topic,
+                            replica=self.router.public_key,
+                            peer=from_pk, size=len(back),
+                            digest=update_digest(back),
+                            tid=trace["tid"], path=path,
+                        )
+                    self._to_peer(from_pk, {"update": back, **trace})
                     # the syncer now holds everything we do (see the
                     # ready-branch advance)
                     self.peer_state_vectors[from_pk] = their_sv.merge(

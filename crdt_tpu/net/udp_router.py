@@ -86,8 +86,9 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from crdt_tpu.codec.lib0 import Decoder, Encoder
 from crdt_tpu.net.transport import SecureBox, UdpEndpoint, keypair
-from crdt_tpu.utils.backoff import jitter
+from crdt_tpu.obs import propagation
 from crdt_tpu.obs.recorder import get_recorder
+from crdt_tpu.utils.backoff import jitter
 from crdt_tpu.utils.trace import get_tracer
 
 _HELLO = 0
@@ -153,7 +154,8 @@ def _unpack_any(data: bytes) -> Any:
 class _Peer:
     __slots__ = ("pk_hex", "addr", "topics", "topics_v", "inst", "box",
                  "last_seen", "announce_ttl", "direct", "relay",
-                 "relay_idx", "relay_paused_until", "introducer")
+                 "relay_idx", "relay_paused_until", "introducer",
+                 "predicted")
 
     def __init__(self, pk_hex: str, addr: Tuple[str, int], inst: str,
                  box: SecureBox, *, direct: bool = True):
@@ -174,6 +176,10 @@ class _Peer:
         self.relay_idx = 0  # election cursor (rotated on NAK/death)
         self.relay_paused_until = 0.0  # budget-shed cooldown
         self.introducer: Optional[str] = None  # who told us about them
+        # the proven direct path landed on a PREDICTED port (not the
+        # advertised one): topic frames toward this peer retag their
+        # newest trace-context path record `predicted` (obs seam)
+        self.predicted = False
 
     def new_incarnation(self, inst: str) -> None:
         """A restarted process announces from version 1 again; carrying
@@ -182,6 +188,10 @@ class _Peer:
         self.inst = inst
         self.topics_v = -1
         self.topics = set()
+        # route attribution resets with the incarnation: the new
+        # process proved whatever path it proved, not the old one's
+        # predicted mapping
+        self.predicted = False
 
 
 class _Dial:
@@ -421,10 +431,33 @@ class UdpRouter:
         self._topics_v += 1
         self._announce_topics()
 
+        def send_msg(p: _Peer, msg: dict) -> None:
+            # transport-route attribution (obs/propagation): a frame
+            # whose newest path record says `direct` but which will
+            # ride a relay or a prediction-proven mapping retags that
+            # record BEFORE sealing — per peer, since the route is
+            # per peer. Failures leave the context unchanged;
+            # attribution never breaks delivery.
+            tc = msg.get("tc")
+            if isinstance(tc, (bytes, bytearray)):
+                if not p.direct:
+                    tc2 = propagation.retag_last_hop(
+                        bytes(tc), "relayed"
+                    )
+                elif p.predicted:
+                    tc2 = propagation.retag_last_hop(
+                        bytes(tc), "predicted"
+                    )
+                else:
+                    tc2 = tc
+                if tc2 is not tc:
+                    msg = dict(msg, tc=tc2)
+            self._send_envelope(p, {"t": "m", "topic": topic, "msg": msg})
+
         def propagate(msg: dict) -> None:
             for p in list(self._peers.values()):
                 if topic in p.topics:
-                    self._send_envelope(p, {"t": "m", "topic": topic, "msg": msg})
+                    send_msg(p, msg)
 
         broadcast = propagate  # the reference uses them interchangeably
 
@@ -435,7 +468,7 @@ class UdpRouter:
         def to_peer(public_key: str, msg: dict) -> None:
             p = self._peers.get(public_key)
             if p is not None and topic in p.topics:
-                self._send_envelope(p, {"t": "m", "topic": topic, "msg": msg})
+                send_msg(p, msg)
 
         return propagate, broadcast, for_peers, to_peer
 
@@ -619,20 +652,33 @@ class UdpRouter:
         Returns the number of router-level messages handled."""
         # announcement refresh (TTL liveness; see __init__): members
         # that joined through a bootstrap keep their topic announcement
-        # warm AT THE RENDEZVOUS PEERS ONLY, so introductions never
-        # hand out aged entries — refreshing the whole swarm would be
-        # O(N^2) steady-state traffic nobody consumes
+        # warm at the RENDEZVOUS peers (so introductions never hand
+        # out aged entries) and — since round 19 — at RELAY-ROUTED
+        # peers too: a relay-met peer is exactly one whose announce
+        # had no reliable path (the one-shot announce rides the relay
+        # chain, where an app-level loss is never retransmitted), so
+        # a dropped announce must be a delay, not a permanently
+        # invisible topic. Refreshing the whole swarm would be O(N^2)
+        # steady-state traffic nobody consumes; these two classes are
+        # the ones with no other repair path.
         if (
-            self._rendezvous_pks
-            and self._handlers
+            self._handlers
             and time.monotonic() - self._last_announce
             > self._announce_ttl / 3
         ):
-            # peer=None path: _announce_topics stamps _last_announce
-            self._announce_topics(targets=[
+            refresh_targets = [
                 p for pk, p in self._peers.items()
-                if pk in self._rendezvous_pks
-            ])
+                if pk in self._rendezvous_pks or not p.direct
+            ]
+            if refresh_targets:
+                # peer=None path: _announce_topics stamps
+                # _last_announce
+                self._announce_topics(targets=refresh_targets)
+            else:
+                # nothing to repair until membership changes (joins
+                # announce directly): stamp anyway, or every later
+                # poll pays the peer scan with an expired deadline
+                self._last_announce = time.monotonic()
         self._service_dials()
         self.endpoint.poll()
         handled = 0
@@ -802,14 +848,17 @@ class UdpRouter:
         peer.last_seen = time.monotonic()
         return self._dispatch(peer, payload, addr, via=None)
 
-    def _on_relayed_frame(self, frame: bytes, via: str) -> bool:
+    def _on_relayed_frame(self, frame: bytes, via: str,
+                          relay_hop: Optional[tuple] = None) -> bool:
         """A frame forwarded to us by a relay: `frame` is the same
         sealed wire body a direct envelope carries (sender pk || box).
         The relay authenticated nothing about the CONTENT — end-to-end
         AEAD under the sender's static key does. An unknown sender
         reached this way is registered route-via-relay (its address is
         unknown by definition) and greeted with our topic set, which
-        is the relayed half of the hello handshake."""
+        is the relayed half of the hello handshake. ``relay_hop`` is
+        the relay's (id, monotonic ts) leg attestation from the
+        wrapper, merged into topic messages' trace contexts."""
         sender_raw, sealed = frame[:32], frame[32:]
         pk_hex = sender_raw.hex()
         if pk_hex == self.public_key:
@@ -832,11 +881,44 @@ class UdpRouter:
         peer.last_seen = time.monotonic()
         if announce_back:
             self._announce_topics(peer)
-        return self._dispatch(peer, payload, None, via=via)
+        return self._dispatch(peer, payload, None, via=via,
+                              relay_hop=relay_hop)
+
+    @staticmethod
+    def _merge_relay_hop(msg: dict, relay_hop: tuple) -> dict:
+        """The receiver-side half of the forward-seam hop
+        incrementer: fold the relay's attested leg into the message's
+        trace fields — the legacy ``hop`` count increments, and the
+        trace context (the relay cannot edit it; the frame is sealed
+        end-to-end) gains the relay's path record, delta-stamped from
+        the relay's forward time. Every failure shape — no trace
+        fields, malformed context, hostile attestation types, hop
+        bound reached — leaves the message unchanged."""
+        import math
+
+        hid, hts = relay_hop
+        # finite-only: NaN fails the self-compare, and +/-inf would
+        # overflow the microsecond conversion downstream — either
+        # way a hostile attestation must degrade to "unattributed",
+        # never raise out of the poll loop
+        if not isinstance(hid, str) or not isinstance(
+            hts, (int, float)
+        ) or isinstance(hts, bool) or not math.isfinite(hts):
+            return msg
+        out = dict(msg)
+        if isinstance(out.get("hop"), int):
+            out["hop"] = out["hop"] + 1
+        tc = out.get("tc")
+        if isinstance(tc, (bytes, bytearray)):
+            out["tc"] = propagation.append_hop_wire(
+                bytes(tc), hid, "relayed", hop_ts=float(hts)
+            )
+        return out
 
     def _dispatch(
         self, peer: _Peer, payload: Any,
         addr: Optional[Tuple[str, int]], via: Optional[str],
+        relay_hop: Optional[tuple] = None,
     ) -> bool:
         pk_hex = peer.pk_hex
         t = payload.get("t") if isinstance(payload, dict) else None
@@ -897,7 +979,10 @@ class UdpRouter:
         elif t == "m":
             handler = self._handlers.get(payload.get("topic"))
             if handler is not None:
-                handler(payload.get("msg"), pk_hex)
+                msg = payload.get("msg")
+                if relay_hop is not None and isinstance(msg, dict):
+                    msg = self._merge_relay_hop(msg, relay_hop)
+                handler(msg, pk_hex)
         elif t == "intro":
             # rendezvous introduction — honored ONLY from peers whose
             # key possession was nonce-proven at a configured bootstrap
@@ -970,14 +1055,25 @@ class UdpRouter:
                         "relay.forward", replica=self.public_key,
                         peer=dst_pk, src=pk_hex, size=len(frame),
                     )
+                # the forward-seam half of the hop incrementer: the
+                # inner frame is sealed end-to-end (this relay cannot
+                # edit it), so the relay ATTESTS its leg in the
+                # wrapper — its identity + monotonic forward stamp —
+                # and the receiving router merges that into the
+                # decoded trace context (see _merge_relay_hop)
                 self._send_envelope(
                     dstp,
-                    {"t": "relayed", "src": pk_hex, "f": bytes(frame)},
+                    {"t": "relayed", "src": pk_hex, "f": bytes(frame),
+                     "hid": self.public_key[:8],
+                     "hts": time.monotonic()},
                 )
         elif t == "relayed" and via is None:
             frame = payload.get("f")
             if isinstance(frame, (bytes, bytearray)) and len(frame) > 32:
-                self._on_relayed_frame(bytes(frame), via=pk_hex)
+                self._on_relayed_frame(
+                    bytes(frame), via=pk_hex,
+                    relay_hop=(payload.get("hid"), payload.get("hts")),
+                )
         elif t == "relay_nak":
             dst_pk = payload.get("dst")
             dstp = self._peers.get(dst_pk) if isinstance(dst_pk, str) else None
@@ -1033,7 +1129,19 @@ class UdpRouter:
                     get_tracer().count("router.relay_upgrades")
                 peer.relay = None
             peer.direct = True
-            self._dials.pop(pk_hex, None)
+            d = self._dials.pop(pk_hex, None)
+            # route attribution: the proven address is NOT the
+            # advertised one and the prediction spray actually ran —
+            # this mapping was found by port prediction, so topic
+            # frames toward it carry the `predicted` route tag. A
+            # proof AT the advertised address (re-dial, restart)
+            # clears it: the tag describes the current path.
+            peer.predicted = (
+                self._port_prediction
+                and d is not None
+                and d.attempts >= self._predict_after
+                and addr != d.addr
+            )
             if addr in self._bootstrap_canon:
                 # key possession proven AT a bootstrap address:
                 # grant introducer trust and replay any intro that

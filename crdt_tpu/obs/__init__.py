@@ -28,7 +28,18 @@ replicas x 100k ops needs to be *seen*, not just claimed):
   windows, per-tick ``overlap_efficiency`` / ``stall_ms``, exported
   as Chrome/Perfetto trace-event JSON.
 - :mod:`crdt_tpu.obs.http` — stdlib-only scrape endpoint
-  (``/metrics`` / ``/snapshot`` / ``/events`` / ``/timeline``).
+  (``/metrics`` / ``/snapshot`` / ``/events`` / ``/timeline``; with
+  a collector attached, ``/fleet`` / ``/fleet/timeline``).
+- :mod:`crdt_tpu.obs.propagation` — round 19: the wire trace
+  context (origin tid + bounded route-tagged path records) carried
+  on update/sync-answer/AE frames, per-hop lag attribution
+  (``replica.hop_lag{route=}``, ``replica.birth_to_visibility``),
+  and the tid-pairing/diverge analysis core shared by ``obsq`` and
+  the collector.
+- :mod:`crdt_tpu.obs.collector` — round 19: the live fleet
+  collector federating N processes' scrape endpoints (proc-labeled
+  registries, live cross-process path reconstruction + divergence
+  correlation, merged Perfetto timelines).
 - :mod:`crdt_tpu.obs.profiling` — ``jax_profile`` (device trace
   capture that cannot leak a running profiler) and per-dispatch
   ``device_annotation`` XProf annotations.
@@ -38,9 +49,18 @@ metric/span/event name registry; ``tools/obsq.py`` is the offline
 query CLI over flight-recorder dumps.
 """
 
+from crdt_tpu.obs.collector import FleetCollector, merge_perfetto
 from crdt_tpu.obs.export import snapshot_json, to_prometheus
 from crdt_tpu.obs.http import ObsHTTPServer
 from crdt_tpu.obs.profiling import device_annotation, jax_profile
+from crdt_tpu.obs.propagation import (
+    PropagationLedger,
+    TraceContext,
+    decode_context,
+    encode_context,
+    get_propagation,
+    set_propagation,
+)
 from crdt_tpu.obs.recorder import (
     FlightRecorder,
     get_recorder,
@@ -59,18 +79,26 @@ from crdt_tpu.obs.tracer import Histogram, Tracer, get_tracer, set_tracer
 __all__ = [
     "DivergenceSentinel",
     "MultiDocSentinel",
+    "FleetCollector",
     "FlightRecorder",
     "Histogram",
     "ObsHTTPServer",
+    "PropagationLedger",
     "SLOLedger",
     "TickTimeline",
+    "TraceContext",
     "Tracer",
+    "decode_context",
     "delete_set_digest",
     "device_annotation",
+    "encode_context",
+    "get_propagation",
     "get_recorder",
     "get_timeline",
     "get_tracer",
     "jax_profile",
+    "merge_perfetto",
+    "set_propagation",
     "set_recorder",
     "set_timeline",
     "set_tracer",

@@ -67,12 +67,22 @@ def _filter_events(events, q: Dict[str, list]) -> list:
 
 
 class ObsHTTPServer:
-    """Scrape endpoint over the process-global obs singletons."""
+    """Scrape endpoint over the process-global obs singletons.
+
+    With ``collector=`` (a :class:`crdt_tpu.obs.collector.
+    FleetCollector`), the server additionally exposes the fleet
+    surfaces: ``GET /fleet`` (scrape every registered process, then
+    the fleet report — proc-labeled registries, live cross-process
+    trace pairing and divergence correlation; ``?scrape=0`` reports
+    from the last ingest instead) and ``GET /fleet/timeline`` (the
+    collector-merged multi-process Perfetto trace)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  snapshot_extra: Optional[
-                     Callable[[], Dict[str, Any]]] = None):
+                     Callable[[], Dict[str, Any]]] = None,
+                 collector: Optional[Any] = None):
         self._extra = snapshot_extra
+        self.collector = collector
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -132,10 +142,24 @@ class ObsHTTPServer:
         if u.path == "/timeline":
             return (get_timeline().perfetto_json().encode(),
                     "application/json", 200)
+        if self.collector is not None and u.path == "/fleet":
+            q = parse_qs(u.query)
+            if q.get("scrape", ["1"])[0] not in ("0", "false"):
+                self.collector.scrape()
+            return (json.dumps(
+                self.collector.fleet_report(), sort_keys=True,
+                default=str,
+            ).encode(), "application/json", 200)
+        if self.collector is not None and u.path == "/fleet/timeline":
+            return (json.dumps(
+                self.collector.merged_perfetto()
+            ).encode(), "application/json", 200)
+        routes = ["/metrics", "/snapshot", "/events", "/timeline"]
+        if self.collector is not None:
+            routes += ["/fleet", "/fleet/timeline"]
         return (json.dumps({
             "error": "unknown path",
-            "routes": ["/metrics", "/snapshot", "/events",
-                       "/timeline"],
+            "routes": routes,
         }).encode(), "application/json", 404)
 
     # -- lifecycle -----------------------------------------------------

@@ -211,11 +211,27 @@ class TickTimeline:
         with self._lock:
             self._ring.clear()
 
-    def to_perfetto(self) -> Dict[str, Any]:
+    def to_perfetto(self, *, pid: Optional[int] = None,
+                    process_name: Optional[str] = None
+                    ) -> Dict[str, Any]:
         """The ring as Chrome trace-event JSON (the subset Perfetto
         renders): host phases on tid 1, dispatch in-flight windows on
         tid 2, a counter track for overlap efficiency. Timestamps are
-        microseconds from the first recorded tick."""
+        microseconds from the first recorded tick.
+
+        ``pid`` defaults to the PROCESS identity (``os.getpid()``) —
+        round 18 emitted one flat pid, so collector-merged timelines
+        from multiple processes collided onto one track; now every
+        process exports under its own pid and ``process_name``
+        (default ``crdt_tpu.serve[<pid>]``), and the fleet
+        collector's merge re-pids deterministically on top (see
+        :func:`crdt_tpu.obs.collector.merge_perfetto`)."""
+        if pid is None:
+            import os
+
+            pid = os.getpid()
+        if process_name is None:
+            process_name = f"crdt_tpu.serve[{pid}]"
         epoch = self._epoch if self._epoch is not None else 0.0
 
         def us(t: float) -> float:
@@ -223,12 +239,12 @@ class TickTimeline:
 
         events: List[Dict[str, Any]] = [
             {"name": "process_name", "ph": "M", "ts": 0,
-             "pid": 1, "tid": 0,
-             "args": {"name": "crdt_tpu.serve"}},
+             "pid": pid, "tid": 0,
+             "args": {"name": process_name}},
             {"name": "thread_name", "ph": "M", "ts": 0,
-             "pid": 1, "tid": 1, "args": {"name": "host"}},
+             "pid": pid, "tid": 1, "args": {"name": "host"}},
             {"name": "thread_name", "ph": "M", "ts": 0,
-             "pid": 1, "tid": 2, "args": {"name": "device"}},
+             "pid": pid, "tid": 2, "args": {"name": "device"}},
         ]
         for rec in self.records():
             targs = {"tick": rec["tick"],
@@ -239,13 +255,13 @@ class TickTimeline:
                 "name": f"{rec['label']}[{rec['tick']}]",
                 "ph": "X", "ts": us(rec["t0"]),
                 "dur": round(rec["wall_s"] * 1e6, 1),
-                "pid": 1, "tid": 1, "cat": "tick", "args": targs,
+                "pid": pid, "tid": 1, "cat": "tick", "args": targs,
             })
             for name, a, b in rec["phases"]:
                 events.append({
                     "name": name, "ph": "X", "ts": us(a),
                     "dur": round(max(0.0, b - a) * 1e6, 1),
-                    "pid": 1, "tid": 1, "cat": "phase",
+                    "pid": pid, "tid": 1, "cat": "phase",
                     "args": {"tick": rec["tick"]},
                 })
             for d in rec["dispatches"]:
@@ -255,7 +271,7 @@ class TickTimeline:
                     "name": f"dispatch({d['i']})", "ph": "X",
                     "ts": us(d["enq"]),
                     "dur": round((d["end"] - d["enq"]) * 1e6, 1),
-                    "pid": 1, "tid": 2, "cat": "dispatch",
+                    "pid": pid, "tid": 2, "cat": "dispatch",
                     "args": {
                         "tick": rec["tick"],
                         "fetch_wait_ms": round(
@@ -265,7 +281,7 @@ class TickTimeline:
                 })
             events.append({
                 "name": "overlap_efficiency", "ph": "C",
-                "ts": us(rec["t0"]), "pid": 1, "tid": 1,
+                "ts": us(rec["t0"]), "pid": pid, "tid": 1,
                 "args": {"value": round(rec["overlap_efficiency"], 4)},
             })
         return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -173,6 +173,31 @@ def test_bench_smoke_mode(tmp_path):
     assert out["obs_disabled_span_ns"] < 5000
     assert out["multitenant"]["steady"]["slo_ms"] > 0
 
+    # the round-19 distributed-tracing registries: the traced
+    # loopback swarm lit the per-route hop-lag histograms, the
+    # birth-to-visibility span, the context byte/overhead accounting
+    # (with a hostile context counted, not fatal), and the
+    # self-scrape collector leg federated this process with full
+    # path reconstruction
+    assert out.get("propagation_registry_ok") is True
+    assert out.get("collector_registry_ok") is True
+    for cname in ("propagation.contexts_sent",
+                  "propagation.contexts_received",
+                  "propagation.context_bytes",
+                  "propagation.traced_update_bytes",
+                  "propagation.malformed_contexts",
+                  "collector.scrapes"):
+        assert report["counters"].get(cname, 0) > 0, cname
+    assert "propagation.wire_overhead_ratio" in report["gauges"]
+    assert report["gauges"].get("collector.procs") == 1
+    assert report["gauges"].get("collector.pair_rate") == 1.0
+    for sname in ('replica.hop_lag{route="direct"}',
+                  'replica.hop_lag{route="sync_answer"}',
+                  'replica.hop_lag{route="anti_entropy"}',
+                  "replica.birth_to_visibility"):
+        span = report["spans"].get(sname)
+        assert span is not None and span["count"] > 0, sname
+
     # the guard-layer registry (README "Overload & failure policy"):
     # (kernel_ablation_leg is pinned in-process below — the smoke
     # subprocess stays on its <30s budget)

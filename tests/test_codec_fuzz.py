@@ -351,3 +351,151 @@ def test_replica_survives_keyless_protocol_messages():
         assert dict(b.c)["m"]["k"] == 1
     finally:
         set_tracer(old_tracer)
+
+
+# ---------------------------------------------------------------------------
+# round 19: trace-context wire fuzz — the `tc` frame field fails CLOSED
+# ---------------------------------------------------------------------------
+
+
+def _context_corpus():
+    """Deterministic valid trace-context blobs across the shape
+    space: every route tag, empty through max-hops paths, big tids
+    and deltas."""
+    from crdt_tpu.obs import propagation as P
+
+    blobs = []
+    for i, route in enumerate(P.ROUTES):
+        ctx = P.start_context(7 + i, 1 + i, f"proc{i:04d}", route,
+                              ts=100.0 + i)
+        blobs.append(P.encode_context(ctx))
+        for h in range(P.max_hops() - 1):
+            P.append_hop(ctx, f"fwd{h}", "relayed",
+                         10_000 * (h + 1))
+        blobs.append(P.encode_context(ctx))
+    ctx = P.start_context(2**31 - 1, 2**40, "x" * 16, "direct",
+                          ts=1e9)
+    P.append_hop(ctx, "y" * 16, "anti_entropy", 2**40)
+    blobs.append(P.encode_context(ctx))
+    return blobs
+
+
+def test_fuzzed_trace_contexts_raise_value_error_only():
+    """Seeded truncation / bit-flip / splice mutants of valid
+    contexts: ValueError is the ONLY legal reject (anything else
+    escaping the decode seam would kill a router poll loop), and a
+    mutant that still decodes must decode to an in-bounds context."""
+    from crdt_tpu.obs import propagation as P
+
+    blobs = _context_corpus()
+    rng = random.Random(20260804)
+    checked = rejected = 0
+    for blob in blobs:
+        for _ in range(80):
+            b = bytearray(blob)
+            op = rng.randrange(3)
+            if op == 0 and len(b) > 1:
+                m = bytes(b[: rng.randrange(1, len(b))])
+            elif op == 1:
+                for _ in range(rng.randrange(1, 4)):
+                    b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+                m = bytes(b)
+            else:
+                other = blobs[rng.randrange(len(blobs))]
+                cut = rng.randrange(1, len(b) + 1)
+                m = bytes(b[:cut]) + other[rng.randrange(len(other)):]
+            checked += 1
+            try:
+                ctx = P.decode_context(m)
+            except ValueError:
+                rejected += 1
+                continue
+            # survivors uphold every bound the decoder promises
+            assert len(ctx.hops) <= P.max_hops()
+            for replica, route, delta in ctx.hops:
+                assert route in P.ROUTES
+                assert len(replica) <= P.MAX_REPLICA_ID
+                assert 0 <= delta < 2**53
+    assert checked >= 800
+    assert rejected > checked // 4  # the corpus genuinely bites
+
+
+def test_hostile_context_families_reject():
+    """The named hostile families from the round-19 contract:
+    oversized hop lists, negative ts-deltas, non-bytes payloads,
+    allocation-bomb blobs — each rejects with ValueError."""
+    from crdt_tpu.codec.lib0 import Encoder
+    from crdt_tpu.obs import propagation as P
+
+    def header(n_hops):
+        enc = Encoder()
+        enc.write_uint8(1)
+        enc.write_var_uint(3)
+        enc.write_var_uint(4)
+        enc.write_float64(1.0)
+        enc.write_var_uint(n_hops)
+        return enc
+
+    # oversized hop list (declared count past the protocol bound,
+    # with enough real bytes that only the bound can reject it)
+    enc = header(P.max_hops() + 1)
+    for _ in range(P.max_hops() + 1):
+        enc.write_var_string("ab")
+        enc.write_uint8(0)
+        enc.write_var_int(1)
+    with pytest.raises(ValueError):
+        P.decode_context(enc.to_bytes())
+    # negative ts-delta
+    enc = header(1)
+    enc.write_var_string("ab")
+    enc.write_uint8(0)
+    enc.write_var_int(-1)
+    with pytest.raises(ValueError, match="negative"):
+        P.decode_context(enc.to_bytes())
+    # non-bytes payloads
+    for bad in (None, "s", 0, 1.5, [b"x"], {}, object()):
+        with pytest.raises(ValueError, match="not bytes"):
+            P.decode_context(bad)
+    # allocation bomb: a huge declared blob rejects on size before
+    # any field parses
+    with pytest.raises(ValueError, match="wire bound"):
+        P.decode_context(b"\x01" * (P.MAX_CONTEXT_BYTES + 1))
+
+
+def test_hostile_contexts_never_kill_the_poll_loop():
+    """Replica-level integration: updates carrying every hostile
+    context family apply cleanly (the context drops, counted; the
+    payload integrates; later traffic flows) — the same degrade-not-
+    die contract as malformed updates."""
+    from crdt_tpu.net.replica import ypear_crdt
+    from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+    from crdt_tpu.obs.tracer import Tracer, get_tracer, set_tracer
+
+    old_tracer = get_tracer()
+    set_tracer(Tracer(enabled=True))
+    try:
+        net = LoopbackNetwork()
+        a = ypear_crdt(LoopbackRouter(net, "a"), topic="t",
+                       client_id=1)
+        b = ypear_crdt(LoopbackRouter(net, "b"), topic="t",
+                       client_id=2)
+        net.run()
+        rng = random.Random(42)
+        hostiles = [b"", b"\x00", b"\xff" * 40, "str", 99, [1],
+                    b"\x01" + bytes(rng.randrange(256)
+                                    for _ in range(500))]
+        a.set("m", "base", 0)
+        net.run()
+        blob = a.doc.encode_state_as_update()
+        for i, evil in enumerate(hostiles):
+            b._on_data({"update": blob, "tid": [1, 100 + i, 0.0],
+                        "hop": 0, "tc": evil}, "a")
+        b.flush_incoming()
+        counters = get_tracer().counters()
+        assert counters["propagation.malformed_contexts"] >= \
+            len(hostiles) - 1  # b"" et al: every non-decodable shape
+        a.set("m", "after", 1)
+        net.run()
+        assert dict(b.c)["m"]["after"] == 1
+    finally:
+        set_tracer(old_tracer)

@@ -1,0 +1,243 @@
+"""Round 19: the live fleet collector + merged Perfetto namespacing.
+
+Push- and scrape-mode federation, proc-labeled registries, the live
+analysis surfaces (/fleet pair_rate + divergence correlation), and
+the pid-by-process-identity Perfetto merge the round-19 satellite
+pins (round 18 exported one flat pid, so merged timelines collided).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from crdt_tpu.obs.collector import FleetCollector, merge_perfetto
+from crdt_tpu.obs.http import ObsHTTPServer
+from crdt_tpu.obs.propagation import PropagationLedger, set_propagation
+from crdt_tpu.obs.recorder import FlightRecorder, set_recorder
+from crdt_tpu.obs.timeline import TickTimeline, set_timeline
+from crdt_tpu.obs.tracer import Tracer, set_tracer
+
+
+@pytest.fixture
+def installed():
+    tracer = set_tracer(Tracer(enabled=True))
+    rec = set_recorder(FlightRecorder(enabled=True))
+    tl = set_timeline(TickTimeline(enabled=True))
+    set_propagation(PropagationLedger())
+    yield tracer, rec, tl
+    set_tracer(Tracer(enabled=False))
+    set_recorder(FlightRecorder(enabled=False))
+    set_timeline(TickTimeline(enabled=False))
+    set_propagation(PropagationLedger())
+
+
+def _events_for(proc, tid, *, recv_only=False):
+    out = []
+    if not recv_only:
+        out.append({"ts": 10.0, "kind": "update.send", "tid": tid,
+                    "hop": 0, "path": [[proc, "direct", 0]]})
+    else:
+        out.append({"ts": 10.5, "kind": "update.recv", "tid": tid,
+                    "hop": 1, "path": [["p0", "direct", 0]]})
+    return out
+
+
+class TestPushFederation:
+    def test_cross_proc_pairing_and_labels(self, installed):
+        tracer, _, _ = installed
+        col = FleetCollector()
+        col.push("p0", snapshot={"tracer": {
+            "counters": {"replica.updates_applied": 3,
+                         "tenant.shed{tenant=\"d\"}": 1},
+            "gauges": {"timeline.stall_ms": 0.5},
+        }}, events=_events_for("p0", [1, 1, 10.0]))
+        col.push("p1", snapshot={"tracer": {
+            "counters": {"replica.updates_applied": 4},
+            "gauges": {},
+        }}, events=_events_for("p1", [1, 1, 10.0], recv_only=True))
+        rep = col.fleet_report()
+        assert rep["procs"] == ["p0", "p1"]
+        # the send lives in p0's stream, the recv in p1's — pairing
+        # is genuinely cross-process
+        assert rep["paths"]["pair_rate"] == 1.0
+        assert rep["paths"]["origin_procs"] == ["p0"]
+        m = rep["metrics"]
+        assert m["counters"][
+            'replica.updates_applied{proc="p0"}'] == 3
+        assert m["counters"][
+            'replica.updates_applied{proc="p1"}'] == 4
+        # proc label COMPOSES with existing labels
+        assert m["counters"][
+            'tenant.shed{proc="p0",tenant="d"}'] == 1
+        assert m["sums"]["replica.updates_applied"] == 7
+        g = tracer.report()["gauges"]
+        assert g["collector.pair_rate"] == 1.0
+        assert g["collector.procs"] == 2
+
+    def test_divergence_correlation_is_live(self, installed):
+        col = FleetCollector()
+        col.push("a", events=[
+            {"ts": 1.0, "kind": "update.recv", "topic": "room",
+             "digest": "d1"},
+            {"ts": 2.0, "kind": "divergence", "topic": "room",
+             "local_digest": "xx", "peer_digest": "yy"},
+        ])
+        col.push("b", events=[
+            {"ts": 1.5, "kind": "update.recv", "topic": "room",
+             "digest": "d1"},
+        ])
+        rep = col.fleet_report()
+        assert rep["divergence"]["divergences"] == 1
+        ev = rep["divergence"]["events"][0]
+        assert set(ev["context"]) == {"a", "b"}
+        assert ev["last_common_digests"] == ["d1"]
+
+    def test_divergence_counted_once_across_reports(self, installed):
+        """The same divergence event sits in the merged stream on
+        every scrape; the collector.divergences counter must count
+        it ONCE, not once per fleet_report()."""
+        tracer, _, _ = installed
+        col = FleetCollector()
+        col.push("a", events=[
+            {"ts": 2.0, "kind": "divergence", "topic": "room",
+             "local_digest": "xx", "peer_digest": "yy"},
+        ])
+        for _ in range(5):
+            col.fleet_report()
+        assert tracer.report()["counters"][
+            "collector.divergences"] == 1
+        # a genuinely NEW divergence still counts
+        col.push("b", events=[
+            {"ts": 3.0, "kind": "divergence", "topic": "room2",
+             "local_digest": "aa", "peer_digest": "bb"},
+        ])
+        col.fleet_report()
+        assert tracer.report()["counters"][
+            "collector.divergences"] == 2
+
+
+class TestScrapeFederation:
+    def test_scrape_own_endpoint_and_degrade(self, installed):
+        tracer, rec, _ = installed
+        rec.record("update.send", tid=[1, 1, 1.0], hop=0,
+                   path=[["self", "direct", 0]])
+        tracer.count("replica.updates_applied", 2)
+        obs = ObsHTTPServer(port=0).start()
+        try:
+            col = FleetCollector()
+            col.add_proc("self", obs.url)
+            col.add_proc("dead", "http://127.0.0.1:1")  # no listener
+            ok = col.scrape()
+            assert ok == {"dead": False, "self": True}
+            assert col.scrape_errors == 1
+            rep = col.fleet_report()
+            assert rep["procs"] == ["self"]
+            assert rep["stale_procs"] == ["dead"]
+            assert any(k.endswith('{proc="self"}')
+                       for k in rep["metrics"]["counters"])
+            c = tracer.report()["counters"]
+            assert c["collector.scrapes"] == 1
+            assert c["collector.scrape_errors"] == 1
+        finally:
+            obs.stop()
+
+    def test_fleet_endpoint_routes(self, installed):
+        col = FleetCollector()
+        col.push("p0", snapshot={"tracer": {"counters": {},
+                                            "gauges": {}}},
+                 events=[], timeline={"traceEvents": [
+                     {"name": "process_name", "ph": "M", "ts": 0,
+                      "pid": 77, "tid": 0, "args": {"name": "x"}},
+                 ]})
+        obs = ObsHTTPServer(port=0, collector=col).start()
+        try:
+            body = json.loads(urllib.request.urlopen(
+                obs.url + "/fleet?scrape=0").read())
+            assert body["procs"] == ["p0"]
+            tl = json.loads(urllib.request.urlopen(
+                obs.url + "/fleet/timeline").read())
+            assert tl["traceEvents"][0]["pid"] == 1  # re-pidded
+            # the 404 surface advertises the fleet routes
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(obs.url + "/nope")
+            assert "/fleet" in json.loads(exc.value.read())["routes"]
+        finally:
+            obs.stop()
+
+    def test_no_collector_means_no_fleet_route(self):
+        obs = ObsHTTPServer(port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(obs.url + "/fleet")
+            body = json.loads(exc.value.read())
+            assert body["error"] == "unknown path"
+            assert "/fleet" not in body["routes"]
+        finally:
+            obs.stop()
+
+
+class TestPerfettoNamespacing:
+    def test_to_perfetto_keys_pid_by_process_identity(self,
+                                                      installed):
+        import os
+
+        _, _, tl = installed
+        tl.tick_begin(0)
+        with tl.phase("prepare"):
+            pass
+        tl.tick_end()
+        pf = tl.to_perfetto()
+        pids = {e["pid"] for e in pf["traceEvents"]}
+        assert pids == {os.getpid()}
+        meta = [e for e in pf["traceEvents"]
+                if e["name"] == "process_name"]
+        assert meta[0]["args"]["name"] == \
+            f"crdt_tpu.serve[{os.getpid()}]"
+        # explicit override for embedders
+        pf2 = tl.to_perfetto(pid=5, process_name="gateway")
+        assert {e["pid"] for e in pf2["traceEvents"]} == {5}
+
+    def test_merge_assigns_distinct_deterministic_pids(self):
+        def trace(label):
+            return {"traceEvents": [
+                {"name": "process_name", "ph": "M", "ts": 0,
+                 "pid": 4242, "tid": 0, "args": {"name": label}},
+                {"name": "tick[0]", "ph": "X", "ts": 0, "dur": 5,
+                 "pid": 4242, "tid": 1},
+            ]}
+
+        # identical flat pids in, per-proc pids out — the round-18
+        # collision this satellite closes
+        merged = merge_perfetto({
+            "p1": trace("x"), "p0": trace("x"), "p2": trace("x"),
+        })
+        by_pid = {}
+        for e in merged["traceEvents"]:
+            if e["name"] == "process_name":
+                by_pid[e["pid"]] = e["args"]["name"]
+        assert by_pid == {1: "p0", 2: "p1", 3: "p2"}
+        ticks = [e for e in merged["traceEvents"]
+                 if e["name"] == "tick[0]"]
+        assert sorted(e["pid"] for e in ticks) == [1, 2, 3]
+        # stable under re-merge (sorted by proc name, not dict order)
+        again = merge_perfetto({
+            "p2": trace("x"), "p0": trace("x"), "p1": trace("x"),
+        })
+        assert again == merged
+
+    def test_merged_export_pins_collector_path(self, installed):
+        _, _, tl = installed
+        tl.tick_begin(0)
+        tl.tick_end()
+        col = FleetCollector()
+        col.push("pa", timeline=tl.to_perfetto())
+        col.push("pb", timeline=tl.to_perfetto())
+        merged = col.merged_perfetto()
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert pids == {1, 2}
+        names = {e["args"]["name"]
+                 for e in merged["traceEvents"]
+                 if e["name"] == "process_name"}
+        assert names == {"pa", "pb"}

@@ -1026,3 +1026,77 @@ def test_ruff_clean_if_available():
         text=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_registry_covers_propagation_and_collector_counters():
+    """Round 19 (distributed tracing) added the `propagation.*` and
+    `collector.*` namespaces plus the per-hop replica rows and the
+    bad-context event kind. Both directions must hold: the emitted
+    names stay documented in the README registry, and an
+    UNdocumented propagation/collector name still fires CL201 — the
+    namespaces genuinely joined the registry-checked pool."""
+    reg = _real_registry()
+    for name in ("propagation.contexts_sent",
+                 "propagation.contexts_received",
+                 "propagation.malformed_contexts",
+                 "propagation.hops_appended",
+                 "propagation.hops_capped",
+                 "propagation.context_bytes",
+                 "propagation.traced_update_bytes",
+                 "propagation.wire_overhead_ratio",
+                 "replica.hop_lag",
+                 "replica.birth_to_visibility",
+                 "collector.procs", "collector.pair_rate",
+                 "collector.scrapes", "collector.scrape_errors",
+                 "collector.events_ingested",
+                 "collector.divergences"):
+        assert name in reg.metrics, (
+            f"{name} dropped out of the README registry (round-19 "
+            f"distributed-tracing contract)"
+        )
+    assert "update.bad_context" in reg.events | reg.metrics, (
+        "update.bad_context event kind missing from the README "
+        "event registry"
+    )
+    for path, snippet in (
+        ("crdt_tpu/obs/x.py",
+         'def f(tracer):\n    tracer.count("propagation.bogus", 1)\n'),
+        ("crdt_tpu/obs/x.py",
+         'def f(tracer):\n    tracer.gauge("collector.bogus", 1)\n'),
+    ):
+        result = _lint_snippet(path, snippet,
+                               _reg("propagation.contexts_sent"))
+        assert any(f.code == "CL201" for f in result.findings), (
+            "an undocumented propagation/collector metric no longer "
+            "fires CL201"
+        )
+
+
+def test_hop_lag_route_labels_declared_at_computed_site():
+    """The route-labeled hop-lag observe is a computed name (one
+    f-string over the closed route enum): the `emits=` directive
+    must keep declaring it so both registry directions see it."""
+    with open(os.path.join(REPO, "crdt_tpu", "obs",
+                           "propagation.py")) as f:
+        src = f.read()
+    assert "crdtlint: emits=replica.hop_lag" in src
+
+
+def test_wiretaint_scope_covers_trace_context_decode():
+    """The round-19 decode path is inside the CL10xx/CL11xx scope:
+    an unfenced wire read feeding an allocation in
+    obs/propagation.py must fire, exactly like codec/."""
+    from tools.crdtlint.checkers.decodealloc import DECODE_SCOPE
+    from tools.crdtlint.checkers.wiretaint import SCOPE
+
+    assert any("obs/propagation" in s for s in SCOPE)
+    assert any("obs/propagation" in s for s in DECODE_SCOPE)
+    result = _lint_snippet("crdt_tpu/obs/propagation.py", '''
+def decode_thing(dec):
+    n = dec.read_var_uint()
+    return [0] * n
+''')
+    assert any(f.code == "CL1002" for f in result.findings), (
+        "an unfenced allocation in obs/propagation.py no longer "
+        "fires the wire-taint checker"
+    )

@@ -629,3 +629,77 @@ def test_multitenant_obs_v2_section_keys_gated():
     rows, regressed = compare(tiny_old, tiny_bad)
     assert regressed == []
     assert any(r["verdict"] == "noise" for r in rows)
+
+
+def test_fleet_trace_section_keys_gated():
+    """Round 19: the --fleet-trace artifact keys — procs and
+    pair_rate regress when they FALL (fewer processes federated /
+    paths no longer reconstructing), wire_overhead_ratio when it
+    RISES (the tracing tax grew). Counts/ratios: the seconds noise
+    floor never mutes them."""
+    old = {"fleet_trace": {"procs": 3, "pair_rate": 1.0,
+                           "wire_overhead_ratio": 0.02}}
+    _, regressed = compare(old, copy.deepcopy(old))
+    assert regressed == []
+    bad = {"fleet_trace": {"procs": 2, "pair_rate": 0.6,
+                           "wire_overhead_ratio": 0.06}}
+    _, regressed = compare(old, bad, threshold=0.2)
+    assert "fleet_trace.procs" in regressed
+    assert "fleet_trace.pair_rate" in regressed
+    assert "fleet_trace.wire_overhead_ratio" in regressed
+    # the opposite directions never fail
+    better = {"fleet_trace": {"procs": 5, "pair_rate": 1.0,
+                              "wire_overhead_ratio": 0.001}}
+    _, regressed = compare(old, better)
+    assert regressed == []
+
+
+def test_collector_and_propagation_gauges_gated():
+    """Round 19 tracer rows: collector.procs / collector.pair_rate
+    regress on a FALL (federation shrank / live reconstruction
+    broke); propagation.wire_overhead_ratio and
+    propagation.malformed_contexts regress on a RISE."""
+    old = {"tracer": {
+        "counters": {"propagation.malformed_contexts": 2},
+        "gauges": {"collector.procs": 3, "collector.pair_rate": 1.0,
+                   "propagation.wire_overhead_ratio": 0.02},
+    }}
+    _, regressed = compare(old, copy.deepcopy(old))
+    assert regressed == []
+    bad = {"tracer": {
+        "counters": {"propagation.malformed_contexts": 50},
+        "gauges": {"collector.procs": 1, "collector.pair_rate": 0.5,
+                   "propagation.wire_overhead_ratio": 0.2},
+    }}
+    _, regressed = compare(old, bad, threshold=0.2)
+    assert "tracer.collector.procs" in regressed
+    assert "tracer.collector.pair_rate" in regressed
+    assert "tracer.propagation.wire_overhead_ratio" in regressed
+    assert "tracer.propagation.malformed_contexts" in regressed
+
+
+def test_per_route_hop_lag_spans_gated():
+    """The route-labeled replica.hop_lag histograms ride the span
+    loop: p50/p99/total per route, lower-is-better, seconds noise
+    floor applies (a sub-5ms wobble is scheduler noise)."""
+    old = {"tracer": {"spans": {
+        'replica.hop_lag{route="relayed"}': {
+            "p50_s": 0.10, "p99_s": 0.30, "total_s": 2.0},
+        'replica.hop_lag{route="direct"}': {
+            "p50_s": 0.01, "p99_s": 0.02, "total_s": 0.2},
+    }}}
+    bad = copy.deepcopy(old)
+    bad["tracer"]["spans"][
+        'replica.hop_lag{route="relayed"}']["p99_s"] = 0.9
+    _, regressed = compare(old, bad, threshold=0.2)
+    assert 'tracer.replica.hop_lag{route="relayed"}.p99_s' in \
+        regressed
+    # sub-floor route lags never fail
+    tiny_old = {"tracer": {"spans": {
+        'replica.hop_lag{route="direct"}': {
+            "p50_s": 0.0001, "p99_s": 0.0002, "total_s": 0.001}}}}
+    tiny_bad = copy.deepcopy(tiny_old)
+    tiny_bad["tracer"]["spans"][
+        'replica.hop_lag{route="direct"}']["p99_s"] = 0.002
+    _, regressed = compare(tiny_old, tiny_bad)
+    assert regressed == []

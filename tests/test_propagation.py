@@ -1,0 +1,602 @@
+"""Round 19: the distributed-tracing plane — wire trace context,
+per-hop lag attribution, and the shared analysis core.
+
+The codec contract (bounds, fail-closed rejects), the forward-seam
+hop incrementer (relayed deliveries record hop=2 with the relay's
+identity in the path), the route retag seams, the ledger's
+route-tagged decomposition, and the tid-pairing / path-reconstruction
+core obsq and the fleet collector share.
+"""
+
+import pytest
+
+from crdt_tpu.net.replica import Replica
+from crdt_tpu.net.router import LoopbackNetwork, LoopbackRouter
+from crdt_tpu.obs import propagation as P
+from crdt_tpu.obs.propagation import (
+    PropagationLedger,
+    TraceContext,
+    correlate_divergences,
+    decode_context,
+    encode_context,
+    pair_latency,
+    reconstruct_paths,
+    set_propagation,
+)
+from crdt_tpu.obs.recorder import FlightRecorder, set_recorder
+from crdt_tpu.obs.tracer import Tracer, set_tracer
+
+
+@pytest.fixture
+def installed():
+    tracer = set_tracer(Tracer(enabled=True))
+    rec = set_recorder(FlightRecorder(enabled=True))
+    ledger = set_propagation(PropagationLedger())
+    yield tracer, rec, ledger
+    set_tracer(Tracer(enabled=False))
+    set_recorder(FlightRecorder(enabled=False))
+    set_propagation(PropagationLedger())
+
+
+# ---------------------------------------------------------------------------
+# wire codec: the stable contract
+# ---------------------------------------------------------------------------
+
+
+class TestContextCodec:
+    def test_round_trip(self):
+        ctx = P.start_context(7, 3, "abcd1234", "direct", ts=100.5)
+        P.append_hop(ctx, "relay001", "relayed", 2500)
+        out = decode_context(encode_context(ctx))
+        assert out.origin_client == 7
+        assert out.origin_seq == 3
+        assert out.origin_ts == 100.5
+        assert out.hops == [("abcd1234", "direct", 0),
+                            ("relay001", "relayed", 2500)]
+        assert out.tid == [7, 3, 100.5]
+        assert out.path_json() == [["abcd1234", "direct", 0],
+                                   ["relay001", "relayed", 2500]]
+
+    def test_every_route_tag_round_trips(self):
+        for route in P.ROUTES:
+            ctx = P.start_context(1, 1, "r", route, ts=0.0)
+            assert decode_context(
+                encode_context(ctx)
+            ).hops[0][1] == route
+
+    def test_compactness(self):
+        """The wire tax stays a few dozen bytes even at the hop
+        bound — the <5% overhead budget needs this."""
+        ctx = P.start_context(2**31 - 1, 10_000, "abcdef12",
+                              "direct", ts=12345.678)
+        while P.append_hop(ctx, "someproc", "relayed", 10**7):
+            pass
+        blob = encode_context(ctx)
+        assert len(ctx.hops) == P.max_hops()
+        assert len(blob) <= 16 + 16 * P.max_hops()
+
+    def test_rejects_non_bytes(self):
+        for bad in (None, "text", 7, [1, 2], {"a": 1}, 3.5):
+            with pytest.raises(ValueError):
+                decode_context(bad)
+
+    def test_rejects_truncations_value_error_only(self):
+        ctx = P.start_context(9, 9, "abcd", "anti_entropy", ts=5.0)
+        P.append_hop(ctx, "efgh", "relayed", 123)
+        blob = encode_context(ctx)
+        for cut in range(len(blob)):
+            try:
+                decode_context(blob[:cut])
+            except ValueError:
+                pass  # the only legal outcome besides success
+
+    def test_rejects_trailing_garbage(self):
+        blob = encode_context(
+            P.start_context(1, 1, "a", "direct", ts=0.0)
+        )
+        with pytest.raises(ValueError):
+            decode_context(blob + b"\x00")
+
+    def test_rejects_oversized_hop_list(self):
+        from crdt_tpu.codec.lib0 import Encoder
+
+        enc = Encoder()
+        enc.write_uint8(1)
+        enc.write_var_uint(1)
+        enc.write_var_uint(1)
+        enc.write_float64(0.0)
+        enc.write_var_uint(2**40)  # declared hops: absurd
+        with pytest.raises(ValueError):
+            decode_context(enc.to_bytes())
+
+    def test_rejects_negative_ts_delta(self):
+        from crdt_tpu.codec.lib0 import Encoder
+
+        enc = Encoder()
+        enc.write_uint8(1)
+        enc.write_var_uint(1)
+        enc.write_var_uint(1)
+        enc.write_float64(0.0)
+        enc.write_var_uint(1)
+        enc.write_var_string("ab")
+        enc.write_uint8(0)
+        enc.write_var_int(-5)
+        with pytest.raises(ValueError, match="negative"):
+            decode_context(enc.to_bytes())
+
+    def test_rejects_unknown_route_and_version(self):
+        from crdt_tpu.codec.lib0 import Encoder
+
+        enc = Encoder()
+        enc.write_uint8(1)
+        enc.write_var_uint(1)
+        enc.write_var_uint(1)
+        enc.write_float64(0.0)
+        enc.write_var_uint(1)
+        enc.write_var_string("ab")
+        enc.write_uint8(250)  # no such route
+        enc.write_var_int(0)
+        with pytest.raises(ValueError, match="route"):
+            decode_context(enc.to_bytes())
+        blob = bytearray(encode_context(
+            P.start_context(1, 1, "a", "direct", ts=0.0)
+        ))
+        blob[0] = 9  # no such version
+        with pytest.raises(ValueError, match="version"):
+            decode_context(bytes(blob))
+
+    def test_rejects_oversized_blob_before_parsing(self):
+        with pytest.raises(ValueError, match="wire bound"):
+            decode_context(b"\x01" + b"x" * P.MAX_CONTEXT_BYTES)
+
+    def test_rejects_non_finite_origin_ts(self):
+        for hostile in (float("nan"), float("inf"), float("-inf")):
+            blob = encode_context(
+                P.start_context(1, 1, "a", "direct", ts=hostile)
+            )
+            with pytest.raises(ValueError, match="finite"):
+                decode_context(blob)
+
+    def test_forward_seam_survives_hostile_stamps(self):
+        """A hostile relay attestation (inf/NaN stamp, wrong types)
+        must degrade to 'unattributed' — never raise out of the
+        router poll loop (OverflowError was the reviewed crash)."""
+        from crdt_tpu.net.udp_router import UdpRouter
+
+        blob = encode_context(
+            P.start_context(1, 1, "a", "direct", ts=100.0)
+        )
+        for stamp in (float("inf"), float("-inf"), float("nan")):
+            assert P.append_hop_wire(blob, "r", "relayed",
+                                     hop_ts=stamp) == blob
+        msg = {"update": b"u", "tid": [1, 1, 100.0], "hop": 0,
+               "tc": blob}
+        for hts in (float("inf"), float("nan"), "soon", None, True):
+            out = UdpRouter._merge_relay_hop(msg, ("relay1", hts))
+            assert out == msg  # unchanged, no exception
+        # a sane far-future stamp clamps into the wire-legal range
+        far = P.append_hop_wire(blob, "r", "relayed", hop_ts=1e300)
+        assert decode_context(far).hops[-1][2] < 2**53
+
+    def test_decode_or_none_counts_malformed(self, installed):
+        tracer, _, _ = installed
+        assert P.decode_or_none(b"\xffgarbage") is None
+        assert P.decode_or_none("not-bytes") is None
+        assert P.decode_or_none(None) is None  # absent != malformed
+        assert tracer.report()["counters"][
+            "propagation.malformed_contexts"] == 2
+
+    def test_hop_bound_refuses_and_counts(self, installed):
+        tracer, _, _ = installed
+        ctx = P.start_context(1, 1, "a", "direct", ts=0.0)
+        for _ in range(P.max_hops() * 2):
+            P.append_hop(ctx, "b", "relayed", 1)
+        assert len(ctx.hops) == P.max_hops()
+        c = tracer.report()["counters"]
+        assert c["propagation.hops_capped"] > 0
+        # append_hop_wire honors the same bound (blob unchanged)
+        blob = encode_context(ctx)
+        assert P.append_hop_wire(blob, "c", "relayed") == blob
+
+    def test_retag_preserves_semantic_routes(self):
+        direct = encode_context(
+            P.start_context(1, 1, "a", "direct", ts=0.0)
+        )
+        assert decode_context(
+            P.retag_last_hop(direct, "relayed")
+        ).hops[0][1] == "relayed"
+        assert decode_context(
+            P.retag_last_hop(direct, "predicted")
+        ).hops[0][1] == "predicted"
+        ae = encode_context(
+            P.start_context(1, 1, "a", "anti_entropy", ts=0.0)
+        )
+        assert P.retag_last_hop(ae, "relayed") == ae  # preserved
+        assert P.retag_last_hop(b"junk", "relayed") == b"junk"
+
+    def test_sampling_deterministic_and_bounded(self):
+        assert P.sampled(1, 1, 1.0)
+        assert not P.sampled(1, 1, 0.0)
+        picks = [P.sampled(5, s, 0.5) for s in range(400)]
+        assert picks == [P.sampled(5, s, 0.5) for s in range(400)]
+        assert 0.3 < sum(picks) / len(picks) < 0.7
+
+
+# ---------------------------------------------------------------------------
+# leg attribution math + the ledger
+# ---------------------------------------------------------------------------
+
+
+class TestLegAttribution:
+    def test_hop_legs_close_against_next_stamp_then_recv(self):
+        path = [("a", "direct", 0), ("r", "relayed", 400_000)]
+        legs = P.hop_legs(path, 100.0, 101.0)
+        assert legs == [("a", "direct", pytest.approx(0.4)),
+                        ("r", "relayed", pytest.approx(0.6))]
+
+    def test_hop_legs_clamp_clock_skew(self):
+        # a cross-host offset can put the recv BEFORE a stamp: lags
+        # clamp at 0, never negative
+        path = [["a", "direct", 900_000]]
+        legs = P.hop_legs(path, 100.0, 100.1)
+        assert legs == [("a", "direct", 0.0)]
+
+    def test_hop_legs_reject_malformed_offline_paths(self):
+        assert P.hop_legs([["a", "bogus_route", 0]], 0.0, 1.0) == []
+        assert P.hop_legs([["a", "direct", "NaN"]], 0.0, 1.0) == []
+
+    def test_ledger_routes_and_overhead(self, installed):
+        tracer, _, ledger = installed
+        ledger.record_send(b"x" * 30, 1000)
+        ledger.record_send(b"y" * 20, 1000)
+        ctx = TraceContext(1, 1, 0.0, [("a", "direct", 0)])
+        assert ledger.record_receipt(ctx, recv_ts=0.25) == 1
+        rep = ledger.report()
+        assert rep["wire_overhead_ratio"] == pytest.approx(0.025)
+        assert rep["contexts_sent"] == 2
+        assert rep["contexts_received"] == 1
+        assert rep["hop_lag_by_route"]["direct"]["count"] == 1
+        assert rep["birth_to_visibility"]["count"] == 1
+        g = tracer.report()["gauges"]
+        assert g["propagation.wire_overhead_ratio"] == \
+            pytest.approx(0.025)
+        spans = tracer.report()["spans"]
+        assert spans['replica.hop_lag{route="direct"}']["count"] == 1
+        assert spans["replica.birth_to_visibility"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# replica integration over the loopback fabric
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaTracing:
+    def test_origin_routes_and_paths_recorded(self, installed):
+        tracer, rec, _ = installed
+        net = LoopbackNetwork()
+        ra = Replica(LoopbackRouter(net, "aaaa"), topic="t",
+                     client_id=1)
+        rb = Replica(LoopbackRouter(net, "bbbb"), topic="t",
+                     client_id=2)
+        ra.set("m", "k", "v" * 64)
+        net.run()
+        rc = Replica(LoopbackRouter(net, "cccc"), topic="t",
+                     client_id=3)  # late join: sync_answer legs
+        net.run()
+        events = rec.events()
+        sends = [e for e in events if e["kind"] == "update.send"]
+        assert sends and all(
+            e["path"] == [["aaaa", "direct", 0]] for e in sends
+        )
+        answers = [e for e in events if e["kind"] == "sync.answer"]
+        assert answers
+        for e in answers:
+            assert e["tid"] is not None
+            assert e["path"][0][1] == "sync_answer"
+        recvs = [e for e in events if e["kind"] == "update.recv"
+                 and e.get("path")]
+        assert recvs
+        for e in recvs:
+            assert e["hop"] == len(e["path"])
+        spans = tracer.report()["spans"]
+        assert spans['replica.hop_lag{route="direct"}']["count"] > 0
+        assert spans[
+            'replica.hop_lag{route="sync_answer"}']["count"] > 0
+        assert rc.c == ra.c
+
+    def test_anti_entropy_route_tagged(self, installed):
+        from crdt_tpu.core.ids import StateVector
+
+        tracer, rec, _ = installed
+        net = LoopbackNetwork()
+        ra = Replica(LoopbackRouter(net, "aaaa"), topic="t",
+                     client_id=1)
+        rb = Replica(LoopbackRouter(net, "bbbb"), topic="t",
+                     client_id=2)
+        ra.set("m", "k", "v")
+        net.run()
+        ra.peer_state_vectors["bbbb"] = StateVector()  # fake deficit
+        ra.anti_entropy()
+        net.run()
+        deltas = [e for e in rec.events() if e["kind"] == "ae.delta"]
+        assert deltas and deltas[0]["path"][0][1] == "anti_entropy"
+        assert tracer.report()["spans"][
+            'replica.hop_lag{route="anti_entropy"}']["count"] > 0
+        assert rb.c == ra.c
+
+    def test_hostile_context_never_blocks_the_update(self, installed):
+        tracer, rec, _ = installed
+        net = LoopbackNetwork()
+        ra = Replica(LoopbackRouter(net, "aaaa"), topic="t",
+                     client_id=1)
+        rb = Replica(LoopbackRouter(net, "bbbb"), topic="t",
+                     client_id=2)
+        ra.set("m", "k", "v")
+        net.run()
+        blob = ra.doc.encode_state_as_update()
+        for evil in (b"\xff\x01junk", "not-bytes", 123,
+                     b"\x01" + b"z" * 600):
+            rb._on_data({"update": blob, "tid": [1, 99, 0.0],
+                         "hop": 0, "tc": evil}, "aaaa")
+            rb.flush_incoming()
+        c = tracer.report()["counters"]
+        assert c["propagation.malformed_contexts"] >= 4
+        bad = [e for e in rec.events()
+               if e["kind"] == "update.bad_context"]
+        assert len(bad) >= 4
+        assert rb.c == ra.c  # every update applied regardless
+
+    def test_hostile_tid_never_blocks_the_update(self, installed):
+        """The tid rides the same untrusted frame as tc: non-numeric
+        / non-finite origin stamps and unhashable elements must
+        degrade (no lag observed), never raise out of the poll
+        loop."""
+        tracer, rec, _ = installed
+        net = LoopbackNetwork()
+        ra = Replica(LoopbackRouter(net, "aaaa"), topic="t",
+                     client_id=1)
+        rb = Replica(LoopbackRouter(net, "bbbb"), topic="t",
+                     client_id=2)
+        ra.set("m", "k", "v")
+        net.run()
+        blob = ra.doc.encode_state_as_update()
+        for evil_tid in ([1, 2, "evil"], [1, 2, float("nan")],
+                         [1, 2, float("inf")], [[1], 2, 3.0],
+                         [1, 2, None], [1, 2, True]):
+            rb._on_data({"update": blob, "tid": evil_tid,
+                         "hop": 0}, "aaaa")
+            rb.flush_incoming()
+        assert rb.c == ra.c
+        # the analysis core survives the same tids off the ring
+        events = [dict(e, _src="x") for e in rec.events()]
+        pair_latency(events)
+        reconstruct_paths(events)
+
+    def test_obs_off_ships_no_context(self):
+        """The free-when-off contract: with tracer AND recorder
+        disabled, origin frames carry tid/hop but no wire context —
+        the obs-off send path pays no encode, no ledger lock."""
+        from crdt_tpu.obs.propagation import (
+            PropagationLedger,
+            set_propagation,
+        )
+
+        ledger = set_propagation(PropagationLedger())
+        try:
+            net = LoopbackNetwork()
+            ra = Replica(LoopbackRouter(net, "aaaa"), topic="t",
+                         client_id=1)
+            rb = Replica(LoopbackRouter(net, "bbbb"), topic="t",
+                         client_id=2)
+            seen = []
+            orig = ra._propagate
+            ra._propagate = lambda m: (seen.append(m),
+                                       orig(m))[-1]
+            ra.set("m", "k", "v")
+            net.run()
+            updates = [m for m in seen if "update" in m]
+            assert updates and all("tc" not in m for m in updates)
+            assert all("tid" in m for m in updates)  # tid stays
+            assert ledger.report()["contexts_sent"] == 0
+            assert rb.c == ra.c
+        finally:
+            set_propagation(PropagationLedger())
+
+    def test_sampling_zero_attaches_no_context(self, installed,
+                                               monkeypatch):
+        monkeypatch.setenv("CRDT_TPU_TRACE_SAMPLE", "0")
+        tracer, rec, ledger = installed
+        net = LoopbackNetwork()
+        ra = Replica(LoopbackRouter(net, "aaaa"), topic="t",
+                     client_id=1)
+        rb = Replica(LoopbackRouter(net, "bbbb"), topic="t",
+                     client_id=2)
+        ra.set("m", "k", "v")
+        net.run()
+        sends = [e for e in rec.events()
+                 if e["kind"] == "update.send"]
+        assert sends and all(e["path"] is None for e in sends)
+        assert ledger.report()["contexts_sent"] == 0
+        assert rb.c == ra.c  # tid/hop (and delivery) unaffected
+
+
+# ---------------------------------------------------------------------------
+# the relay forward seam: hop=2 with the relay's identity
+# ---------------------------------------------------------------------------
+
+
+class TestRelayedHopIncrement:
+    @pytest.mark.slow
+    def test_relayed_delivery_records_two_hops(self, installed):
+        from crdt_tpu.net.faults import (
+            NatFabric,
+            SymmetricNat,
+            install_nat,
+            pump_until,
+        )
+        from crdt_tpu.net.udp_router import UdpRouter
+
+        tracer, rec, _ = installed
+        fabric = NatFabric()
+        boot = UdpRouter(rendezvous=True)
+        install_nat(boot, fabric)
+        kw = dict(dial_retry_s=0.05, port_prediction=False,
+                  relay_after_s=0.3)
+        a = UdpRouter(bootstrap=[boot.addr], **kw)
+        install_nat(a, fabric, SymmetricNat(21000))
+        b = UdpRouter(bootstrap=[boot.addr], **kw)
+        install_nat(b, fabric, SymmetricNat(23000))
+        routers = [boot, a, b]
+        try:
+            ra = Replica(a, topic="room", client_id=1,
+                         probe_retry_s=0.1, anti_entropy_s=0.2)
+            rb = Replica(b, topic="room", client_id=2,
+                         probe_retry_s=0.1, anti_entropy_s=0.2)
+            ra.set("m", "ka", "x" * 32)
+            pump_until(
+                routers,
+                lambda: rb.c.get("m", {}).get("ka") == "x" * 32,
+                timeout_s=30.0,
+            )
+            assert not a._peers[b.public_key].direct  # really relayed
+            relayed = [
+                e for e in rec.events()
+                if e["kind"] == "update.recv" and e.get("path")
+                and len(e["path"]) == 2
+            ]
+            assert relayed, "no two-hop delivery recorded"
+            for e in relayed:
+                origin, leg2 = e["path"]
+                # the origin leg keeps its SEMANTIC tag when it is a
+                # sync answer / AE delta; plain broadcasts retag
+                # `relayed` at the send seam
+                assert origin[1] in ("relayed", "sync_answer",
+                                     "anti_entropy")
+                assert leg2[1] == "relayed"
+                assert leg2[0] == boot.public_key[:8]  # the relay
+                assert e["hop"] == 2
+            spans = tracer.report()["spans"]
+            assert spans[
+                'replica.hop_lag{route="relayed"}']["count"] > 0
+        finally:
+            for r in routers:
+                r.close()
+
+
+# ---------------------------------------------------------------------------
+# the shared analysis core (offline == live; obsq is a thin shell)
+# ---------------------------------------------------------------------------
+
+
+def _mk_events():
+    return [
+        {"ts": 100.0, "kind": "update.send", "tid": [1, 1, 100.0],
+         "hop": 0, "path": [["a", "direct", 0]], "_src": "a"},
+        {"ts": 100.2, "kind": "update.recv", "tid": [1, 1, 100.0],
+         "hop": 1, "path": [["a", "direct", 0]], "_src": "b"},
+        {"ts": 100.3, "kind": "update.recv", "tid": [1, 1, 100.0],
+         "hop": 2,
+         "path": [["a", "relayed", 0], ["r", "relayed", 100_000]],
+         "_src": "c"},
+        {"ts": 101.0, "kind": "ae.delta", "tid": [2, 1, 101.0],
+         "path": [["b", "anti_entropy", 0]], "_src": "b"},
+        {"ts": 101.4, "kind": "update.recv", "tid": [2, 1, 101.0],
+         "hop": 1, "path": [["b", "anti_entropy", 0]], "_src": "c"},
+    ]
+
+
+class TestAnalysisCore:
+    def test_pair_latency_routes_and_percentiles(self):
+        lat = pair_latency(_mk_events())
+        assert lat["sends"] == 2
+        assert lat["pairs"] == 3
+        assert lat["unmatched_recv"] == 0
+        assert lat["hops"] == {"1": 2, "2": 1}
+        assert set(lat["routes"]) == {"direct", "relayed",
+                                      "anti_entropy"}
+        assert lat["routes"]["relayed"]["count"] == 2
+        assert lat["paths"]["pair_rate"] == 1.0
+
+    def test_reconstruct_flags_incomplete(self):
+        evs = _mk_events()
+        evs.append({"ts": 102.0, "kind": "update.recv",
+                    "tid": [9, 9, 102.0], "hop": 1,
+                    "path": [["z", "direct", 0]], "_src": "c"})
+        out = reconstruct_paths(evs)
+        assert out["traced_recvs"] == 4
+        assert out["complete"] == 3
+        assert out["pair_rate"] == pytest.approx(3 / 4)
+        assert out["incomplete_sample"][0]["tid"] == [9, 9, 102.0]
+        # hop-count / path-depth mismatch is incomplete too
+        evs2 = _mk_events()
+        evs2[1]["hop"] = 5
+        assert reconstruct_paths(evs2)["complete"] == 2
+
+    def test_correlate_divergences_matches_obsq_shape(self):
+        evs = _mk_events()
+        evs.append({"ts": 103.0, "kind": "divergence",
+                    "topic": None, "local_digest": "xx",
+                    "peer_digest": "yy", "_src": "c"})
+        out = correlate_divergences(evs, context=2)
+        assert out["divergences"] == 1
+        assert set(out["events"][0]["context"]) == {"a", "b", "c"}
+
+    def test_unhashable_tids_never_crash_the_core(self):
+        evs = _mk_events()
+        evs.append({"ts": 200.0, "kind": "update.send",
+                    "tid": [[1], {"a": 2}, 3.0], "hop": 0,
+                    "path": [["z", "direct", 0]], "_src": "a"})
+        evs.append({"ts": 200.1, "kind": "update.recv",
+                    "tid": [[1], 2, 3.0], "hop": 1,
+                    "path": [["z", "direct", 0]], "_src": "b"})
+        lat = pair_latency(evs)  # no TypeError
+        out = reconstruct_paths(evs)
+        # the unhashable recv is traced but cannot pair: incomplete
+        assert out["complete"] == 3
+        assert lat["unmatched_recv"] >= 1
+
+    def test_relayed_hostile_context_counts_once(self, installed):
+        tracer, _, _ = installed
+        evil = b"\xffnot-a-context"
+        # the forward seam declines to count (the receiver is the
+        # authoritative counter)
+        assert P.append_hop_wire(evil, "r", "relayed") == evil
+        assert P.retag_last_hop(evil, "relayed") == evil
+        c = tracer.report()["counters"]
+        assert c.get("propagation.malformed_contexts", 0) == 0
+        assert P.decode_or_none(evil) is None  # receiver seam counts
+        assert tracer.report()["counters"][
+            "propagation.malformed_contexts"] == 1
+
+    def test_proc_tag_is_src_fallback(self):
+        # collector events carry `proc=`, obsq events `_src=` — the
+        # core accepts either spelling
+        evs = [dict(e) for e in _mk_events()]
+        for e in evs:
+            e["proc"] = e.pop("_src")
+        assert reconstruct_paths(evs)["pair_rate"] == 1.0
+        assert sorted(
+            reconstruct_paths(evs)["origin_procs"]
+        ) == ["a", "b"]
+
+
+class TestLoopbackEndToEndPairRate:
+    def test_full_swarm_reconstructs_completely(self, installed):
+        tracer, rec, _ = installed
+        net = LoopbackNetwork(reorder=True, duplicate=0.1, seed=3)
+        reps = [
+            Replica(LoopbackRouter(net, f"r{i}"), topic="t",
+                    client_id=10 + i)
+            for i in range(3)
+        ]
+        for i, r in enumerate(reps):
+            r.set("m", f"k{i}", "v" * 128)
+            net.run()
+        events = [dict(e, _src="proc") for e in rec.events()]
+        out = reconstruct_paths(events)
+        assert out["traced_recvs"] > 0
+        assert out["pair_rate"] == 1.0
+        assert all(reps[0].c == r.c for r in reps)
+        # convergence stamp: the ledger saw every traced delivery
+        lat = pair_latency(events)
+        assert lat["unmatched_recv"] == 0

@@ -39,7 +39,11 @@ from tools.crdtlint.checkers.wiretaint import (
 )
 from tools.crdtlint.core import Checker, Finding, LintContext, Module
 
-DECODE_SCOPE = ("crdt_tpu/codec/", "crdt_tpu/storage/kv.py")
+DECODE_SCOPE = ("crdt_tpu/codec/", "crdt_tpu/storage/kv.py",
+                # round 19: decode_context is a wire-facing decode
+                # entry (trace contexts on update frames) — held to
+                # the same buffer-anchored allocation standard
+                "crdt_tpu/obs/propagation.py")
 
 
 def _handler_bound_names(fn_node) -> Dict[str, Set[str]]:

@@ -77,7 +77,11 @@ from tools.crdtlint.astutil import (
 )
 from tools.crdtlint.core import Checker, Finding, LintContext, Module
 
-SCOPE = ("crdt_tpu/codec/", "crdt_tpu/storage/", "crdt_tpu/net/")
+SCOPE = ("crdt_tpu/codec/", "crdt_tpu/storage/", "crdt_tpu/net/",
+         # round 19: the wire trace-context decode rides update
+         # frames off the open network — same hostile-input class as
+         # the codec paths, same machine-checked fences
+         "crdt_tpu/obs/propagation.py")
 
 # wire-reader call tails: distinctive enough to match on any receiver
 READER_TAILS = frozenset({

@@ -35,6 +35,9 @@ NAMESPACES = frozenset({
     # round 18 (observability v2): the SLO ledger and the
     # tick-timeline profiler
     "slo", "timeline",
+    # round 19 (distributed tracing): the wire trace-context /
+    # per-hop lag plane and the live fleet collector
+    "propagation", "collector",
 })
 
 # backticked dotted names that share a namespace but are NOT metrics
@@ -53,6 +56,8 @@ NON_METRICS = frozenset({
     "shard.wire",                 # tracer names (they surface only as
     "shard.out",                  # {path=...} label values on the
     "shard.sv",                   # xfer byte counters)
+    "timeline.to_perfetto",       # API reference in the round-19
+    #                               tracing section, not a metric
 })
 
 # span names without a dot, pinned only by HOT_PATH_SPANS

@@ -56,6 +56,15 @@ Compared (whatever of these both artifacts carry):
   and the chaos flooder's deterministic
   ``multitenant.flood.slo_flooder.breaches`` (lower).
 
+- distributed tracing (round 19): the ``fleet_trace.*`` section keys
+  from ``bench.py --fleet-trace`` (``procs`` / ``pair_rate`` higher,
+  ``wire_overhead_ratio`` lower), the collector federation gauges
+  (``collector.procs`` / ``collector.pair_rate``, higher, counts),
+  ``propagation.wire_overhead_ratio`` /
+  ``propagation.malformed_contexts`` (lower), and the per-route
+  ``replica.hop_lag{route=...}`` latency histograms via the span
+  loop (lower, seconds noise floor).
+
 Prints a table (one row per metric: old, new, delta, verdict) and
 exits non-zero when any metric regressed past ``--threshold``
 (relative; default 0.20 = 20%). Improvements never fail the gate.
@@ -147,6 +156,15 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     # loop below, where the ms noise floor applies.
     (("multitenant", "timeline", "mean_overlap_efficiency"), True),
     (("multitenant", "flood", "slo_flooder", "breaches"), False),
+    # distributed tracing (round 19, bench --fleet-trace): processes
+    # federated and the fraction of traced receives whose full
+    # per-hop path reconstructs across them (both higher = better,
+    # count semantics — never muted by the seconds floor), and the
+    # trace-context wire tax as a fraction of traced update bytes
+    # (lower = better: the tracing plane must stay cheap)
+    (("fleet_trace", "procs"), True),
+    (("fleet_trace", "pair_rate"), True),
+    (("fleet_trace", "wire_overhead_ratio"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 
@@ -314,10 +332,20 @@ def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
     # timeline.overlap_efficiency is the double-buffer's measured
     # overlap (HIGHER is better — the one gauge whose drop means the
     # pipeline re-serialized; a ratio, never muted)
+    # distributed tracing (round 19): the collector's federation
+    # gauges (procs scraped, live path-reconstruction rate — both
+    # HIGHER is better, count semantics), the wire-overhead ratio
+    # and malformed-context count (lower). The per-route
+    # `replica.hop_lag{route=...}` histograms ride the span loop
+    # above (p50/p99/total lower-is-better like every latency).
     for section, name, hib, is_seconds in (
         ("counters", "slo.breaches", False, False),
         ("gauges", "timeline.stall_ms", False, True),
         ("gauges", "timeline.overlap_efficiency", True, False),
+        ("gauges", "collector.procs", True, False),
+        ("gauges", "collector.pair_rate", True, False),
+        ("gauges", "propagation.wire_overhead_ratio", False, False),
+        ("counters", "propagation.malformed_contexts", False, False),
     ):
         a = (old.get("tracer") or {}).get(section, {}).get(name)
         b = (new.get("tracer") or {}).get(section, {}).get(name)

@@ -1,38 +1,50 @@
 #!/usr/bin/env python
-"""obsq — query flight-recorder JSONL dumps offline.
+"""obsq — query flight-recorder event streams, offline or live.
 
 The flight recorder answers "what happened at the sync seams" one
-process at a time; the ROADMAP item-2 fleet will dump one ring per
-server process, and the questions the divergence sentinel raises are
-CROSS-dump questions ("which doc forked, and what did each side see
-right before?"). This CLI loads one or more dumps (each line one
-event, as ``FlightRecorder.dump_jsonl`` writes them), merges them on
-the shared monotonic timebase, and answers the recurring postmortem
-queries without a notebook:
+process at a time; the round-19 distributed-tracing plane makes the
+CROSS-process questions first-class. This CLI is a THIN shell over
+the shared analysis core in :mod:`crdt_tpu.obs.propagation` — the
+same tid-pairing, per-route hop-lag decomposition, path
+reconstruction, and divergence correlation the live fleet collector
+serves at ``/fleet`` — so offline dumps and live scrapes share one
+implementation (round-19 satellite; the logic used to live here).
+
+Inputs are flight-recorder JSONL dumps (as ``FlightRecorder.
+dump_jsonl`` writes them) — or, live, ``http(s)://`` base URLs of
+running ``ObsHTTPServer`` processes (their ``/events`` tail is
+fetched; ``obsq diverge http://a:9001 http://b:9002`` promotes the
+divergence postmortem from offline to live):
 
     python tools/obsq.py summary  dump_a.jsonl dump_b.jsonl
     python tools/obsq.py filter   dump.jsonl --kind update.recv --doc room
     python tools/obsq.py filter   dump.jsonl --tid 7:3
     python tools/obsq.py latency  dump_a.jsonl dump_b.jsonl
+    python tools/obsq.py paths    dump_a.jsonl http://127.0.0.1:9001
     python tools/obsq.py diverge  dump_a.jsonl dump_b.jsonl
 
-- ``summary``  — event counts per kind and per source file, time range.
+- ``summary``  — event counts per kind and per source, time range.
 - ``filter``   — events matching ``--kind`` (exact), ``--doc``
   (matches an event's ``doc`` or ``topic``), ``--peer`` (``peer`` or
   ``replica``), ``--tid`` (``client:seq`` prefix of the origin trace
   id), printed as JSONL oldest-first with a ``_src`` field naming the
-  dump each event came from.
-- ``latency``  — pairs ``update.send``/``update.recv`` events by
-  trace id ACROSS dumps and prints propagation-latency percentiles
-  (p50/p90/p99/max) plus the hop-count distribution (round 18: recv
-  events carry ``hop``).
-- ``diverge``  — finds ``divergence`` events and correlates the two
-  dumps around each: the last ``--context`` events from every source
-  before the divergence timestamp, filtered to its topic, digests
-  compared side by side — the "what did each side see" question.
+  source each event came from.
+- ``latency``  — pairs origin events (``update.send``,
+  ``sync.answer``, ``ae.delta``) with ``update.recv`` by trace id
+  ACROSS sources: propagation percentiles, hop-count distribution,
+  and per-ROUTE leg-lag percentiles decomposed from the carried path
+  records (``crdt_tpu.obs.propagation.pair_latency``).
+- ``paths``    — full path reconstruction: the fraction of traced
+  receives whose complete per-hop path (route tags + origin pairing)
+  reconstructs across sources, with an incomplete sample for
+  debugging (``reconstruct_paths``).
+- ``diverge``  — correlates ``divergence`` events with each source's
+  trailing context and the last common digests
+  (``correlate_divergences``).
 
 Exit code: 0 on success (even when nothing matches), 2 on unreadable
-input. Stdlib-only (the analysis lane must not import jax).
+input. Stdlib + ``crdt_tpu.obs.propagation`` only — the analysis
+lane must not import jax (the package imports it lazily).
 """
 
 from __future__ import annotations
@@ -42,30 +54,61 @@ import json
 import sys
 from typing import Any, Dict, List, Optional
 
+from crdt_tpu.obs.propagation import (
+    correlate_divergences,
+    pair_latency,
+    reconstruct_paths,
+)
 
-def load_events(paths: List[str]) -> List[Dict[str, Any]]:
-    """All events of all dumps, oldest-first on the shared monotonic
-    timebase, each tagged with ``_src`` (basename of its dump)."""
+
+def _read_source(path: str) -> List[str]:
+    """Lines of one source: a JSONL file, or — for http(s) URLs — a
+    live ObsHTTPServer's ``/events`` tail."""
+    if path.startswith(("http://", "https://")):
+        import urllib.request
+
+        url = path.rstrip("/")
+        if not url.endswith("/events"):
+            url += "/events"
+        try:
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                return resp.read().decode(
+                    "utf-8", "replace"
+                ).splitlines()
+        except OSError as exc:
+            raise OSError(f"{path}: {exc}") from None
+    with open(path, encoding="utf-8") as f:
+        return f.read().splitlines()
+
+
+def _src_name(path: str) -> str:
     import os
 
+    if path.startswith(("http://", "https://")):
+        return path.split("//", 1)[1].rstrip("/")
+    return os.path.basename(path)
+
+
+def load_events(paths: List[str]) -> List[Dict[str, Any]]:
+    """All events of all sources, oldest-first on the shared
+    monotonic timebase, each tagged with ``_src``."""
     events: List[Dict[str, Any]] = []
     for path in paths:
-        src = os.path.basename(path)
-        with open(path, encoding="utf-8") as f:
-            for lineno, line in enumerate(f, start=1):
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    ev = json.loads(line)
-                except ValueError as exc:
-                    # surfaces as exit 2 in main() — same unreadable-
-                    # input class as a missing file
-                    raise ValueError(
-                        f"{path}:{lineno}: not JSONL ({exc})"
-                    ) from None
-                ev["_src"] = src
-                events.append(ev)
+        src = _src_name(path)
+        for lineno, line in enumerate(_read_source(path), start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError as exc:
+                # surfaces as exit 2 in main() — same unreadable-
+                # input class as a missing file
+                raise ValueError(
+                    f"{path}:{lineno}: not JSONL ({exc})"
+                ) from None
+            ev["_src"] = src
+            events.append(ev)
     events.sort(key=lambda e: (e.get("ts", 0.0), e["_src"]))
     return events
 
@@ -91,23 +134,6 @@ def match(ev: Dict[str, Any], *, kind: Optional[str] = None,
     return True
 
 
-def _percentiles(sorted_vals: List[float]) -> Dict[str, float]:
-    def q(p: float) -> float:
-        if not sorted_vals:
-            return 0.0
-        i = min(len(sorted_vals) - 1,
-                max(0, int(p * len(sorted_vals) + 0.5) - 1))
-        return sorted_vals[i]
-
-    return {
-        "count": len(sorted_vals),
-        "p50_s": q(0.50),
-        "p90_s": q(0.90),
-        "p99_s": q(0.99),
-        "max_s": sorted_vals[-1] if sorted_vals else 0.0,
-    }
-
-
 def cmd_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     kinds: Dict[str, int] = {}
     srcs: Dict[str, int] = {}
@@ -126,101 +152,25 @@ def cmd_summary(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     }
 
 
-def cmd_latency(events: List[Dict[str, Any]]) -> Dict[str, Any]:
-    """send/recv pairing by trace id across every loaded dump: the
-    cross-process propagation story. One send may fan out to many
-    receivers; every (send, recv) pair contributes one latency."""
-    sends: Dict[tuple, float] = {}
-    for e in events:
-        t = e.get("tid")
-        if e.get("kind") == "update.send" and isinstance(
-                t, (list, tuple)) and len(t) >= 3:
-            sends.setdefault((t[0], t[1]), float(t[2]))
-    lats: List[float] = []
-    unmatched_recv = 0
-    hops: Dict[str, int] = {}
-    for e in events:
-        if e.get("kind") != "update.recv":
-            continue
-        t = e.get("tid")
-        key = (t[0], t[1]) if isinstance(
-            t, (list, tuple)) and len(t) >= 2 else None
-        if key is not None and key in sends and isinstance(
-                e.get("ts"), (int, float)):
-            lats.append(max(0.0, e["ts"] - sends[key]))
-        else:
-            unmatched_recv += 1
-        h = e.get("hop")
-        hkey = str(h) if isinstance(h, int) else "unknown"
-        hops[hkey] = hops.get(hkey, 0) + 1
-    lats.sort()
-    return {
-        "sends": len(sends),
-        "pairs": len(lats),
-        "unmatched_recv": unmatched_recv,
-        "propagation": _percentiles(lats),
-        "hops": dict(sorted(hops.items())),
-    }
-
-
-def cmd_diverge(events: List[Dict[str, Any]],
-                context: int = 8) -> Dict[str, Any]:
-    """Correlate divergence events across the loaded dumps: for each,
-    the trailing ``context`` events per source on the same topic
-    before the divergence, with digests surfaced for eyeballing which
-    update the two sides last disagreed on."""
-    out: List[Dict[str, Any]] = []
-    divs = [e for e in events if e.get("kind") == "divergence"]
-    for div in divs:
-        topic = div.get("topic")
-        ts = div.get("ts", float("inf"))
-        per_src: Dict[str, List[Dict[str, Any]]] = {}
-        for e in events:
-            if e is div or e.get("ts", 0.0) > ts:
-                continue
-            if topic is not None and \
-                    e.get("topic") not in (None, topic):
-                continue
-            per_src.setdefault(e["_src"], []).append(e)
-        ctx = {
-            src: [
-                {k: ev.get(k) for k in
-                 ("ts", "kind", "peer", "replica", "digest", "tid",
-                  "hop", "size") if k in ev}
-                for ev in evs[-context:]
-            ]
-            for src, evs in sorted(per_src.items())
-        }
-        digests = {
-            src: [e.get("digest") for e in evs if e.get("digest")]
-            for src, evs in ctx.items()
-        }
-        common = set.intersection(
-            *(set(d) for d in digests.values())
-        ) if len(digests) > 1 else set()
-        out.append({
-            "divergence": {
-                k: div.get(k) for k in
-                ("ts", "topic", "peer", "replica", "local_digest",
-                 "peer_digest", "doc") if k in div
-            },
-            "src": div["_src"],
-            "context": ctx,
-            "last_common_digests": sorted(common),
-        })
-    return {"divergences": len(divs), "events": out}
+# thin aliases over the shared core — kept as module attributes so
+# existing callers (tests, notebooks) keep working
+cmd_latency = pair_latency
+cmd_paths = reconstruct_paths
+cmd_diverge = correlate_divergences
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="obsq",
-        description="query flight-recorder JSONL dumps",
+        description="query flight-recorder event streams "
+                    "(JSONL dumps or live /events URLs)",
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
-    for name in ("summary", "filter", "latency", "diverge"):
+    for name in ("summary", "filter", "latency", "paths", "diverge"):
         p = sub.add_parser(name)
         p.add_argument("dumps", nargs="+",
-                       help="flight-recorder JSONL dump file(s)")
+                       help="flight-recorder JSONL dump file(s) "
+                            "or live ObsHTTPServer URL(s)")
         if name == "filter":
             p.add_argument("--kind")
             p.add_argument("--doc")
@@ -248,6 +198,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.cmd == "latency":
         print(json.dumps(cmd_latency(events), indent=1,
+                         sort_keys=True))
+        return 0
+    if args.cmd == "paths":
+        print(json.dumps(cmd_paths(events), indent=1,
                          sort_keys=True))
         return 0
     if args.cmd == "diverge":
