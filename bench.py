@@ -1430,6 +1430,91 @@ def multitenant_steady_leg() -> dict:
     }
 
 
+def multitenant_pooled_leg() -> dict:
+    """The round-20 pooled-resident-matrix evidence: N warm docs ALL
+    above the device crossover (``CRDT_TPU_DEVICE_MIN=1`` for the
+    leg), small deltas per tick — the pooled route batches every
+    doc's device round into ONE splice+converge dispatch
+    (:class:`crdt_tpu.ops.resident.ResidentPool`), the unpooled
+    baseline pays one per doc. The leg measures the DISPATCH COUNT
+    per steady tick (``packed.device_dispatch_count`` delta — a
+    count, not a timing: the gate never rides the ms noise floor)
+    and publishes the pool's own counters; digests are asserted
+    byte-identical between the two routes."""
+    from crdt_tpu.models.multidoc import MultiDocServer
+    from crdt_tpu.ops import packed as pk
+
+    D = int(os.environ.get("BENCH_MT_POOLED_DOCS", 8))
+    K = int(os.environ.get("BENCH_MT_POOLED_OPS", 384))
+    delta_ops = int(os.environ.get("BENCH_MT_POOLED_DELTA", 4))
+    ticks = int(os.environ.get("BENCH_MT_POOLED_TICKS", 4))
+
+    streams = [_SteadyStream(500 + i) for i in range(D)]
+    ids = [f"p{i:04d}" for i in range(D)]
+    history = [[s.delta(K)] for s in streams]
+    warm = [[s.delta(delta_ops) for s in streams] for _ in range(2)]
+    tick_deltas = [
+        [s.delta(delta_ops) for s in streams] for _ in range(ticks)
+    ]
+
+    def run(pool: bool):
+        srv = MultiDocServer(delta_ticks=True, pool=pool)
+        for i, d in enumerate(ids):
+            srv.submit(d, history[i][0])
+        srv.prepare()
+        srv.tick()                      # cold ingest — untimed
+        for w in warm:                  # promotion + first delta
+            for i, d in enumerate(ids):
+                srv.submit(d, w[i])
+            srv.prepare()
+            srv.tick()
+        d0 = pk.device_dispatch_count
+        t0 = time.perf_counter()
+        for t in range(ticks):
+            for i, d in enumerate(ids):
+                srv.submit(d, tick_deltas[t][i])
+            srv.prepare()
+            srv.tick()
+        dt = time.perf_counter() - t0
+        return (pk.device_dispatch_count - d0) / ticks, dt, srv
+
+    # force every doc above the crossover: the evidence IS the
+    # dispatch count, and below the crossover both routes host-route
+    prev = os.environ.get("CRDT_TPU_DEVICE_MIN")
+    os.environ["CRDT_TPU_DEVICE_MIN"] = "1"
+    try:
+        dp, t_pooled, srv_p = run(True)
+        du, t_unpooled, srv_u = run(False)
+    finally:
+        if prev is None:
+            os.environ.pop("CRDT_TPU_DEVICE_MIN", None)
+        else:
+            os.environ["CRDT_TPU_DEVICE_MIN"] = prev
+
+    mismatches = sum(
+        srv_p.digest(d) != srv_u.digest(d) for d in ids
+    )
+    pool = srv_p.pool
+    return {
+        "pooled_docs": D,
+        "pooled_ops_per_doc": K,
+        # the tentpole number: steady device dispatches per tick,
+        # pooled (O(1)) vs per-doc (O(docs)) — gated lower-is-better
+        # with count semantics in tools/metrics_diff.py
+        "device_dispatches_per_tick": dp,
+        "unpooled_dispatches_per_tick": du,
+        "dispatch_reduction": round(du / dp, 2) if dp else None,
+        "pooled_tick_s": round(t_pooled, 4),
+        "unpooled_tick_s": round(t_unpooled, 4),
+        "pool_dispatches": pool.dispatches if pool else 0,
+        "pool_docs": pool.doc_count() if pool else 0,
+        "pool_bytes": pool.device_bytes() if pool else 0,
+        "pool_peak_bytes": pool.peak_bytes if pool else 0,
+        "pool_compactions": pool.compactions if pool else 0,
+        "pooled_oracle_identical": mismatches == 0,
+    }
+
+
 def multitenant(argv=None) -> int:
     """The ``--multitenant`` harness: run the round-14 packing leg
     AND the round-15 steady-state leg, merge the gated section into
@@ -1453,6 +1538,10 @@ def multitenant(argv=None) -> int:
         timeline = set_timeline(TickTimeline(enabled=True))
     leg = multitenant_leg()
     leg["steady"] = multitenant_steady_leg()
+    # the round-20 pooled dispatch-floor keys publish at the steady
+    # level: multitenant.steady.device_dispatches_per_tick (and the
+    # pool counters) are what tools/metrics_diff.py gates
+    leg["steady"].update(multitenant_pooled_leg())
     if tracer is not None:
         counters = tracer.counters()
         leg["docs_packed_counted"] = counters.get(
@@ -1494,7 +1583,11 @@ def multitenant(argv=None) -> int:
         and bool(leg["steady"]["oracle_identical"]) \
         and leg["steady"]["speedup"] >= 10 \
         and bool(leg["steady"]["eviction"]["bounded"]) \
-        and bool(leg["steady"]["eviction"]["reconverge_identical"])
+        and bool(leg["steady"]["eviction"]["reconverge_identical"]) \
+        and bool(leg["steady"]["pooled_oracle_identical"]) \
+        and leg["steady"]["device_dispatches_per_tick"] <= 2 \
+        and leg["steady"]["device_dispatches_per_tick"] \
+        < leg["steady"]["unpooled_dispatches_per_tick"]
     if ok:
         try:
             with open(BENCH_OUT) as f:
@@ -1519,6 +1612,8 @@ def multitenant(argv=None) -> int:
         "steady_docs_per_s": leg["steady"]["docs_per_s"],
         "steady_speedup": leg["steady"]["speedup"],
         "steady_evictions": leg["steady"]["eviction"]["evictions"],
+        "steady_device_dispatches_per_tick":
+            leg["steady"]["device_dispatches_per_tick"],
         "full_results": os.path.basename(BENCH_OUT),
     }))
     return 0 if ok else 1
@@ -2486,6 +2581,37 @@ def smoke():
             assert gname in report["gauges"], \
                 f"smoke: {gname} gauge missing"
         out["mt_incremental_registry_ok"] = True
+        # the round-20 pooled-resident registry: a tiny all-warm
+        # device-forced leg must batch every doc's device round into
+        # ONE pooled dispatch per tick, byte-identical to the
+        # unpooled route, lighting the tenant.pool_* counters/gauges
+        # the dispatch-floor gates read
+        os.environ.setdefault("BENCH_MT_POOLED_DOCS", "4")
+        os.environ.setdefault("BENCH_MT_POOLED_OPS", "48")
+        os.environ.setdefault("BENCH_MT_POOLED_DELTA", "3")
+        os.environ.setdefault("BENCH_MT_POOLED_TICKS", "2")
+        mtp = multitenant_pooled_leg()
+        assert mtp["pooled_oracle_identical"], \
+            "smoke: pooled route diverges from unpooled"
+        assert mtp["device_dispatches_per_tick"] <= 2, \
+            "smoke: pooled steady ticks above the dispatch floor"
+        assert mtp["device_dispatches_per_tick"] \
+            < mtp["unpooled_dispatches_per_tick"], \
+            "smoke: pooling did not reduce dispatches"
+        assert mtp["pool_dispatches"] > 0, \
+            "smoke: pooled flush never dispatched"
+        out["multitenant"]["steady"]["device_dispatches_per_tick"] = \
+            mtp["device_dispatches_per_tick"]
+        out["multitenant"]["steady"]["pool_peak_bytes"] = \
+            mtp["pool_peak_bytes"]
+        report = tracer.report()
+        for cname in ("tenant.pool_dispatches",):
+            assert report["counters"].get(cname, 0) > 0, \
+                f"smoke: {cname} missing from pooled registry"
+        for gname in ("tenant.pool_bytes", "tenant.pool_docs"):
+            assert gname in report["gauges"], \
+                f"smoke: {gname} gauge missing"
+        out["mt_pooled_registry_ok"] = True
         # the round-18 SLO registry: the chaos flood leg above ran
         # with slo_ms=0, so breaches / burn rate / route mix must be
         # live (shed==breach for the flooder is asserted in the leg
@@ -2528,6 +2654,13 @@ def smoke():
                 assert k in ev, f"smoke: Perfetto event missing {k}"
             if ev["ph"] == "X":
                 assert ev["dur"] >= 0, "smoke: negative duration"
+        tl_art = os.environ.get("BENCH_SMOKE_TIMELINE")
+        if tl_art:
+            # the schema-validated export doubles as CI's uploaded
+            # timeline artifact (open at ui.perfetto.dev) — same
+            # run-what-you-already-ran pattern as BENCH_SMOKE_OUT
+            with open(tl_art, "w") as f:
+                json.dump(pf, f)
         out["timeline_registry_ok"] = True
         # the round-19 propagation registry: a tiny traced loopback
         # swarm (broadcast + late-join sync answer + one forced AE
@@ -2672,11 +2805,16 @@ def smoke():
             json.dump({**out, "tracer": report}, f, indent=1,
                       sort_keys=True)
             f.write("\n")
-    # the numpy contender's phase dict stays in the artifact above;
-    # on stdout it would push the one-line JSON past emit_result's
-    # 1500-byte tail budget (nothing downstream reads it from the
-    # line — the gated dict is phases_device_s)
+    # the numpy contender's phase dict (and the round-20 pooled
+    # steady keys) stay in the artifact above; on stdout they would
+    # push the one-line JSON past emit_result's 1500-byte tail
+    # budget (nothing downstream reads them from the line — the
+    # gated keys ride the artifact, where metrics_diff looks)
     out.pop("phases_numpy_s", None)
+    if isinstance(out.get("multitenant", {}).get("steady"), dict):
+        out["multitenant"]["steady"].pop(
+            "device_dispatches_per_tick", None)
+        out["multitenant"]["steady"].pop("pool_peak_bytes", None)
     emit_result(out, path=None)  # smoke never overwrites run evidence
 
 

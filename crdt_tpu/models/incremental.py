@@ -302,7 +302,8 @@ class IncrementalReplay:
         return dict(cls._calibrate())
 
     def __init__(self, capacity: int = 1 << 14,
-                 device_min_rows: Optional[int] = None):
+                 device_min_rows: Optional[int] = None,
+                 pool=None):
         import jax
         import jax.numpy as jnp
 
@@ -389,6 +390,15 @@ class IncrementalReplay:
         self._capacity = capacity
         self._mat = None
         self.n_dev = 0
+        # pooled resident matrix (round 20): when attached, device
+        # rounds DEFER to the shared pool — the server's tick flushes
+        # every warm doc's delta in ONE dispatch — and this engine
+        # never allocates a private matrix. Registration is host
+        # bookkeeping only; a pool-budget refusal later falls back to
+        # the private route (correctness never depends on pooling).
+        self.pool = pool
+        if pool is not None:
+            pool.register(self)
 
     def _ensure_mat(self):
         if self._mat is None:
@@ -414,9 +424,14 @@ class IncrementalReplay:
             for raw, od in old.items():
                 perm[od] = dense[raw]
             with enable_x64(True):
-                self._mat = pk._relabel_mat(
-                    self._mat, self._jnp.asarray(perm)
-                )
+                if self.pool is not None:
+                    # pooled: only THIS doc's extent columns relabel
+                    # (ids are doc-local in the pooled matrix)
+                    self.pool.relabel(self, perm)
+                else:
+                    self._mat = pk._relabel_mat(
+                        self._mat, self._jnp.asarray(perm)
+                    )
             # host columns keep RAW ids; only the device matrix embeds
             # dense ids, so no host fixups
         # the table commits only AFTER the device relabel succeeded: a
@@ -476,6 +491,10 @@ class IncrementalReplay:
         the seam for callers that decoded once for their own purposes
         (replay_trace's host route) and must not pay the codec
         twice."""
+        if self.pool is not None and self.pool.has_pending(self):
+            # a deferred pooled round left winners/orders stale; the
+            # host paths below may read them — settle first
+            self.pool.flush()
         n_raw = len(dec["client"])
         touched: set = set()
 
@@ -624,10 +643,16 @@ class IncrementalReplay:
         integer column store — the allocations that scale with doc
         size and survive across rounds (content payloads live in the
         caller's blobs either way). The multi-doc resident budget
-        (``CRDT_TPU_MT_RESIDENT_BYTES``) sums this per doc."""
+        (``CRDT_TPU_MT_RESIDENT_BYTES``) sums this per doc. A POOLED
+        doc accounts its reserved extent share of the shared matrix
+        (8 lanes x extent capacity — the extent reserves eagerly at
+        defer time, so the ledger commit after a delta tick sees the
+        post-round share)."""
         dev = 0
         if self._mat is not None:
             dev = int(self._mat.shape[0]) * int(self._mat.shape[1]) * 8
+        elif self.pool is not None:
+            dev = self.pool.doc_device_bytes(self)
         return dev + self.cols._cap * len(_Cols.INT_COLS) * 8
 
     @staticmethod
@@ -637,11 +662,14 @@ class IncrementalReplay:
         building an over-budget engine, so it works from an estimate:
         the pow2 host column capacity plus a worst-case device matrix
         at the same bucket (host-path docs never allocate it; the
-        bound errs toward refusing)."""
+        bound errs toward refusing). The device term uses the POOLED
+        layout's 8 lanes — the wider of the two routes — so the
+        estimate upper-bounds :meth:`resident_bytes` whichever way
+        the doc lands (unit-pinned by tests/test_pooled.py)."""
         cap = 1024
         while cap < max(n_rows, 1):
             cap *= 2
-        return cap * len(_Cols.INT_COLS) * 8 + 7 * bucket_pow2(cap) * 8
+        return cap * len(_Cols.INT_COLS) * 8 + 8 * bucket_pow2(cap) * 8
 
     # -- local-op fast path -------------------------------------------
     def admit_local(self, recs, ds: Optional[DeleteSet] = None) -> None:
@@ -657,6 +685,10 @@ class IncrementalReplay:
         back to the exact blob path; while stashed or rootless rows
         are outstanding the fast path is skipped entirely (only the
         full pass retries them)."""
+        if self.pool is not None and self.pool.has_pending(self):
+            # deferred pooled round outstanding: the incremental
+            # splices below read winners/orders — settle first
+            self.pool.flush()
         if self._pending or self._rootless or not self._can_fast(recs):
             from crdt_tpu.codec import v1 as _v1
 
@@ -1240,6 +1272,10 @@ class IncrementalReplay:
         """The plain-JSON view, flushed on read: rounds only mark
         touched segments dirty, so a replica that is never read pays
         no materialization (crdt.js's `c` equivalent)."""
+        if self.pool is not None and self.pool.has_pending(self):
+            # a deferred pooled round must settle before the rebuild
+            # reads winners/orders
+            self.pool.flush()
         if self._dirty:
             dirty, self._dirty = self._dirty, set()
             try:
@@ -1622,6 +1658,32 @@ class IncrementalReplay:
                 host_segs.extend(dev_segs)
                 dev_segs = []
 
+        if dev_segs and self.pool is not None:
+            # pooled route (round 20): the device part of this round
+            # DEFERS — the pool's flush splices every warm doc's
+            # tail and converges all touched segments in ONE
+            # dispatch. Winners/orders of the deferred segments stay
+            # stale until that flush; every read path (cache,
+            # admit_local, the next apply) force-flushes first.
+            if self.pool.defer(self, dev_segs):
+                dev_segs = []
+            else:
+                # pool budget refused the doc's extent
+                # (CRDT_TPU_MT_POOL_BYTES): permanent fallback to the
+                # private matrix. This round routes host-side (exact)
+                # — including anything the pool still held deferred —
+                # and the next device round re-splices the whole host
+                # column set into a fresh private matrix (n_dev=0).
+                pend = self.pool.take_pending(self)
+                self.pool.release(self)
+                self.pool = None
+                self._mat = None
+                self.n_dev = 0
+                host_segs.extend(
+                    (set(dev_segs) | pend) - set(host_segs)
+                )
+                dev_segs = []
+
         if dev_segs:
             # stage the UNSPLICED TAIL (this batch + any rows host
             # rounds left behind) as a packed matrix; row 7 carries
@@ -1722,6 +1784,7 @@ class IncrementalReplay:
                 dev_segs = []
         if dev_segs:
             self._mat, h, sel_bucket = res
+            pk.count_device_dispatch()
             # advance by the REAL row count: the padded tail is
             # invalid and the next splice overwrites it, keeping
             # device positions identical to host row ids

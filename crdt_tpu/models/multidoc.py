@@ -141,6 +141,7 @@ _PENDING_BYTES_ENV = "CRDT_TPU_MT_PENDING_BYTES"
 _PENDING_UPDATES_ENV = "CRDT_TPU_MT_PENDING_UPDATES"
 _RESIDENT_BYTES_ENV = "CRDT_TPU_MT_RESIDENT_BYTES"
 _DELTA_TICKS_ENV = "CRDT_TPU_MT_DELTA_TICKS"
+_POOL_BYTES_ENV = "CRDT_TPU_MT_POOL_BYTES"
 
 
 def _env_int(name: str, default: int) -> int:
@@ -273,6 +274,9 @@ class TickReport(NamedTuple):
     delta_rows: int = 0    # delta rows those docs staged (their whole
     #                        staging cost — history stayed resident)
     promotions: int = 0    # docs promoted to resident this tick
+    pool_dispatches: int = 0  # pooled flush dispatches (round 20:
+    #                           0 or 1 — every warm doc's device-route
+    #                           delta batched into one converge)
 
 
 class ServeReport(NamedTuple):
@@ -306,7 +310,9 @@ class MultiDocServer:
                  pack_docs: bool = True,
                  delta_ticks: Optional[bool] = None,
                  resident_max_bytes: Optional[int] = None,
-                 slo_ms: Optional[float] = None):
+                 slo_ms: Optional[float] = None,
+                 pool: Optional[bool] = None,
+                 pool_max_bytes: Optional[int] = None):
         self.max_rows = (max_rows_per_dispatch
                          if max_rows_per_dispatch is not None
                          else _env_int(_MAX_ROWS_ENV, 1 << 16))
@@ -325,6 +331,23 @@ class MultiDocServer:
             env = os.environ.get(_RESIDENT_BYTES_ENV, "")
             resident_max_bytes = int(env) if env else None
         self.rbudget = ResidentBudget(resident_max_bytes)
+        # pooled resident matrix (round 20): every promoted engine
+        # shares ONE device allocation, and the tick's above-crossover
+        # deltas batch into ONE flush dispatch. ``pool=False`` (or
+        # CRDT_TPU_MT_POOL_BYTES=0) keeps the per-doc private
+        # matrices — the unpooled oracle the differential suite and
+        # the bench baseline measure against. Construction is host
+        # bookkeeping only; the matrix allocates on the first flush.
+        if pool_max_bytes is None:
+            env = os.environ.get(_POOL_BYTES_ENV, "")
+            pool_max_bytes = int(env) if env else None
+        if pool is None:
+            pool = pool_max_bytes != 0
+        self.pool = None
+        if self.delta_ticks and pool and pool_max_bytes != 0:
+            from crdt_tpu.ops.resident import ResidentPool
+
+            self.pool = ResidentPool(max_bytes=pool_max_bytes)
         self.shards = shards
         self.pack_docs = pack_docs
         self.ticks = 0
@@ -591,6 +614,15 @@ class MultiDocServer:
                     cold.append(d)
         finally:
             self._serving = set()
+        pool_disp = 0
+        if self.pool is not None and self.pool.has_pending():
+            # the tick's ONE pooled dispatch (round 20): every warm
+            # doc's above-crossover delta deferred during routing
+            # splices + converges here, before anything settles or
+            # reads — O(1) device-route dispatches per tick however
+            # many docs went warm
+            with tl.phase("pool"):
+                pool_disp = self.pool.flush()
         with tl.phase("settle"):
             for d in delta_served:
                 self._settle([d], route="delta")
@@ -599,7 +631,7 @@ class MultiDocServer:
         staged = [(d, len(self._docs[d].dec["client"])) for d in cold]
         batches = (pack_batches(staged, self.max_rows)
                    if self.pack_docs else [[d] for d, _ in staged])
-        dispatches = 0
+        dispatches = pool_disp
         fallback = 0
         rows = delta_rows
         sizes = []
@@ -652,6 +684,11 @@ class MultiDocServer:
             tracer.gauge("tenant.pending_bytes", self.pending_bytes())
             tracer.gauge("tenant.resident_bytes", self.rbudget.total)
             tracer.gauge("tenant.resident_docs", self.rbudget.docs())
+            if self.pool is not None:
+                tracer.gauge("tenant.pool_bytes",
+                             self.pool.device_bytes())
+                tracer.gauge("tenant.pool_docs",
+                             self.pool.doc_count())
             if n_delta:
                 tracer.count("tenant.delta_docs", n_delta)
             if delta_rows:
@@ -662,7 +699,7 @@ class MultiDocServer:
                 tracer.count("tenant.fallback_docs", fallback)
         return TickReport(len(dirty), dispatches, rows, fallback,
                           tuple(sizes), n_delta, delta_rows,
-                          promotions)
+                          promotions, pool_disp)
 
     # ---- the live-ingest scheduler -----------------------------------
 
@@ -757,10 +794,11 @@ class MultiDocServer:
             evict=self._evict_resident,
         ):
             return False
-        eng = IncrementalReplay()
+        eng = IncrementalReplay(pool=self.pool)
         eng.apply(st.blobs + st.in_flight)
         if eng._pending or eng._rootless:
             st.no_promote_len = st.history_len()
+            self._release_pool(eng)
             return False
         st.resident = eng
         self._adopt_engine(d)
@@ -796,6 +834,17 @@ class MultiDocServer:
                 st.no_promote_len = st.history_len()
         self.rbudget.note_peak()
 
+    def _release_pool(self, eng) -> None:
+        """Free a discarded engine's pooled extent (LRU eviction,
+        delta fallback, failed promotion). Release may trigger the
+        pool's bounded compaction — the hole squeeze the
+        ``tenant.pool_compactions`` counter evidences. The engine's
+        own read path already flushed any deferred round (cache
+        materializes before every release site)."""
+        if eng is not None and eng.pool is not None:
+            eng.pool.release(eng)
+            eng.pool = None
+
     def _lru_residents(self, protect=frozenset()) -> List:
         return sorted(
             (d for d, st in self._docs.items()
@@ -818,6 +867,7 @@ class MultiDocServer:
         if st.resident is None:
             return
         st.cache = st.resident.cache  # materialize the lazy view
+        self._release_pool(st.resident)
         st.resident = None
         st.delta_dec = None
         st.delta_ok = False
@@ -843,6 +893,7 @@ class MultiDocServer:
         if st.resident is None:
             return
         st.cache = st.resident.cache  # materialize the lazy view
+        self._release_pool(st.resident)
         st.resident = None
         st.delta_dec = None
         st.delta_ok = False

@@ -1587,6 +1587,222 @@ def _relabel_mat(mat, perm):
     return mat.at[4, :].set(new_oc.astype(mat.dtype))
 
 
+# ---- the POOLED resident matrix (round 20) --------------------------
+#
+# N warm docs co-located in ONE device allocation: rows carry their
+# doc's POOL SLOT as lane 7 and store doc-LOCAL dense client / parent
+# ids, and every dispatch composes DOC-COMPOSITE ids on the fly from
+# per-slot base offsets (the `_compose_doc_ids` discipline: disjoint
+# composite ranges keep dedup, origin resolution, and segment
+# numbering doc-local with ZERO changes to `_converge_core`). Storing
+# local ids — and composing per dispatch from traced base operands —
+# means a doc joining or growing its id table never relabels any
+# OTHER doc's rows, and base growth never recompiles.
+
+# running count of warm device-route converge dispatches (one per
+# `_splice_select_converge` round, one per pooled flush): the bench's
+# `multitenant.steady.device_dispatches_per_tick` reads the delta
+# around a tick. A plain module int — single-process bench plumbing,
+# same pattern as the tracer's process-local counters.
+device_dispatch_count = 0
+
+
+def count_device_dispatch(n: int = 1) -> None:
+    global device_dispatch_count
+    device_dispatch_count += n
+
+
+def stage_pooled_delta(client, clock, pref, kid, oc, ock, slot,
+                       pos, kpad: int, pool_cap: int):
+    """Stage one POOLED round's delta: the ``[8, kpad]`` int64 block
+    plus the ``[kpad]`` int32 scatter positions
+    :func:`_pool_splice_select_converge` consumes. Rows 0-6 follow
+    :func:`stage_resident_delta` (doc-LOCAL dense ids), row 7 is the
+    doc's pool slot. Padding positions land at ``pool_cap`` and are
+    dropped by the scatter — the touched-segment keys travel as their
+    own operand (no kpad >= tpad coupling)."""
+    k = len(client)
+    delta = np.zeros((8, kpad), np.int64)
+    delta[3:6, :] = -1
+    delta[7, :] = -1
+    pref = np.asarray(pref, np.int64)
+    delta[0, :k] = client
+    delta[1, :k] = clock
+    delta[2, :k] = np.maximum(pref, 0)
+    delta[3, :k] = kid
+    delta[4, :k] = oc
+    delta[5, :k] = ock
+    delta[6, :k] = pref >= 0
+    delta[7, :k] = slot
+    ppos = np.full(kpad, pool_cap, np.int32)
+    ppos[:k] = pos
+    return delta, ppos
+
+
+def _pool_splice_body(mat, delta8, pos, touched_sorted, cbase, pbase,
+                      num_segments: int, sel_bucket: int,
+                      seq_bucket: int, mode: str):
+    """Shared traced body of the pooled splice+select+converge (see
+    :func:`_pool_splice_select_converge` for the contract)."""
+    mat = mat.at[:, pos].set(delta8.astype(mat.dtype), mode="drop")
+    live = mat[6] != 0
+    slot = jnp.clip(mat[7], 0, cbase.shape[0] - 1)
+    cb = jnp.where(live, cbase[slot], 0)
+    pb = jnp.where(live, pbase[slot], 0)
+    client = (mat[0] + cb).astype(jnp.int32)
+    clock = mat[1].astype(jnp.int64)
+    pref = (mat[2] + pb).astype(jnp.int64)
+    kid = mat[3].astype(jnp.int32)
+    oc0 = mat[4]
+    oc = jnp.where(oc0 >= 0, oc0 + cb, oc0).astype(jnp.int32)
+    ock = mat[5].astype(jnp.int64)
+
+    segkey = segkey_of(pref, kid.astype(jnp.int64))
+    tpos = jnp.searchsorted(touched_sorted, segkey, method="sort")
+    tpos_c = jnp.clip(tpos, 0, touched_sorted.shape[0] - 1)
+    sel = live & (touched_sorted[tpos_c] == segkey)
+    skey = jnp.where(sel, segkey, jnp.int64(2**63 - 1))
+    order2 = jnp.argsort(skey, stable=True)
+    sel_rows = order2[:sel_bucket].astype(jnp.int32)
+    sub_valid = sel[sel_rows]
+    out = _converge_core(
+        client[sel_rows], clock[sel_rows], pref[sel_rows], kid[sel_rows],
+        oc[sel_rows], ock[sel_rows], sub_valid,
+        num_segments=num_segments, seq_bucket=seq_bucket, mode=mode,
+    )
+    packed_out = jnp.concatenate([
+        out, jnp.where(sub_valid, sel_rows, NULLI).astype(jnp.int32)
+    ])
+    return mat, packed_out
+
+
+@partial(
+    jax.jit,
+    donate_argnums=(0,),
+    static_argnames=("num_segments", "sel_bucket", "seq_bucket",
+                     "mode"),
+)
+def _pool_splice_select_converge(mat, delta8, pos, touched_sorted,
+                                 cbase, pbase,
+                                 num_segments: int, sel_bucket: int,
+                                 seq_bucket: int, mode: str = "jnp"):
+    """One warm dispatch for EVERY pooled doc's delta: scatter-splice
+    the combined delta block into the pooled matrix (donated) at the
+    docs' extent positions, compose doc-composite client / origin /
+    parent ids from the per-slot bases, select the rows of the
+    touched COMPOSITE segments, and re-converge that compact subset —
+    the exact :func:`_splice_select_converge` contract lifted from
+    one doc to the whole warm set. Returns the same
+    ``(mat, [ out[S + 2B] | sel_rows[sel_bucket] ])`` shape; sel_rows
+    are POOL positions (callers map back through their extents).
+
+    ``touched_sorted`` must hold ascending composite segkeys
+    (``sk_local + (pbase[slot] << _KID_BITS)``, int64-max padded) and
+    ``cbase``/``pbase`` the per-slot id base offsets — disjoint
+    ranges per doc, so every cross-doc comparison inside
+    `_converge_core` is decided by the doc part of the key."""
+    return _pool_splice_body(
+        mat, delta8, pos, touched_sorted, cbase, pbase,
+        num_segments, sel_bucket, seq_bucket, mode,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("num_segments", "sel_bucket", "seq_bucket",
+                     "mode"),
+)
+def _pool_splice_select_converge_nodonate(
+        mat, delta8, pos, touched_sorted, cbase, pbase,
+        num_segments: int, sel_bucket: int,
+        seq_bucket: int, mode: str = "jnp"):
+    """Undonated twin of :func:`_pool_splice_select_converge` for
+    repeat-dispatch consumers (bench probes re-driving one staged
+    pool, CPU hosts where donation only warns) — same contract, the
+    input matrix stays valid after the call."""
+    return _pool_splice_body(
+        mat, delta8, pos, touched_sorted, cbase, pbase,
+        num_segments, sel_bucket, seq_bucket, mode,
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("new_cap",))
+def _pool_grow(mat, new_cap: int):
+    """Capacity growth for the POOLED matrix (8 lanes: lane 7 holds
+    pool slots, null = -1)."""
+    big = jnp.zeros((8, new_cap), mat.dtype)
+    big = big.at[3:6, :].set(-1)
+    big = big.at[7, :].set(-1)
+    return jax.lax.dynamic_update_slice(big, mat, (0, 0))
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("width",))
+def _pool_kill(mat, off, width: int):
+    """Kill a released extent's columns (valid + slot lanes) so an
+    evicted doc's stale rows can never be selected — and a reused
+    slot can never alias them onto another doc's composite ids. Runs
+    lazily at the next flush (idempotent: killing twice is a no-op),
+    or is subsumed by a compaction's gather dropping the range."""
+    dead = jnp.zeros((1, width), mat.dtype)
+    mat = jax.lax.dynamic_update_slice(
+        mat, dead, (jnp.int32(6), off.astype(jnp.int32))
+    )
+    return jax.lax.dynamic_update_slice(
+        mat, dead - 1, (jnp.int32(7), off.astype(jnp.int32))
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnames=("width",))
+def _pool_move(mat, src_off, dst_off, width: int):
+    """Relocate one doc's extent (pow2 outgrowth): copy the ``width``
+    columns at ``src_off`` to ``dst_off``, then kill the old extent
+    (valid + slot lanes) so stale copies can never be selected. The
+    allocator guarantees the ranges never overlap (the destination is
+    fresh tail space)."""
+    blk = jax.lax.dynamic_slice(
+        mat, (jnp.int32(0), src_off.astype(jnp.int32)), (8, width)
+    )
+    mat = jax.lax.dynamic_update_slice(
+        mat, blk, (jnp.int32(0), dst_off.astype(jnp.int32))
+    )
+    dead = jnp.zeros((1, width), mat.dtype)
+    mat = jax.lax.dynamic_update_slice(
+        mat, dead, (jnp.int32(6), src_off.astype(jnp.int32))
+    )
+    return jax.lax.dynamic_update_slice(
+        mat, dead - 1, (jnp.int32(7), src_off.astype(jnp.int32))
+    )
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _pool_relabel_range(mat, perm, off, n):
+    """Per-DOC client relabel after a mid-table insertion: rewrite
+    dense ids through ``perm`` over the doc's extent columns
+    ``[off, off+n)`` only — other docs' rows (their id spaces are
+    doc-local) are untouched."""
+    idx = jnp.arange(mat.shape[1])
+    m = (idx >= off) & (idx < off + n)
+    cl = mat[0]
+    oc = mat[4]
+    pc = perm[jnp.clip(cl, 0, perm.shape[0] - 1)].astype(mat.dtype)
+    mat = mat.at[0, :].set(jnp.where(m, pc, cl))
+    po = jnp.where(
+        oc >= 0, perm[jnp.clip(oc, 0, perm.shape[0] - 1)], oc
+    ).astype(mat.dtype)
+    return mat.at[4, :].set(jnp.where(m, po, oc))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _pool_compact(mat, src, keep):
+    """Bounded pool compaction (eviction holes): one device gather
+    through the host-computed ``src`` index array (new position ->
+    old position); positions outside any live extent reset to the
+    null pattern."""
+    out = mat[:, src]
+    fill = jnp.array([0, 0, 0, -1, -1, -1, 0, -1], mat.dtype)
+    return jnp.where(keep[None, :], out, fill[:, None])
+
+
 class PackedResult(NamedTuple):
     win_rows: np.ndarray     # [S] original row of each map winner (-1 none)
     stream_seg: np.ndarray   # [B] doc-order segment ids (-1 padding)

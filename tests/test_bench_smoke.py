@@ -150,6 +150,21 @@ def test_bench_smoke_mode(tmp_path):
     assert "tenant.resident_bytes" in report["gauges"]
     assert "tenant.resident_docs" in report["gauges"]
 
+    # the round-20 pooled-resident registry: the smoke runs a tiny
+    # all-warm device-forced leg — every doc's device round batches
+    # into ONE pooled dispatch per tick (the gated dispatch floor),
+    # byte-identical to the unpooled route, with the tenant.pool_*
+    # evidence live. The gated keys ride the ARTIFACT (the stdout
+    # line's 1500-byte budget drops them, like phases_numpy_s)
+    assert out.get("mt_pooled_registry_ok") is True
+    fsteady = full["multitenant"]["steady"]
+    for key in ("device_dispatches_per_tick", "pool_peak_bytes"):
+        assert isinstance(fsteady.get(key), (int, float)), key
+    assert fsteady["device_dispatches_per_tick"] <= 2
+    assert report["counters"].get("tenant.pool_dispatches", 0) > 0
+    assert "tenant.pool_bytes" in report["gauges"]
+    assert "tenant.pool_docs" in report["gauges"]
+
     # the round-18 observability-v2 registries: the SLO ledger lit
     # breaches/burn-rate/route-mix (the chaos flood leg runs with
     # slo_ms=0 and shed==breach is asserted inside the leg), the

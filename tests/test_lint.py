@@ -669,6 +669,29 @@ def f(tracer):
     )
 
 
+def test_registry_covers_pooled_resident_counters():
+    """Round 20 (pooled resident matrix) added the pool's dispatch /
+    compaction counters and allocation gauges. Both directions must
+    hold: the emitted names stay documented in the README registry
+    (never bare baseline entries), and an UNdocumented pool name
+    still fires CL201 — the rows genuinely joined the
+    registry-checked pool."""
+    reg = _real_registry()
+    for name in ("tenant.pool_dispatches", "tenant.pool_compactions",
+                 "tenant.pool_bytes", "tenant.pool_docs"):
+        assert name in reg.metrics, (
+            f"{name} dropped out of the README registry (round-20 "
+            f"pooled-resident contract)"
+        )
+    result = _lint_snippet("crdt_tpu/ops/x.py", '''
+def f(tracer):
+    tracer.count("tenant.pool_bogus_extent", 1)
+''', _reg("tenant.pool_dispatches"))
+    assert any(f.code == "CL201" for f in result.findings), (
+        "an undocumented tenant.pool_* metric no longer fires CL201"
+    )
+
+
 def test_registry_drift_fixed_event_kinds():
     """First-run CL201 drift on flight-recorder event kinds from the
     guard/storage/device adversaries."""

@@ -524,6 +524,57 @@ def test_multitenant_steady_section_gated():
     assert "tracer.tenant.delta_fallbacks" in regressed
 
 
+def test_pooled_dispatch_floor_gated():
+    """Round 20 (pooled resident matrix): the steady dispatch floor
+    ``multitenant.steady.device_dispatches_per_tick`` gates lower-
+    is-better with COUNT semantics — the ms noise floor must never
+    mute a pooled route rotting back to one-dispatch-per-doc — and
+    the pool's peak allocation gates like the eviction flood's
+    resident peak (bytes, lower). Both directions pinned."""
+    old = copy.deepcopy(OLD)
+    old["multitenant"] = {
+        "steady": {
+            "device_dispatches_per_tick": 1.0,
+            "pool_peak_bytes": 2_097_152,
+        },
+    }
+    new = copy.deepcopy(old)
+    rows, regressed = compare(old, new)
+    names = {r["metric"] for r in rows}
+    assert "multitenant.steady.device_dispatches_per_tick" in names
+    assert "multitenant.steady.pool_peak_bytes" in names
+    assert regressed == []
+
+    # the floor eroding back toward per-doc dispatches FAILS — and
+    # not as "noise", however cheap each dispatch is (count, not ms)
+    bad = copy.deepcopy(old)
+    bad["multitenant"]["steady"]["device_dispatches_per_tick"] = 8.0
+    rows, regressed = compare(old, bad, threshold=0.2)
+    assert "multitenant.steady.device_dispatches_per_tick" \
+        in regressed
+    by_name = {r["metric"]: r["verdict"] for r in rows}
+    assert by_name[
+        "multitenant.steady.device_dispatches_per_tick"
+    ] == "REGRESSION"
+
+    # fewer dispatches (a better batch) never fails
+    better = copy.deepcopy(old)
+    old2 = copy.deepcopy(old)
+    old2["multitenant"]["steady"]["device_dispatches_per_tick"] = 2.0
+    _, regressed = compare(old2, better, threshold=0.2)
+    assert regressed == []
+
+    # pool peak growing past threshold fails; shrinking never
+    bad2 = copy.deepcopy(old)
+    bad2["multitenant"]["steady"]["pool_peak_bytes"] = 4_194_304
+    _, regressed = compare(old, bad2, threshold=0.2)
+    assert "multitenant.steady.pool_peak_bytes" in regressed
+    good2 = copy.deepcopy(old)
+    good2["multitenant"]["steady"]["pool_peak_bytes"] = 1_048_576
+    _, regressed = compare(old, good2, threshold=0.2)
+    assert regressed == []
+
+
 def test_lint_open_by_family_gates_against_pre_round16_artifact():
     """Review round 2: an old artifact predating the round-16 digest
     has no open_by_family key — that means 0 open findings (the

@@ -1,0 +1,356 @@
+"""Differential suite for the round-20 pooled resident matrix.
+
+The tentpole contract: every warm doc's above-crossover delta batches
+into ONE pooled scatter-splice + converge dispatch
+(:class:`crdt_tpu.ops.resident.ResidentPool`), with per-doc state
+BYTE-identical to the unpooled per-doc route — pinned here for mixed
+LWW/YATA docs, deletes, duplicate redelivery across ticks,
+eviction-then-resubmit reconvergence, a doc alone outgrowing the
+pool (private-matrix fallback), and the forced-2-device sharded cold
+route. On top: the dispatch-floor pin (>=8 warm docs, <=2 device
+dispatches per steady tick vs >=N unpooled) and the round-20
+accounting seam (pooled ledger vs ``resident_bytes`` vs the MT
+budget estimate; ``tenant.pool_bytes`` peak <= ``max_bytes`` even
+mid-compaction).
+"""
+
+import numpy as np
+import pytest
+
+from crdt_tpu.codec import v1
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.models.incremental import IncrementalReplay
+from crdt_tpu.models.multidoc import MultiDocServer
+from crdt_tpu.ops import packed, shard
+from crdt_tpu.ops.resident import ResidentPool, _EXT_FLOOR, _LANES
+
+from tests.test_multidoc import doc_blobs
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_sharding(monkeypatch):
+    monkeypatch.delenv(shard.SHARD_ENV, raising=False)
+    monkeypatch.delenv(shard.MIN_ROWS_ENV, raising=False)
+
+
+@pytest.fixture
+def force_device(monkeypatch):
+    """Route every delta above the crossover: engines built during the
+    test see threshold 1, so the pooled defer/flush seam is exercised
+    by small docs."""
+    monkeypatch.setenv("CRDT_TPU_DEVICE_MIN", "1")
+
+
+def delta_blobs(seed, start, *, n_clients=3, K=6, lists=2, maps=2,
+                deletes=False, base=10):
+    """Continuation traffic for a doc seeded by :func:`doc_blobs`:
+    fresh clocks from ``start`` (contiguous per client, so
+    ``delta_admissible`` accepts), list appends chaining onto the
+    client's previous tail row — the steady-state delta shape the
+    pooled flush batches."""
+    rng = np.random.default_rng(seed * 7919 + start)
+    blobs = []
+    for c in range(n_clients):
+        client = base + c
+        recs = []
+        prev = (client, start - 1)
+        for k in range(K):
+            clk = start + k
+            if k % 3 == 0:
+                recs.append(ItemRecord(
+                    client=client, clock=clk,
+                    parent_root=f"m{k % maps}",
+                    key=f"k{int(rng.integers(0, 6))}",
+                    content=int(seed * 1000 + c * 100 + clk),
+                ))
+            else:
+                recs.append(ItemRecord(
+                    client=client, clock=clk,
+                    parent_root=f"l{k % lists}", origin=prev,
+                    content=int(seed * 1000 + c * 100 + clk),
+                ))
+                prev = (client, clk)
+        ds = DeleteSet()
+        if deletes:
+            ds.add(client, start + 1)
+        blobs.append(v1.encode_update(recs, ds))
+    return blobs
+
+
+def _pair(**kw):
+    """A pooled server and its unpooled oracle, same config."""
+    return (MultiDocServer(delta_ticks=True, pool=True, **kw),
+            MultiDocServer(delta_ticks=True, pool=False, **kw))
+
+
+def _warm(srv, doc_sets):
+    """Cold-converge then promote every doc (promotion is on the
+    second touch: redeliver the history)."""
+    for d, blobs in doc_sets.items():
+        srv.submit_many(d, blobs)
+    srv.tick()
+    srv.tick()
+    for d, blobs in doc_sets.items():
+        srv.submit_many(d, blobs)
+    return srv.tick()
+
+
+def _assert_equal(sp, su, docs):
+    for d in docs:
+        assert sp.digest(d) == su.digest(d), ("digest", d)
+        assert sp.cache(d) == su.cache(d), ("cache", d)
+        ep = sp._docs[d].resident
+        eu = su._docs[d].resident
+        if ep is not None and eu is not None:
+            assert ep.state_vector() == eu.state_vector(), ("sv", d)
+            assert ep.encode_state_as_update() == \
+                eu.encode_state_as_update(), ("snapshot", d)
+
+
+def test_pooled_matches_unpooled_mixed_docs(force_device):
+    """Mixed LWW/YATA docs (varying K, right origins, deletes, shared
+    raw client ids): promotion + two delta rounds through the pooled
+    route are byte-identical to the per-doc oracle, and each pooled
+    tick issues at most ONE flush dispatch."""
+    doc_sets = {}
+    for i in range(6):
+        doc_sets[i] = doc_blobs(
+            i, K=18 + 3 * (i % 3), rights=(i % 2 == 1), deletes=True)
+    sp, su = _pair()
+    rp = _warm(sp, doc_sets)
+    ru = _warm(su, doc_sets)
+    assert rp.promotions == ru.promotions == 6
+    assert rp.pool_dispatches <= 1
+    _assert_equal(sp, su, doc_sets)
+
+    for rnd, deletes in ((0, False), (1, True)):
+        for i in doc_sets:
+            blobs = delta_blobs(i, 18 + 3 * (i % 3) + 6 * rnd,
+                                deletes=deletes)
+            sp.submit_many(i, blobs)
+            su.submit_many(i, blobs)
+        rp = sp.tick()
+        ru = su.tick()
+        assert rp.delta_docs == ru.delta_docs == 6
+        assert rp.pool_dispatches <= 1
+        assert ru.pool_dispatches == 0
+        _assert_equal(sp, su, doc_sets)
+
+
+def test_duplicate_redelivery_across_ticks(force_device):
+    """A delta batch redelivered on a LATER tick (duplicate gossip)
+    must dedup identically on both routes — the pooled splice never
+    re-admits rows, and the segment state stays byte-stable."""
+    doc_sets = {i: doc_blobs(i, K=15) for i in range(4)}
+    sp, su = _pair()
+    _warm(sp, doc_sets)
+    _warm(su, doc_sets)
+
+    deltas = {i: delta_blobs(i, 15) for i in doc_sets}
+    for srv in (sp, su):
+        for i, blobs in deltas.items():
+            srv.submit_many(i, blobs)
+        srv.tick()
+    _assert_equal(sp, su, doc_sets)
+
+    # redeliver the SAME deltas next tick, plus one fresh doc's worth
+    for srv in (sp, su):
+        for i, blobs in deltas.items():
+            srv.submit_many(i, blobs)
+        srv.submit_many(0, delta_blobs(0, 21))
+        srv.tick()
+    _assert_equal(sp, su, doc_sets)
+
+
+def test_eviction_then_resubmit_reconverges(force_device):
+    """LRU eviction releases the doc's pooled extent; resubmitted
+    history re-promotes into a FRESH extent and reconverges exactly.
+    The oracle is an UNBUDGETED unpooled server — evictions change
+    residency, never state."""
+    doc_sets = {i: doc_blobs(i, K=20) for i in range(3)}
+    first = {i: doc_sets[i] for i in (0, 1)}
+    last = {2: doc_sets[2]}
+    # budget fits ~2 POOLED resident docs: doc 2's LATER promotion
+    # evicts the LRU and frees its extent (same-tick promotions are
+    # protected from the sweep, so the doc arrives on its own tick)
+    est = IncrementalReplay.estimate_resident_bytes(60)
+    sp = MultiDocServer(delta_ticks=True, pool=True,
+                        resident_max_bytes=int(est * 2.5))
+    su = MultiDocServer(delta_ticks=True, pool=False)
+    for srv in (sp, su):
+        _warm(srv, first)
+        _warm(srv, last)
+    assert sp.eviction_count > 0, "budget should have evicted a doc"
+    assert sp.pool.doc_count() == sp.resident_doc_count()
+    assert sp.resident_doc_count() < len(doc_sets)
+    _assert_equal(sp, su, doc_sets)
+
+    # grow the evicted doc(s): cold re-converge, later re-promote
+    for srv in (sp, su):
+        for i in doc_sets:
+            srv.submit_many(i, delta_blobs(i, 20))
+        srv.tick()
+        for i in doc_sets:
+            srv.submit_many(i, delta_blobs(i, 26))
+        srv.tick()
+    assert sp.pool.doc_count() == sp.resident_doc_count()
+    _assert_equal(sp, su, doc_sets)
+
+
+def test_doc_alone_outgrows_pool(force_device):
+    """A doc whose extent cannot fit ``max_bytes`` even after
+    compaction is refused at defer and falls back PERMANENTLY to a
+    private resident matrix — with the small docs still pooling and
+    every doc byte-identical to the oracle."""
+    pool_bytes = _EXT_FLOOR * _LANES * 8  # exactly one minimal extent
+    doc_sets = {
+        "small": doc_blobs(0, K=12),
+        # 3 clients x 400 ops = 1200 rows > the 1024-row extent the
+        # budget can hold
+        "big": doc_blobs(1, K=400, deletes=False),
+    }
+    sp, su = _pair(pool_max_bytes=pool_bytes)
+    _warm(sp, doc_sets)
+    _warm(su, doc_sets)
+    _assert_equal(sp, su, doc_sets)
+
+    big_eng = sp._docs["big"].resident
+    assert big_eng is not None and big_eng.pool is None, \
+        "big doc should have unpooled itself"
+    assert sp.pool.doc_count() == 1  # only the small doc pools
+    assert sp.pool.device_bytes() <= pool_bytes
+
+    for srv in (sp, su):
+        srv.submit_many("small", delta_blobs(0, 12))
+        srv.submit_many("big", delta_blobs(1, 400, K=9))
+        srv.tick()
+    _assert_equal(sp, su, doc_sets)
+
+
+def test_pooled_matches_on_sharded_route(force_device):
+    """Forced-2-device sharded cold route + pooled warm route: the
+    cold converge partitions across chips while promoted docs pool —
+    both ends byte-identical to the unsharded, unpooled oracle."""
+    doc_sets = {i: doc_blobs(i, K=16) for i in range(4)}
+    sp = MultiDocServer(delta_ticks=True, pool=True, shards=2)
+    su = MultiDocServer(delta_ticks=True, pool=False)
+    _warm(sp, doc_sets)
+    _warm(su, doc_sets)
+    _assert_equal(sp, su, doc_sets)
+    for srv in (sp, su):
+        for i in doc_sets:
+            srv.submit_many(i, delta_blobs(i, 16))
+        srv.tick()
+    _assert_equal(sp, su, doc_sets)
+
+
+def test_steady_state_dispatch_floor(force_device):
+    """The acceptance pin: >=8 warm above-crossover docs converge
+    their steady delta tick in <=2 device-route dispatches pooled
+    (was >= N unpooled)."""
+    N = 8
+    doc_sets = {i: doc_blobs(i, K=18) for i in range(N)}
+    sp, su = _pair()
+    _warm(sp, doc_sets)
+    _warm(su, doc_sets)
+
+    def steady(srv, start):
+        for i in doc_sets:
+            srv.submit_many(i, delta_blobs(i, start))
+        c0 = packed.device_dispatch_count
+        rep = srv.tick()
+        return rep, packed.device_dispatch_count - c0
+
+    rp, dp = steady(sp, 18)
+    ru, du = steady(su, 18)
+    assert rp.delta_docs == ru.delta_docs == N
+    assert dp <= 2, f"pooled steady tick took {dp} device dispatches"
+    assert du >= N, f"unpooled oracle dispatched {du} < {N} times"
+    assert rp.pool_dispatches == 1
+    _assert_equal(sp, su, doc_sets)
+
+
+def test_pool_accounting_pins(force_device):
+    """Round-20 accounting seam: the pooled ledger, the engine's
+    ``resident_bytes``, and the MT budget estimate agree in UNITS —
+    a pooled doc's device share is extent_cap x 8 lanes x 8 bytes,
+    ``resident_bytes`` folds exactly that share in, and the
+    pre-promotion estimate upper-bounds the realized footprint on
+    BOTH routes."""
+    doc_sets = {i: doc_blobs(i, K=20) for i in range(3)}
+    sp, _ = _pair()
+    _warm(sp, doc_sets)
+    pool = sp.pool
+    mat = pool._mat
+    # the pool gauge is dtype-derived from the live allocation
+    assert pool.device_bytes() == \
+        int(mat.shape[0]) * int(mat.shape[1]) * np.dtype(np.int64).itemsize
+    for i in doc_sets:
+        ep = sp._docs[i].resident
+        ext = pool._ext[ep]
+        share = ext.cap * _LANES * 8
+        assert pool.doc_device_bytes(ep) == share
+        # resident_bytes = pooled share + host integer columns, and
+        # nothing else (no private matrix on the pooled route)
+        from crdt_tpu.models.incremental import _Cols
+        assert ep._mat is None
+        assert ep.resident_bytes() == \
+            share + ep.cols._cap * len(_Cols.INT_COLS) * 8
+        est = IncrementalReplay.estimate_resident_bytes(ep.cols.n)
+        assert est >= ep.resident_bytes(), "estimate must upper-bound pooled"
+    # doc shares partition the allocation (never exceed it)
+    assert pool.device_bytes() >= sum(
+        pool.doc_device_bytes(sp._docs[i].resident) for i in doc_sets)
+    # the fleet accessor speaks the same dtype-derived unit language
+    from crdt_tpu.ops.resident import COLUMNS, ResidentColumns
+    rc = ResidentColumns(capacity=1024)
+    assert rc.device_bytes() == sum(
+        rc.capacity * np.dtype(dt).itemsize for _, dt in COLUMNS)
+    # the MT ledger never exceeds its budget (commit-time enforcement)
+    assert sp.rbudget.total <= (sp.rbudget.max_bytes or float("inf"))
+
+
+def test_pool_peak_within_budget_mid_compaction(force_device):
+    """Eviction holes squeeze without ever bursting ``max_bytes``:
+    the compaction target is the covering bucket of the LIVE extents,
+    not the default first bucket — peak_bytes stays <= budget."""
+    budget = 4 * _EXT_FLOOR * _LANES * 8  # room for 4 minimal extents
+    pool = ResidentPool(max_bytes=budget)
+    engs = []
+    for i in range(3):
+        eng = IncrementalReplay(device_min_rows=1, pool=pool)
+        eng.apply(doc_blobs(i, K=20))
+        engs.append(eng)
+    pool.flush()
+    assert pool.device_bytes() <= budget
+    full = pool.device_bytes()
+
+    # release two docs -> tail (3 extents) > 2x live (1): compaction
+    pool.release(engs[0])
+    pool.release(engs[1])
+    assert pool.compactions >= 1
+    assert pool.device_bytes() < full
+    assert pool.peak_bytes <= budget, \
+        "mid-compaction allocation burst the pool budget"
+    # the survivor still converges exactly after the squeeze
+    eng = engs[2]
+    eng.apply(delta_blobs(2, 20))
+    pool.flush()
+    oracle = IncrementalReplay(device_min_rows=1)
+    oracle.apply(doc_blobs(2, K=20))
+    oracle.apply(delta_blobs(2, 20))
+    assert eng.cache == oracle.cache
+    assert eng.state_vector() == oracle.state_vector()
+
+
+def test_pool_disabled_by_env(force_device, monkeypatch):
+    """``CRDT_TPU_MT_POOL_BYTES=0`` turns pooling off entirely — the
+    opt-out knob documented in README."""
+    monkeypatch.setenv("CRDT_TPU_MT_POOL_BYTES", "0")
+    srv = MultiDocServer(delta_ticks=True)
+    assert srv.pool is None
+    monkeypatch.setenv("CRDT_TPU_MT_POOL_BYTES", "262144")
+    srv = MultiDocServer(delta_ticks=True)
+    assert srv.pool is not None
+    assert srv.pool.max_bytes == 262144

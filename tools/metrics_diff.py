@@ -65,6 +65,14 @@ Compared (whatever of these both artifacts carry):
   ``replica.hop_lag{route=...}`` latency histograms via the span
   loop (lower, seconds noise floor).
 
+- pooled resident matrix (round 20): the steady dispatch floor —
+  ``multitenant.steady.device_dispatches_per_tick`` (lower = better,
+  COUNT semantics: never muted by the ms noise floor — the O(1)
+  batching claim must not rot behind cheap dispatches) and the
+  pool's ``multitenant.steady.pool_peak_bytes`` (lower); the
+  already-gated ``timeline.overlap_efficiency`` keys hold the
+  double-buffer overlap through the pooled route.
+
 Prints a table (one row per metric: old, new, delta, verdict) and
 exits non-zero when any metric regressed past ``--threshold``
 (relative; default 0.20 = 20%). Improvements never fail the gate.
@@ -144,6 +152,14 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     (("multitenant", "steady", "docs_per_s"), True),
     (("multitenant", "steady", "speedup"), True),
     (("multitenant", "steady", "eviction", "peak_bytes"), False),
+    # pooled resident matrix (round 20): steady device dispatches
+    # per tick — the O(1)-dispatch tentpole number. A COUNT (lower =
+    # better): the ms noise floor never mutes it, so a pooled route
+    # rotting back to one-dispatch-per-doc fails the gate even when
+    # each dispatch is cheap. The pool's peak allocation is gated
+    # like the eviction flood's resident peak (bytes, lower).
+    (("multitenant", "steady", "device_dispatches_per_tick"), False),
+    (("multitenant", "steady", "pool_peak_bytes"), False),
     # observability v2 (round 18): the run-stable timeline/SLO
     # digests the --multitenant harness embeds — the mean overlap of
     # the double-buffered ticks (higher = better; the per-tick gauge
