@@ -14,6 +14,9 @@ failure domains the network schedule cannot reach:
   of the i-th batch, after which the wrapper is dead — the crash-point
   matrix over ``LogPersistence.compact``/``store_updates`` reopens the
   real file underneath and proves no acked update is lost).
+  :class:`FaultyFs` extends the same schedule to the snapshot
+  writer's file primitives (write/fsync/rename/unlink), so the
+  round-21 snapshot ALICE matrix kills the writer at every op.
 - **device** — :class:`DeviceFaultPlan` installs itself as the
   :func:`crdt_tpu.ops.device.set_device_fault_hook` hook and fails the
   first N guarded dispatch attempts with ``RuntimeError`` (optionally
@@ -162,6 +165,82 @@ class FaultyKv:
 
     def close(self) -> None:
         self._inner.close()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class FaultyFs:
+    """Snapshot-seam fs adversary (round 21): wraps the
+    :class:`crdt_tpu.storage.snapshot.Fs` primitives and applies a
+    :class:`DiskFaultSchedule` to every MUTATING op — ``write``,
+    ``fsync``, ``rename``, ``fsync_dir``, ``unlink`` — addressed by a
+    single per-op index (the n-th mutating op overall), so
+    ``crash_at=(i, 0)`` kills the writer immediately BEFORE its i-th
+    op and ``torn`` lands half the bytes of a ``write`` before dying.
+    Together the two modes cover every prefix of the snapshot
+    writer's op sequence — the ALICE matrix ``tests/test_snapshot.py``
+    enumerates from a clean run's recorded ``ops`` list. Reads pass
+    through untouched (recovery must see whatever the crash left)."""
+
+    def __init__(self, inner, schedule: DiskFaultSchedule):
+        self._inner = inner
+        self.schedule = schedule
+        self.n = 0
+        self.ops: List[Tuple[str, str]] = []
+        self.dead = False
+        self.stats: Dict[str, int] = {
+            "enospc": 0, "eio": 0, "torn": 0, "crashed": 0,
+        }
+
+    def _gate(self, verb: str, path: str, data: Optional[bytes] = None):
+        if self.dead:
+            raise SimulatedCrash("fs is dead (post-crash)")
+        n = self.n
+        self.n += 1
+        self.ops.append((verb, path))
+        kind = self.schedule.decide(n)
+        rec = get_recorder()
+        if kind and rec.enabled:
+            rec.record("fault.fs", kind=kind, op=n, verb=verb)
+        if kind == "crash":
+            # crash BEFORE the op applies (torn covers the mid-write
+            # states); the fs is dead from here on
+            self.stats["crashed"] += 1
+            self.dead = True
+            raise SimulatedCrash(f"crash at fs op {n} ({verb})")
+        if kind == "enospc":
+            self.stats["enospc"] += 1
+            raise OSError(errno.ENOSPC, "injected: no space left")
+        if kind == "eio":
+            self.stats["eio"] += 1
+            raise OSError(errno.EIO, "injected: I/O error")
+        if kind == "torn":
+            self.stats["torn"] += 1
+            if verb == "write" and data:
+                self._inner.write(path, data[: len(data) // 2])
+            raise OSError(errno.EIO, "injected: torn write")
+        return None
+
+    def write(self, path: str, data: bytes) -> None:
+        self._gate("write", path, data)
+        self._inner.write(path, data)
+
+    def fsync(self, path: str) -> None:
+        self._gate("fsync", path)
+        self._inner.fsync(path)
+
+    def rename(self, src: str, dst: str) -> None:
+        self._gate("rename", src)
+        self._inner.rename(src, dst)
+
+    def fsync_dir(self, path: str) -> None:
+        self._gate("fsync_dir", path)
+        self._inner.fsync_dir(path)
+
+    def unlink(self, path: str) -> None:
+        self._gate("unlink", path)
+        self._inner.unlink(path)
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

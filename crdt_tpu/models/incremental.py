@@ -390,6 +390,12 @@ class IncrementalReplay:
         self._capacity = capacity
         self._mat = None
         self.n_dev = 0
+        # snapshot-rehydrated engines (round 21) carry exact winner /
+        # order caches but NO device state: their device rounds first
+        # try the O(delta) host tail advances, so the recovery path
+        # never pays an O(doc) re-splice just to append — the backlog
+        # waits for the first round the fast shapes cannot handle
+        self._from_snapshot = False
         # pooled resident matrix (round 20): when attached, device
         # rounds DEFER to the shared pool — the server's tick flushes
         # every warm doc's delta in ONE dispatch — and this engine
@@ -878,6 +884,45 @@ class IncrementalReplay:
             if (int(rc[row]), int(rk[row])) != hr:
                 return False
             prev = row
+        return True
+
+    def _advance_seq_tail(self, sk: int, new_rows: List[int]) -> bool:
+        """Pure TAIL-append advance for a sequence segment: a chained
+        run anchored on the current order tail with no right anchor —
+        O(delta), exact, and side-effect free on refusal (unlike
+        :meth:`_splice_seq_local`, which re-derives wholesale when its
+        preconditions bend). The rehydrated-engine device rounds use
+        this to skip the dispatch entirely for steady tail traffic."""
+        if not self._is_chained_run(new_rows):
+            return False
+        head = new_rows[0]
+        left_row, right_row, _, right_decl = self._anchor_rows(head)
+        if right_decl or right_row is not None:
+            return False
+        if sk in self._linked:
+            tail = self._lnk_tail.get(sk, -1)
+            if (left_row if left_row is not None else -1) != tail:
+                return False
+            prev = left_row
+            for row in new_rows:
+                self._link_splice(sk, row, prev)
+                prev = row
+            self._order_stale.add(sk)
+            return True
+        order = self._order.get(sk)
+        if order is None or \
+                len(order) + len(new_rows) != len(self._seg_rows[sk]):
+            return False
+        if not ((left_row is None and not order)
+                or (order and left_row == order[-1])):
+            return False
+        pos_map = self._order_pos.get(sk)
+        if pos_map is not None:
+            base = len(order)
+            for i, row in enumerate(new_rows):
+                pos_map[row] = base + i
+        order.extend(new_rows)
+        # tail append: existing positions unchanged, no epoch bump
         return True
 
     def _splice_seq_local(self, sk: int, new_rows: List[int]):
@@ -1634,6 +1679,26 @@ class IncrementalReplay:
             sk for sk in touched
             if sk in self._seg_rows and not self._seg_rights.get(sk)
         )
+        if self._from_snapshot and dev_segs:
+            # snapshot-rehydrated engine (round 21): the restored
+            # winner/order caches are exact, so a tail-shaped delta
+            # advances host-side in O(delta) — the alternative is an
+            # O(doc) re-splice of the whole column set into a fresh
+            # matrix (n_dev=0), which would make every recovery pay
+            # the full device promotion just to append. Rows handled
+            # here stay in the unspliced backlog; the first round the
+            # fast shapes refuse dispatches them all at once.
+            still = []
+            for sk in dev_segs:
+                new = by_seg.get(sk)
+                if new:
+                    if self._seg_kid.get(sk, -1) >= 0:
+                        if self._advance_map_tail(sk, new):
+                            continue
+                    elif self._advance_seq_tail(sk, new):
+                        continue
+                still.append(sk)
+            dev_segs = still
         host_segs = [
             sk for sk in touched
             if sk in self._seg_rows and self._seg_rights.get(sk)

@@ -116,6 +116,7 @@ Env knobs: ``CRDT_TPU_MT_MAX_ROWS`` (dispatch row cap, default
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import time
 from collections import deque
@@ -312,7 +313,8 @@ class MultiDocServer:
                  resident_max_bytes: Optional[int] = None,
                  slo_ms: Optional[float] = None,
                  pool: Optional[bool] = None,
-                 pool_max_bytes: Optional[int] = None):
+                 pool_max_bytes: Optional[int] = None,
+                 snap_store=None):
         self.max_rows = (max_rows_per_dispatch
                          if max_rows_per_dispatch is not None
                          else _env_int(_MAX_ROWS_ENV, 1 << 16))
@@ -348,6 +350,17 @@ class MultiDocServer:
             from crdt_tpu.ops.resident import ResidentPool
 
             self.pool = ResidentPool(max_bytes=pool_max_bytes)
+        # snapshot store (round 21): when attached (explicitly or
+        # via CRDT_TPU_SNAP_DIR), evicted residents write a snapshot
+        # on the way out and promotions rehydrate from it instead of
+        # rebuilding over the full history; checkpoint()/restore()
+        # round-trip the WHOLE resident set through it. Absent store
+        # = every path below is stock round-15 behavior.
+        if snap_store is None:
+            from crdt_tpu.storage.snapshot import store_from_env
+
+            snap_store = store_from_env()
+        self.snap_store = snap_store
         self.shards = shards
         self.pack_docs = pack_docs
         self.ticks = 0
@@ -794,8 +807,10 @@ class MultiDocServer:
             evict=self._evict_resident,
         ):
             return False
-        eng = IncrementalReplay(pool=self.pool)
-        eng.apply(st.blobs + st.in_flight)
+        eng = self._rehydrate_candidate(d, st)
+        if eng is None:
+            eng = IncrementalReplay(pool=self.pool)
+            eng.apply(st.blobs + st.in_flight)
         if eng._pending or eng._rootless:
             st.no_promote_len = st.history_len()
             self._release_pool(eng)
@@ -804,6 +819,52 @@ class MultiDocServer:
         self._adopt_engine(d)
         self._settle([d])
         return True
+
+    def _rehydrate_candidate(self, d, st):
+        """The round-21 promotion shortcut: a stored snapshot whose
+        coverage is a PREFIX of the doc's admitted history
+        rehydrates and applies only the uncovered tail — the
+        eviction-then-resubmit case pays delta cost, not a full
+        engine rebuild. Any problem (damage, coverage skew, a tail
+        that stashes) returns None and the stock full-history build
+        runs; correctness never depends on the snapshot."""
+        if self.snap_store is None:
+            return None
+        loaded = self.snap_store.load_latest(d)
+        if loaded is None:
+            return None
+        snap, seq = loaded
+        if seq > len(st.blobs):
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.count("snap.fallbacks",
+                             labels={"reason": "coverage"})
+            return None
+        from crdt_tpu.storage.snapshot import rehydrate
+
+        eng = None
+        try:
+            eng = rehydrate(snap, pool=self.pool)
+            eng.apply(st.blobs[seq:] + st.in_flight)
+        except ValueError:
+            if eng is not None:
+                self._release_pool(eng)
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.count("snap.fallbacks",
+                             labels={"reason": "rehydrate"})
+            return None
+        if eng._pending or eng._rootless:
+            # the tail did not settle over this snapshot (foreign or
+            # skewed coverage): fall back to the stock build rather
+            # than pinning no_promote_len on the doc
+            self._release_pool(eng)
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.count("snap.fallbacks",
+                             labels={"reason": "tail_stash"})
+            return None
+        return eng
 
     def _adopt_engine(self, d) -> None:
         """Commit a doc's engine-converged state: op count from the
@@ -867,6 +928,7 @@ class MultiDocServer:
         if st.resident is None:
             return
         st.cache = st.resident.cache  # materialize the lazy view
+        self._snapshot_on_evict(d, st)
         self._release_pool(st.resident)
         st.resident = None
         st.delta_dec = None
@@ -880,6 +942,30 @@ class MultiDocServer:
             tracer.count("tenant.resident_evictions")
             tracer.gauge("tenant.resident_bytes", self.rbudget.total)
             tracer.gauge("tenant.resident_docs", self.rbudget.docs())
+
+    def _snapshot_on_evict(self, d, st) -> None:
+        """Kill the eviction cold-start tax (round 21): a resident
+        doc leaving the budget writes a snapshot covering its
+        settled ``blobs`` prefix, so eviction-then-resubmit
+        rehydrates + applies the delta instead of re-replaying the
+        whole history. Budget-permitting and best-effort: a refused
+        or failed write (counted inside the store) just means the
+        next promotion pays the stock rebuild. Skipped when the
+        engine's coverage is ambiguous (un-settled in-flight blobs)
+        — a wrong coverage cursor would be corrected by the
+        tail-stash fallback, but never writing it is cheaper."""
+        if self.snap_store is None or st.in_flight:
+            return
+        from crdt_tpu.storage.snapshot import encode_engine
+
+        try:
+            payload = encode_engine(st.resident, seq=len(st.blobs))
+        except ValueError:
+            return
+        if self.snap_store.write(d, payload, len(st.blobs)):
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.count("snap.evict_writes")
 
     def _drop_resident(self, d) -> None:
         """Inadmissible delta: the resident engine cannot absorb it
@@ -903,6 +989,114 @@ class MultiDocServer:
         tracer = get_tracer()
         if tracer.enabled:
             tracer.count("tenant.delta_fallbacks")
+
+    # ---- checkpoint / restore (round 21) -----------------------------
+
+    def checkpoint(self, store=None) -> int:
+        """Snapshot the WHOLE resident set into ``store`` (default:
+        the attached ``snap_store``). Per resident doc: one snapshot
+        generation covering its settled ``blobs`` prefix plus a
+        sidecar history blob (``encode_state_as_update`` — the
+        encode is paid NOW so a restore never decodes more than it
+        must), tied together by a manifest sidecar. Docs with
+        un-settled in-flight state are skipped (call between ticks
+        for full coverage). Returns the number of docs
+        checkpointed; counted ``tenant.checkpoint_docs``."""
+        from crdt_tpu.storage.snapshot import encode_engine
+
+        store = store if store is not None else self.snap_store
+        if store is None:
+            raise ValueError("checkpoint: no snapshot store attached")
+        tracer = get_tracer()
+        manifest = {}
+        done = 0
+        for d, st in sorted(self._docs.items(), key=lambda kv:
+                            str(kv[0])):
+            if st.resident is None or st.in_flight:
+                continue
+            seq = len(st.blobs)
+            try:
+                payload = encode_engine(st.resident, seq=seq)
+            except ValueError:
+                continue
+            if not store.write(d, payload, seq):
+                continue
+            hist = st.resident.encode_state_as_update()
+            store.put_blob("%s.hist" % d, hist)
+            manifest[str(d)] = {"seq": seq, "n_ops": st.n_ops}
+            done += 1
+            if tracer.enabled:
+                tracer.count("tenant.checkpoint_docs")
+        store.put_blob(
+            "checkpoint.manifest",
+            json.dumps(manifest, sort_keys=True).encode())
+        return done
+
+    def restore(self, store=None) -> int:
+        """Rehydrate the resident set a :meth:`checkpoint` wrote —
+        the whole-server warm restart. Per manifest doc: snapshot ->
+        live engine re-registered with the pool and the resident
+        budget (ledgers rebuild through the stock ``_adopt_engine``
+        commit), history re-seeded from the sidecar blob so every
+        later route (re-promotion, cold fallback, digesting) sees an
+        equivalent doc. A damaged snapshot falls back to the sidecar
+        blob COLD (served correctly, promoted on its next touch);
+        a missing sidecar skips the doc. Returns docs restored
+        warm."""
+        from crdt_tpu.storage.snapshot import rehydrate
+
+        store = store if store is not None else self.snap_store
+        if store is None:
+            raise ValueError("restore: no snapshot store attached")
+        raw = store.get_blob("checkpoint.manifest")
+        if raw is None:
+            return 0
+        try:
+            manifest = json.loads(raw)
+        except ValueError:
+            return 0
+        tracer = get_tracer()
+        warm = 0
+        for d in sorted(manifest):
+            hist = store.get_blob("%s.hist" % d)
+            if hist is None:
+                continue
+            st = self._docs.setdefault(d, _DocState())
+            st.blobs = [hist]
+            st.pending.clear()
+            st.pending_ts.clear()
+            st.in_flight = []
+            st.in_flight_ts = []
+            st.stale = True
+            st.no_promote_len = -1
+            st._digest = None
+            eng = None
+            loaded = store.load_latest(d)
+            if loaded is not None:
+                snap, _seq = loaded
+                try:
+                    eng = rehydrate(snap, pool=self.pool)
+                except ValueError:
+                    if tracer.enabled:
+                        tracer.count("snap.fallbacks",
+                                     labels={"reason": "rehydrate"})
+                    eng = None
+            if eng is None:
+                # cold rung: the doc serves from the sidecar blob
+                # via the stock replay path on its next touch
+                st.n_ops = int(manifest[d].get("n_ops", 0))
+                continue
+            st.resident = eng
+            st.stale = False
+            st.cache = {}
+            self._adopt_engine(d)
+            if st.resident is None:
+                continue  # budget evicted it right back
+            warm += 1
+        if tracer.enabled:
+            tracer.gauge("tenant.resident_docs", self.rbudget.docs())
+            tracer.gauge("tenant.resident_bytes", self.rbudget.total)
+        return warm
 
     # ---- converge engines (the round-14 cold path) -------------------
 

@@ -634,3 +634,53 @@ def replay_trace(
     return ReplayResult(
         cache=cache, snapshot=compact(dec, ds), n_ops=n, path="device"
     )
+
+
+def cold_start(doc_name: str, persistence, snapshots=None,
+               *, pool=None):
+    """Bring a doc up from durable state, preferring snapshot +
+    WAL-tail over full-history replay (round 21, ROADMAP item 4).
+
+    The recovery ladder, top rung first:
+
+    1. newest valid snapshot generation (damage is counted and
+       skipped inside ``SnapshotStore.load_latest``) rehydrated into
+       a live engine, plus ``persistence.get_updates_since`` for the
+       tail the snapshot does not cover;
+    2. if the tail does not settle exactly (stashed/rootless rows —
+       a snapshot from a FOREIGN log, or coverage skew), counted
+       ``snap.fallbacks{reason="tail_stash"}`` and down one rung;
+    3. full WAL replay through a fresh ``IncrementalReplay`` — the
+       byte-identical baseline every upper rung must match.
+
+    Returns ``(engine, path)`` with path in {"snapshot", "wal"}."""
+    from crdt_tpu.models.incremental import IncrementalReplay
+
+    tracer = get_tracer()
+    if snapshots is not None:
+        loaded = snapshots.load_latest(doc_name)
+        if loaded is not None:
+            from crdt_tpu.storage import snapshot as snap_mod
+
+            snap, seq = loaded
+            eng = None
+            try:
+                eng = snap_mod.rehydrate(snap, pool=pool)
+                eng.apply(persistence.get_updates_since(doc_name, seq))
+            except ValueError:
+                if tracer.enabled:
+                    tracer.count("snap.fallbacks",
+                                 labels={"reason": "rehydrate"})
+            else:
+                if not (eng._pending or eng._rootless):
+                    return eng, "snapshot"
+                if tracer.enabled:
+                    tracer.count("snap.fallbacks",
+                                 labels={"reason": "tail_stash"})
+            # abandoned rung: give back any pooled registration
+            if eng is not None and eng.pool is not None:
+                eng.pool.release(eng)
+                eng.pool = None
+    eng = IncrementalReplay(pool=pool)
+    eng.apply(persistence.get_all_updates(doc_name))
+    return eng, "wal"

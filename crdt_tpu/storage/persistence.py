@@ -383,6 +383,20 @@ class LogPersistence:
         ]
         return logged + list(self._overflow.get(doc_name, []))
 
+    def get_updates_since(self, doc_name: str, seq: int) -> List[bytes]:
+        """The WAL tail a snapshot at coverage ``seq`` still needs:
+        logged updates with sequence number STRICTLY greater than
+        ``seq`` (the snapshot rider lands at the compaction blob's
+        own seq, so the blob is never replayed on top of itself),
+        plus any degraded-mode overflow (accepted but not yet on
+        disk — always newer than any durable snapshot)."""
+        tail = [
+            v for k, v in
+            self._require().scan_prefix(_update_prefix(doc_name))
+            if int(k.rsplit(b"_", 1)[1]) > seq
+        ]
+        return tail + list(self._overflow.get(doc_name, []))
+
     def get_state_vector(self, doc_name: str) -> Optional[bytes]:
         ov = self._overflow_sv.get(doc_name)
         if ov is not None and self._overflow.get(doc_name):

@@ -165,6 +165,19 @@ def test_bench_smoke_mode(tmp_path):
     assert "tenant.pool_bytes" in report["gauges"]
     assert "tenant.pool_docs" in report["gauges"]
 
+    # the round-21 snapshot registry: the smoke runs a tiny coldstart
+    # leg (snapshot join digest-identical to WAL replay, corruption
+    # falls back counted, server checkpoint/restore round-trips) with
+    # the snap.* write/load/fallback evidence live
+    assert out.get("snap_registry_ok") is True
+    for cname in ("snap.writes", "snap.loads", "snap.bytes",
+                  "tenant.checkpoint_docs"):
+        assert report["counters"].get(cname, 0) > 0, cname
+    assert any(k.startswith("snap.fallbacks{")
+               for k in report["counters"]), "snap.fallbacks missing"
+    assert "snap.write_ms" in report["gauges"]
+    assert "snap.load_ms" in report["gauges"]
+
     # the round-18 observability-v2 registries: the SLO ledger lit
     # breaches/burn-rate/route-mix (the chaos flood leg runs with
     # slo_ms=0 and shed==breach is asserted inside the leg), the

@@ -692,6 +692,30 @@ def f(tracer):
     )
 
 
+def test_registry_covers_snapshot_counters():
+    """Round 21 (crash-proof recovery) added the snapshot store's
+    write/load/fallback plane. Both directions must hold: the emitted
+    names stay documented in the README registry, and an undocumented
+    ``snap.*`` name still fires CL201 — the new namespace genuinely
+    joined the registry-checked pool."""
+    reg = _real_registry()
+    for name in ("snap.writes", "snap.loads", "snap.bytes",
+                 "snap.fallbacks", "snap.write_errors",
+                 "snap.evict_writes", "snap.write_ms", "snap.load_ms",
+                 "tenant.checkpoint_docs"):
+        assert name in reg.metrics, (
+            f"{name} dropped out of the README registry (round-21 "
+            f"snapshot contract)"
+        )
+    result = _lint_snippet("crdt_tpu/ops/x.py", '''
+def f(tracer):
+    tracer.count("snap.bogus_extent", 1)
+''', _reg("snap.writes"))
+    assert any(f.code == "CL201" for f in result.findings), (
+        "an undocumented snap.* metric no longer fires CL201"
+    )
+
+
 def test_registry_drift_fixed_event_kinds():
     """First-run CL201 drift on flight-recorder event kinds from the
     guard/storage/device adversaries."""

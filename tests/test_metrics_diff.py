@@ -754,3 +754,64 @@ def test_per_route_hop_lag_spans_gated():
         'replica.hop_lag{route="direct"}']["p99_s"] = 0.002
     _, regressed = compare(tiny_old, tiny_bad)
     assert regressed == []
+
+
+def test_cold_start_section_keys_gated():
+    """Round 21: the --coldstart artifact keys — join_ms /
+    checkpoint_ms / restore_ms regress when they RISE (recovery got
+    slower; SECTION keys, so the ms noise floor never mutes them),
+    speedup when it FALLS (the snapshot join's edge over full WAL
+    replay eroded — the >=5x acceptance bar lives in the artifact),
+    and snap_fallbacks_counted when it RISES (the same run hit more
+    damaged snapshots on the ladder)."""
+    old = {"cold_start": {
+        "join_ms": 40.0, "replay_ms": 800.0, "speedup": 20.0,
+        "checkpoint_ms": 30.0, "restore_ms": 25.0,
+        "snap_fallbacks_counted": 1,
+    }}
+    _, regressed = compare(old, copy.deepcopy(old))
+    assert regressed == []
+    bad = {"cold_start": {
+        "join_ms": 400.0, "replay_ms": 800.0, "speedup": 2.0,
+        "checkpoint_ms": 90.0, "restore_ms": 80.0,
+        "snap_fallbacks_counted": 9,
+    }}
+    _, regressed = compare(old, bad, threshold=0.2)
+    assert "cold_start.join_ms" in regressed
+    assert "cold_start.speedup" in regressed
+    assert "cold_start.checkpoint_ms" in regressed
+    assert "cold_start.restore_ms" in regressed
+    assert "cold_start.snap_fallbacks_counted" in regressed
+    # replay_ms is a workload fact (the baseline), never gated
+    assert not any("replay_ms" in r for r in regressed)
+    # the opposite directions never fail: a faster join, a bigger
+    # speedup, a cleaner ladder
+    better = {"cold_start": {
+        "join_ms": 4.0, "replay_ms": 800.0, "speedup": 200.0,
+        "checkpoint_ms": 3.0, "restore_ms": 2.0,
+        "snap_fallbacks_counted": 0,
+    }}
+    _, regressed = compare(old, better)
+    assert regressed == []
+
+
+def test_snapshot_guard_counters_lower_is_better():
+    """Round 21 guard rows: an UNLABELED snap.fallbacks /
+    snap.write_errors total regresses on a rise like any guard
+    counter (the reason-labeled variants ride the artifact section
+    above — the guard loop skips labeled names by design)."""
+    old = {"tracer": {"counters": {
+        "snap.fallbacks": 1, "snap.write_errors": 0,
+        'snap.fallbacks{reason="crc"}': 1,
+    }}}
+    bad = {"tracer": {"counters": {
+        "snap.fallbacks": 6, "snap.write_errors": 3,
+        'snap.fallbacks{reason="crc"}': 6,
+    }}}
+    _, regressed = compare(old, bad, threshold=0.2)
+    assert "tracer.snap.fallbacks" in regressed
+    assert "tracer.snap.write_errors" in regressed
+    # labeled variants stay out of the guard loop
+    assert not any("{" in r for r in regressed)
+    _, regressed = compare(old, copy.deepcopy(old))
+    assert regressed == []

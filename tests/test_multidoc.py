@@ -590,6 +590,69 @@ def test_resident_budget_evicts_lru_and_reconverges():
         set_tracer(Tracer(enabled=False))
 
 
+def test_eviction_flood_writes_snapshots_and_rehydrates(tmp_path):
+    """Round 21: the eviction cold-start tax fix. Same budget-flood
+    shape as above, but with a snapshot store attached — every
+    committed eviction leaves a snapshot behind (``snap.evict_writes``
+    tracks it), and the evicted doc's next promotion REHYDRATES
+    (``snap.loads`` grows) instead of replaying its full history,
+    byte-identical to the oracle."""
+    from crdt_tpu.models.incremental import IncrementalReplay
+    from crdt_tpu.storage.snapshot import SnapshotStore
+
+    tracer = set_tracer(Tracer(enabled=True))
+    try:
+        streams = {f"w{i}": DocStream(i, n_clients=1)
+                   for i in range(6)}
+        history = {d: [] for d in streams}
+        budget = int(
+            IncrementalReplay.estimate_resident_bytes(64) * 2.5
+        )
+        store = SnapshotStore(str(tmp_path))
+        srv = MultiDocServer(resident_max_bytes=budget,
+                             snap_store=store)
+
+        def touch(docs, k):
+            for d in docs:
+                b = streams[d].delta(k)
+                history[d].append(b)
+                srv.submit(d, b)
+            srv.tick()
+
+        wave1 = ["w0", "w1", "w2"]
+        wave2 = ["w3", "w4", "w5"]
+        touch(wave1, 12)
+        touch(wave1, 3)
+        touch(wave2, 12)
+        touch(wave2, 3)             # promotions evict wave-1 LRU
+        touch(wave2, 3)
+        assert srv.eviction_count > 0
+        counters = get_tracer().counters()
+        assert counters.get("snap.evict_writes", 0) \
+            == srv.eviction_count
+        assert counters.get("snap.evict_writes", 0) \
+            <= counters.get("snap.writes", 0)
+        evicted = [d for d in wave1
+                   if srv._docs[d].resident is None]
+        assert evicted, "no wave-1 resident was evicted"
+        d = evicted[0]
+        loads0 = counters.get("snap.loads", 0)
+        # resubmit twice: serve-cold then promote — the promotion
+        # must go through the snapshot, not a full-history rebuild
+        for _ in range(2):
+            b = streams[d].delta(3)
+            history[d].append(b)
+            srv.submit(d, b)
+            srv.tick()
+        assert srv.cache(d) == oracle_cache(history[d])
+        if srv._docs[d].resident is not None:
+            assert get_tracer().counters().get("snap.loads", 0) \
+                > loads0, "re-promotion did not rehydrate"
+        assert srv.resident_peak_bytes() <= budget
+    finally:
+        set_tracer(Tracer(enabled=False))
+
+
 def test_serve_live_ingest_scheduler():
     """The round-15 live-ingest loop: a stream of update batches is
     drained across bounded ticks (ingest overlapping in-flight

@@ -1,0 +1,528 @@
+"""Round 21: device-layout snapshots — corruption fuzz + ALICE matrix.
+
+Three contract families over ``crdt_tpu.storage.snapshot``:
+
+- **rejects fail closed** — seeded truncation / bit-flip / splice /
+  header mutants over a REAL snapshot must raise ``ValueError`` only
+  (never a hang, never another exception type) and leave zero
+  partial state; the store-level ladder then recovers via WAL replay
+  to a byte-identical digest (the ``test_codec_fuzz.py`` discipline
+  applied to the snapshot wire);
+- **crash-proof writes** — the ALICE matrix: a simulated kill at
+  EVERY fs op of the snapshot writer's write/rename/delete sequence
+  (plus torn writes), after which a reopen serves a byte-identical
+  doc with zero acked-update loss (acked updates live in the WAL;
+  the snapshot writer never touches it);
+- **byte-identical restore** — engine -> snapshot -> rehydrate
+  round-trips digest- and state-blob-identically, stays identical
+  under subsequent deltas, and the server-level checkpoint/restore
+  round-trips the whole resident set.
+"""
+
+import os
+import random
+
+import pytest
+
+from crdt_tpu.codec import v1
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.guard.faults import (
+    DiskFaultSchedule,
+    FaultyFs,
+    SimulatedCrash,
+)
+from crdt_tpu.models.incremental import IncrementalReplay
+from crdt_tpu.models.multidoc import MultiDocServer, cache_digest
+from crdt_tpu.models.replay import cold_start, replay_trace
+from crdt_tpu.obs import Tracer, get_tracer, set_tracer
+from crdt_tpu.storage import snapshot as sn
+from crdt_tpu.storage.persistence import LogPersistence
+
+
+@pytest.fixture
+def tracer():
+    t = set_tracer(Tracer(enabled=True))
+    yield t
+    set_tracer(Tracer(enabled=False))
+
+
+class Stream:
+    """SV-admissible incremental doc generator: map sets chaining per
+    key, a YATA list chain with mid-inserts, occasional deletes —
+    the union of wire shapes a resident engine holds."""
+
+    def __init__(self, seed, n_clients=2):
+        self.seed = seed
+        self.clients = [10 + c for c in range(n_clients)]
+        self.clock = {c: 0 for c in self.clients}
+        self.chain: list = []
+        self.map_tail: dict = {}
+
+    def delta(self, k_ops, *, deletes=False) -> bytes:
+        recs = []
+        ds = DeleteSet()
+        for i in range(k_ops):
+            c = self.clients[i % len(self.clients)]
+            k = self.clock[c]
+            self.clock[c] = k + 1
+            if i % 3 == 0:
+                key = f"k{(self.seed + i) % 5}"
+                recs.append(ItemRecord(
+                    client=c, clock=k, parent_root="m", key=key,
+                    origin=self.map_tail.get(key),
+                    content=self.seed * 1000 + k,
+                ))
+                self.map_tail[key] = (c, k)
+            elif len(self.chain) > 2 and i % 3 == 2:
+                j = len(self.chain) // 2
+                recs.append(ItemRecord(
+                    client=c, clock=k, parent_root="l",
+                    origin=self.chain[j - 1], right=self.chain[j],
+                    content=self.seed * 1000 + k,
+                ))
+                self.chain.insert(j, (c, k))
+            else:
+                recs.append(ItemRecord(
+                    client=c, clock=k, parent_root="l",
+                    origin=self.chain[-1] if self.chain else None,
+                    content=self.seed + k,
+                ))
+                self.chain.append((c, k))
+        if deletes and len(self.chain) > 4:
+            dc, dk = self.chain[1]
+            ds.add(dc, dk, 1)
+        return v1.encode_update(recs, ds)
+
+
+def _engine(n_deltas=30, k=8, seed=1):
+    s = Stream(seed)
+    blobs = [s.delta(k, deletes=(i % 7 == 6)) for i in range(n_deltas)]
+    eng = IncrementalReplay()
+    eng.apply(blobs)
+    assert not eng._pending and not eng._rootless
+    return eng, blobs, s
+
+
+# ---------------------------------------------------------------------------
+# round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestRoundTrip:
+    def test_rehydrate_byte_identical_and_stays_identical(self):
+        eng, blobs, s = _engine()
+        payload = sn.encode_engine(eng, seq=17)
+        snap = sn.decode_payload(payload)
+        assert snap.seq == 17
+        assert snap.n == eng.cols.n
+        eng2 = sn.rehydrate(snap)
+        assert cache_digest(eng2.cache) == cache_digest(eng.cache)
+        assert eng2.encode_state_as_update() == \
+            eng.encode_state_as_update()
+        # the rehydrated engine must keep converging identically
+        tail = [s.delta(8, deletes=(i == 2)) for i in range(6)]
+        ref = IncrementalReplay()
+        ref.apply(blobs + tail)
+        eng.apply(tail)
+        eng2.apply(tail)
+        assert cache_digest(eng.cache) == cache_digest(ref.cache)
+        assert cache_digest(eng2.cache) == cache_digest(ref.cache)
+        assert eng2.encode_state_as_update() == \
+            ref.encode_state_as_update()
+
+    def test_deterministic_encode(self):
+        eng, _, _ = _engine(n_deltas=10)
+        assert sn.encode_engine(eng, seq=3) == \
+            sn.encode_engine(eng, seq=3)
+
+    def test_refuses_unsettled_engine(self):
+        eng = IncrementalReplay()
+        # a gapped clock stashes as pending
+        eng.apply([v1.encode_update([ItemRecord(
+            client=5, clock=9, parent_root="m", key="k",
+            content=1)], DeleteSet())])
+        assert eng._pending
+        with pytest.raises(ValueError):
+            sn.encode_engine(eng)
+
+
+# ---------------------------------------------------------------------------
+# corruption fuzz (rejects fail closed; ladder recovers)
+# ---------------------------------------------------------------------------
+
+
+def _mutants(payload, rng, n=220):
+    """Seeded truncation / bit-flip / splice / header mutants."""
+    hdr = len(sn.MAGIC) + 4
+    for _ in range(n):
+        b = bytearray(payload)
+        op = rng.randrange(4)
+        if op == 0 and len(b) > 1:  # truncation
+            yield bytes(b[: rng.randrange(1, len(b))])
+        elif op == 1:  # bit flips anywhere
+            for _ in range(rng.randrange(1, 4)):
+                b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+            yield bytes(b)
+        elif op == 2:  # splice with self at random offsets
+            cut = rng.randrange(1, len(b) + 1)
+            yield bytes(b[:cut]) + payload[rng.randrange(len(payload)):]
+        else:  # header-targeted flips (magic/len/table region)
+            lo = rng.randrange(0, hdr + 64)
+            b[min(lo, len(b) - 1)] ^= 0xFF
+            yield bytes(b)
+
+
+class TestCorruptionFuzz:
+    def test_mutants_reject_value_error_only(self):
+        eng, _, _ = _engine(n_deltas=12)
+        payload = sn.encode_engine(eng, seq=1)
+        rng = random.Random(20260806)
+        rejected = survived = 0
+        for m in _mutants(payload, rng):
+            try:
+                snap = sn.decode_payload(m)
+            except ValueError:
+                rejected += 1
+                continue
+            except Exception as exc:  # noqa: BLE001 - the contract
+                pytest.fail(f"non-ValueError escape: {exc!r}")
+            # a mutant that still parses must rehydrate or reject
+            # cleanly — never crash the promotion seam
+            survived += 1
+            try:
+                eng2 = sn.rehydrate(snap)
+                eng2.cache
+            except ValueError:
+                pass
+        assert rejected > 100  # the corpus really exercised rejects
+
+    def test_targeted_header_mutants(self):
+        eng, _, _ = _engine(n_deltas=8)
+        payload = sn.encode_engine(eng, seq=1)
+        with pytest.raises(ValueError, match="magic"):
+            sn.decode_payload(b"NOTASNAP" + payload[8:])
+        with pytest.raises(ValueError, match="truncated"):
+            sn.decode_payload(payload[: len(payload) // 2])
+        with pytest.raises(ValueError, match="truncated"):
+            sn.decode_payload(payload[:10])
+        # header crc catches a table flip
+        b = bytearray(payload)
+        b[len(sn.MAGIC) + 6] ^= 0x01
+        with pytest.raises(ValueError):
+            sn.decode_payload(bytes(b))
+        # payload crc catches a tail flip
+        b = bytearray(payload)
+        b[-3] ^= 0x10
+        with pytest.raises(ValueError, match="crc|digest"):
+            sn.decode_payload(bytes(b))
+
+    def test_store_ladder_falls_back_to_wal_byte_identical(
+            self, tmp_path, tracer):
+        lp = LogPersistence(str(tmp_path / "s.kvlog"))
+        store = sn.SnapshotStore(str(tmp_path / "snaps"))
+        s = Stream(3)
+        for i in range(20):
+            lp.store_update("d", s.delta(6, deletes=(i % 9 == 8)))
+        eng = IncrementalReplay()
+        eng.apply(lp.get_all_updates("d"))
+        assert sn.compact_with_snapshot(lp, "d", eng, store)
+        for _ in range(4):
+            lp.store_update("d", s.delta(6))
+        ref = IncrementalReplay()
+        ref.apply(lp.get_all_updates("d"))
+        ref_blob = ref.encode_state_as_update()
+
+        fast, path = cold_start("d", lp, store)
+        assert path == "snapshot"
+        assert fast.encode_state_as_update() == ref_blob
+
+        # every mutant of the on-disk file recovers via WAL replay
+        snap_files = [n for n in os.listdir(str(tmp_path / "snaps"))
+                      if n.endswith(".snap")]
+        assert len(snap_files) == 1
+        p = os.path.join(str(tmp_path / "snaps"), snap_files[0])
+        pristine = open(p, "rb").read()
+        rng = random.Random(77)
+        fb0 = tracer.counters().get("snap.fallbacks{reason=\"crc\"}", 0)
+        for m in list(_mutants(pristine, rng, n=24)):
+            with open(p, "wb") as f:
+                f.write(m)
+            eng2, _ = cold_start("d", lp, store)
+            assert eng2.encode_state_as_update() == ref_blob
+        with open(p, "wb") as f:
+            f.write(pristine)
+        counters = tracer.counters()
+        assert sum(v for k, v in counters.items()
+                   if k.startswith("snap.fallbacks")) > 0, counters
+        assert fb0 == 0
+        lp.close()
+
+    def test_tmp_leftover_of_torn_rename_is_ignored(self, tmp_path):
+        eng, _, _ = _engine(n_deltas=8)
+        store = sn.SnapshotStore(str(tmp_path))
+        payload = sn.encode_engine(eng, seq=2)
+        assert store.write("d", payload, 2)
+        # a torn rename leaves the NEXT generation as .tmp only
+        with open(str(tmp_path / ("d-%020d.snap.tmp" % 3)), "wb") as f:
+            f.write(b"half a snapshot")
+        snap, seq = store.load_latest("d")
+        assert seq == 2
+        assert snap.n == eng.cols.n
+
+
+# ---------------------------------------------------------------------------
+# the ALICE crash-point matrix over the snapshot writer
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotAliceMatrix:
+    """Kill the snapshot writer at EVERY fs op of a two-generation
+    write workload (crash-before-op at each index, torn variant at
+    each write op). After every kill: the store reopens to a valid
+    old/new generation or none, cold start converges byte-identical
+    to pure WAL replay, and no acked update is lost."""
+
+    def _workload(self, root, fs):
+        """Two generations + a checkpoint sidecar through one fs."""
+        store = sn.SnapshotStore(root, fs=fs)
+        eng1, blobs1, s = _engine(n_deltas=10, seed=5)
+        store.write("d", sn.encode_engine(eng1, seq=10), 10)
+        tail = [s.delta(8) for _ in range(4)]
+        eng2 = IncrementalReplay()
+        eng2.apply(blobs1 + tail)
+        store.write("d", sn.encode_engine(eng2, seq=14), 14)
+        return blobs1 + tail
+
+    def test_matrix(self, tmp_path, tracer):
+        # clean run enumerates the op sequence (the matrix axis)
+        clean_fs = FaultyFs(sn.Fs(), DiskFaultSchedule())
+        blobs = self._workload(str(tmp_path / "clean"), clean_fs)
+        n_ops = len(clean_fs.ops)
+        assert n_ops >= 10  # 2 generations x (write..fsync_dir) + unlink
+        assert ("unlink", ) [0] in [v for v, _ in clean_fs.ops][0:0] \
+            or any(v == "unlink" for v, _ in clean_fs.ops)
+
+        ref = IncrementalReplay()
+        ref.apply(blobs)
+        ref_blob = ref.encode_state_as_update()
+        ref_digest = cache_digest(ref.cache)
+
+        scenarios = [("crash", i) for i in range(n_ops)]
+        write_ops = [i for i, (verb, _) in enumerate(clean_fs.ops)
+                     if verb == "write"]
+        scenarios += [("torn", i) for i in write_ops]
+
+        for kind, i in scenarios:
+            root = str(tmp_path / f"{kind}_{i}")
+            lp = LogPersistence(os.path.join(root, "wal.kvlog"))
+            for b in blobs:
+                lp.store_update("d", b)  # every update is acked
+            if kind == "crash":
+                sched = DiskFaultSchedule(crash_at=(i, 0))
+            else:
+                sched = DiskFaultSchedule(fail_writes=(), torn=0.0)
+                sched.fail_writes = set()
+                sched.crash_at = None
+                # deterministic torn at exactly op i
+                sched.decide = (  # type: ignore[method-assign]
+                    lambda n, _i=i: "torn" if n == _i else None)
+            fs = FaultyFs(sn.Fs(), sched)
+            try:
+                self._workload(root, fs)
+            except SimulatedCrash:
+                pass
+            except OSError:
+                pass  # torn write surfaces as EIO; writer degraded
+            # reopen: a fresh store over whatever the crash left
+            eng, path = cold_start(
+                "d", lp, sn.SnapshotStore(root))
+            assert eng.encode_state_as_update() == ref_blob, (kind, i)
+            assert cache_digest(eng.cache) == ref_digest, (kind, i)
+            assert not eng._pending and not eng._rootless, (kind, i)
+            lp.close()
+
+    def test_enospc_eio_degrade_keep_serving(self, tmp_path, tracer):
+        """Disk faults at the snapshot seam degrade (write refused,
+        counted, serving continues from WAL) and heal on retry."""
+        eng, blobs, _ = _engine(n_deltas=8)
+        lp = LogPersistence(str(tmp_path / "wal.kvlog"))
+        for b in blobs:
+            lp.store_update("d", b)
+        sched = DiskFaultSchedule(fail_writes={0},
+                                  fail_errno=__import__("errno").ENOSPC)
+        fs = FaultyFs(sn.Fs(), sched)
+        store = sn.SnapshotStore(str(tmp_path / "snaps"), fs=fs)
+        payload = sn.encode_engine(eng, seq=len(blobs))
+        assert store.write("d", payload, len(blobs)) is False
+        c = tracer.counters()
+        assert c.get('snap.write_errors{reason="io"}', 0) == 1
+        # WAL serving unaffected
+        eng2, path = cold_start("d", lp, store)
+        assert path == "wal"
+        assert eng2.encode_state_as_update() == \
+            eng.encode_state_as_update()
+        # the disk healed: the retried write lands and loads
+        assert store.write("d", payload, len(blobs)) is True
+        eng3, path = cold_start("d", lp, store)
+        assert path == "snapshot"
+        assert eng3.encode_state_as_update() == \
+            eng.encode_state_as_update()
+        lp.close()
+
+    def test_store_budget_refuses_politely(self, tmp_path, tracer):
+        eng, blobs, _ = _engine(n_deltas=8)
+        payload = sn.encode_engine(eng, seq=1)
+        store = sn.SnapshotStore(
+            str(tmp_path), max_bytes=len(payload) // 2)
+        assert store.write("d", payload, 1) is False
+        c = tracer.counters()
+        assert c.get('snap.write_errors{reason="budget"}', 0) == 1
+        assert store.load_latest("d") is None
+
+    def test_rider_crash_between_snapshot_and_compact(
+            self, tmp_path, tracer):
+        """The rider's ordering contract: the snapshot lands at the
+        seq the WAL compaction will use BEFORE old keys die, so a
+        kill in the window leaves snapshot + full old WAL — and the
+        tail query returns nothing stale."""
+        lp = LogPersistence(str(tmp_path / "wal.kvlog"))
+        s = Stream(9)
+        for _ in range(12):
+            lp.store_update("d", s.delta(6))
+        eng = IncrementalReplay()
+        eng.apply(lp.get_all_updates("d"))
+        ref_blob = eng.encode_state_as_update()
+        store = sn.SnapshotStore(str(tmp_path / "snaps"))
+        # crash the WAL compact (write index 0 of the WAL kv is the
+        # compact batch after 12 appends? no — kill via kv seam is
+        # round 10's matrix; here simulate by snapshotting WITHOUT
+        # compacting: the window state is snapshot + full old WAL)
+        seq = lp._seq_for("d")
+        lp._next_seq["d"] = seq
+        assert store.write("d", sn.encode_engine(eng, seq=seq), seq)
+        # reopen in the window: snapshot covers the whole WAL, the
+        # tail (seq strictly greater) is empty, digest identical
+        assert lp.get_updates_since("d", seq) == []
+        eng2, path = cold_start("d", lp, store)
+        assert path == "snapshot"
+        assert eng2.encode_state_as_update() == ref_blob
+        # now the compact completes; still identical, and appends
+        # after it are served as tail
+        sn.compact_with_snapshot(lp, "d", eng, store)
+        for _ in range(3):
+            lp.store_update("d", s.delta(6))
+        ref2 = IncrementalReplay()
+        ref2.apply(lp.get_all_updates("d"))
+        eng3, path = cold_start("d", lp, store)
+        assert path == "snapshot"
+        assert eng3.encode_state_as_update() == \
+            ref2.encode_state_as_update()
+        lp.close()
+
+
+# ---------------------------------------------------------------------------
+# server-level seams (eviction tax + checkpoint/restore)
+# ---------------------------------------------------------------------------
+
+
+class TestServerSeams:
+    def _warm_server(self, store, n_docs=3, rounds=4):
+        srv = MultiDocServer(snap_store=store)
+        streams = {f"doc{i}": Stream(i) for i in range(n_docs)}
+        for _ in range(rounds):
+            for d, s in streams.items():
+                srv.submit_many(d, [s.delta(6) for _ in range(3)])
+            srv.tick()
+        return srv, streams
+
+    def test_eviction_writes_snapshot_and_rehydrates(
+            self, tmp_path, tracer):
+        """The round-15 eviction-flood pin extended: an evicted doc
+        leaves a snapshot behind, and its resubmit re-promotes by
+        rehydrating + applying only the tail — byte-identical to the
+        full-history oracle."""
+        store = sn.SnapshotStore(str(tmp_path))
+        srv, streams = self._warm_server(store)
+        warm = [d for d, st in srv._docs.items()
+                if st.resident is not None]
+        assert warm
+        victim = warm[0]
+        srv._evict_resident(victim)
+        c = tracer.counters()
+        assert c.get("snap.evict_writes", 0) == 1
+        assert c.get("snap.writes", 0) >= 1
+        loads0 = c.get("snap.loads", 0)
+        # resubmit: the next promotion must load, not rebuild
+        for _ in range(2):
+            srv.submit_many(
+                victim, [streams[victim].delta(6) for _ in range(3)])
+            srv.tick()
+        st = srv._docs[victim]
+        assert st.resident is not None
+        assert tracer.counters().get("snap.loads", 0) > loads0
+        oracle = replay_trace(st.blobs)
+        assert cache_digest(srv._cache_of(st)) == \
+            cache_digest(oracle.cache)
+
+    def test_checkpoint_restore_whole_resident_set(
+            self, tmp_path, tracer):
+        store = sn.SnapshotStore(str(tmp_path))
+        srv, streams = self._warm_server(store, n_docs=4)
+        n = srv.checkpoint()
+        assert n == len([d for d, st in srv._docs.items()
+                         if st.resident is not None])
+        assert tracer.counters().get("tenant.checkpoint_docs") == n
+
+        srv2 = MultiDocServer(snap_store=store)
+        warm = srv2.restore()
+        assert warm == n
+        for d in srv._docs:
+            assert cache_digest(srv2._cache_of(srv2._docs[d])) == \
+                cache_digest(srv._cache_of(srv._docs[d])), d
+        # the restored set keeps serving identically
+        for d, s in streams.items():
+            blob = s.delta(6)
+            srv.submit(d, blob)
+            srv2.submit(d, blob)
+        srv.tick()
+        srv2.tick()
+        for d in srv._docs:
+            assert cache_digest(srv2._cache_of(srv2._docs[d])) == \
+                cache_digest(srv._cache_of(srv._docs[d])), d
+
+    def test_restore_with_damaged_snapshot_serves_cold(
+            self, tmp_path, tracer):
+        store = sn.SnapshotStore(str(tmp_path))
+        srv, _ = self._warm_server(store, n_docs=2)
+        assert srv.checkpoint() >= 1
+        # damage every snapshot generation; sidecars stay
+        for name in os.listdir(str(tmp_path)):
+            if name.endswith(".snap"):
+                p = os.path.join(str(tmp_path), name)
+                b = bytearray(open(p, "rb").read())
+                b[len(b) // 2] ^= 0xFF
+                with open(p, "wb") as f:
+                    f.write(bytes(b))
+        srv2 = MultiDocServer(snap_store=store)
+        assert srv2.restore() == 0  # nothing warm...
+        for d in srv._docs:  # ...but every doc's history survived
+            assert cache_digest(replay_trace(
+                srv2._docs[d].blobs).cache) == \
+                cache_digest(srv._cache_of(srv._docs[d])), d
+        # and serving from the cold rung converges identically
+        streams = {}
+        for d in srv._docs:
+            s = Stream(int(d[3:]) + 50)
+            s.clients = [90, 91]  # fresh writers, clocks from 0
+            s.clock = {c: 0 for c in s.clients}
+            streams[d] = s
+        for d, s in streams.items():
+            blob = s.delta(6)
+            srv.submit(d, blob)
+            srv2.submit(d, blob)
+        srv.tick()
+        srv2.tick()
+        for d in srv._docs:
+            assert cache_digest(srv2._cache_of(srv2._docs[d])) == \
+                cache_digest(srv._cache_of(srv._docs[d])), d

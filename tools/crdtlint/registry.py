@@ -38,6 +38,9 @@ NAMESPACES = frozenset({
     # round 19 (distributed tracing): the wire trace-context /
     # per-hop lag plane and the live fleet collector
     "propagation", "collector",
+    # round 21 (crash-proof recovery): the snapshot store's
+    # write/load/fallback plane
+    "snap",
 })
 
 # backticked dotted names that share a namespace but are NOT metrics

@@ -181,6 +181,22 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     (("fleet_trace", "procs"), True),
     (("fleet_trace", "pair_rate"), True),
     (("fleet_trace", "wire_overhead_ratio"), False),
+    # crash-proof recovery (round 21, bench --coldstart): the scale
+    # doc's snapshot join time (lower = better; a SECTION key, so the
+    # ms noise floor never mutes it even when the join is fast) and
+    # its speedup over full WAL replay (higher = better — the >=5x
+    # acceptance bar is a gated artifact, not a doc sentence). The
+    # server-side checkpoint/restore times ride the same contract.
+    (("cold_start", "join_ms"), False),
+    (("cold_start", "speedup"), True),
+    (("cold_start", "checkpoint_ms"), False),
+    (("cold_start", "restore_ms"), False),
+    # the recovery ladder's fallback count for the leg (the tracer's
+    # snap.fallbacks counters are reason-labeled, so the guard loop
+    # skips them — the harness publishes the sum here): a rise means
+    # the same run hit more damaged/unusable snapshots (lower =
+    # better, a count — never muted by the seconds floor)
+    (("cold_start", "snap_fallbacks_counted"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 
@@ -214,6 +230,13 @@ GUARD_PREFIXES: Tuple[str, ...] = (
     # facts and stay ungated)
     "tenant.resident_evictions",
     "tenant.delta_fallbacks",
+    # round 21: snapshot-plane degradations — more fallbacks means
+    # the same trace hit more damaged/unusable snapshots on the
+    # recovery ladder, more write errors means the store refused or
+    # failed more writes (snap.writes / loads / bytes are workload
+    # facts and stay ungated)
+    "snap.fallbacks",
+    "snap.write_errors",
 )
 
 
