@@ -1797,6 +1797,221 @@ def coldstart(argv=None) -> int:
     return 0 if ok else 1
 
 
+def autopilot_leg() -> dict:
+    """The ``--autopilot`` evidence (round 22, ROADMAP item 2): the
+    SLO-driven control plane A/B — one flooding tenant beside small
+    steady neighbors through :class:`MultiDocServer` twice, identical
+    submissions, identical STATIC per-tenant budgets:
+
+    - **OFF** (oracle): no controller — the static budget is the only
+      defense (the pre-round-22 serving shape);
+    - **ON**: a :class:`crdt_tpu.obs.control.Controller` squeezes the
+      breaching flooder's budget, shields its docs from the LRU
+      sweep, and restores the static budget with hysteresis once the
+      flood drains.
+
+    Burn is driven by SHEDS only (``slo_ms`` is effectively infinite
+    and the burn window is outcome-counted, never wall-clock), so the
+    recovery evidence is deterministic: each flood blob overflows the
+    squeezed byte budget, so under keep-the-newest the squeezed
+    flooder sheds 7 of its 8 blobs every flood tick (burn pins at
+    14/16), and ``recovery_ticks`` counts calm ticks until burn
+    drains to the restore threshold ``burn_lo`` (0 = already there at
+    flood end). Neighbor digests must be byte-identical across ON/OFF
+    (the squeeze touches ONE tenant) and the ON ledger must replay
+    byte-identically from its own sensor trace.
+    ``tools/metrics_diff.py`` gates ``autopilot.recovery_ticks`` and
+    ``autopilot.neighbor_p99_ms`` (both lower-is-better)."""
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.ids import DeleteSet
+    from crdt_tpu.core.records import ItemRecord
+    from crdt_tpu.models.multidoc import MultiDocServer
+    from crdt_tpu.obs.control import Controller
+    from crdt_tpu.obs.slo import SLOLedger
+
+    flood_ticks = int(os.environ.get("BENCH_AP_FLOOD_TICKS", 6))
+    calm_ticks = int(os.environ.get("BENCH_AP_CALM_TICKS", 28))
+    n_neighbors = int(os.environ.get("BENCH_AP_NEIGHBORS", 6))
+    budget_bytes, budget_updates = 2048, 4
+    burn_window = 16
+    # "recovered" = burn drained to the controller's restore
+    # threshold — the same bar the hysteresis rule applies
+    recover_lo = 0.25
+    flooder = "flood!"
+
+    def flood_blob(i: int) -> bytes:
+        # one independent single-record update (own client, no
+        # origin: shedding any subset never orphans a survivor),
+        # sized BETWEEN the squeezed byte budget and the static one —
+        # the squeezed server sheds every flood blob (burn pins at
+        # 1.0), the static server keeps a couple per tick
+        return v1.encode_update([ItemRecord(
+            client=10_000 + i, clock=0, parent_root="m",
+            key=f"f{i}", content="f" * 700,
+        )], DeleteSet())
+
+    assert budget_bytes // 4 < len(flood_blob(0)) < budget_bytes, \
+        "autopilot: flood blob out of the squeeze band"
+
+    def run(on: bool):
+        ctrl = (Controller(cooldown_ticks=4, restore_after=2)
+                if on else None)
+        srv = MultiDocServer(
+            tenant_max_pending_bytes=budget_bytes,
+            tenant_max_pending_updates=budget_updates,
+            slo_ms=1e9,  # serves never breach: sheds drive burn
+            control=ctrl,
+        )
+        # fast-flushing burn window (16 outcomes, not 128): the
+        # restore hysteresis is observable within the calm phase
+        srv.slo = SLOLedger(1e9, burn_window=burn_window)
+        neighbors = [f"n{i}" for i in range(n_neighbors)]
+        streams = {d: _SteadyStream(i)
+                   for i, d in enumerate(neighbors)}
+        fstream = _SteadyStream(500)
+        lat: list = []
+        recovery = None
+        restore_tick = None
+        burn_flood_end = None
+        nblob = 0
+        for t in range(flood_ticks + calm_ticks):
+            if t < flood_ticks:
+                for _ in range(8):
+                    srv.submit(flooder, flood_blob(nblob))
+                    nblob += 1
+            else:
+                # calm: tiny admissible deltas so the burn window
+                # keeps flushing (no outcomes = frozen burn)
+                srv.submit(flooder, fstream.delta(2))
+            for d in neighbors:
+                srv.submit(d, streams[d].delta(4))
+            srv.tick()
+            for d in neighbors:
+                ls = srv.latency_s(d)
+                if ls is not None:
+                    lat.append(ls)
+            burn = srv.slo.report()["tenants"].get(
+                flooder, {}).get("burn_rate", 0.0)
+            if t == flood_ticks - 1:
+                burn_flood_end = burn
+                if burn <= recover_lo:
+                    recovery = 0
+            elif (t >= flood_ticks and recovery is None
+                    and burn <= recover_lo):
+                recovery = t - flood_ticks + 1
+            if (on and restore_tick is None and t >= flood_ticks
+                    and not ctrl.overrides()):
+                restore_tick = t
+        p99 = (round(float(np.percentile(lat, 99)) * 1e3, 3)
+               if lat else None)
+        return {
+            "srv": srv, "ctrl": ctrl, "recovery": recovery,
+            "restore_tick": restore_tick, "p99_ms": p99,
+            "burn_flood_end": burn_flood_end,
+            "neighbors": neighbors,
+        }
+
+    run(True)   # warm (compile) — untimed, like every bench warmup
+    run(False)
+    on = run(True)
+    off = run(False)
+
+    neighbors_identical = all(
+        on["srv"].digest(d) == off["srv"].digest(d)
+        for d in on["neighbors"]
+    )
+    ctrl = on["ctrl"]
+    replay = Controller.replay(list(ctrl.trace), **ctrl.config())
+    rules = [r["rule"] for r in ctrl.ledger.rows()]
+    return {
+        "flood_ticks": flood_ticks,
+        "calm_ticks": calm_ticks,
+        "neighbors": len(on["neighbors"]),
+        "recovery_ticks": on["recovery"],
+        "recovery_ticks_off": off["recovery"],
+        "recovery_budget_ticks": int(os.environ.get(
+            "BENCH_AP_RECOVERY_BUDGET", burn_window)),
+        "burn_flood_end": on["burn_flood_end"],
+        "burn_flood_end_off": off["burn_flood_end"],
+        "neighbor_p99_ms": on["p99_ms"],
+        "neighbor_p99_ms_off": off["p99_ms"],
+        "neighbors_identical": neighbors_identical,
+        "squeezed": "budget_squeeze" in rules,
+        "restored": "budget_restore" in rules,
+        "restore_tick": on["restore_tick"],
+        "decisions": ctrl.decisions,
+        "cooldown_skips": ctrl.cooldown_skips,
+        "ledger_rows": ctrl.ledger.total,
+        "ledger_dropped": ctrl.ledger.dropped,
+        "ledger_replay_identical": (
+            replay.ledger.to_jsonl() == ctrl.ledger.to_jsonl()
+        ),
+        "shed_updates_on": on["srv"].shed_count,
+        "shed_updates_off": off["srv"].shed_count,
+    }
+
+
+def autopilot(argv=None) -> int:
+    """The ``--autopilot`` harness: run the round-22 control-plane
+    A/B leg, merge the gated ``autopilot`` section into
+    BENCH_OUT.json (like ``--multitenant``), one summary line on
+    stdout. Exits non-zero when the controller failed to squeeze or
+    restore, the flooder's burn did not recover within the budget, a
+    neighbor diverged from the controller-OFF oracle, or the ledger
+    replay was not byte-identical — a control plane that distorts
+    documents or loses its audit trail must never publish as
+    evidence."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from crdt_tpu.obs import (
+        TickTimeline, Tracer, set_timeline, set_tracer,
+    )
+
+    tracer = None
+    if os.environ.get("BENCH_TRACE", "1") != "0":
+        tracer = set_tracer(Tracer(enabled=True))
+        set_timeline(TickTimeline(enabled=True))
+    leg = autopilot_leg()
+    if tracer is not None:
+        counters = tracer.counters()
+        leg["decisions_counted"] = counters.get(
+            "control.decisions", 0)
+    ok = bool(leg["neighbors_identical"]) \
+        and bool(leg["ledger_replay_identical"]) \
+        and bool(leg["squeezed"]) \
+        and bool(leg["restored"]) \
+        and leg["recovery_ticks"] is not None \
+        and leg["recovery_ticks"] <= leg["recovery_budget_ticks"]
+    if ok:
+        try:
+            with open(BENCH_OUT) as f:
+                full = json.load(f)
+        except (OSError, ValueError):
+            full = {}
+        full["autopilot"] = leg
+        try:
+            with open(BENCH_OUT, "w") as f:
+                json.dump(full, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError as exc:
+            log(f"{BENCH_OUT} not written: {exc}")
+    print(json.dumps({
+        "metric": "autopilot",
+        "ok": ok,
+        "recovery_ticks": leg["recovery_ticks"],
+        "recovery_ticks_off": leg["recovery_ticks_off"],
+        "neighbor_p99_ms": leg["neighbor_p99_ms"],
+        "neighbor_p99_ms_off": leg["neighbor_p99_ms_off"],
+        "decisions": leg["decisions"],
+        "restore_tick": leg["restore_tick"],
+        "full_results": os.path.basename(BENCH_OUT),
+    }))
+    return 0 if ok else 1
+
+
 def overload_leg(seed: int = 11) -> dict:
     """Seeded overload evidence (guard layer): flood one replica at 4x
     its inbox byte budget in a single delivery round, record the
@@ -2168,10 +2383,31 @@ def fleet_trace_child(argv) -> int:
     ))
     rep = Replica(router, topic="fleet", client_id=101 + idx,
                   anti_entropy_s=0.2, batch_incoming=True)
+    # round 22: child 1 carries a live control plane — a seeded
+    # synthetic flood drives a budget squeeze whose placement-advice
+    # row the parent's collector must surface at /fleet within one
+    # scrape (the other children stay control-less: the collector's
+    # /control fetch must tolerate the 404)
+    ctrl = None
+    if idx == 1:
+        from crdt_tpu.obs import Controller
+
+        ctrl = Controller(cooldown_ticks=2)
+        for ct in range(4):
+            ctrl.observe({
+                "tick": ct,
+                "budget": {"max_bytes": 2048, "max_updates": 4},
+                "tenants": {"flood!": {
+                    "burn": 1.0, "shed": 8 * (ct + 1),
+                    "pending_bytes": 4096,
+                }},
+            })
+        assert ctrl.advice(), "fleet-trace child: no advice"
     obs = ObsHTTPServer(port=int(cfg["obs_ports"][idx]),
                         snapshot_extra=lambda: {
                             "propagation": get_propagation().report(),
-                        }).start()
+                        },
+                        control=ctrl).start()
 
     def pump_for(seconds: float) -> None:
         deadline = time.monotonic() + seconds
@@ -2428,6 +2664,17 @@ def fleet_trace(argv=None) -> int:
             if isinstance(e, dict)}
     assert len(pids) >= n_procs, \
         f"fleet-trace: merged timeline pids collided: {pids}"
+    # round 22: the flooded child's control plane federates — its
+    # squeeze must surface as a proc-tagged advice row (and its
+    # ledger tail under report["control"]) within the ONE live
+    # scrape above
+    advice = report.get("advice") or []
+    assert any(a.get("proc") == "p1"
+               and a.get("action") == "rebalance_away"
+               for a in advice), \
+        f"fleet-trace: control advice not federated: {advice}"
+    assert report.get("control", {}).get("p1", {}).get("rows"), \
+        "fleet-trace: control ledger tail missing from /fleet"
 
     out = {
         "metric": "fleet_trace",
@@ -2439,6 +2686,7 @@ def fleet_trace(argv=None) -> int:
             "routes": paths["routes"],
             "hops": report["latency"]["hops"],
             "relay_frames_forwarded": relay_forwards,
+            "control_advice_rows": len(advice),
             "converged": True,
             "wall_s": round(time.perf_counter() - t_start, 2),
         },
@@ -2998,6 +3246,75 @@ def smoke():
         assert report["gauges"].get("collector.pair_rate") == 1.0, \
             "smoke: collector.pair_rate gauge missing"
         out["collector_registry_ok"] = True
+        # the round-22 control-plane registry: a deterministic
+        # synthetic sensor trace through a tiny-ledger Controller
+        # (squeeze, cooldown-blocked oscillation, restore) must light
+        # every control.* counter/gauge the regression gates read,
+        # replay to a byte-identical ledger, and a cadence-configured
+        # server over a real snapshot store must count
+        # snap.cadence_writes (README "Control plane" registry)
+        from crdt_tpu.obs import Controller
+        from crdt_tpu.storage.snapshot import SnapshotStore
+
+        sctrl = Controller(cooldown_ticks=3, restore_after=2,
+                           ledger_capacity=2)
+        for st in range(14):
+            # flood -> clean (restore blocked by cooldown, counted)
+            # -> restore -> re-flood (squeeze blocked, counted) ->
+            # squeeze -> clean -> restore: both rules fire twice and
+            # the cooldown gate blocks both directions
+            burn = 1.0 if st in (0, 4, 5, 6) else 0.0
+            sctrl.observe({
+                "tick": st,
+                "budget": {"max_bytes": 2048, "max_updates": 4},
+                "tenants": {"flood!": {
+                    "burn": burn, "shed": 4 * st,
+                    "pending_bytes": 4096 if st < 6 else 0,
+                }},
+            })
+        srules = [r["rule"] for r in sctrl.ledger.rows()]
+        assert "budget_restore" in srules, \
+            "smoke: controller never restored"
+        assert sctrl.decisions >= 2 and sctrl.ledger.dropped > 0, \
+            "smoke: control ledger drop accounting missing"
+        assert sctrl.cooldown_skips > 0, \
+            "smoke: cooldown never blocked an oscillating sensor"
+        sreplay = Controller.replay(list(sctrl.trace),
+                                    **sctrl.config())
+        assert sreplay.ledger.to_jsonl() == sctrl.ledger.to_jsonl(), \
+            "smoke: control ledger replay not byte-identical"
+        # cadence actuation through a REAL server + snapshot store
+        from crdt_tpu.models.multidoc import MultiDocServer as _MDS
+
+        with tempfile.TemporaryDirectory() as td:
+            csrv = _MDS(snap_store=SnapshotStore(td),
+                        checkpoint_every_ticks=2)
+            cstream = _SteadyStream(700)
+            for ct in range(5):
+                csrv.submit("cadence", cstream.delta(4))
+                csrv.tick()
+            assert csrv.cadence_checkpoints > 0, \
+                "smoke: cadence checkpoint never fired"
+        report = tracer.report()
+        for cname in ("control.decisions", "control.cooldown_skips",
+                      "control.ledger_dropped",
+                      "snap.cadence_writes"):
+            assert report["counters"].get(cname, 0) > 0, \
+                f"smoke: {cname} missing from control registry"
+        assert any(k.startswith("control.decisions{rule=")
+                   for k in report["counters"]), \
+            "smoke: control.decisions{rule=} counter missing"
+        assert any(k.startswith("control.setpoint{knob=")
+                   for k in report["gauges"]), \
+            "smoke: control.setpoint{knob=} gauge missing"
+        ctl_art = os.environ.get("BENCH_SMOKE_CONTROL")
+        if ctl_art:
+            # the smoke controller's decision ledger doubles as CI's
+            # uploaded control-plane artifact (audit it offline with
+            # ``tools/obsq.py control``) — same run-what-you-
+            # already-ran pattern as BENCH_SMOKE_OUT
+            sctrl.ledger.dump_jsonl(ctl_art)
+        out["control_registry_ok"] = True
         out["tracer_spans_ok"] = True
     # obs-off overhead pin (round 18 satellite): a DISABLED tracer's
     # span hook must stay one attribute check + one shared no-op
@@ -4090,6 +4407,8 @@ if __name__ == "__main__":
         _sys_main.exit(multitenant())
     elif "--coldstart" in _sys_main.argv[1:]:
         _sys_main.exit(coldstart())
+    elif "--autopilot" in _sys_main.argv[1:]:
+        _sys_main.exit(autopilot())
     elif (
         "--smoke" in _sys_main.argv[1:]
         or os.environ.get("BENCH_SMOKE") == "1"

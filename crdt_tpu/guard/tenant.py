@@ -54,6 +54,31 @@ class TenantBudget:
                  max_updates: int = 4096):
         self.max_bytes = int(max_bytes)
         self.max_updates = int(max_updates)
+        # round 22: per-tenant runtime overrides (the control plane's
+        # budget_squeeze actuator) — tenant -> (max_bytes, max_updates)
+        self._overrides: Dict[object, Tuple[int, int]] = {}
+
+    def limits(self, tenant=None) -> Tuple[int, int]:
+        """The effective ``(max_bytes, max_updates)`` for a tenant:
+        its override when the control plane has squeezed it, the
+        static budget otherwise."""
+        if tenant is not None:
+            ov = self._overrides.get(tenant)
+            if ov is not None:
+                return ov
+        return (self.max_bytes, self.max_updates)
+
+    def set_override(self, tenant, max_bytes: int,
+                     max_updates: int) -> None:
+        self._overrides[tenant] = (
+            max(1, int(max_bytes)), max(1, int(max_updates))
+        )
+
+    def clear_override(self, tenant) -> None:
+        self._overrides.pop(tenant, None)
+
+    def overrides(self) -> Dict[object, Tuple[int, int]]:
+        return dict(self._overrides)
 
     def trim(self, queue: Deque[bytes],
              tenant=None) -> List[bytes]:
@@ -63,11 +88,13 @@ class TenantBudget:
         when given, attributes the shed: the labeled
         ``tenant.shed{tenant=}`` counter and a ``tenant.shed``
         flight-recorder event carry it into the SLO route mix and
-        the ``/events`` filters."""
+        the ``/events`` filters — and selects any control-plane
+        override of the static budget (:meth:`limits`)."""
+        max_bytes, max_updates = self.limits(tenant)
         shed: List[bytes] = []
         size = sum(len(b) for b in queue)
         while len(queue) > 1 and (
-            size > self.max_bytes or len(queue) > self.max_updates
+            size > max_bytes or len(queue) > max_updates
         ):
             old = queue.popleft()
             size -= len(old)

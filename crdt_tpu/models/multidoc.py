@@ -314,7 +314,10 @@ class MultiDocServer:
                  slo_ms: Optional[float] = None,
                  pool: Optional[bool] = None,
                  pool_max_bytes: Optional[int] = None,
-                 snap_store=None):
+                 snap_store=None,
+                 control=None,
+                 checkpoint_every_ticks: Optional[int] = None,
+                 checkpoint_every_bytes: Optional[int] = None):
         self.max_rows = (max_rows_per_dispatch
                          if max_rows_per_dispatch is not None
                          else _env_int(_MAX_ROWS_ENV, 1 << 16))
@@ -388,6 +391,43 @@ class MultiDocServer:
         # (tenant, submit stamps) settled this tick, awaiting the
         # tick-end served stamp
         self._served_buf: List = []
+        # control plane (round 22): a deterministic per-tick rule
+        # engine over the sensors above (burn rates, queue pressure,
+        # settled bytes) actuating the knobs above (tenant budget
+        # overrides, LRU protection, max_rows pacing, checkpoint
+        # cadence). ``control=True`` builds the default
+        # :class:`crdt_tpu.obs.control.Controller`; a Controller
+        # instance is adopted as-is; ``None``/``False`` with no
+        # cadence params disables the whole phase (zero tick cost).
+        # ``checkpoint_every_ticks=``/``checkpoint_every_bytes=``
+        # ride the controller's actuation path (ROADMAP item 4c) —
+        # setting either implies a controller.
+        if control is True or (
+            control is None
+            and (checkpoint_every_ticks or checkpoint_every_bytes)
+        ):
+            from crdt_tpu.obs.control import Controller
+
+            control = Controller()
+        self.control = control or None
+        if self.control is not None:
+            if checkpoint_every_ticks is not None:
+                self.control.checkpoint_every_ticks = int(
+                    checkpoint_every_ticks)
+            if checkpoint_every_bytes is not None:
+                self.control.checkpoint_every_bytes = int(
+                    checkpoint_every_bytes)
+        # docs on control-squeezed tenants: shielded from the LRU
+        # sweep (best-effort, like ``_serving`` — the budget bound
+        # stays hard)
+        self._protected: set = set()
+        # settled-byte odometer for the bytes-based cadence rule
+        self._settled_since_ckpt = 0
+        self.cadence_checkpoints = 0
+        # deterministic snapshot-fallback odometer (the tracer's
+        # ``snap.fallbacks`` counter is enabled-gated; the control
+        # sensor must not be)
+        self.snap_fallback_count = 0
 
     # ---- admission (the ingest side) ---------------------------------
 
@@ -406,23 +446,36 @@ class MultiDocServer:
         tracer = get_tracer()
         if tracer.enabled:
             tracer.count("tenant.submitted")
-        shed = self.budget.trim(st.pending, tenant=doc_id)
-        if shed:
-            nbytes = sum(len(b) for b in shed)
-            self.shed_count += len(shed)
-            self.shed_bytes += nbytes
-            self._pending_total -= nbytes
-            # trim pops oldest-first; the stamp queue follows in
-            # lockstep, and every shed blob is an SLO breach (it will
-            # never be served)
-            for _ in shed:
-                st.pending_ts.popleft()
-            self.slo.shed(doc_id, len(shed))
-            if tracer.enabled:
-                tracer.count("tenant.shed", len(shed))
-                tracer.count("tenant.shed_bytes", nbytes)
+        shed_n = self._trim_tenant(doc_id, st)
         if tracer.enabled:
             tracer.gauge("tenant.pending_bytes", self.pending_bytes())
+        return shed_n
+
+    def _trim_tenant(self, doc_id, st) -> int:
+        """Apply the tenant's admission budget — the static one, or
+        a control-plane override (:meth:`crdt_tpu.guard.tenant.
+        TenantBudget.limits`) — to its pending queue, with the full
+        shed bookkeeping: shed counters, SLO breaches, submit-stamp
+        lockstep. Called per submit, and by the control phase right
+        after a squeeze (immediate containment: the flooder's
+        backlog shrinks THIS tick, not on its next submit)."""
+        shed = self.budget.trim(st.pending, tenant=doc_id)
+        if not shed:
+            return 0
+        nbytes = sum(len(b) for b in shed)
+        self.shed_count += len(shed)
+        self.shed_bytes += nbytes
+        self._pending_total -= nbytes
+        # trim pops oldest-first; the stamp queue follows in
+        # lockstep, and every shed blob is an SLO breach (it will
+        # never be served)
+        for _ in shed:
+            st.pending_ts.popleft()
+        self.slo.shed(doc_id, len(shed))
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.count("tenant.shed", len(shed))
+            tracer.count("tenant.shed_bytes", nbytes)
         return len(shed)
 
     def submit_many(self, doc_id, blobs: Sequence[bytes]) -> int:
@@ -586,6 +639,14 @@ class MultiDocServer:
         self.ticks += 1
         tl = get_timeline()
         tl.tick_begin(self.ticks)
+        # control phase (round 22) FIRST: the rules read the sensor
+        # state the PREVIOUS tick settled (burn rates, queue bytes),
+        # actuate the knobs this tick runs under, and fire BEFORE the
+        # idle early-return so the checkpoint cadence covers quiet
+        # ticks too
+        if self.control is not None:
+            with tl.phase("control"):
+                self._run_control(tl)
         with tl.phase("prepare"):
             self.prepare()
         with tl.phase("fair_order"):
@@ -618,7 +679,9 @@ class MultiDocServer:
                         continue
                     if st.stale:
                         if self._try_promote(
-                            d, protect=served_set | {d}
+                            d,
+                            protect=(served_set | {d}
+                                     | self._protected),
                         ):
                             promotions += 1
                             served_set.add(d)
@@ -713,6 +776,86 @@ class MultiDocServer:
         return TickReport(len(dirty), dispatches, rows, fallback,
                           tuple(sizes), n_delta, delta_rows,
                           promotions, pool_disp)
+
+    # ---- the control plane (round 22) --------------------------------
+
+    def _run_control(self, tl) -> None:
+        """One controller consult per tick: build the JSON-ready
+        sensor snapshot (per-tenant burn/shed from the SLO ledger,
+        queue + pool + resident pressure, the settled-byte odometer),
+        run the deterministic rules, apply the actuation — budget
+        overrides with an IMMEDIATE trim of the squeezed backlog,
+        the LRU protection set, the ``max_rows`` setpoint, a cadence
+        checkpoint — and annotate every decision into the tick
+        timeline as a Perfetto instant."""
+        slo = self.slo.control_snapshot()
+        tenants = {}
+        byname = {}
+        for d, s in slo.items():
+            st = self._docs.get(d)
+            pend = 0
+            if st is not None:
+                pend = (sum(len(b) for b in st.pending)
+                        + sum(len(b) for b in st.in_flight))
+            name = str(d)
+            byname[name] = d
+            tenants[name] = {
+                "burn": s["burn"],
+                "shed": int(s["shed"]),
+                "breaches": int(s["breaches"]),
+                "pending_bytes": pend,
+            }
+        sensors = {
+            "tick": self.ticks,
+            "max_rows": self.max_rows,
+            "pending_bytes": self._pending_total,
+            "settled_bytes": self._settled_since_ckpt,
+            "budget": {
+                "max_bytes": self.budget.max_bytes,
+                "max_updates": self.budget.max_updates,
+            },
+            "tenants": tenants,
+            "pool_bytes": (self.pool.device_bytes()
+                           if self.pool is not None else 0),
+            "pool_compactions": (self.pool.compactions
+                                 if self.pool is not None else 0),
+            "resident_bytes": self.rbudget.total,
+            "snap_fallbacks": self.snap_fallback_count,
+        }
+        act = self.control.observe(sensors)
+        # reconcile the budget override set (controller answers the
+        # FULL set, keyed by stringified tenant — map back to the
+        # server's own doc ids)
+        for t in list(self.budget.overrides()):
+            if str(t) not in act.tenant_limits:
+                self.budget.clear_override(t)
+        for name in sorted(act.tenant_limits):
+            mb, mu = act.tenant_limits[name]
+            t = byname.get(name, name)
+            self.budget.set_override(t, mb, mu)
+            st = self._docs.get(t)
+            if st is not None and st.pending:
+                # immediate containment: the flooder's backlog
+                # shrinks to the squeezed budget THIS tick
+                self._trim_tenant(t, st)
+        self._protected = {byname.get(n, n) for n in act.protect}
+        if act.max_rows is not None:
+            self.max_rows = int(act.max_rows)
+        if act.checkpoint and self.snap_store is not None:
+            # background cadence checkpoint (ROADMAP item 4c): a
+            # restart replays at most one cadence of WAL tail
+            self.checkpoint()
+            self.cadence_checkpoints += 1
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.count("snap.cadence_writes")
+        for row in act.rows:
+            tl.instant("control:%s" % row["rule"], {
+                "tenant": row["tenant"],
+                "knob": row["knob"],
+                "old": row["old"],
+                "new": row["new"],
+            })
 
     # ---- the live-ingest scheduler -----------------------------------
 
@@ -835,6 +978,7 @@ class MultiDocServer:
             return None
         snap, seq = loaded
         if seq > len(st.blobs):
+            self.snap_fallback_count += 1
             tracer = get_tracer()
             if tracer.enabled:
                 tracer.count("snap.fallbacks",
@@ -849,6 +993,7 @@ class MultiDocServer:
         except ValueError:
             if eng is not None:
                 self._release_pool(eng)
+            self.snap_fallback_count += 1
             tracer = get_tracer()
             if tracer.enabled:
                 tracer.count("snap.fallbacks",
@@ -859,6 +1004,7 @@ class MultiDocServer:
             # skewed coverage): fall back to the stock build rather
             # than pinning no_promote_len on the doc
             self._release_pool(eng)
+            self.snap_fallback_count += 1
             tracer = get_tracer()
             if tracer.enabled:
                 tracer.count("snap.fallbacks",
@@ -887,7 +1033,9 @@ class MultiDocServer:
             # again without protection, and a doc that ALONE
             # outgrows the whole budget is evicted on the spot (and
             # not re-attempted until its history grows)
-            self._enforce_budget(protect={d} | self._serving)
+            self._enforce_budget(
+                protect={d} | self._serving | self._protected
+            )
             if self.rbudget.total > self.rbudget.max_bytes:
                 self._enforce_budget(protect={d})
             if self.rbudget.total > self.rbudget.max_bytes:
@@ -1077,6 +1225,7 @@ class MultiDocServer:
                 try:
                     eng = rehydrate(snap, pool=self.pool)
                 except ValueError:
+                    self.snap_fallback_count += 1
                     if tracer.enabled:
                         tracer.count("snap.fallbacks",
                                      labels={"reason": "rehydrate"})
@@ -1177,7 +1326,9 @@ class MultiDocServer:
         done = time.perf_counter()
         for d in batch:
             st = self._docs[d]
-            self._pending_total -= sum(len(b) for b in st.in_flight)
+            nbytes = sum(len(b) for b in st.in_flight)
+            self._pending_total -= nbytes
+            self._settled_since_ckpt += nbytes
             st.blobs.extend(st.in_flight)
             st.in_flight.clear()
             if st.in_flight_ts:

@@ -40,6 +40,12 @@ replicas x 100k ops needs to be *seen*, not just claimed):
   collector federating N processes' scrape endpoints (proc-labeled
   registries, live cross-process path reconstruction + divergence
   correlation, merged Perfetto timelines).
+- :mod:`crdt_tpu.obs.control` — round 22: the SLO-driven control
+  plane — a deterministic tick-synchronous rule engine over the
+  sensors above (burn rates, queue/pool pressure) actuating the
+  serving knobs (tenant budget squeeze/restore with hysteresis,
+  LRU protection, dispatch pacing, checkpoint cadence), every
+  decision in a bounded auditable ledger served at ``/control``.
 - :mod:`crdt_tpu.obs.profiling` — ``jax_profile`` (device trace
   capture that cannot leak a running profiler) and per-dispatch
   ``device_annotation`` XProf annotations.
@@ -50,6 +56,7 @@ query CLI over flight-recorder dumps.
 """
 
 from crdt_tpu.obs.collector import FleetCollector, merge_perfetto
+from crdt_tpu.obs.control import Actuation, ControlLedger, Controller
 from crdt_tpu.obs.export import snapshot_json, to_prometheus
 from crdt_tpu.obs.http import ObsHTTPServer
 from crdt_tpu.obs.profiling import device_annotation, jax_profile
@@ -77,6 +84,9 @@ from crdt_tpu.obs.timeline import TickTimeline, get_timeline, set_timeline
 from crdt_tpu.obs.tracer import Histogram, Tracer, get_tracer, set_tracer
 
 __all__ = [
+    "Actuation",
+    "ControlLedger",
+    "Controller",
     "DivergenceSentinel",
     "MultiDocSentinel",
     "FleetCollector",

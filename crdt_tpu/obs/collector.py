@@ -99,6 +99,10 @@ class FleetCollector:
         self._snapshots: Dict[str, Dict[str, Any]] = {}
         self._events: Dict[str, List[Dict[str, Any]]] = {}
         self._timelines: Dict[str, Dict[str, Any]] = {}
+        # round 22: per-process /control reports (ledger tail +
+        # placement advice) — absent for processes without a control
+        # plane (the endpoint 404s; the scrape tolerates it)
+        self._controls: Dict[str, Dict[str, Any]] = {}
         self.timeout_s = timeout_s
         self.events_limit = events_limit
         self.scrapes = 0
@@ -135,7 +139,8 @@ class FleetCollector:
     def push(self, name: str, *,
              snapshot: Optional[Dict[str, Any]] = None,
              events: Optional[List[Dict[str, Any]]] = None,
-             timeline: Optional[Dict[str, Any]] = None) -> None:
+             timeline: Optional[Dict[str, Any]] = None,
+             control: Optional[Dict[str, Any]] = None) -> None:
         """Push-mode ingest: a process (or a test) hands the same
         payloads a scrape would fetch. Partial pushes update only the
         supplied surfaces."""
@@ -155,6 +160,8 @@ class FleetCollector:
                 self._events[name] = tagged
             if timeline is not None:
                 self._timelines[name] = timeline
+            if control is not None:
+                self._controls[name] = control
         if tagged is not None:
             get_tracer().count(
                 "collector.events_ingested", len(tagged)
@@ -196,8 +203,16 @@ class FleetCollector:
                 tracer.count("collector.scrape_errors")
                 ok[name] = False
                 continue
+            # the control surface is OPTIONAL (round 22): a process
+            # without a control plane 404s here, which must neither
+            # fail the scrape nor count as a scrape error
+            control = None
+            try:
+                control = json.loads(self._get(f"{base}/control"))
+            except (OSError, ValueError, urllib.error.URLError):
+                pass
             self.push(name, snapshot=snap, events=events,
-                      timeline=timeline)
+                      timeline=timeline, control=control)
             ok[name] = True
         with self._lock:
             self.scrapes += 1
@@ -266,6 +281,7 @@ class FleetCollector:
         live = set(self.procs)
         with self._lock:
             stale = sorted(set(self._urls) - live)
+            controls = dict(self._controls)
         return {
             "procs": self.procs,
             "stale_procs": stale,
@@ -276,7 +292,25 @@ class FleetCollector:
             "latency": latency,
             "paths": paths,
             "divergence": diverge,
+            # round 22: each process's live control report (ledger
+            # tail, setpoints) plus the flattened proc-tagged advice
+            # rows — ROADMAP item 2's rebalance hints, federated
+            # here, consumed by a later round's placement loop
+            "control": controls,
+            "advice": self.fleet_advice(),
         }
+
+    def fleet_advice(self) -> List[Dict[str, Any]]:
+        """Every process's placement-advice rows, proc-tagged, in
+        deterministic (proc, tenant) order."""
+        with self._lock:
+            controls = dict(self._controls)
+        out: List[Dict[str, Any]] = []
+        for name in sorted(controls):
+            for row in (controls[name] or {}).get("advice") or ():
+                if isinstance(row, dict):
+                    out.append(dict(row, proc=name))
+        return out
 
     def merged_perfetto(self) -> Dict[str, Any]:
         with self._lock:

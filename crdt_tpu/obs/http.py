@@ -15,6 +15,10 @@ instrumented process) runs:
   ``replica``), ``?limit=`` (newest N).
 - ``GET /timeline``  — the tick-timeline ring as Perfetto
   trace-event JSON (open it at ui.perfetto.dev).
+- ``GET /control``   — the control plane's live report (round 22,
+  ``control=`` a :class:`crdt_tpu.obs.control.Controller`): config,
+  decision/cooldown counters, current setpoints, placement advice,
+  and the ledger tail (``?limit=`` rows, default 128).
 
 Reads are snapshots under the producers' own locks (tracer, recorder
 and timeline are all thread-safe), so scraping never blocks the tick
@@ -80,9 +84,11 @@ class ObsHTTPServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
                  snapshot_extra: Optional[
                      Callable[[], Dict[str, Any]]] = None,
-                 collector: Optional[Any] = None):
+                 collector: Optional[Any] = None,
+                 control: Optional[Any] = None):
         self._extra = snapshot_extra
         self.collector = collector
+        self.control = control
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -142,6 +148,16 @@ class ObsHTTPServer:
         if u.path == "/timeline":
             return (get_timeline().perfetto_json().encode(),
                     "application/json", 200)
+        if self.control is not None and u.path == "/control":
+            q = parse_qs(u.query)
+            try:
+                limit = max(0, int(q.get("limit", ["128"])[0]))
+            except ValueError:
+                limit = 128
+            return (json.dumps(
+                self.control.report(limit), sort_keys=True,
+                default=str,
+            ).encode(), "application/json", 200)
         if self.collector is not None and u.path == "/fleet":
             q = parse_qs(u.query)
             if q.get("scrape", ["1"])[0] not in ("0", "false"):
@@ -155,6 +171,8 @@ class ObsHTTPServer:
                 self.collector.merged_perfetto()
             ).encode(), "application/json", 200)
         routes = ["/metrics", "/snapshot", "/events", "/timeline"]
+        if self.control is not None:
+            routes += ["/control"]
         if self.collector is not None:
             routes += ["/fleet", "/fleet/timeline"]
         return (json.dumps({

@@ -223,6 +223,23 @@ class SLOLedger:
             tracer.gauge("slo.burn_rate", worst)
         return worst
 
+    def control_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Cheap per-tenant sensor slice for the control plane
+        (round 22): burn rate, shed count, and total breaches per
+        tenant — no histograms, so the controller's once-per-tick
+        read stays O(tenants). Keys are the ORIGINAL tenant objects
+        (the server joins them against its own doc table); the
+        controller stringifies for its JSON ledger."""
+        with self._lock:
+            return {
+                k: {
+                    "burn": round(t.burn_rate(), 4),
+                    "shed": t.routes["shed"],
+                    "breaches": t.breaches,
+                }
+                for k, t in self._tenants.items()
+            }
+
     def report(self) -> Dict[str, Any]:
         """JSON-ready per-tenant summary — the ``/snapshot`` section
         and the ``bench --multitenant`` evidence block."""

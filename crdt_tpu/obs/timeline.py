@@ -123,6 +123,18 @@ class TickTimeline:
             return
         self._cur["phases"].append((name, t0, t1))
 
+    def instant(self, name: str,
+                args: Optional[Dict[str, Any]] = None) -> None:
+        """Mark a point event inside the current tick (round 22: the
+        control plane stamps one per decision, so a budget squeeze
+        is visible AT the tick it fired on the Perfetto track).
+        No-op when disabled or outside a tick."""
+        if not self.enabled or self._cur is None:
+            return
+        self._cur.setdefault("instants", []).append(
+            (name, time.perf_counter(), args or {})
+        )
+
     def dispatch_begin(self, t: Optional[float] = None) -> Optional[int]:
         """A converge dispatch was enqueued (its async in-flight
         window opens). Returns a token for :meth:`dispatch_end`, or
@@ -186,6 +198,7 @@ class TickTimeline:
             "stall_ms": cur["stall_s"] * 1e3,
             "overlap_efficiency": eff,
             "lanes": lanes,
+            "instants": cur.get("instants", []),
         }
         with self._lock:
             self._ring.append(rec)
@@ -278,6 +291,16 @@ class TickTimeline:
                             (d["end"] - d["fetch0"]) * 1e3, 3
                         ) if d["fetch0"] is not None else None,
                     },
+                })
+            for name, t, iargs in rec.get("instants", ()):
+                # ph "i": a Perfetto instant — the control plane's
+                # decision markers land on the host track at the
+                # moment the rule fired (scope "t": thread-scoped)
+                events.append({
+                    "name": name, "ph": "i", "ts": us(t),
+                    "pid": pid, "tid": 1, "cat": "control",
+                    "s": "t", "args": dict(iargs,
+                                           tick=rec["tick"]),
                 })
             events.append({
                 "name": "overlap_efficiency", "ph": "C",

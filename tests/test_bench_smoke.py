@@ -226,6 +226,22 @@ def test_bench_smoke_mode(tmp_path):
         span = report["spans"].get(sname)
         assert span is not None and span["count"] > 0, sname
 
+    # the round-22 control-plane registry: the smoke drives a
+    # deterministic synthetic squeeze/skip/restore schedule through
+    # a Controller (ledger bounded with drop accounting, replay
+    # byte-identical) plus a tiny cadence-checkpoint server leg, so
+    # every control.* counter/gauge the regression gates read is
+    # live, and the decision ledger artifact uploads from CI
+    assert out.get("control_registry_ok") is True
+    for cname in ("control.decisions", "control.cooldown_skips",
+                  "control.ledger_dropped", "snap.cadence_writes"):
+        assert report["counters"].get(cname, 0) > 0, cname
+    for cname in ('control.decisions{rule="budget_squeeze"}',
+                  'control.decisions{rule="budget_restore"}'):
+        assert report["counters"].get(cname, 0) > 0, cname
+    assert any(k.startswith("control.setpoint{knob=")
+               for k in report["gauges"]), "setpoint gauges missing"
+
     # the guard-layer registry (README "Overload & failure policy"):
     # (kernel_ablation_leg is pinned in-process below — the smoke
     # subprocess stays on its <30s budget)

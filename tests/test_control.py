@@ -1,0 +1,545 @@
+"""Round-22 control plane: deterministic rules, auditable ledger.
+
+The tentpole contract, pinned four ways:
+
+- **Determinism** — the controller reads no wall clock, visits
+  tenants in sorted order, and indexes every window/cooldown by the
+  server's tick number, so replaying a recorded sensor trace through
+  ``Controller.replay`` yields a ledger whose ``to_jsonl()`` is
+  BYTE-identical to the original (pure-synthetic and server-driven).
+- **Hysteresis** — an oscillating burn signal cannot flap a setpoint
+  faster than ``cooldown_ticks`` (consecutive ledger rows for one
+  knob are at least a cooldown apart, and the blocked attempts are
+  counted as ``control.cooldown_skips``), and one clean tick is
+  never enough to restore (``restore_after``).
+- **Containment** — the seeded flood chaos leg: with the controller
+  ON the flooding tenant is squeezed, trimmed, protected, and
+  restored after the flood drains, while every NEIGHBOR digest stays
+  byte-identical to a controller-OFF oracle run fed the same
+  submissions.
+- **Audit** — the ledger ring is bounded with drop accounting, the
+  ``/control`` endpoint serves the report, the fleet collector
+  federates proc-tagged advice, ``obsq control`` answers tick-ranged
+  tenant queries offline (with an SLO join), and each decision lands
+  on the Perfetto tick timeline as a ``cat: control`` instant.
+
+Plus the satellite: checkpoint cadence through the actuation path —
+a restart replays at most one cadence of WAL tail.
+"""
+
+import importlib
+import json
+import os
+import sys as _sys
+import urllib.request
+
+import pytest
+
+from crdt_tpu.codec import v1
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.models.multidoc import MultiDocServer
+from crdt_tpu.obs import (
+    FleetCollector,
+    ObsHTTPServer,
+    SLOLedger,
+    TickTimeline,
+    Tracer,
+    set_timeline,
+    set_tracer,
+)
+from crdt_tpu.obs.control import Actuation, Controller
+from crdt_tpu.storage.snapshot import SnapshotStore
+
+
+@pytest.fixture(autouse=True)
+def _quiet_obs():
+    """Each test starts from disabled process-global tracer/timeline
+    (tests that want them enabled install their own)."""
+    old_tracer = set_tracer(Tracer(enabled=False))
+    old_tl = set_timeline(TickTimeline(enabled=False))
+    yield
+    set_tracer(old_tracer)
+    set_timeline(old_tl)
+
+
+def _sensors(tick, burn, *, tenant="flood!", shed=0, pending=0,
+             max_rows=0, pending_total=0, settled=0):
+    return {
+        "tick": tick,
+        "max_rows": max_rows,
+        "pending_bytes": pending_total,
+        "settled_bytes": settled,
+        "budget": {"max_bytes": 2048, "max_updates": 4},
+        "tenants": {tenant: {"burn": burn, "shed": shed,
+                             "pending_bytes": pending}},
+    }
+
+
+def flood_blob(i):
+    """One independent single-record update (own client, no origin:
+    shedding any subset never orphans a survivor), sized between the
+    divided-by-4 budget and the static 2048-byte one."""
+    return v1.encode_update([ItemRecord(
+        client=10_000 + i, clock=0, parent_root="m",
+        key=f"f{i}", content="f" * 700,
+    )], DeleteSet())
+
+
+def chain_blob(client, k0, n_ops=4):
+    """One tenant's chained list appends (clocks k0..k0+n_ops-1)."""
+    recs = []
+    for j in range(n_ops):
+        k = k0 + j
+        recs.append(ItemRecord(
+            client=client, clock=k, parent_root="l",
+            origin=(client, k - 1) if k else None,
+            content=client * 1000 + k,
+        ))
+    return v1.encode_update(recs, DeleteSet())
+
+
+# ---- the pure rule engine -------------------------------------------
+
+
+class TestRules:
+    def test_squeeze_then_restore_with_hysteresis(self):
+        c = Controller(cooldown_ticks=0, restore_after=3)
+        act = c.observe(_sensors(0, 1.0))
+        assert isinstance(act, Actuation)
+        assert act.tenant_limits == {"flood!": (512, 1)}
+        assert act.protect == frozenset({"flood!"})
+        assert [r["rule"] for r in act.rows] == ["budget_squeeze"]
+        # two clean ticks are NOT enough (restore_after=3)
+        for t in (1, 2):
+            act = c.observe(_sensors(t, 0.0))
+            assert act.rows == [] and c.overrides()
+        act = c.observe(_sensors(3, 0.0))
+        assert [r["rule"] for r in act.rows] == ["budget_restore"]
+        assert act.tenant_limits == {} and act.protect == frozenset()
+        row = act.rows[0]
+        assert row["tenant"] == "flood!"
+        assert row["old"] == [512, 1] and row["new"] == [2048, 4]
+
+    def test_dirty_tick_resets_clean_streak(self):
+        c = Controller(cooldown_ticks=0, restore_after=2)
+        c.observe(_sensors(0, 1.0))
+        c.observe(_sensors(1, 0.0))
+        c.observe(_sensors(2, 0.9))  # streak resets
+        c.observe(_sensors(3, 0.0))
+        assert c.overrides()  # one clean tick since the reset
+        act = c.observe(_sensors(4, 0.0))
+        assert [r["rule"] for r in act.rows] == ["budget_restore"]
+
+    def test_hysteresis_pin_no_flap_faster_than_cooldown(self):
+        """The ISSUE pin: a burn square wave cannot flap the tenant
+        budget faster than ``cooldown_ticks``, and every blocked
+        attempt is counted."""
+        cd = 5
+        c = Controller(cooldown_ticks=cd, restore_after=1)
+        for t in range(40):
+            c.observe(_sensors(t, 1.0 if t % 2 == 0 else 0.0))
+        ticks = [r["tick"] for r in c.ledger.rows()
+                 if r["knob"] == "tenant_budget"]
+        assert len(ticks) >= 4
+        assert all(b - a >= cd for a, b in zip(ticks, ticks[1:]))
+        assert c.cooldown_skips > 0
+        # the flap cadence is exactly the cooldown here: squeeze at
+        # 0, restore at 5, squeeze at 10, ...
+        assert ticks[:4] == [0, cd, 2 * cd, 3 * cd]
+
+    def test_ledger_bounded_with_drop_accounting(self):
+        c = Controller(cooldown_ticks=5, restore_after=1,
+                       ledger_capacity=2)
+        for t in range(40):
+            c.observe(_sensors(t, 1.0 if t % 2 == 0 else 0.0))
+        rows = c.ledger.rows()
+        assert len(rows) == 2
+        assert c.ledger.total == c.decisions
+        assert c.ledger.dropped == c.ledger.total - 2
+        assert c.ledger.dropped > 0
+        # tail keeps the NEWEST rows
+        assert [r["tick"] for r in rows] == \
+            [r["tick"] for r in c.ledger.tail(8)]
+        assert rows[-1]["tick"] == max(r["tick"] for r in rows)
+
+    def test_rows_pacing_squeeze_floor_restore(self):
+        c = Controller(cooldown_ticks=0, restore_after=2,
+                       pace_pending_bytes=1000, rows_floor=4)
+        seen = []
+        for t in range(4):  # sustained pressure: 16 -> 8 -> 4, floor
+            act = c.observe(_sensors(t, 0.0, max_rows=16,
+                                     pending_total=5000))
+            seen.append(act.max_rows)
+        assert seen == [8, 4, None, None]  # floor holds, no churn
+        # calm below half the threshold for restore_after ticks
+        c.observe(_sensors(4, 0.0, max_rows=16, pending_total=100))
+        act = c.observe(_sensors(5, 0.0, max_rows=16,
+                                 pending_total=100))
+        assert act.max_rows == 16
+        rules = [r["rule"] for r in c.ledger.rows()]
+        assert rules == ["rows_squeeze", "rows_squeeze",
+                         "rows_restore"]
+
+    def test_rows_pacing_off_without_threshold(self):
+        c = Controller(cooldown_ticks=0)
+        act = c.observe(_sensors(0, 0.0, max_rows=16,
+                                 pending_total=1 << 30))
+        assert act.max_rows is None and act.rows == []
+
+    def test_checkpoint_cadence_by_ticks_and_bytes(self):
+        c = Controller(checkpoint_every_ticks=4)
+        fires = [c.observe(_sensors(t, 0.0)).checkpoint
+                 for t in range(9)]
+        assert fires == [False, False, False, False, True,
+                         False, False, False, True]
+        b = Controller(checkpoint_every_bytes=100)
+        assert not b.observe(_sensors(0, 0.0, settled=60)).checkpoint
+        act = b.observe(_sensors(1, 0.0, settled=120)).checkpoint
+        assert act  # 120 - 0 >= 100
+        # the odometer mark moved: 40 more settled bytes are not due
+        assert not b.observe(_sensors(2, 0.0, settled=160)).checkpoint
+        by = [r["sensors"]["by"] for r in b.ledger.rows()]
+        assert by == ["bytes"]
+
+    def test_replay_is_byte_identical_and_report_shape(self):
+        c = Controller(cooldown_ticks=3, restore_after=2,
+                       ledger_capacity=2,
+                       pace_pending_bytes=1000, rows_floor=2,
+                       checkpoint_every_ticks=5)
+        for t in range(14):
+            burn = 1.0 if t in (0, 4, 5, 6) else 0.0
+            c.observe(_sensors(t, burn, shed=4 * t,
+                               max_rows=16,
+                               pending_total=5000 if t < 3 else 0,
+                               settled=64 * t))
+        assert c.decisions > 4 and c.cooldown_skips > 0
+        assert c.ledger.dropped == c.ledger.total - 2
+        r = Controller.replay(list(c.trace), **c.config())
+        assert r.ledger.to_jsonl() == c.ledger.to_jsonl()
+        assert r.decisions == c.decisions
+        assert r.cooldown_skips == c.cooldown_skips
+        rep = c.report(limit=1)
+        assert rep["config"] == c.config()
+        assert len(rep["rows"]) == 1
+        assert rep["ledger_dropped"] == c.ledger.dropped
+        json.dumps(rep)  # JSON-ready end to end
+
+    def test_advice_rows_for_squeezed_tenants(self):
+        c = Controller(cooldown_ticks=0)
+        assert c.advice() == []
+        c.observe({
+            "tick": 7,
+            "budget": {"max_bytes": 2048, "max_updates": 4},
+            "tenants": {"b!": {"burn": 1.0},
+                        "a!": {"burn": 0.9},
+                        "ok": {"burn": 0.0}},
+        })
+        adv = c.advice()
+        assert [a["tenant"] for a in adv] == ["a!", "b!"]  # sorted
+        assert all(a["action"] == "rebalance_away" and
+                   a["since_tick"] == 7 for a in adv)
+
+    def test_counters_and_setpoint_gauges(self):
+        tracer = set_tracer(Tracer(enabled=True))
+        c = Controller(cooldown_ticks=3, restore_after=2,
+                       ledger_capacity=2)
+        for t in range(14):
+            c.observe(_sensors(t, 1.0 if t in (0, 4, 5, 6) else 0.0))
+        counters = tracer.counters()
+        assert counters["control.decisions"] == c.decisions
+        assert counters["control.cooldown_skips"] == c.cooldown_skips
+        assert counters["control.ledger_dropped"] == c.ledger.dropped
+        assert counters['control.decisions{rule="budget_squeeze"}'] \
+            >= 1
+        assert counters['control.decisions{rule="budget_restore"}'] \
+            >= 1
+        assert any(k.startswith("control.setpoint{knob=")
+                   for k in tracer.report()["gauges"])
+
+
+# ---- the server integration (chaos flood vs OFF oracle) -------------
+
+
+def _flood_run(on, *, flood_ticks=4, calm_ticks=16, neighbors=2):
+    ctrl = (Controller(cooldown_ticks=4, restore_after=2)
+            if on else None)
+    srv = MultiDocServer(
+        tenant_max_pending_bytes=2048,
+        tenant_max_pending_updates=4,
+        slo_ms=1e9,  # serves never breach: sheds drive burn
+        control=ctrl,
+    )
+    srv.slo = SLOLedger(1e9, burn_window=16)
+    docs = [f"n{i}" for i in range(neighbors)]
+    clocks = {d: 0 for d in docs}
+    clocks["flood!"] = 0
+    nblob = 0
+    burns = []
+    for t in range(flood_ticks + calm_ticks):
+        if t < flood_ticks:
+            for _ in range(8):
+                srv.submit("flood!", flood_blob(nblob))
+                nblob += 1
+        else:
+            srv.submit("flood!", chain_blob(500, clocks["flood!"], 2))
+            clocks["flood!"] += 2
+        for i, d in enumerate(docs):
+            srv.submit(d, chain_blob(600 + i, clocks[d], 3))
+            clocks[d] += 3
+        srv.tick()
+        burns.append(srv.slo.report()["tenants"].get(
+            "flood!", {}).get("burn_rate", 0.0))
+    return srv, ctrl, docs, burns, flood_ticks
+
+
+@pytest.mark.slow
+class TestServerChaos:
+    def test_flood_squeezed_neighbors_byte_identical_to_oracle(self):
+        srv_on, ctrl, docs, burns_on, ft = _flood_run(True)
+        srv_off, _, _, burns_off, _ = _flood_run(False)
+        rules = [r["rule"] for r in ctrl.ledger.rows()]
+        assert "budget_squeeze" in rules
+        assert "budget_restore" in rules
+        assert not ctrl.overrides()  # restored by the end
+        # the flooder never starves (keep-the-newest trim serves one
+        # blob per flood tick) but its burn breaches during the flood
+        # and drains below the restore threshold within the window
+        assert burns_on[ft - 1] >= ctrl.burn_hi
+        recovery = next(k for k in range(len(burns_on) - ft)
+                        if burns_on[ft + k] <= ctrl.burn_lo)
+        assert recovery <= 16
+        # neighbors: byte-identical to the controller-OFF oracle
+        for d in docs:
+            assert srv_on.digest(d) == srv_off.digest(d)
+        # ... and the flood was actually contained: the squeezed run
+        # sheds MORE flooder updates than the static-budget oracle
+        assert srv_on.shed_count > srv_off.shed_count
+
+    def test_server_driven_ledger_replays_byte_identical(self):
+        _, ctrl, _, _, _ = _flood_run(True)
+        replayed = Controller.replay(list(ctrl.trace),
+                                     **ctrl.config())
+        assert replayed.ledger.to_jsonl() == ctrl.ledger.to_jsonl()
+
+    def test_squeeze_trims_backlog_and_protects_docs(self):
+        ctrl = Controller(cooldown_ticks=4, restore_after=2)
+        srv = MultiDocServer(
+            tenant_max_pending_bytes=2048,
+            tenant_max_pending_updates=4,
+            slo_ms=1e9, control=ctrl,
+        )
+        srv.slo = SLOLedger(1e9, burn_window=16)
+        for t in range(2):
+            for i in range(8):
+                srv.submit("flood!", flood_blob(8 * t + i))
+            srv.tick()
+        assert ctrl.overrides() == {"flood!": (512, 1)}
+        assert srv.budget.overrides() == {"flood!": (512, 1)}
+        assert srv._protected == {"flood!"}
+        # immediate containment: the backlog fits the SQUEEZED budget
+        st = srv._docs["flood!"]
+        backlog = sum(len(b) for b in st.pending)
+        assert len(st.pending) <= 1 and backlog <= 717
+
+    def test_timeline_instants_and_perfetto_category(self):
+        tl = set_timeline(TickTimeline(enabled=True))
+        _flood_run(True, flood_ticks=2, calm_ticks=0)
+        names = [n for rec in tl.records()
+                 for n, _, _ in rec.get("instants", ())]
+        assert "control:budget_squeeze" in names
+        trace = tl.to_perfetto(pid=7)
+        inst = [e for e in trace["traceEvents"]
+                if e.get("ph") == "i" and e.get("cat") == "control"]
+        assert inst and inst[0]["name"].startswith("control:")
+        assert inst[0]["args"]["knob"] == "tenant_budget"
+
+
+# ---- checkpoint cadence + restart (satellite 1) ---------------------
+
+
+@pytest.mark.slow
+class TestCadenceRestart:
+    def test_cadence_checkpoints_bound_the_wal_tail(self, tmp_path):
+        cadence, total = 3, 10
+        store = SnapshotStore(str(tmp_path))
+        srv = MultiDocServer(snap_store=store,
+                             checkpoint_every_ticks=cadence)
+        assert srv.control is not None  # cadence implies a controller
+        blobs = [chain_blob(42, 4 * k) for k in range(total)]
+        for b in blobs:
+            srv.submit("w", b)
+            srv.tick()
+        assert srv.cadence_checkpoints >= total // cadence
+        manifest = json.loads(store.get_blob("checkpoint.manifest"))
+        seq = manifest["w"]["seq"]
+        # the restart bound: at most one cadence of WAL tail
+        assert total - cadence <= seq <= total
+        # restart: fresh server restores the snapshot and replays
+        # ONLY the tail — the digest matches a full-history oracle
+        srv2 = MultiDocServer(snap_store=SnapshotStore(str(tmp_path)))
+        assert srv2.restore() == 1
+        assert len(srv2._docs["w"].blobs) == 1  # consolidated
+        for b in blobs[seq:]:
+            srv2.submit("w", b)
+        srv2.tick()
+        oracle = MultiDocServer(snap_store=None)
+        for b in blobs:
+            oracle.submit("w", b)
+        oracle.tick()
+        assert srv2.digest("w") == oracle.digest("w")
+
+    def test_cadence_by_bytes_fires_on_settled_odometer(self,
+                                                        tmp_path):
+        blob = chain_blob(7, 0)
+        srv = MultiDocServer(snap_store=SnapshotStore(str(tmp_path)),
+                             checkpoint_every_bytes=2 * len(blob))
+        for k in range(8):
+            srv.submit("w", chain_blob(7, 4 * k))
+            srv.tick()
+        assert srv.cadence_checkpoints >= 2
+        by = [r["sensors"]["by"]
+              for r in srv.control.ledger.rows()]
+        assert set(by) == {"bytes"}
+
+
+# ---- /control endpoint + fleet federation ---------------------------
+
+
+def _squeezed_controller():
+    c = Controller(cooldown_ticks=2)
+    for t in range(4):
+        c.observe(_sensors(t, 1.0, shed=8 * (t + 1), pending=4096))
+    return c
+
+
+class TestControlEndpoint:
+    def test_control_report_served_with_limit(self):
+        ctrl = _squeezed_controller()
+        obs = ObsHTTPServer(port=0, control=ctrl).start()
+        try:
+            body = urllib.request.urlopen(
+                obs.url + "/control", timeout=5).read()
+            rep = json.loads(body)
+            assert rep["decisions"] == ctrl.decisions
+            assert rep["setpoints"]["tenants"] == {
+                "flood!": [512, 1]}
+            assert rep["advice"][0]["action"] == "rebalance_away"
+            assert rep["rows"]
+            one = json.loads(urllib.request.urlopen(
+                obs.url + "/control?limit=1", timeout=5).read())
+            assert len(one["rows"]) == 1
+        finally:
+            obs.stop()
+
+    def test_control_404_without_controller(self):
+        obs = ObsHTTPServer(port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(obs.url + "/control",
+                                       timeout=5)
+            assert ei.value.code == 404
+        finally:
+            obs.stop()
+
+    def test_fleet_collector_federates_advice_and_ledger_tail(self):
+        ctrl = _squeezed_controller()
+        obs = ObsHTTPServer(port=0, control=ctrl).start()
+        plain = ObsHTTPServer(port=0).start()  # control-less: 404 ok
+        try:
+            col = FleetCollector()
+            col.add_proc("p1", obs.url)
+            col.add_proc("p2", plain.url)
+            ok = col.scrape()
+            assert ok == {"p1": True, "p2": True}
+            rep = col.fleet_report()
+            assert rep["control"]["p1"]["rows"]
+            assert rep["control"].get("p2") in (None, {})
+            adv = [a for a in rep["advice"] if a["proc"] == "p1"]
+            assert adv and adv[0]["action"] == "rebalance_away"
+            assert adv[0]["tenant"] == "flood!"
+        finally:
+            obs.stop()
+            plain.stop()
+
+
+# ---- obsq control (satellite 2) -------------------------------------
+
+
+class TestObsqControl:
+    @pytest.fixture(autouse=True)
+    def _import_obsq(self, monkeypatch):
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        monkeypatch.syspath_prepend(os.path.join(repo, "tools"))
+        mod = importlib.import_module("obsq")
+        _sys.modules.setdefault("obsq_under_test", mod)
+        self.obsq = mod
+
+    def _run(self, capsys, *argv):
+        rc = self.obsq.main(list(argv))
+        return rc, capsys.readouterr().out
+
+    def _dump(self, tmp_path):
+        ctrl = Controller(cooldown_ticks=3, restore_after=2)
+        for t in range(10):
+            burn = 1.0 if t in (0, 4) else 0.0
+            ctrl.observe(_sensors(t, burn, shed=4 * t))
+        path = str(tmp_path / "ledger.jsonl")
+        n = ctrl.ledger.dump_jsonl(path)
+        assert n == ctrl.ledger.total
+        return path, ctrl
+
+    def test_tenant_and_tick_range_filter(self, tmp_path, capsys):
+        path, ctrl = self._dump(tmp_path)
+        rc, out = self._run(capsys, "control", path,
+                            "--tenant", "flood!")
+        assert rc == 0
+        rows = [json.loads(ln) for ln in out.splitlines()]
+        assert rows and all(r["tenant"] == "flood!" for r in rows)
+        assert [r["tick"] for r in rows] == \
+            sorted(r["tick"] for r in rows)
+        lo, hi = rows[0]["tick"], rows[0]["tick"]
+        rc, out = self._run(capsys, "control", path,
+                            "--tick-range", f"{lo}:{hi}")
+        assert rc == 0
+        windowed = [json.loads(ln) for ln in out.splitlines()]
+        assert windowed and all(lo <= r["tick"] <= hi
+                                for r in windowed)
+        assert len(windowed) < len(ctrl.ledger.rows())
+
+    def test_slo_join_answers_why(self, tmp_path, capsys):
+        """The ISSUE's audit question: *why did tenant T's budget
+        drop at tick N* — the row carries the decision AND the
+        tenant's SLO summary, joined offline."""
+        path, _ = self._dump(tmp_path)
+        slo = SLOLedger(1e9, burn_window=8)
+        for _ in range(6):
+            slo.shed("flood!", 1)
+        slo_path = str(tmp_path / "slo.json")
+        with open(slo_path, "w") as f:
+            json.dump(slo.report(), f)
+        rc, out = self._run(capsys, "control", path,
+                            "--tenant", "flood!", "--slo", slo_path)
+        assert rc == 0
+        rows = [json.loads(ln) for ln in out.splitlines()]
+        assert rows
+        assert rows[0]["slo"]["burn_rate"] == 1.0
+        assert rows[0]["rule"] == "budget_squeeze"
+
+    def test_live_control_url_source(self, tmp_path, capsys):
+        ctrl = _squeezed_controller()
+        obs = ObsHTTPServer(port=0, control=ctrl).start()
+        try:
+            rc, out = self._run(capsys, "control", obs.url)
+            assert rc == 0
+            rows = [json.loads(ln) for ln in out.splitlines()]
+            assert rows and rows[0]["rule"] == "budget_squeeze"
+            assert all("_src" in r for r in rows)
+        finally:
+            obs.stop()
+
+    def test_unreadable_input_exits_2(self, tmp_path, capsys):
+        rc, _ = self._run(capsys, "control",
+                          str(tmp_path / "missing.jsonl"))
+        assert rc == 2

@@ -716,6 +716,30 @@ def f(tracer):
     )
 
 
+def test_registry_covers_control_plane_counters():
+    """Round 22 (the SLO-driven control plane) added the
+    ``control.*`` decision/cooldown/ledger/setpoint registry plus the
+    cadence-checkpoint counter. Both directions must hold: the
+    emitted names stay documented in the README registry, and an
+    undocumented ``control.*`` name still fires CL201 — the new
+    namespace genuinely joined the registry-checked pool."""
+    reg = _real_registry()
+    for name in ("control.decisions", "control.cooldown_skips",
+                 "control.ledger_dropped", "control.setpoint",
+                 "snap.cadence_writes"):
+        assert name in reg.metrics, (
+            f"{name} dropped out of the README registry (round-22 "
+            f"control-plane contract)"
+        )
+    result = _lint_snippet("crdt_tpu/obs/x.py", '''
+def f(tracer):
+    tracer.count("control.bogus_rule", 1)
+''', _reg("control.decisions"))
+    assert any(f.code == "CL201" for f in result.findings), (
+        "an undocumented control.* metric no longer fires CL201"
+    )
+
+
 def test_registry_drift_fixed_event_kinds():
     """First-run CL201 drift on flight-recorder event kinds from the
     guard/storage/device adversaries."""

@@ -815,3 +815,54 @@ def test_snapshot_guard_counters_lower_is_better():
     assert not any("{" in r for r in regressed)
     _, regressed = compare(old, copy.deepcopy(old))
     assert regressed == []
+
+
+def test_autopilot_section_keys_gated():
+    """Round 22: the --autopilot artifact keys — recovery_ticks
+    regresses when it RISES (the flooder's burn takes longer to
+    drain once the flood stops; a deterministic tick count, never
+    noise-floored) and neighbor_p99_ms when it RISES (the squeeze
+    stopped shielding the neighbors; a SECTION key, so a rotted
+    squeeze rule fails the gate even under the ms noise floor).
+    The OFF-leg twins are workload facts, never gated."""
+    old = {"autopilot": {
+        "recovery_ticks": 11, "recovery_ticks_off": 10,
+        "neighbor_p99_ms": 4.0, "neighbor_p99_ms_off": 5.0,
+    }}
+    _, regressed = compare(old, copy.deepcopy(old))
+    assert regressed == []
+    bad = {"autopilot": {
+        "recovery_ticks": 20, "recovery_ticks_off": 10,
+        "neighbor_p99_ms": 40.0, "neighbor_p99_ms_off": 5.0,
+    }}
+    _, regressed = compare(old, bad, threshold=0.2)
+    assert "autopilot.recovery_ticks" in regressed
+    assert "autopilot.neighbor_p99_ms" in regressed
+    assert not any("_off" in r for r in regressed)
+    better = {"autopilot": {
+        "recovery_ticks": 2, "recovery_ticks_off": 10,
+        "neighbor_p99_ms": 1.0, "neighbor_p99_ms_off": 5.0,
+    }}
+    _, regressed = compare(old, better)
+    assert regressed == []
+
+
+def test_control_ledger_dropped_lower_is_better():
+    """Round 22 guard row: control.ledger_dropped regresses on a
+    rise (a control loop hot enough to churn its own audit ring is
+    a finding); decisions/cooldown_skips are deliberately ungated —
+    their healthy level is workload-dependent."""
+    old = {"tracer": {"counters": {
+        "control.ledger_dropped": 0, "control.decisions": 4,
+        "control.cooldown_skips": 4,
+    }}}
+    bad = {"tracer": {"counters": {
+        "control.ledger_dropped": 50, "control.decisions": 40,
+        "control.cooldown_skips": 40,
+    }}}
+    _, regressed = compare(old, bad, threshold=0.2)
+    assert "tracer.control.ledger_dropped" in regressed
+    assert not any("decisions" in r or "cooldown" in r
+                   for r in regressed)
+    _, regressed = compare(old, copy.deepcopy(old))
+    assert regressed == []

@@ -44,6 +44,12 @@ COMPUTED_ALLOWLIST = (
     ("guard/faults.py", "retry_with_backoff"),
     ("ops/device.py", "xfer_put"),
     ("ops/device.py", "xfer_fetch"),
+    # round 22: Controller.observe(sensors) is the control plane's
+    # rule-engine consult (one sensor dict per tick), not a metric
+    # emission — the controller's own tracer calls stay literal and
+    # registry-checked
+    ("models/multidoc.py", "_run_control"),
+    ("obs/control.py", "replay"),
 )
 
 

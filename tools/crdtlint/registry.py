@@ -41,6 +41,9 @@ NAMESPACES = frozenset({
     # round 21 (crash-proof recovery): the snapshot store's
     # write/load/fallback plane
     "snap",
+    # round 22 (control plane): the SLO-driven controller's
+    # decision/cooldown/ledger/setpoint registry
+    "control",
 })
 
 # backticked dotted names that share a namespace but are NOT metrics

@@ -197,6 +197,14 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     # the same run hit more damaged/unusable snapshots (lower =
     # better, a count — never muted by the seconds floor)
     (("cold_start", "snap_fallbacks_counted"), False),
+    # the control plane (round 22, bench --autopilot): ticks for the
+    # seeded flooder's burn rate to recover once the flood stops
+    # (tick counts — deterministic, never muted) and the neighbors'
+    # p99 serve latency with the controller ON (wall-clock ms, but a
+    # SECTION key so a squeeze rule rotting away fails the gate even
+    # on a fast machine). Both lower = better.
+    (("autopilot", "recovery_ticks"), False),
+    (("autopilot", "neighbor_p99_ms"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 
@@ -237,6 +245,12 @@ GUARD_PREFIXES: Tuple[str, ...] = (
     # facts and stay ungated)
     "snap.fallbacks",
     "snap.write_errors",
+    # round 22: a hot control loop churning its own bounded audit
+    # ledger is a degradation — more dropped rows on the same
+    # workload means decisions became unauditable (count semantics;
+    # control.decisions / cooldown_skips are rule-mix facts and stay
+    # ungated)
+    "control.ledger_dropped",
 )
 
 

@@ -41,6 +41,15 @@ divergence postmortem from offline to live):
 - ``diverge``  — correlates ``divergence`` events with each source's
   trailing context and the last common digests
   (``correlate_divergences``).
+- ``control``  — round 22: query CONTROL-LEDGER rows (as
+  ``ControlLedger.dump_jsonl`` writes them, or a live ``/control``
+  URL), ``--tenant T`` / ``--tick-range A:B`` filtered, optionally
+  joined with an SLO snapshot (``--slo report.json``) so "why did
+  tenant T's budget drop at tick 412" is answerable from dumps
+  alone:
+
+      python tools/obsq.py control ledger.jsonl --tenant flood! \\
+          --tick-range 400:420 --slo slo_report.json
 
 Exit code: 0 on success (even when nothing matches), 2 on unreadable
 input. Stdlib + ``crdt_tpu.obs.propagation`` only — the analysis
@@ -159,6 +168,97 @@ cmd_paths = reconstruct_paths
 cmd_diverge = correlate_divergences
 
 
+# -- the control-ledger lane (round 22) ------------------------------
+
+
+def load_control_rows(paths: List[str]) -> List[Dict[str, Any]]:
+    """Control-ledger rows from JSONL dumps or live ``/control``
+    URLs (the endpoint answers a JSON report whose ``rows`` is the
+    ledger tail), each tagged ``_src``, sorted by (tick, source)."""
+    rows: List[Dict[str, Any]] = []
+    for path in paths:
+        src = _src_name(path)
+        if path.startswith(("http://", "https://")):
+            import urllib.request
+
+            url = path.rstrip("/")
+            if not url.endswith("/control"):
+                url += "/control"
+            try:
+                with urllib.request.urlopen(
+                    url, timeout=5.0
+                ) as resp:
+                    body = resp.read().decode("utf-8", "replace")
+            except OSError as exc:
+                raise OSError(f"{path}: {exc}") from None
+            try:
+                report = json.loads(body)
+            except ValueError as exc:
+                raise ValueError(
+                    f"{path}: not JSON ({exc})") from None
+            found = report.get("rows") or []
+        else:
+            found = []
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, start=1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        found.append(json.loads(line))
+                    except ValueError as exc:
+                        raise ValueError(
+                            f"{path}:{lineno}: not JSONL ({exc})"
+                        ) from None
+        for r in found:
+            if isinstance(r, dict):
+                rows.append(dict(r, _src=src))
+    rows.sort(key=lambda r: (r.get("tick", 0), r["_src"]))
+    return rows
+
+
+def cmd_control(rows: List[Dict[str, Any]], *,
+                tenant: Optional[str] = None,
+                tick_range: Optional[str] = None,
+                slo: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Filter ledger rows by tenant and tick range; with ``slo`` (a
+    JSON file holding ``SLOLedger.report()``, or the ``slo`` section
+    of a ``/snapshot``), each row gains an ``slo`` field with the
+    tenant's breach/burn/route summary — the decision and the sensor
+    history it acted on, joined offline."""
+    lo = hi = None
+    if tick_range:
+        a, _, b = tick_range.partition(":")
+        lo = int(a) if a else None
+        hi = int(b) if b else None
+    slo_tenants: Dict[str, Any] = {}
+    if slo:
+        with open(slo, encoding="utf-8") as f:
+            snap = json.load(f)
+        # accept a bare SLOLedger.report() or a /snapshot with an
+        # "slo" section
+        slo_tenants = (snap.get("slo", snap) or {}).get(
+            "tenants") or {}
+    out = []
+    for r in rows:
+        t = r.get("tick", 0)
+        if lo is not None and t < lo:
+            continue
+        if hi is not None and t > hi:
+            continue
+        if tenant is not None and r.get("tenant") != tenant:
+            continue
+        if slo_tenants and r.get("tenant") in slo_tenants:
+            s = slo_tenants[r["tenant"]]
+            r = dict(r, slo={
+                "breaches": s.get("breaches"),
+                "burn_rate": s.get("burn_rate"),
+                "routes": s.get("routes"),
+            })
+        out.append(r)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="obsq",
@@ -179,7 +279,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                            help="client:seq prefix of the trace id")
         if name == "diverge":
             p.add_argument("--context", type=int, default=8)
+    pc = sub.add_parser("control")
+    pc.add_argument("dumps", nargs="+",
+                    help="control-ledger JSONL dump(s) or live "
+                         "/control URL(s)")
+    pc.add_argument("--tenant")
+    pc.add_argument("--tick-range", metavar="A:B",
+                    help="inclusive tick window (either side open)")
+    pc.add_argument("--slo", metavar="REPORT.json",
+                    help="SLO report (or /snapshot) to join per "
+                         "tenant")
     args = ap.parse_args(argv)
+    if args.cmd == "control":
+        try:
+            rows = load_control_rows(args.dumps)
+            out = cmd_control(rows, tenant=args.tenant,
+                              tick_range=args.tick_range,
+                              slo=args.slo)
+        except (OSError, ValueError) as exc:
+            print(f"obsq: {exc}", file=sys.stderr)
+            return 2
+        for r in out:
+            print(json.dumps(r, sort_keys=True, default=str))
+        return 0
     try:
         events = load_events(args.dumps)
     except (OSError, ValueError) as exc:
