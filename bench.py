@@ -1797,6 +1797,198 @@ def coldstart(argv=None) -> int:
     return 0 if ok else 1
 
 
+def build_subtree_trace(R: int, K: int, seed: int = 5):
+    """The round-23 hard case the shared-anchor conflict trace only
+    grazes: many writers grow one hot list as a BRANCHING tree (every
+    op anchors a uniformly random earlier op — wide stars, bushy
+    subtrees, caterpillar spines all occur, never a flat chain), half
+    the anchors landing on client 1's shared heads, plus deep
+    origin-chained LWW sets on a handful of hot map keys. Without the
+    subtree split the doubling-rounds bound tracks the whole hot
+    segment and the deepest key chain; with it, the split width."""
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.ids import DeleteSet
+    from crdt_tpu.core.records import ItemRecord
+
+    rng = np.random.default_rng(seed)
+    n_map = (K * 3) // 10
+    blobs = []
+    for r in range(R):
+        client = r + 1
+        recs = []
+        last_set: dict = {}
+        for k in range(n_map):
+            key = int(rng.integers(0, 8))
+            prev_set = last_set.get(key)
+            recs.append(ItemRecord(
+                client=client, clock=k, parent_root="m0",
+                key=f"k{key}", content=k,
+                origin=(client, prev_set)
+                if prev_set is not None else None,
+            ))
+            last_set[key] = k
+        own: list = []
+        for k in range(n_map, K):
+            if client == 1 and len(own) < 8:
+                origin = None  # the shared heads everyone piles onto
+            elif own and rng.random() < 0.5:
+                origin = (client, own[int(rng.integers(0, len(own)))])
+            elif rng.random() < 0.5:
+                # pile onto a shared head -> R-wide sibling groups
+                origin = (1, n_map + int(rng.integers(0, 8)))
+            else:
+                origin = None
+            recs.append(ItemRecord(
+                client=client, clock=k, parent_root="hot",
+                origin=origin, content=k,
+            ))
+            own.append(k)
+        ds = DeleteSet()
+        for k in rng.choice(K - n_map, size=max(1, K // 25),
+                            replace=False):
+            ds.add(client, int(n_map + k))
+        blobs.append(v1.encode_update(recs, ds))
+    return blobs
+
+
+def conflict_leg() -> dict:
+    """The ``--conflict`` evidence (round 23): replay the branching
+    hot-list + deep-map-chain trace with the subtree split DISABLED
+    (the oracle), then at widths {1, odd, default} single-chip and
+    2/4-way sharded — every leg digest-asserted byte-identical
+    against the oracle (cache AND snapshot), with the staged
+    ``converge.wyllie_rounds`` / ``converge.map_rounds`` bounds and
+    the ``converge.subtree_cuts`` / ``converge.map_chain_cuts``
+    counts read from the tracer at the gated width.
+
+    Knobs: ``BENCH_CONFLICT_REPLICAS`` (writers, default 16),
+    ``BENCH_CONFLICT_OPS`` (ops per writer, default 3000),
+    ``BENCH_CONFLICT_WIDTH`` (the gated odd width, default 257)."""
+    import hashlib
+
+    from crdt_tpu.models import replay as rp
+    from crdt_tpu.obs import get_tracer
+    from crdt_tpu.ops import packed, shard
+
+    R = int(os.environ.get("BENCH_CONFLICT_REPLICAS", "16"))
+    K = int(os.environ.get("BENCH_CONFLICT_OPS", "3000"))
+    W = int(os.environ.get("BENCH_CONFLICT_WIDTH", "257"))
+    blobs = build_subtree_trace(R, K)
+    gauge_names = ("converge.wyllie_rounds", "converge.map_rounds",
+                   "converge.subtree_cuts", "converge.map_chain_cuts")
+
+    def run(width, shards=None):
+        if width is None:
+            os.environ.pop(packed._CHAIN_SPLIT_ENV, None)
+        else:
+            os.environ[packed._CHAIN_SPLIT_ENV] = str(width)
+        if shards is None:
+            os.environ.pop(shard.SHARD_ENV, None)
+            os.environ.pop(shard.MIN_ROWS_ENV, None)
+        else:
+            os.environ[shard.SHARD_ENV] = str(shards)
+            os.environ[shard.MIN_ROWS_ENV] = "1"
+        t0 = time.perf_counter()
+        res = rp.replay_trace(blobs)
+        e2e_s = round(time.perf_counter() - t0, 3)
+        digest = hashlib.sha256(
+            json.dumps(res.cache, sort_keys=True).encode()
+            + hashlib.sha256(res.snapshot).digest()
+        ).hexdigest()
+        gauges = get_tracer().report()["gauges"]
+        return digest, e2e_s, {g.split(".", 1)[1]: gauges[g]
+                               for g in gauge_names if g in gauges}
+
+    ref, oracle_s, oracle_g = run(0)
+    legs: dict = {"oracle": {"e2e_s": oracle_s, **oracle_g}}
+    identical = True
+    for width in (1, W, None):
+        d, s, g = run(width)
+        name = "default" if width is None else str(width)
+        legs[name] = {"e2e_s": s, "identical": d == ref, **g}
+        identical = identical and d == ref
+    gated = legs[str(W)]
+    for shards in (2, 4):
+        d, s, _ = run(None, shards=shards)
+        legs[f"sharded_{shards}"] = {"e2e_s": s,
+                                     "identical": d == ref}
+        identical = identical and d == ref
+    os.environ.pop(packed._CHAIN_SPLIT_ENV, None)
+    os.environ.pop(shard.SHARD_ENV, None)
+    os.environ.pop(shard.MIN_ROWS_ENV, None)
+    return {
+        "replicas": R,
+        "ops_per_replica": K,
+        "gated_width": W,
+        "legs": legs,
+        # the gated numbers: the staged rounds bounds at the gated
+        # width (lower = better; the tentpole) and the cut counts
+        # (the split engaging at all — 0 means the shapes regressed
+        # to refused)
+        "converge": {
+            "wyllie_rounds": gated.get("wyllie_rounds"),
+            "map_rounds": gated.get("map_rounds"),
+            "subtree_cuts": gated.get("subtree_cuts", 0),
+            "map_chain_cuts": gated.get("map_chain_cuts", 0),
+        },
+        "oracle_rounds": {
+            "wyllie_rounds": oracle_g.get("wyllie_rounds"),
+            "map_rounds": oracle_g.get("map_rounds"),
+        },
+        "identical": bool(identical),
+    }
+
+
+def conflict(argv=None) -> int:
+    """The ``--conflict`` harness: run the round-23 subtree-split leg,
+    merge the gated ``conflict`` section into BENCH_OUT.json (like
+    ``--coldstart``), one summary line on stdout. Exits non-zero on
+    any divergent digest or when either staged rounds bound fails to
+    drop STRICTLY below the split-disabled oracle — a split that is
+    wrong, or that stopped engaging, must never publish as
+    evidence."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the 2/4-way sharded legs need virtual devices before jax wakes
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    from crdt_tpu.obs import Tracer, set_tracer
+
+    set_tracer(Tracer(enabled=True))
+    leg = conflict_leg()
+    ok = bool(leg["identical"]) \
+        and leg["converge"]["subtree_cuts"] > 0 \
+        and leg["converge"]["map_chain_cuts"] > 0 \
+        and leg["converge"]["wyllie_rounds"] \
+        < leg["oracle_rounds"]["wyllie_rounds"] \
+        and leg["converge"]["map_rounds"] \
+        < leg["oracle_rounds"]["map_rounds"]
+    if ok:
+        try:
+            with open(BENCH_OUT) as f:
+                full = json.load(f)
+        except (OSError, ValueError):
+            full = {}
+        full["conflict"] = leg
+        try:
+            with open(BENCH_OUT, "w") as f:
+                json.dump(full, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError as exc:
+            log(f"{BENCH_OUT} not written: {exc}")
+    print(json.dumps({
+        "metric": "conflict",
+        "ok": ok,
+        "identical": leg["identical"],
+        "wyllie_rounds": leg["converge"]["wyllie_rounds"],
+        "map_rounds": leg["converge"]["map_rounds"],
+        "oracle_rounds": leg["oracle_rounds"],
+        "subtree_cuts": leg["converge"]["subtree_cuts"],
+        "map_chain_cuts": leg["converge"]["map_chain_cuts"],
+        "full_results": os.path.basename(BENCH_OUT),
+    }))
+    return 0 if ok else 1
+
+
 def autopilot_leg() -> dict:
     """The ``--autopilot`` evidence (round 22, ROADMAP item 2): the
     SLO-driven control plane A/B — one flooding tenant beside small
@@ -2933,6 +3125,52 @@ def smoke():
             assert "converge.wyllie_rounds" in report["gauges"], \
                 "smoke: converge.wyllie_rounds gauge missing"
             out["shard_registry_ok"] = True
+        # the round-23 subtree-split registry: a small branching-tree
+        # doc (a shape the round-13 chain split refused outright)
+        # plus a deep origin-chained map key chain, re-cut at a tiny
+        # width — byte-identical to the split-disabled plan, and the
+        # cut/rounds gauges the --conflict regression gate reads
+        # must fire
+        from crdt_tpu.codec import v1 as _v1
+        from crdt_tpu.core.ids import DeleteSet as _DS
+        from crdt_tpu.models import replay as _rp23
+        from crdt_tpu.ops import packed as _packed
+
+        recs23 = []
+        for k in range(96):  # bushy tree: op k anchors op k // 3
+            recs23.append(ItemRecord(
+                client=1, clock=k, parent_root="t23",
+                origin=(1, k // 3) if k else None, content=k))
+        prev23 = None
+        for k in range(40):  # deep origin-chained hot-key sets
+            recs23.append(ItemRecord(
+                client=1, clock=96 + k, parent_root="m23", key="hot",
+                origin=(1, prev23) if prev23 is not None else None,
+                content=k))
+            prev23 = 96 + k
+        blobs23 = [_v1.encode_update(recs23, _DS())]
+        prior23 = os.environ.get(_packed._CHAIN_SPLIT_ENV)
+        try:
+            os.environ[_packed._CHAIN_SPLIT_ENV] = "0"
+            want23 = _rp23.replay_trace(blobs23)
+            os.environ[_packed._CHAIN_SPLIT_ENV] = "16"
+            got23 = _rp23.replay_trace(blobs23)
+        finally:
+            if prior23 is None:
+                os.environ.pop(_packed._CHAIN_SPLIT_ENV, None)
+            else:
+                os.environ[_packed._CHAIN_SPLIT_ENV] = prior23
+        assert got23.cache == want23.cache \
+            and got23.snapshot == want23.snapshot, \
+            "smoke: subtree split diverges on the branching doc"
+        g23 = tracer.report()["gauges"]
+        for gname in ("converge.subtree_cuts",
+                      "converge.map_chain_cuts"):
+            assert g23.get(gname, 0) > 0, \
+                f"smoke: {gname} did not fire on the branching doc"
+        assert "converge.map_rounds" in g23, \
+            "smoke: converge.map_rounds gauge missing"
+        out["subtree_split_ok"] = True
         # the round-14 multi-tenant registry: a tiny mixed-tenant
         # batch through MultiDocServer, digest-identical to the
         # per-doc baseline, lighting up the tenant.* counters and
@@ -3356,6 +3594,9 @@ def smoke():
     # 1500-byte budget, and nothing downstream reads timings from it
     for k in ("numpy_s", "device_s", "stream_s"):
         out.pop(k, None)
+    # the round-23 subtree-split flag rides the artifact only, for
+    # the same budget reason (tier-1 reads it from the artifact)
+    out.pop("subtree_split_ok", None)
     if isinstance(out.get("multitenant", {}).get("steady"), dict):
         out["multitenant"]["steady"].pop(
             "device_dispatches_per_tick", None)
@@ -4409,6 +4650,8 @@ if __name__ == "__main__":
         _sys_main.exit(coldstart())
     elif "--autopilot" in _sys_main.argv[1:]:
         _sys_main.exit(autopilot())
+    elif "--conflict" in _sys_main.argv[1:]:
+        _sys_main.exit(conflict())
     elif (
         "--smoke" in _sys_main.argv[1:]
         or os.environ.get("BENCH_SMOKE") == "1"

@@ -1820,6 +1820,16 @@ class IncrementalReplay:
                         num_segments=tpad,
                         sel_bucket=sel_bucket, seq_bucket=sel_bucket,
                         mode=pk.kernel_mode_for(sel_bucket),
+                        # rounds stay at the sel_bucket bound (None):
+                        # the splice path numbers segments ON DEVICE,
+                        # and rows whose origins are still in flight
+                        # root-attach there — so device segment
+                        # populations can exceed any host-side
+                        # `_seg_rows` count (fleet swarms with drops/
+                        # delays hit this). The round-23 tightened
+                        # bound only applies where numbering is
+                        # host-side (packed._stage, ops/shard)
+                        rank_rounds=None, map_rounds=None,
                     )
                     # the round's ONE fetch
                     return mat, xfer_fetch(

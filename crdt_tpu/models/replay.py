@@ -230,7 +230,15 @@ def _assemble_packed(dec: Dict, res, row_map=None):
         cuts = np.r_[0, np.flatnonzero(segs[1:] != segs[:-1]) + 1, len(segs)]
         for a, b in zip(cuts[:-1], cuts[1:]):
             chunk = rows[a:b].tolist()
-            seq_orders[parent_spec(dec, chunk[0])] = chunk
+            spec = parent_spec(dec, chunk[0])
+            # extend on recurrence: the sharder's cross-shard subtree
+            # pre-cut (round 23) emits one list's pieces as separate
+            # runs — shard-concatenated in exact piece order, so
+            # appending reproduces the unsplit stream bit-for-bit
+            if spec in seq_orders:
+                seq_orders[spec].extend(chunk)
+            else:
+                seq_orders[spec] = chunk
     return win_rows, seq_orders
 
 

@@ -33,6 +33,7 @@ prescribes.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Dict, NamedTuple, Optional
 
@@ -86,33 +87,46 @@ _RIGHT_WALK_CAP = 1024
 # and the product replay always measure the same pipeline shape.
 EAGER_PUT_MIN_ROWS = 1 << 19
 
-# chain-split width (round 13, the post-sort-diet ROUNDS lever): a
-# sequence segment that is a pure bundle of append CHAINS (every node
-# has at most one child — the shape own-chain appends produce) and
-# larger than this many rows is re-cut at staging into bounded-length
-# chain segments. Each piece's Wyllie doubling then runs
-# ceil(log2(width)) rounds instead of ceil(log2(longest list)), and
-# the pieces are synthetic segments the multi-chip sharder can spread
-# across chips. The seams are host-stitched: pieces are numbered in
-# exact document order (sibling order of the chain heads x piece
-# depth), so concatenating the per-piece streams IS the unsplit
-# stream — byte-identical, tests/test_shard.py. CRDT_TPU_CHAIN_SPLIT
-# overrides (0 disables).
+# chain-split width (round 13, widened to SUBTREE granularity in
+# round 23 — the post-sort-diet ROUNDS lever): a sequence segment
+# larger than this many rows is re-cut at staging into bounded-size
+# synthetic segments, each a contiguous suffix of the segment's DFS
+# stream (any node whose remaining subtree ends the stream is a cut
+# candidate, so branching trees split too, not just pure append
+# chains). Deep LWW map key chains re-cut the same way. Each piece's
+# doubling then runs ceil(log2(width)) rounds instead of
+# ceil(log2(deepest path)), and the pieces are synthetic segments the
+# multi-chip sharder can spread across chips. The seams are
+# host-stitched: pieces are numbered in exact document order, so
+# concatenating the per-piece streams IS the unsplit stream —
+# byte-identical, tests/test_shard.py + tests/test_subtree_split.py.
+# CRDT_TPU_CHAIN_SPLIT overrides (0 disables).
 _CHAIN_SPLIT_ENV = "CRDT_TPU_CHAIN_SPLIT"
 CHAIN_SPLIT_DEFAULT = 1 << 13
+
+# cached (raw env string, parsed width): staging consults the width
+# once per union and re-parsing the environment each call was pure
+# overhead. Keying on the RAW string keeps the override semantics
+# exact for tests that monkeypatch the variable between calls, and
+# the value is only ever read on the host — never inside a traced
+# body (the r16 CRDT_TPU_PALLAS host-static discipline).
+_split_width_cache: tuple = (None, CHAIN_SPLIT_DEFAULT)
 
 
 def chain_split_width() -> int:
     """The staging chain-split width (0 = disabled)."""
-    import os
-
+    global _split_width_cache
     raw = os.environ.get(_CHAIN_SPLIT_ENV, "")
-    if raw == "":
-        return CHAIN_SPLIT_DEFAULT
-    try:
-        return max(0, int(raw))
-    except ValueError:
-        return CHAIN_SPLIT_DEFAULT
+    if raw != _split_width_cache[0]:
+        if raw == "":
+            w = CHAIN_SPLIT_DEFAULT
+        else:
+            try:
+                w = max(0, int(raw))
+            except ValueError:
+                w = CHAIN_SPLIT_DEFAULT
+        _split_width_cache = (raw, w)
+    return _split_width_cache[1]
 
 
 # ---------------------------------------------------------------------------
@@ -358,6 +372,17 @@ class PackedPlan(NamedTuple):
                               # seams; counted as converge.chain_seams
                               # at staging and shard.seam_rows per
                               # sharded dispatch
+    win_src: Optional[np.ndarray] = None
+                              # [S] winner-stitch for split MAP
+                              # segments: slot i of the fetched win
+                              # vector reads win[win_src[i]] (-1
+                              # suppresses the slot). A split map
+                              # segment's first synthetic slot points
+                              # at the piece holding the true winner;
+                              # its other slots are suppressed so the
+                              # per-original-segment winner set stays
+                              # exactly the unsplit one. None =
+                              # identity (no map split)
 
 
 def _even_up(x: int) -> int:
@@ -384,7 +409,12 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
     in-group anchors replay the Yjs conflict scan (_simulate_group);
     attachment-free groups keep the plain (client, clock-desc) key.
 
-    Returns (client column, caller-space hard rows, max rank written).
+    Returns (client column, caller-space hard rows, max rank written,
+    hard segment ids). The hard segment ids let the subtree split
+    skip exactly the segments whose staged order is inexact — every
+    other right-bearing segment has its conflict-scan ranks baked
+    into the client column by the time the split runs, so the
+    sibling comparator (and any DFS-suffix cut of it) stays exact.
     """
     from crdt_tpu.ops.yata import _simulate_group
 
@@ -393,7 +423,7 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
     rk = np.asarray(cols["right_clock"], np.int64)[order]
     rows_r = np.flatnonzero(uniq_valid & (kid_s < 0) & (rr >= 0))
     if not len(rows_r):
-        return client_s, [], 0
+        return client_s, [], 0, []
 
     # resolve right-target rows through the dense id table (leftmost
     # match is the kept duplicate representative, like origins)
@@ -418,6 +448,7 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
     }
 
     hard_reps: list = []
+    hard_segs: list = []
     max_rank = 0
     # accumulated conflict-scan ranks, written with ONE bulk
     # searchsorted at the end (a per-sid binary search dominated text
@@ -433,6 +464,7 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
         # replay's dominant cost
         if bool(np.any((oc_s[members] >= 0) & (origin_row[members] < 0))):
             hard_reps.append(int(order[int(members[0])]))
+            hard_segs.append(int(S))
             continue
         # groups within the segment, keyed by in-union origin row:
         # one stable sort + run split instead of a python setdefault
@@ -509,6 +541,7 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
             seg_max_rank = max(seg_max_rank, len(ordered) - 1)
         if hard:
             hard_reps.append(int(order[int(members[0])]))
+            hard_segs.append(int(S))
             continue
         rank_sids.extend(seg_rank_sids)
         rank_vals.extend(seg_rank_vals)
@@ -516,125 +549,332 @@ def _stage_rights(cols, order, ikey_s, uniq, seg, origin_row, oc_s,
     if rank_sids:
         rows = np.searchsorted(ikey_s, np.asarray(rank_sids, np.int64))
         client_s[rows] = np.asarray(rank_vals, np.int64)
-    return client_s, hard_reps, max_rank
+    return client_s, hard_reps, max_rank, hard_segs
 
 
-def _chain_split(seg, seq_rows, c_parent, client_s, rr_s, width):
-    """Re-cut oversized pure-chain-bundle sequence segments into
-    bounded-length synthetic chain segments (the round-13 ROUNDS
-    lever — see the CHAIN_SPLIT_DEFAULT block).
+def dfs_suffix_boundaries(par_l, cl_l, posd_l, width: int,
+                          max_pieces: int):
+    """Greedy DFS-suffix cut of ONE segment's compact forest (round
+    23, the subtree generalization of the round-13 chain cut).
 
-    A segment qualifies when it is larger than ``width`` rows and
-    every member has AT MOST ONE child in the origin tree (the shape
-    own-chain appends produce: disjoint chains hanging off the
-    virtual root), carries no right origins (their conflict-scan
-    ranks / hard fallback must see the original segment), and has no
-    origin cycles. Its DFS stream is then exactly: chains in sibling
-    order of their heads (client asc, clock desc — the same key the
-    staged sibling tables use), each chain in depth order. The re-cut
-    preserves that order bit-for-bit: short chains pack greedily into
-    <=width synthetic segments in head order; a chain longer than
-    ``width`` takes consecutive EXCLUSIVE synthetic segments, one per
-    depth-``width`` piece, its seam rows' parent links cut (the host
-    stitch is the synthetic numbering itself — concatenating the
-    per-piece streams in synthetic-segment order IS the unsplit
-    stream).
+    ``par_l`` are segment-local parent indices (-1 roots), ``cl_l`` /
+    ``posd_l`` the sibling comparator keys — client ascending then
+    ``posd_l`` ascending, EXACTLY the staged sibling-table keys, so
+    the preorder computed here is the stream the device will emit.
 
-    Returns ``(seg2, c_parent2, seam_compact_rows, synth_orig)`` —
-    the renumbered dense segment column, the cut compact parents, the
-    compact indices of the seam rows, and the synthetic->original
-    dense-id table — or None when nothing splits.
+    The cut walks the stream from its END: the last remaining node's
+    every ancestor owns a remaining subtree that is a contiguous
+    stream SUFFIX, so the topmost ancestor still inside the width
+    window opens a piece, extended left over whole preceding
+    same-parent sibling subtrees while they fit. Cutting a suffix
+    keeps the invariant for the next round, so concatenating pieces
+    in cut order (piece 0 = the final prefix) reproduces the stream
+    bit-for-bit. ``max_pieces`` bounds hostile shapes that shed
+    one-row suffixes: when reached, the remaining prefix stays one
+    (large) piece — a best-effort rounds bound, never an error.
+
+    Returns ``(pos, starts)``: the preorder position per local node
+    and the ascending piece start positions (``starts[0] == 0``).
+    Pure host numpy — log2-depth doubling passes plus one python
+    step per piece (each bounded by that piece's size).
     """
+    m = len(par_l)
+    levels = max(1, (max(m, 2) - 1).bit_length() + 1)
+    # sibling tables, exactly as staging's g1 builds them
+    pslot = np.where(par_l >= 0, par_l, m)
+    sord = np.lexsort((posd_l, cl_l, pslot))
+    ps = pslot[sord]
+    same = ps[1:] == ps[:-1]
+    nxt = np.full(m, -1, np.int64)
+    nxt[sord[:-1][same]] = sord[1:][same]
+    fc = np.full(m + 1, -1, np.int64)
+    starts_r = np.r_[0, np.flatnonzero(~same) + 1]
+    fc[ps[starts_r]] = sord[starts_r]
+    # g(v): nearest ancestor-or-self with a next sibling (absorbing
+    # path doubling: nodes that have one are fixed points)
+    g = np.where(nxt >= 0, np.arange(m, dtype=np.int64), par_l)
+    for _ in range(levels):
+        g = np.where(g >= 0, g[np.clip(g, 0, m - 1)], np.int64(-1))
+    # preorder successor chain -> position = m-1 - distance-to-end
+    succ = np.where(
+        fc[:m] >= 0, fc[:m],
+        np.where(g >= 0, nxt[np.clip(g, 0, m - 1)], np.int64(-1)),
+    )
+    t = np.where(succ >= 0, succ, np.arange(m, dtype=np.int64))
+    dist = (succ >= 0).astype(np.int64)
+    for _ in range(levels):
+        dist = dist + dist[t]
+        t = t[t]
+    pos = (m - 1) - dist
+    by_pos = np.empty(m, np.int64)
+    by_pos[pos] = np.arange(m)
+    # sibling runs in sorted order (positions ascend within a run —
+    # sibling order IS subtree-start order), for the left-extension
+    # binary search
+    spos = np.empty(m, np.int64)
+    spos[sord] = np.arange(m)
+    run_of = np.cumsum(np.r_[True, ~same]) - 1
+    pos_sorted = pos[sord]
+    bounds = [m]
+    e = m
+    while e > width and len(bounds) <= max_pieces:
+        lim = e - width
+        A = int(by_pos[e - 1])
+        while par_l[A] >= 0 and pos[par_l[A]] >= lim:
+            A = int(par_l[A])
+        i = int(spos[A])
+        lo = int(starts_r[run_of[i]])
+        j = lo + int(np.searchsorted(pos_sorted[lo:i + 1], lim))
+        b = int(pos_sorted[j])
+        bounds.append(b)
+        e = b
+    bounds.append(0)
+    return pos, np.unique(np.asarray(bounds[::-1][:-1], np.int64))
+
+
+def _subtree_split(seg, seq_rows, c_parent, client_s, width,
+                   hard_seg_ids, map_rows, origin_row, rr_s):
+    """Re-cut oversized sequence segments at SUBTREE granularity and
+    deep LWW map key chains at depth granularity into bounded-size
+    synthetic segments (round 23, generalizing the round-13 chain
+    split — see the CHAIN_SPLIT_DEFAULT block).
+
+    A sequence segment qualifies when it is larger than ``width``
+    rows, is not HARD (the scalar fallback must see the original
+    segment), and has no origin cycles. Branching nodes and benign
+    right-origin rows no longer disqualify: this runs AFTER
+    :func:`_stage_rights`, so the conflict-scan ranks are already
+    baked into ``client_s`` and the sibling comparator — hence the
+    DFS stream and any suffix cut of it — is exact. Pure chain
+    bundles keep the fully vectorized round-13 bin/depth cut;
+    branching trees take :func:`dfs_suffix_boundaries`. Either way
+    the pieces are numbered in exact document order, so the host
+    stitch remains the synthetic numbering itself.
+
+    A map segment qualifies when it is larger than ``width`` rows,
+    is a pure chain bundle (argmax-descend only factors over pieces
+    of single-child chains), carries no right origins (the host
+    right-fix at assembly walks the original chain), and has no
+    cycles. Its chains bin/depth-cut like sequence chains; the piece
+    holding the true winner (the deepest node of the max-root chain)
+    is recorded in the returned ``win_src`` stitch so the assembled
+    winner set is exactly the unsplit one.
+
+    Returns ``(seg2, c_parent2, seam_compact_rows, synth_orig,
+    win_src, n_seq_cuts, n_map_cuts)`` or None when nothing splits.
+    ``win_src`` is None when no map segment split.
+    """
+    n = len(seg)
     n_seq = len(seq_rows)
-    if width <= 0 or n_seq == 0:
+    n_map = len(map_rows)
+    if width <= 0 or n == 0:
         return None
-    seg_q = seg[seq_rows]
     n_segs = int(seg.max()) + 1
-    sizes = np.bincount(seg_q, minlength=n_segs)
-    big = sizes > width
-    if not big.any():
-        return None
-    excl = np.zeros(n_segs, bool)
-    rb = rr_s[seq_rows] >= 0
-    if rb.any():
-        excl[np.unique(seg_q[rb])] = True
-    cc = np.bincount(c_parent[c_parent >= 0], minlength=n_seq)
-    branch = cc > 1
-    if branch.any():
-        excl[np.unique(seg_q[branch])] = True
-    # host pointer doubling over the compact parents: chain head +
-    # depth per row (vectorized; log2(n_seq) gathers)
-    idx = np.arange(n_seq, dtype=np.int64)
-    f = np.where(c_parent >= 0, c_parent, idx)
-    d = (c_parent >= 0).astype(np.int64)
-    for _ in range(max(1, (max(n_seq, 2) - 1).bit_length() + 1)):
-        d = d + d[f]
-        f = f[f]
-    # hostile cyclic origins never reach a root; exclude their
-    # segments (the unsplit path already has defined semantics there)
-    incyc = c_parent[f] >= 0
-    if incyc.any():
-        excl[np.unique(seg_q[incyc])] = True
-    cand = big & ~excl
-    if not cand.any():
-        return None
-    clen = np.bincount(f, minlength=n_seq)
-    cl_q = client_s[seq_rows]
-    posd = (int(seq_rows.max()) if n_seq else 0) - seq_rows
-    sub = np.zeros(n_seq, np.int64)
+    sub_full = np.zeros(n, np.int64)
     seam_mask = np.zeros(n_seq, bool)
-    for s in np.flatnonzero(cand).tolist():
-        rows_s = np.flatnonzero(seg_q == s)
-        heads = rows_s[c_parent[rows_s] < 0]
-        horder = np.lexsort((posd[heads], cl_q[heads]))
-        heads_o = heads[horder]
-        # first synthetic id of each head's bin/piece run, aligned
-        # with heads_o — all scratch here is SEGMENT-local (a full
-        # n_seq-wide table per candidate would turn staging
-        # quadratic on many-list documents)
-        head_base = np.zeros(len(heads_o), np.int64)
-        cur = 0
-        fill = 0
-        started = False
-        for i, h in enumerate(heads_o.tolist()):
-            length = int(clen[h])
-            if length > width:
-                if started:
-                    cur += 1
-                    fill = 0
-                    started = False
-                head_base[i] = cur
-                cur += -(-length // width)
-            else:
-                if started and fill + length > width:
-                    cur += 1
-                    fill = 0
-                head_base[i] = cur
-                fill += length
-                started = True
-        # row -> its head's position in heads_o, by binary search
-        hsort = np.argsort(heads_o, kind="stable")
-        hs = heads_o[hsort]
-        r_root = f[rows_s]
-        hpos = hsort[np.searchsorted(hs, r_root)]
-        r_long = clen[r_root] > width
-        sub_s = head_base[hpos] + np.where(
-            r_long, d[rows_s] // width, 0
-        )
-        seam = r_long & (d[rows_s] % width == 0) & (d[rows_s] > 0)
-        seam_mask[rows_s[seam]] = True
-        sub[rows_s] = sub_s
-    maxsub = int(sub.max()) + 1
-    sub_full = np.zeros(len(seg), np.int64)
-    sub_full[seq_rows] = sub
+    n_seq_cuts = 0
+    n_map_cuts = 0
+    win_map: dict = {}
+    did = False
+
+    if n_seq:
+        seg_q = seg[seq_rows]
+        sizes = np.bincount(seg_q, minlength=n_segs)
+        excl = np.zeros(n_segs, bool)
+        if hard_seg_ids:
+            excl[np.asarray(hard_seg_ids, np.int64)] = True
+        # host pointer doubling over the compact parents: chain head +
+        # depth per row (vectorized; log2(n_seq) gathers)
+        idx = np.arange(n_seq, dtype=np.int64)
+        f = np.where(c_parent >= 0, c_parent, idx)
+        d = (c_parent >= 0).astype(np.int64)
+        for _ in range(max(1, (max(n_seq, 2) - 1).bit_length() + 1)):
+            d = d + d[f]
+            f = f[f]
+        # hostile cyclic origins never reach a root; exclude their
+        # segments (the unsplit path already has defined semantics
+        # there)
+        incyc = c_parent[f] >= 0
+        if incyc.any():
+            excl[np.unique(seg_q[incyc])] = True
+        cand = (sizes > width) & ~excl
+        if cand.any():
+            clen = np.bincount(f, minlength=n_seq)
+            cc = np.bincount(c_parent[c_parent >= 0], minlength=n_seq)
+            branchy = np.zeros(n_segs, bool)
+            if (cc > 1).any():
+                branchy[np.unique(seg_q[cc > 1])] = True
+            cl_q = client_s[seq_rows]
+            posd = int(seq_rows.max()) - seq_rows
+            for s in np.flatnonzero(cand).tolist():
+                rows_s = np.flatnonzero(seg_q == s)
+                if branchy[s]:
+                    cp = c_parent[rows_s]
+                    par_l = np.where(
+                        cp >= 0,
+                        np.searchsorted(rows_s, np.clip(cp, 0, None)),
+                        np.int64(-1),
+                    )
+                    pos, cuts = dfs_suffix_boundaries(
+                        par_l, cl_q[rows_s], posd[rows_s], width,
+                        max_pieces=max(2, 4 * len(rows_s) // width),
+                    )
+                    if len(cuts) < 2:
+                        continue
+                    sub_s = np.searchsorted(
+                        cuts, pos, side="right"
+                    ) - 1
+                    seam = (par_l >= 0) & (
+                        sub_s[np.clip(par_l, 0, len(rows_s) - 1)]
+                        != sub_s
+                    )
+                else:
+                    sub_s, seam = _chain_bundle_cut(
+                        rows_s, c_parent, f, d, clen, cl_q, posd,
+                        width,
+                    )
+                sub_full[seq_rows[rows_s]] = sub_s
+                seam_mask[rows_s[seam]] = True
+                n_seq_cuts += int(sub_s.max())
+                did = did or bool(sub_s.max())
+
+    if n_map:
+        seg_m = seg[map_rows]
+        msizes = np.bincount(seg_m, minlength=n_segs)
+        mbig = msizes > width
+        if mbig.any():
+            o = origin_row[map_rows]
+            o_c = np.clip(o, 0, n - 1)
+            same_m = (o >= 0) & (seg[o_c] == seg_m)
+            m_par = np.where(
+                same_m, np.searchsorted(map_rows, o_c), np.int64(-1)
+            )
+            mexcl = np.zeros(n_segs, bool)
+            if rr_s is not None:
+                rb = rr_s[map_rows] >= 0
+                if rb.any():
+                    mexcl[np.unique(seg_m[rb])] = True
+            ccm = np.bincount(m_par[m_par >= 0], minlength=n_map)
+            if (ccm > 1).any():
+                mexcl[np.unique(seg_m[ccm > 1])] = True
+            idx_m = np.arange(n_map, dtype=np.int64)
+            fm = np.where(m_par >= 0, m_par, idx_m)
+            dm = (m_par >= 0).astype(np.int64)
+            for _ in range(
+                max(1, (max(n_map, 2) - 1).bit_length() + 1)
+            ):
+                dm = dm + dm[fm]
+                fm = fm[fm]
+            incyc_m = m_par[fm] >= 0
+            if incyc_m.any():
+                mexcl[np.unique(seg_m[incyc_m])] = True
+            mcand = mbig & ~mexcl
+            if mcand.any():
+                clen_m = np.bincount(fm, minlength=n_map)
+                # head order by compact row index: map pieces never
+                # emit a stream, so any deterministic order works —
+                # index order keeps the win stitch trivial
+                zid = np.zeros(n_map, np.int64)
+                for s in np.flatnonzero(mcand).tolist():
+                    rows_s = np.flatnonzero(seg_m == s)
+                    sub_s, _seam = _chain_bundle_cut(
+                        rows_s, m_par, fm, dm, clen_m, zid,
+                        idx_m, width,
+                    )
+                    if not sub_s.max():
+                        continue
+                    sub_full[map_rows[rows_s]] = sub_s
+                    n_map_cuts += int(sub_s.max())
+                    did = True
+                    # winner stitch: the device's winner root is the
+                    # root run's prefix-argmax read at its end — the
+                    # (max client, min clock) root (see _map_block);
+                    # its chain's deepest node lives in that chain's
+                    # LAST piece. The same argmax inside the winner's
+                    # piece re-elects it (any subset containing the
+                    # global argmax keeps it), so pointing the stitch
+                    # at that piece reads the true unsplit winner
+                    roots = rows_s[m_par[rows_s] < 0]
+                    rcl = client_s[map_rows[roots]]
+                    best = int(roots[rcl == rcl.max()].min())
+                    lo = np.searchsorted(rows_s, best)
+                    base = int(sub_s[lo])
+                    depth_last = (int(clen_m[best]) - 1) // width \
+                        if clen_m[best] > width else 0
+                    win_map[s] = base + depth_last
+
+    if not did:
+        return None
+    maxsub = int(sub_full.max()) + 1
     live = seg >= 0
     key = seg * maxsub + sub_full
     uniq_k, inv = np.unique(key[live], return_inverse=True)
-    seg2 = np.full(len(seg), -1, np.int64)
+    seg2 = np.full(n, -1, np.int64)
     seg2[live] = inv
+    synth_orig = uniq_k // maxsub
     c_parent2 = np.array(c_parent, copy=True)
     c_parent2[seam_mask] = -1
-    return seg2, c_parent2, np.flatnonzero(seam_mask), uniq_k // maxsub
+    win_src = None
+    if win_map:
+        win_src = np.arange(len(uniq_k), dtype=np.int64)
+        for s, wsub in win_map.items():
+            a = int(np.searchsorted(synth_orig, s))
+            b = int(np.searchsorted(synth_orig, s + 1))
+            wid = int(np.searchsorted(uniq_k, s * maxsub + wsub))
+            win_src[a:b] = -1
+            win_src[a] = wid
+    return (seg2, c_parent2, np.flatnonzero(seam_mask), synth_orig,
+            win_src, n_seq_cuts, n_map_cuts)
+
+
+def _chain_bundle_cut(rows_s, c_parent, f, d, clen, cl_q, posd,
+                      width: int):
+    """The round-13 vectorized cut of ONE pure-chain-bundle segment
+    (every member has at most one child): short chains pack greedily
+    into <=``width`` synthetic pieces in head sibling order (client
+    asc, clock desc — the staged sibling key); a chain longer than
+    ``width`` takes consecutive EXCLUSIVE pieces, one per
+    depth-``width`` slab. Pieces are numbered in exact document
+    order. Returns ``(sub_s, seam_mask_local)`` aligned with
+    ``rows_s``."""
+    heads = rows_s[c_parent[rows_s] < 0]
+    horder = np.lexsort((posd[heads], cl_q[heads]))
+    heads_o = heads[horder]
+    # first synthetic id of each head's bin/piece run, aligned
+    # with heads_o — all scratch here is SEGMENT-local (a full
+    # compact-width table per candidate would turn staging
+    # quadratic on many-list documents)
+    head_base = np.zeros(len(heads_o), np.int64)
+    cur = 0
+    fill = 0
+    started = False
+    for i, h in enumerate(heads_o.tolist()):
+        length = int(clen[h])
+        if length > width:
+            if started:
+                cur += 1
+                fill = 0
+                started = False
+            head_base[i] = cur
+            cur += -(-length // width)
+        else:
+            if started and fill + length > width:
+                cur += 1
+                fill = 0
+            head_base[i] = cur
+            fill += length
+            started = True
+    # row -> its head's position in heads_o, by binary search
+    hsort = np.argsort(heads_o, kind="stable")
+    hs = heads_o[hsort]
+    r_root = f[rows_s]
+    hpos = hsort[np.searchsorted(hs, r_root)]
+    r_long = clen[r_root] > width
+    sub_s = head_base[hpos] + np.where(
+        r_long, d[rows_s] // width, 0
+    )
+    seam = r_long & (d[rows_s] % width == 0) & (d[rows_s] > 0)
+    return sub_s, seam
 
 
 def stage(cols: Dict[str, np.ndarray],
@@ -887,26 +1127,57 @@ def _stage(cols: Dict[str, np.ndarray],
     else:
         c_parent = np.empty(0, np.int64)
 
-    # chain split (round 13): re-cut oversized pure-chain segments
-    # into bounded-length synthetic chain segments, dropping the
-    # Wyllie doubling bound from ceil(log2(longest list)) to
+    # right-origin attachment ordering (mid-inserts/prepends): groups
+    # with in-group anchors get their exact conflict-scan ranks
+    # written INTO the client column (ranks are unique per group, so
+    # the id tie-break never fires and the sibling tables need no
+    # change); inexpressible shapes mark their segments hard for the
+    # scalar fallback at gather. Since round 23 this runs BEFORE the
+    # subtree split: with the ranks baked into client_s the sibling
+    # comparator — hence the DFS stream any suffix cut preserves — is
+    # exact, so benign right-bearing segments become split candidates
+    # and only HARD segments stay pinned
+    hard_rep_rows: list = []
+    hard_seg_ids: list = []
+    if "right_client" in cols:
+        client_s, hard_rep_rows, _, hard_seg_ids = _stage_rights(
+            cols, order, ikey_s, uniq, seg, origin_row, oc_s, seq_rows,
+            uniq_valid, kid_s, client_s.copy(), client[order],
+            clock[order],
+        )
+
+    # subtree split (rounds 13 + 23): re-cut oversized sequence
+    # segments at DFS-suffix subtree granularity — branching trees
+    # included — and deep LWW map key chains at depth granularity
+    # into bounded-size synthetic segments, dropping BOTH device
+    # doubling bounds from ceil(log2(deepest structure)) to
     # ceil(log2(split width)) — and giving the multi-chip sharder
     # independent pieces to spread across chips
+    map_rows = np.flatnonzero(is_map_row)
+    n_map = len(map_rows)
     synth_orig = None
     seam_compact = np.empty(0, np.int64)
+    win_src = None
+    n_seq_cuts = n_map_cuts = 0
     w_split = chain_split_width()
-    if w_split and n_seq:
+    if w_split and (n_seq or n_map):
         rr_all = (np.asarray(cols["right_client"], np.int64)[order]
                   if "right_client" in cols
                   else np.full(n, -1, np.int64))
-        split = _chain_split(
-            seg, seq_rows, c_parent, client_s, rr_all, w_split
+        split = _subtree_split(
+            seg, seq_rows, c_parent, client_s, w_split,
+            hard_seg_ids, map_rows, origin_row, rr_all,
         )
         if split is not None and len(split[3]) < _SEQ_FLAG:
-            seg, c_parent, seam_compact, synth_orig = split
+            (seg, c_parent, seam_compact, synth_orig, win_src,
+             n_seq_cuts, n_map_cuts) = split
             n_segs = len(synth_orig)
-            bc2 = np.bincount(seg[seq_rows], minlength=1)
-            max_seq = int(bc2.max()) if len(bc2) else 1
+            if n_seq:
+                bc2 = np.bincount(seg[seq_rows], minlength=1)
+                max_seq = int(bc2.max())
+            if n_map:
+                bcm = np.bincount(seg[map_rows], minlength=1)
+                max_map = int(bcm.max())
 
     # size buckets early: eager shipping needs the padded widths now,
     # and the int32-index guard must run BEFORE the first put — an
@@ -954,9 +1225,10 @@ def _stage(cols: Dict[str, np.ndarray],
     # contiguous run ordered (client asc, clock asc), so the device's
     # segmented argmax scan reads each run's last child at its END —
     # the sort + run-edge chain of lww.map_winners collapses to one
-    # VMEM pass at map-bucket width M, not padded n
-    map_rows = np.flatnonzero(is_map_row)
-    n_map = len(map_rows)
+    # VMEM pass at map-bucket width M, not padded n. Runs on the
+    # POST-split segment column: a split map chain's pieces parent
+    # within their own synthetic segment only, so the same-segment
+    # test below cuts each piece's chain at its seam for free
     map_key = np.full(M, -1, np.int64)
     chain_end = np.full(M, -1, np.int64)
     root_end = np.full(Sb, -1, np.int64)
@@ -994,20 +1266,6 @@ def _stage(cols: Dict[str, np.ndarray],
         w_all.update(w2)
         shipped += f2.nbytes
         d2 = put(f2)
-
-    # right-origin attachment ordering (mid-inserts/prepends): groups
-    # with in-group anchors get their exact conflict-scan ranks
-    # written INTO the client column (ranks are unique per group, so
-    # the id tie-break never fires and the sibling tables need no
-    # change); inexpressible shapes mark their segments hard for the
-    # scalar fallback at gather
-    hard_rep_rows: list = []
-    if "right_client" in cols:
-        client_s, hard_rep_rows, _ = _stage_rights(
-            cols, order, ikey_s, uniq, seg, origin_row, oc_s, seq_rows,
-            uniq_valid, kid_s, client_s.copy(), client[order],
-            clock[order],
-        )
 
     # group 1 sections (after the rank overwrites): the sequence
     # forest's sibling tables. ONE host lexsort by (parent, client,
@@ -1071,12 +1329,17 @@ def _stage(cols: Dict[str, np.ndarray],
         np.add.at(counts_asm, first_idx[inv_o], counts[:n_segs])
 
     rank_rounds_v = _even_up((max_seq + 2).bit_length() + 1)
+    map_rounds_v = _even_up((max_map + 2).bit_length() + 1)
     tracer = get_tracer()
     if tracer.enabled:
-        # the doubling-rounds bound this plan's dispatch will run —
-        # the chain-split lever's regression evidence (lower = fewer
-        # random-gather rounds on the device)
+        # the doubling-rounds bounds this plan's dispatch will run —
+        # the subtree-split lever's regression evidence (lower =
+        # fewer random-gather rounds on the device), plus the cut
+        # counts that explain WHY a bound moved
         tracer.gauge("converge.wyllie_rounds", rank_rounds_v)
+        tracer.gauge("converge.map_rounds", map_rounds_v)
+        tracer.gauge("converge.subtree_cuts", n_seq_cuts)
+        tracer.gauge("converge.map_chain_cuts", n_map_cuts)
         if len(seam_compact):
             tracer.count("converge.chain_seams", len(seam_compact))
         if doc is not None:
@@ -1085,6 +1348,13 @@ def _stage(cols: Dict[str, np.ndarray],
             # amortizes the fixed floor across that many tenants)
             tracer.count("converge.docs_packed",
                          len(np.unique(doc[valid])))
+
+    # map-winner stitch, padded to the segment bucket with identity
+    # (pad slots read their own — always -1 — winner)
+    win_src_pad = None
+    if win_src is not None:
+        win_src_pad = np.arange(Sb, dtype=np.int64)
+        win_src_pad[:len(win_src)] = win_src
 
     map_back = np.full(M, NULLI, np.int32)
     if n_map:
@@ -1101,7 +1371,7 @@ def _stage(cols: Dict[str, np.ndarray],
         order=order,
         clients=uniq,
         rank_rounds=rank_rounds_v,
-        map_rounds=_even_up((max_map + 2).bit_length() + 1),
+        map_rounds=map_rounds_v,
         hard_rows=tuple(hard_rep_rows),
         staged_widths=tuple(sorted(w_all.items())),
         encs=encs,
@@ -1111,6 +1381,7 @@ def _stage(cols: Dict[str, np.ndarray],
         seam_rows=tuple(
             np.asarray(order)[seq_rows[seam_compact]].tolist()
         ) if len(seam_compact) else (),
+        win_src=win_src_pad,
     )
 
 
@@ -1516,11 +1787,13 @@ def stage_resident_delta(client, clock, pref, kid, oc, ock,
     jax.jit,
     donate_argnums=(0,),
     static_argnames=("num_segments", "sel_bucket", "seq_bucket",
-                     "mode"),
+                     "mode", "rank_rounds", "map_rounds"),
 )
 def _splice_select_converge(mat, delta8, n_off,
                             num_segments: int, sel_bucket: int,
-                            seq_bucket: int, mode: str = "jnp"):
+                            seq_bucket: int, mode: str = "jnp",
+                            rank_rounds: Optional[int] = None,
+                            map_rounds: Optional[int] = None):
     """Incremental warm dispatch — exactly THREE host<->device
     interactions per round: ONE upload (``delta8``: the packed delta
     columns with the touched-segment keys riding as row 7 — ascending
@@ -1558,6 +1831,7 @@ def _splice_select_converge(mat, delta8, n_off,
         client[sel_rows], clock[sel_rows], pref[sel_rows], kid[sel_rows],
         oc[sel_rows], ock[sel_rows], sub_valid,
         num_segments=num_segments, seq_bucket=seq_bucket, mode=mode,
+        rank_rounds=rank_rounds, map_rounds=map_rounds,
     )
     packed_out = jnp.concatenate([
         out, jnp.where(sub_valid, sel_rows, NULLI).astype(jnp.int32)
@@ -1641,7 +1915,9 @@ def stage_pooled_delta(client, clock, pref, kid, oc, ock, slot,
 
 def _pool_splice_body(mat, delta8, pos, touched_sorted, cbase, pbase,
                       num_segments: int, sel_bucket: int,
-                      seq_bucket: int, mode: str):
+                      seq_bucket: int, mode: str,
+                      rank_rounds: Optional[int] = None,
+                      map_rounds: Optional[int] = None):
     """Shared traced body of the pooled splice+select+converge (see
     :func:`_pool_splice_select_converge` for the contract)."""
     mat = mat.at[:, pos].set(delta8.astype(mat.dtype), mode="drop")
@@ -1669,6 +1945,7 @@ def _pool_splice_body(mat, delta8, pos, touched_sorted, cbase, pbase,
         client[sel_rows], clock[sel_rows], pref[sel_rows], kid[sel_rows],
         oc[sel_rows], ock[sel_rows], sub_valid,
         num_segments=num_segments, seq_bucket=seq_bucket, mode=mode,
+        rank_rounds=rank_rounds, map_rounds=map_rounds,
     )
     packed_out = jnp.concatenate([
         out, jnp.where(sub_valid, sel_rows, NULLI).astype(jnp.int32)
@@ -1680,12 +1957,14 @@ def _pool_splice_body(mat, delta8, pos, touched_sorted, cbase, pbase,
     jax.jit,
     donate_argnums=(0,),
     static_argnames=("num_segments", "sel_bucket", "seq_bucket",
-                     "mode"),
+                     "mode", "rank_rounds", "map_rounds"),
 )
 def _pool_splice_select_converge(mat, delta8, pos, touched_sorted,
                                  cbase, pbase,
                                  num_segments: int, sel_bucket: int,
-                                 seq_bucket: int, mode: str = "jnp"):
+                                 seq_bucket: int, mode: str = "jnp",
+                                 rank_rounds: Optional[int] = None,
+                                 map_rounds: Optional[int] = None):
     """One warm dispatch for EVERY pooled doc's delta: scatter-splice
     the combined delta block into the pooled matrix (donated) at the
     docs' extent positions, compose doc-composite client / origin /
@@ -1704,18 +1983,21 @@ def _pool_splice_select_converge(mat, delta8, pos, touched_sorted,
     return _pool_splice_body(
         mat, delta8, pos, touched_sorted, cbase, pbase,
         num_segments, sel_bucket, seq_bucket, mode,
+        rank_rounds, map_rounds,
     )
 
 
 @partial(
     jax.jit,
     static_argnames=("num_segments", "sel_bucket", "seq_bucket",
-                     "mode"),
+                     "mode", "rank_rounds", "map_rounds"),
 )
 def _pool_splice_select_converge_nodonate(
         mat, delta8, pos, touched_sorted, cbase, pbase,
         num_segments: int, sel_bucket: int,
-        seq_bucket: int, mode: str = "jnp"):
+        seq_bucket: int, mode: str = "jnp",
+        rank_rounds: Optional[int] = None,
+        map_rounds: Optional[int] = None):
     """Undonated twin of :func:`_pool_splice_select_converge` for
     repeat-dispatch consumers (bench probes re-driving one staged
     pool, CPU hosts where donation only warns) — same contract, the
@@ -1723,6 +2005,7 @@ def _pool_splice_select_converge_nodonate(
     return _pool_splice_body(
         mat, delta8, pos, touched_sorted, cbase, pbase,
         num_segments, sel_bucket, seq_bucket, mode,
+        rank_rounds, map_rounds,
     )
 
 
@@ -1870,6 +2153,14 @@ def _assemble_result(plan: PackedPlan, h: np.ndarray) -> PackedResult:
     s = plan.num_segments
     b = plan.seq_bucket
     win = h[:s]
+    if plan.win_src is not None:
+        # map-chain split stitch (round 23): a split map segment's
+        # true winner lives in the piece holding its max-root chain's
+        # bottom; the first piece reads it from there and the other
+        # pieces mute (their locally-converged winners are interior
+        # chain nodes of the unsplit segment)
+        src = plan.win_src
+        win = np.where(src >= 0, win[np.clip(src, 0, s - 1)], -1)
     perm = h[s:s + b]
     counts = plan.seg_counts
     k = int(counts.sum())

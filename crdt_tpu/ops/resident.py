@@ -767,6 +767,12 @@ class ResidentPool:
                     num_segments=tpad, sel_bucket=sel_bucket,
                     seq_bucket=sel_bucket,
                     mode=pk.kernel_mode_for(sel_bucket),
+                    # None = sel_bucket bound: device-side segment
+                    # numbering root-attaches in-flight-origin rows,
+                    # so host `_seg_rows` counts can undercount the
+                    # device populations (see the private-round note
+                    # in models/incremental.py)
+                    rank_rounds=None, map_rounds=None,
                 )
                 return mat2, xfer_fetch(
                     packed_out, label="incremental.out"

@@ -10,11 +10,15 @@ segment granularity across the mesh:
    (parent ref + key), and whole segments greedy-balance across K
    shards by row count. YATA origins and LWW key chains never cross
    segments, so every shard's converge is independent — Wyllie
-   doubling never crosses a chip. (A pure append chain longer than
-   the staging chain-split width was already re-cut into bounded
-   synthetic chain segments by :func:`crdt_tpu.ops.packed._chain_split`
-   INSIDE its shard, so per-shard doubling runs
-   ceil(log2(split width)) rounds, not ceil(log2(longest list)).)
+   doubling never crosses a chip. A DOMINATING sequence segment is
+   pre-cut at DFS-suffix subtree granularity (round 23, the same
+   :func:`crdt_tpu.ops.packed.dfs_suffix_boundaries` cut the staging
+   split uses) and its pieces spread ACROSS chips — one hot list no
+   longer bounds one shard. Inside each shard, anything still over
+   the staging split width is re-cut by
+   :func:`crdt_tpu.ops.packed._subtree_split`, so per-shard doubling
+   runs ceil(log2(split width)) rounds, not
+   ceil(log2(deepest structure)).
 2. **Stage** — each shard runs the ordinary packed staging
    (layout-only), then every shard's eight sections are padded to
    COMMON bucket sizes and narrow-encoded with ONE shared encoding
@@ -169,8 +173,25 @@ def _partition(cols, K: int):
     K depth-weighted shards (:func:`_chain_weights` — segments weigh
     ``rows x ceil(log2(chain_len))``, the Wyllie rounds bound, so a
     deep chain and a wide segment of equal row count no longer read
-    as equal work). Returns a list of caller-row index arrays (some
-    possibly empty: fewer segments than shards).
+    as equal work). Returns ``(shard_rows, pb_tag)``: a list of
+    caller-row index arrays (some possibly empty: fewer segments
+    than shards) and the cross-shard pre-cut's parent-ref tag column
+    (None when nothing was pre-cut — see below), or None for an
+    empty union.
+
+    A DOMINATING sequence segment (more rows than ``total // K``) no
+    longer bounds one shard: it is pre-cut at DFS-suffix subtree
+    granularity (:func:`crdt_tpu.ops.packed.dfs_suffix_boundaries` —
+    the exact round-23 staging cut, so concatenating the pieces in
+    piece order reproduces the segment's stream bit-for-bit) and the
+    pieces assign MONOTONICALLY across shards. ``pb_tag`` carries
+    per-piece offsets of ``(piece+1) << 45`` for the ``parent_b``
+    column: each piece becomes its own full segment identity inside
+    its shard (the offsets cannot collide with real parent refs, all
+    < 2^44 by the guard), pieces order by tag within a shard and by
+    shard across shards — so the assembler's same-parent run merge
+    stitches them back in exact document order with no new seam
+    plumbing.
 
     Duplicate ids are dropped GLOBALLY first (keep the first caller
     row, packed._stage's rule): equal-id rows under different parents
@@ -224,7 +245,7 @@ def _partition(cols, K: int):
             bins[d] = b
             loads[b] += int(weights[d])
         shard_of_row = bins[doc_inv]
-        return [idx[shard_of_row == k] for k in range(K)]
+        return [idx[shard_of_row == k] for k in range(K)], None
     pir = np.asarray(cols["parent_is_root"], bool)[idx]
     pa = np.asarray(cols["parent_a"], np.int64)[idx]
     pb = np.asarray(cols["parent_b"], np.int64)[idx]
@@ -243,19 +264,109 @@ def _partition(cols, K: int):
     seg_oc = np.bincount(
         seg, weights=oc_live, minlength=len(counts)
     ).astype(np.int64)
+
+    # cross-shard subtree pre-cut (round 23): a dominating sequence
+    # segment's DFS-suffix pieces spread across chips instead of
+    # bounding one shard. The pieces' loads pre-seed the greedy bins;
+    # cut segments skip the whole-segment loop below.
+    pb_tag = None
+    piece_of = {}     # seg id -> (rows_s, piece index per row)
+    loads = np.zeros(K, np.int64)
+    big = np.flatnonzero(
+        counts > max(2048, len(idx) // max(K, 1))
+    )
+    if len(big):
+        cl_i = np.asarray(cols["client"], np.int64)[idx]
+        ck_i = np.asarray(cols["clock"], np.int64)[idx]
+        rr_i = (np.asarray(cols["right_client"], np.int64)[idx]
+                if "right_client" in cols
+                else np.full(len(idx), -1, np.int64))
+        oc_i = np.asarray(cols["origin_client"], np.int64)[idx]
+        ock_i = np.asarray(cols["origin_clock"], np.int64)[idx]
+        kid_i = np.asarray(cols["key_id"], np.int64)[idx]
+        pb_i = np.asarray(cols["parent_b"], np.int64)[idx]
+        uniq_cl = np.unique(cl_i)
+        # id-key packing + tag-offset guards, packed._stage's bounds:
+        # skip the pre-cut (never the route) when a bound trips
+        feasible = (
+            len(uniq_cl) < (1 << 22)
+            and int(ck_i.max(initial=0)) < (1 << packed._CLOCK_BITS)
+            and int(ock_i.max(initial=0)) < (1 << packed._CLOCK_BITS)
+            and int(np.abs(pb_i).max(initial=0)) < (1 << 44)
+        )
+        for s in (big.tolist() if feasible else []):
+            rows_s = np.flatnonzero(seg == s)
+            if (kid_i[rows_s] >= 0).any() or (rr_i[rows_s] >= 0).any():
+                continue  # map segments / right origins stay whole
+            # compact-local forest, exactly as packed._stage builds
+            # it: rows in id order, origins resolved same-segment
+            so_l = np.lexsort((ck_i[rows_s], cl_i[rows_s]))
+            rs = rows_s[so_l]
+            cd = np.searchsorted(uniq_cl, cl_i[rs])
+            ikey_l = (cd << packed._CLOCK_BITS) | ck_i[rs]
+            ocd = np.searchsorted(uniq_cl, np.clip(oc_i[rs], 0, None))
+            okey_l = np.where(
+                oc_i[rs] >= 0,
+                (ocd << packed._CLOCK_BITS) | ock_i[rs], np.int64(-1),
+            )
+            p = np.searchsorted(ikey_l, okey_l)
+            pc = np.clip(p, 0, len(rs) - 1)
+            par_l = np.where(
+                (okey_l >= 0) & (ikey_l[pc] == okey_l), pc, -1
+            )
+            # hostile cyclic origins: the unsplit path's semantics
+            # must stand — leave the segment whole
+            m = len(rs)
+            f = np.where(par_l >= 0, par_l,
+                         np.arange(m, dtype=np.int64))
+            for _ in range(max(1, (max(m, 2) - 1).bit_length() + 1)):
+                f = f[f]
+            if (par_l[f] >= 0).any():
+                continue
+            width = -(-m // K)
+            pos, cuts = packed.dfs_suffix_boundaries(
+                par_l, cd, (m - 1) - np.arange(m, dtype=np.int64),
+                width, max_pieces=2 * K + 2,
+            )
+            if len(cuts) < 2:
+                continue
+            piece = (np.searchsorted(cuts, pos, side="right")
+                     - 1).astype(np.int64)
+            np_c = len(cuts)
+            pshard = (piece * K) // np_c  # monotone piece -> shard
+            prows = np.bincount(piece, minlength=np_c)
+            in_piece = (par_l >= 0) & (
+                piece[np.clip(par_l, 0, m - 1)] == piece
+            )
+            poc = np.bincount(
+                piece, weights=in_piece, minlength=np_c
+            ).astype(np.int64)
+            pw = _chain_weights(prows, poc)
+            for j in range(np_c):
+                loads[(j * K) // np_c] += int(pw[j])
+            if pb_tag is None:
+                pb_tag = np.zeros(
+                    len(np.asarray(cols["valid"])), np.int64
+                )
+            pb_tag[idx[rs]] = (piece + 1) << 45
+            piece_of[s] = (rs, pshard)
+
     # greedy balance by DEPTH-WEIGHTED load, heaviest segments first
-    # into the lightest bin (a single huge segment still bounds one
-    # shard — the honest limit of segment parallelism; chain-split
-    # softens it by re-cutting pure append chains inside the shard)
+    # into the lightest bin (a single huge segment no longer bounds
+    # one shard — its pre-cut pieces are already seeded above; only
+    # refused shapes keep the honest whole-segment limit)
     weights = _chain_weights(counts, seg_oc)
     bins = np.zeros(len(counts), np.int64)
-    loads = np.zeros(K, np.int64)
     for s in np.argsort(-weights, kind="stable"):
+        if s in piece_of:
+            continue
         b = int(np.argmin(loads))
         bins[s] = b
         loads[b] += int(weights[s])
     shard_of_row = bins[seg]
-    return [idx[shard_of_row == k] for k in range(K)]
+    for s, (rs, pshard) in piece_of.items():
+        shard_of_row[rs] = pshard
+    return [idx[shard_of_row == k] for k in range(K)], pb_tag
 
 
 # per-section pad values for the common-bucket repad (seg_off pads
@@ -324,11 +435,21 @@ def stage(cols, n_shards: Optional[int] = None) -> Optional[ShardPlan]:
     K = shard_count(n_shards)
     if K <= 1:
         return None
-    shard_rows = _partition(cols, K)
-    if shard_rows is None:
+    part = _partition(cols, K)
+    if part is None:
         return None
+    shard_rows, pb_tag = part
 
     col_arrays = {k: np.asarray(v) for k, v in cols.items()}
+    if pb_tag is not None:
+        # cross-shard pre-cut: the piece tags ride a COPY of the
+        # parent_b column (the caller's cols stay untouched — a
+        # fallback to the single-chip path must see the original
+        # refs). Tags only shape segment identity and pref order;
+        # assembly decodes parents from dec, never from this column.
+        col_arrays["parent_b"] = (
+            col_arrays["parent_b"].astype(np.int64) + pb_tag
+        )
     layouts = []  # (plan, secs, rows) per non-empty shard; None empty
     for rows_k in shard_rows:
         if not len(rows_k):
@@ -399,9 +520,17 @@ def stage(cols, n_shards: Optional[int] = None) -> Optional[ShardPlan]:
         sb[: len(plan.seq_back)] = plan.seq_back
         sc = np.zeros(S2, np.int64)
         sc[: len(plan.seg_counts)] = plan.seg_counts
+        ws = plan.win_src
+        if ws is not None:
+            # identity pad to the common bucket (pad slots read their
+            # own — always empty — winner), keeping _assemble_result's
+            # index math valid at S2
+            ws2 = np.arange(S2, dtype=np.int64)
+            ws2[: len(ws)] = ws
+            ws = ws2
         plans.append(plan._replace(
             num_segments=S2, seq_bucket=B2, map_bucket=M2,
-            map_back=mb, seq_back=sb, seg_counts=sc,
+            map_back=mb, seq_back=sb, seg_counts=sc, win_src=ws,
         ))
         row_maps.append(np.asarray(rows_k, np.int64))
 

@@ -116,6 +116,20 @@ def test_bench_smoke_mode(tmp_path):
     assert "shard.shards" in report["gauges"]
     assert "converge.wyllie_rounds" in report["gauges"]
 
+    # the round-23 subtree-split registry: the smoke replays a small
+    # branching-tree + deep-map-chain doc at a tiny width — a shape
+    # the round-13 chain split refused outright — byte-identical to
+    # the split-disabled plan (asserted inside the leg, which also
+    # requires the cut counts to fire), and the gauges the --conflict
+    # regression gate reads stay in the registry (the final report
+    # carries the LAST staging's values, so only presence pins here;
+    # the flag rides the artifact — the stdout line's 1500-byte
+    # budget drops it, like phases_numpy_s)
+    assert full.get("subtree_split_ok") is True
+    assert "converge.subtree_cuts" in report["gauges"]
+    assert "converge.map_chain_cuts" in report["gauges"]
+    assert "converge.map_rounds" in report["gauges"]
+
     # the round-14 multi-tenant registry: the smoke runs a tiny
     # mixed-tenant batch through MultiDocServer, digest-identical to
     # the per-doc baseline, and publishes the gated keys + tenant.*

@@ -189,8 +189,9 @@ def test_doc_first_shard_partition():
     decs = [rp.decode(bs) for bs in doc_sets]
     staged = [rp.stage(d) for d in decs]
     comb, row_off = _concat_cols([c for c, _ in staged])
-    parts = shard._partition(comb, 2)
+    parts, pb_tag = shard._partition(comb, 2)
     assert parts is not None and len(parts) == 2
+    assert pb_tag is None  # multi-doc unions never pre-cut
     doc_col = comb["doc"]
     seen = {}
     for k, rows in enumerate(parts):

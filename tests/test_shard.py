@@ -273,11 +273,10 @@ class TestChainSplit:
         monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "64")
         assert run_single(dec, cols, ds) == want
 
-    def test_split_skips_branching_and_right_segments(self,
-                                                      monkeypatch):
-        """Shapes the split must refuse: branching trees (a node with
-        two children) and right-bearing segments stay unsplit — and
-        stay exact."""
+    def test_branching_trees_now_split(self, monkeypatch):
+        """Round 23: branching trees are CUT CANDIDATES — a wide star
+        splits at subtree granularity (real seams, byte-identical),
+        where round 13 refused it segment-wide."""
         recs = []
         for k in range(200):  # wide star: every op anchors the root op
             recs.append(ItemRecord(
@@ -286,11 +285,32 @@ class TestChainSplit:
             ))
         blobs = [v1.encode_update(recs, DeleteSet())]
         dec, cols, ds = stage_all(blobs)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+        want = run_single(dec, cols, ds)
         monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "16")
         plan = packed.stage(cols)
-        assert plan.seam_rows == ()  # refused: branching
+        assert len(plan.seam_rows) > 0  # the star really cut
+        assert run_single(dec, cols, ds) == want
+
+    def test_split_skips_cyclic_origin_segments(self, monkeypatch):
+        """Hostile cyclic origins: the unsplit path's semantics must
+        stand — the cycle's segment stays whole and exact."""
+        recs = [
+            ItemRecord(client=1, clock=0, parent_root="cyc",
+                       origin=(1, 1), content=0),
+            ItemRecord(client=1, clock=1, parent_root="cyc",
+                       origin=(1, 0), content=1),
+        ]
+        recs += [ItemRecord(client=1, clock=2 + k, parent_root="cyc",
+                            content=k) for k in range(60)]
+        blobs = [v1.encode_update(recs, DeleteSet())]
+        dec, cols, ds = stage_all(blobs)
         monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
-        assert run_single(dec, cols, ds) is not None
+        want = run_single(dec, cols, ds)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "16")
+        plan = packed.stage(cols)
+        assert plan.seam_rows == ()  # refused: origin cycle
+        assert run_single(dec, cols, ds) == want
 
 
 class TestDepthWeightedPartition:
@@ -330,7 +350,7 @@ class TestDepthWeightedPartition:
         chain with a wide one."""
         n = 128
         cols = self._cols_three_segments(n)
-        parts = shard._partition(cols, 2)
+        parts, _ = shard._partition(cols, 2)
         assert parts is not None and len(parts) == 2
         by_client = []
         for rows in parts:

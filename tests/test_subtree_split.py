@@ -1,0 +1,232 @@
+"""Seeded fuzz for the round-23 SUBTREE split (see
+``packed._subtree_split``).
+
+Random branching trees — every op may anchor ANY prior op, so wide
+stars, caterpillars, and bushy mixes all occur — plus right origins,
+tombstone runs, deep origin-chained map key chains, and hostile
+cyclic origins. Every trace must be BYTE-identical (cache and
+snapshot) between the split-disabled oracle and the split at widths
+{1, odd, default}, on the single-chip packed route and the 1/2/4-way
+sharded route. The rounds reduction itself is pinned via the
+``converge.wyllie_rounds`` / ``converge.map_rounds`` gauges and the
+``converge.subtree_cuts`` / ``converge.map_chain_cuts`` cut counts.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from crdt_tpu.codec import v1
+from crdt_tpu.core.ids import DeleteSet
+from crdt_tpu.core.records import ItemRecord
+from crdt_tpu.models import replay as rp
+from crdt_tpu.obs import Tracer, get_tracer, set_tracer
+from crdt_tpu.ops import packed
+from crdt_tpu.ops import shard
+
+
+@pytest.fixture(autouse=True)
+def _eight_devices():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_sharding(monkeypatch):
+    monkeypatch.delenv(shard.SHARD_ENV, raising=False)
+    monkeypatch.delenv(shard.MIN_ROWS_ENV, raising=False)
+    monkeypatch.delenv(packed._CHAIN_SPLIT_ENV, raising=False)
+
+
+def conflict_trace(n_clients=5, n_ops=140, n_lists=2, map_chain=36,
+                   rights=True, deletes=True, cycles=False, seed=0):
+    """Per-replica blobs over shared lists: random-anchor branching
+    inserts (the subtree-split shape), occasional right-origin
+    mid-inserts, a deep origin-chained run of sets on one hot map
+    key, optional tombstones and a hostile origin 2-cycle."""
+    rng = np.random.default_rng(seed)
+    blobs = []
+    for c in range(n_clients):
+        client = c + 1
+        recs = []
+        per_list = [[] for _ in range(n_lists)]
+        clock = 0
+        for k in range(n_ops):
+            li = int(rng.integers(0, n_lists))
+            anchors = per_list[li]
+            r = float(rng.random())
+            if rights and anchors and r < 0.12:
+                j = int(rng.integers(0, len(anchors)))
+                recs.append(ItemRecord(
+                    client=client, clock=clock, parent_root=f"l{li}",
+                    origin=anchors[j - 1] if j > 0 else None,
+                    right=anchors[j], content=k,
+                ))
+                anchors.insert(j, (client, clock))
+            elif anchors and r < 0.80:
+                # branch: anchor a uniformly random prior own op
+                j = int(rng.integers(0, len(anchors)))
+                recs.append(ItemRecord(
+                    client=client, clock=clock, parent_root=f"l{li}",
+                    origin=anchors[j], content=k,
+                ))
+                anchors.append((client, clock))
+            else:
+                recs.append(ItemRecord(
+                    client=client, clock=clock, parent_root=f"l{li}",
+                    content=k,
+                ))
+                anchors.append((client, clock))
+            clock += 1
+        prev = None
+        for k in range(map_chain):
+            recs.append(ItemRecord(
+                client=client, clock=clock, parent_root="m",
+                key="hot" if k % 4 else f"k{k % 3}",
+                origin=(client, prev) if prev is not None else None,
+                content=k,
+            ))
+            prev = clock
+            clock += 1
+        if cycles and c == 0:
+            recs.append(ItemRecord(
+                client=client, clock=clock, parent_root="cyc",
+                origin=(client, clock + 1), content=0))
+            recs.append(ItemRecord(
+                client=client, clock=clock + 1, parent_root="cyc",
+                origin=(client, clock), content=1))
+            clock += 2
+            for k in range(40):
+                recs.append(ItemRecord(
+                    client=client, clock=clock, parent_root="cyc",
+                    content=k))
+                clock += 1
+        ds = DeleteSet()
+        if deletes:
+            # a contiguous tombstone run plus scattered singles
+            for k in range(5, 5 + n_ops // 8):
+                ds.add(client, k)
+            for k in rng.choice(n_ops, size=n_ops // 20,
+                                replace=False):
+                ds.add(client, int(k))
+        blobs.append(v1.encode_update(recs, ds))
+    return blobs
+
+
+def stage_all(blobs):
+    dec = rp.decode(blobs)
+    cols, ds = rp.stage(dec)
+    return dec, cols, ds
+
+
+def run_single(dec, cols, ds):
+    plan = packed.stage(cols)
+    assert plan is not None
+    res = packed.converge(plan)
+    w, v, o = rp.gather(dec, ds, ("packed", res))
+    return rp.materialize(dec, ds, w, v, o)
+
+
+def run_sharded(dec, cols, ds, K):
+    splan = shard.stage(cols, n_shards=K)
+    assert splan is not None, f"sharded staging refused at K={K}"
+    res = shard.converge(splan)
+    w, v, o = rp.gather(dec, ds, ("packed", res))
+    return rp.materialize(dec, ds, w, v, o)
+
+
+def _set_width(monkeypatch, w):
+    if w is None:  # the default width
+        monkeypatch.delenv(packed._CHAIN_SPLIT_ENV, raising=False)
+    else:
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, w)
+
+
+WIDTHS = ("1", "13", None)  # degenerate, odd, default
+
+
+class TestSubtreeFuzz:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_single_chip_differential(self, seed, monkeypatch):
+        blobs = conflict_trace(seed=seed)
+        dec, cols, ds = stage_all(blobs)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+        want = run_single(dec, cols, ds)
+        for w in WIDTHS:
+            _set_width(monkeypatch, w)
+            assert run_single(dec, cols, ds) == want, f"width={w}"
+
+    @pytest.mark.parametrize("seed", [3, 4])
+    def test_sharded_differential(self, seed, monkeypatch):
+        blobs = conflict_trace(seed=seed)
+        dec, cols, ds = stage_all(blobs)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+        want = run_single(dec, cols, ds)
+        for w in ("13", None):
+            _set_width(monkeypatch, w)
+            # K=1 is by design the single-chip packed route
+            assert run_single(dec, cols, ds) == want, f"width={w} K=1"
+            for K in (2, 4):
+                got = run_sharded(dec, cols, ds, K)
+                assert got == want, f"width={w} K={K}"
+
+    def test_hostile_cycles_stay_exact(self, monkeypatch):
+        blobs = conflict_trace(n_clients=3, n_ops=90, cycles=True,
+                               seed=5)
+        dec, cols, ds = stage_all(blobs)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+        want = run_single(dec, cols, ds)
+        for w in WIDTHS:
+            _set_width(monkeypatch, w)
+            assert run_single(dec, cols, ds) == want, f"width={w}"
+
+    def test_rights_and_tombstones_heavy(self, monkeypatch):
+        """Right-heavy + delete-heavy: right origins pin only their
+        own conflict-scan neighborhood now, not the whole segment."""
+        blobs = conflict_trace(n_clients=4, n_ops=160, rights=True,
+                               deletes=True, seed=6)
+        dec, cols, ds = stage_all(blobs)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+        want = run_single(dec, cols, ds)
+        for w in ("1", "7", None):
+            _set_width(monkeypatch, w)
+            assert run_single(dec, cols, ds) == want, f"width={w}"
+
+    def test_gauges_drop_and_cuts_counted(self, monkeypatch):
+        """The lever: on a branchy + deep-map trace the split lowers
+        BOTH staged rounds bounds, and the new cut gauges fire."""
+        blobs = conflict_trace(n_clients=6, n_ops=200, map_chain=48,
+                               rights=False, deletes=False, seed=7)
+        dec, cols, ds = stage_all(blobs)
+        prev = get_tracer()
+        tracer = set_tracer(Tracer(enabled=True))
+        try:
+            monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+            assert packed.stage(cols) is not None
+            g0 = dict(tracer.report()["gauges"])
+            monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "16")
+            assert packed.stage(cols) is not None
+            g1 = dict(tracer.report()["gauges"])
+        finally:
+            set_tracer(prev)
+        assert g1["converge.wyllie_rounds"] < g0["converge.wyllie_rounds"]
+        assert g1["converge.map_rounds"] < g0["converge.map_rounds"]
+        assert g1["converge.subtree_cuts"] > 0
+        assert g1["converge.map_chain_cuts"] > 0
+        assert g0["converge.subtree_cuts"] == 0
+        assert g0["converge.map_chain_cuts"] == 0
+
+    def test_replay_route_cache_and_snapshot(self, monkeypatch):
+        """The product seam: replay_trace with the split and the
+        sharded route flipped on stays byte-identical end to end."""
+        blobs = conflict_trace(n_clients=4, n_ops=120, seed=8)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "0")
+        base = rp.replay_trace(blobs)
+        monkeypatch.setenv(packed._CHAIN_SPLIT_ENV, "24")
+        split = rp.replay_trace(blobs)
+        assert split.cache == base.cache
+        assert split.snapshot == base.snapshot
+        monkeypatch.setenv(shard.SHARD_ENV, "4")
+        monkeypatch.setenv(shard.MIN_ROWS_ENV, "1")
+        sharded = rp.replay_trace(blobs)
+        assert sharded.cache == base.cache
+        assert sharded.snapshot == base.snapshot

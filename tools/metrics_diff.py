@@ -205,6 +205,17 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     # on a fast machine). Both lower = better.
     (("autopilot", "recovery_ticks"), False),
     (("autopilot", "neighbor_p99_ms"), False),
+    # subtree split (round 23, bench --conflict): the staged
+    # doubling-rounds bounds on the branching hot-list +
+    # deep-map-chain trace at the gated width (lower = better — the
+    # tentpole; counts, never muted by the seconds floor) and the cut
+    # counts (HIGHER = better: a drop to 0 means the branching or
+    # map-chain shapes regressed to refused and the rounds win is
+    # gone even if the gauges happen to match)
+    (("conflict", "converge", "wyllie_rounds"), False),
+    (("conflict", "converge", "map_rounds"), False),
+    (("conflict", "converge", "subtree_cuts"), True),
+    (("conflict", "converge", "map_chain_cuts"), True),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 
@@ -368,12 +379,13 @@ def iter_metrics(old: Dict[str, Any], new: Dict[str, Any]
                 yield f"tracer.{name}", float(xo[name]), \
                     float(xn[name]), name.endswith("_saved"), False
     # the sharded converge's boundary traffic and the staging
-    # doubling-rounds bound (round 13): both lower-is-better, counts
-    # (never muted by the seconds floor). shard.dispatches/shards are
-    # deliberately ungated — how often the sharded route ran is a
-    # workload-mix fact, not a regression signal.
+    # doubling-rounds bounds (rounds 13/23): all lower-is-better,
+    # counts (never muted by the seconds floor). shard.dispatches/
+    # shards are deliberately ungated — how often the sharded route
+    # ran is a workload-mix fact, not a regression signal.
     for section, name in (("counters", "shard.boundary_bytes"),
-                          ("gauges", "converge.wyllie_rounds")):
+                          ("gauges", "converge.wyllie_rounds"),
+                          ("gauges", "converge.map_rounds")):
         a = (old.get("tracer") or {}).get(section, {}).get(name)
         b = (new.get("tracer") or {}).get(section, {}).get(name)
         if _both_numbers(a, b):
