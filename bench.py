@@ -2204,6 +2204,645 @@ def autopilot(argv=None) -> int:
     return 0 if ok else 1
 
 
+def rebalance_leg() -> dict:
+    """The ``--rebalance`` evidence (round 24, ROADMAP item 2's
+    cross-process arc): the fleet placement loop A/B under seeded
+    chaos — three ``FleetNode`` processes on a ``MemFabric``, one
+    flooding tenant beside small steady neighbors, identical
+    submissions and identical STATIC per-tenant budgets twice:
+
+    - **OFF** (oracle): the same three servers, the same in-process
+      controllers (round 22 squeeze/restore) — but NO placement
+      loop: the flooder stays where the ring put it, the squeeze is
+      the only defense, and the sustain load keeps breaching the
+      squeezed budget forever (burn pins high);
+    - **ON**: a :class:`crdt_tpu.fleet.PlacementLoop` consumes the
+      controllers' federated ``rebalance_away`` advice — mangled by
+      a seeded :class:`DuplicateAdviceSchedule` (duplicates +
+      stale-seq replays) — and live-migrates the flooder to a clean
+      process, where the sustain load fits the static budget and the
+      serving burn drains.
+
+    Chaos riding both legs (`net/faults.HandoffFaultSchedule`): every
+    migration ``ack`` on the c->a link is dropped (the epoch-fenced
+    probe path must complete those handoffs: ``migration.recovery``)
+    and every ``commit`` on a->c is duplicated (the dst's idempotent
+    re-ack). The ON leg additionally live-migrates an untouched
+    identity doc a->c mid-stream, then kills process "a" cold and
+    revives it from its snapshot store. A per-tick digest sweep over
+    every doc x process counts double-serves (must be zero — each
+    refused serve bumps ``fleet.fence_rejects{op=serve}``) and the
+    identity doc + steady neighbors must be byte-identical across
+    ON/OFF: digest, state vector, state-as-update, and the round-13
+    snapshot generation. ``tools/metrics_diff.py`` gates
+    ``rebalance.recovery_ticks`` / ``fence_rejects`` / ``forks`` /
+    ``double_serves`` / ``migration_recoveries``."""
+    import tempfile
+
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.ids import DeleteSet
+    from crdt_tpu.core.records import ItemRecord
+    from crdt_tpu.fleet import FleetNode, PlacementLoop
+    from crdt_tpu.fleet.fabric import MemFabric
+    from crdt_tpu.net.faults import (
+        DuplicateAdviceSchedule,
+        HandoffFaultSchedule,
+    )
+    from crdt_tpu.obs import Tracer, set_tracer
+    from crdt_tpu.obs.control import Controller
+    from crdt_tpu.obs.slo import SLOLedger
+    from crdt_tpu.storage.snapshot import SnapshotStore, encode_engine
+
+    seed = int(os.environ.get("BENCH_RB_SEED", 7))
+    flood_ticks = int(os.environ.get("BENCH_RB_FLOOD_TICKS", 8))
+    sustain_ticks = int(os.environ.get("BENCH_RB_SUSTAIN_TICKS", 28))
+    settle_ticks = 8
+    budget_bytes, budget_updates = 2048, 4
+    burn_window = 16
+    recover_lo = 0.25
+    members = ["a", "b", "c"]
+    flooder = "doc"        # ring arc: a (pinned by test_placement)
+    ident = "y"            # ring arc: a — the migrated identity doc
+    steady = ["w", "tenant-0"]   # ring arcs: b, c — never moved
+    docs = [flooder, ident] + steady
+
+    def flood_blob(i: int) -> bytes:
+        # independent single-record update; the UPDATE-COUNT cap is
+        # the working constraint: 4 of them fit the static byte
+        # budget (so a handoff tail never squeezes the destination)
+        # while the squeezed cap of 1 update/tick sheds one of every
+        # sustain pair forever — the self-sustaining breach the
+        # placement loop exists to break
+        return v1.encode_update([ItemRecord(
+            client=10_000 + i, clock=0, parent_root="m",
+            key=f"f{i}", content="f" * 400,
+        )], DeleteSet())
+
+    blob_len = len(flood_blob(0))
+    assert budget_updates * blob_len <= budget_bytes, \
+        "rebalance: a full update-cap tick must fit the byte budget"
+    assert 2 * blob_len > budget_bytes // 4, \
+        "rebalance: a sustain pair must breach the squeezed budget"
+
+    def run(on: bool) -> dict:
+        tracer = set_tracer(Tracer(enabled=True))
+        tmp = tempfile.TemporaryDirectory()
+        chaos = HandoffFaultSchedule(seed, windows=[
+            # every handoff ack on c->a dies: the src must fence-
+            # probe the dst and complete from its reply
+            {"src": "c", "dst": "a", "kinds": ("ack",),
+             "mode": "drop"},
+            # every commit on a->c arrives twice: the dst re-acks
+            # idempotently (and the re-ack dies too)
+            {"src": "a", "dst": "c", "kinds": ("commit",),
+             "mode": "dup"},
+        ])
+        fab = MemFabric(faults=chaos)
+        dead: set = set()
+        nodes: dict = {}
+        ctrls: dict = {}
+        stores: dict = {}
+
+        def make_hint(p):
+            # fleet-layer wiring: never advise moving a tenant onto
+            # a process that is squeezing it (its budget override
+            # would keep breaching) or a dead one
+            def hint(t):
+                excl = [p] + [q for q in members
+                              if q in dead
+                              or str(t) in {str(k) for k in
+                                            ctrls[q].overrides()}]
+                loads = {q: nodes[q].load() for q in members
+                         if q not in dead}
+                return nodes[p].ring.least_loaded_successor(
+                    str(t), exclude=excl, loads=loads)
+            return hint
+
+        def build_node(p):
+            ctrl = Controller(cooldown_ticks=4, restore_after=2)
+            node = FleetNode(
+                p, members, fab, store=stores[p],
+                timeout_ticks=3, beacon_every=4,
+                server_kw=dict(
+                    tenant_max_pending_bytes=budget_bytes,
+                    tenant_max_pending_updates=budget_updates,
+                    slo_ms=1e9,   # sheds drive burn, never clocks
+                    control=ctrl,
+                ))
+            # fast-flushing burn window, the autopilot idiom
+            node.server.slo = SLOLedger(1e9, burn_window=burn_window)
+            ctrl.placement_hint = make_hint(p)
+            nodes[p], ctrls[p] = node, ctrl
+            return node
+
+        for p in members:
+            stores[p] = SnapshotStore(os.path.join(tmp.name, p))
+            build_node(p)
+        ring = nodes["a"].ring
+        adv_chaos = DuplicateAdviceSchedule(
+            seed, duplicate=0.5, replay=0.5)
+        # placement hysteresis ~ a burn window: a flood spike
+        # shorter than that is the squeeze's job — moving a tenant
+        # mid-spike just ships the spike to the destination (the
+        # tail rides the commit) and cascades squeezes around the
+        # ring. Only SUSTAINED pressure pays for a migration.
+        hysteresis = int(os.environ.get("BENCH_RB_HYSTERESIS", 10))
+        loop = PlacementLoop(
+            ring, lambda p: None if p in dead else nodes.get(p),
+            hysteresis=hysteresis, budget_per_tick=1) if on else None
+
+        streams = {d: _SteadyStream(1 + i)
+                   for i, d in enumerate([ident] + steady)}
+        lost = {d: 0 for d in docs}
+
+        def submit(doc, blob):
+            # redirect-chasing client: offer to each live process in
+            # order; exactly one accepts (or the update is lost for
+            # one tick — tolerated ONLY for the flooder, whose sheds
+            # already differ by design)
+            for p in members:
+                if p in dead:
+                    continue
+                r, _info = nodes[p].submit(doc, blob)
+                if r in ("ok", "buffered"):
+                    return r
+            lost[doc] += 1
+            return "lost"
+
+        def flood_owner():
+            for p in members:
+                if p not in dead and nodes[p].lease.holds(flooder) \
+                        and not nodes[p].migrator.migrating(flooder):
+                    return p
+            return None
+
+        total = flood_ticks + sustain_ticks
+        t_ident = flood_ticks + 2
+        t_kill = total - 6
+        t_revive = t_kill + 2
+        nblob = 0
+        recovery = None
+        burn_flood_end = None
+        burn_last = None
+        double_serves = 0
+        tail_restores = 0
+        for t in range(total + settle_ticks):
+            settling = t >= total
+            if not settling:
+                if t < flood_ticks:
+                    for _ in range(10):
+                        submit(flooder, flood_blob(nblob))
+                        nblob += 1
+                else:
+                    # sustain: a pair per tick — fits the static
+                    # update budget, breaches the squeezed one
+                    # (1 update/tick) every single tick: the load
+                    # that makes "squeeze forever" the wrong answer
+                    # and "move it" right
+                    for _ in range(2):
+                        submit(flooder, flood_blob(nblob))
+                        nblob += 1
+                for d in [ident] + steady:
+                    assert submit(d, streams[d].delta(4)) != "lost", \
+                        f"rebalance: steady update lost for {d}"
+            if on:
+                if t == t_ident:
+                    assert nodes["a"].migrate(ident, "c"), \
+                        "rebalance: identity migration refused"
+                if t == t_kill - 1:
+                    nodes["a"].checkpoint()
+                if t == t_kill:
+                    fab.kill("a")
+                    dead.add("a")
+                if t == t_revive:
+                    dead.discard("a")
+                    node = build_node("a")
+                    fab.revive("a", node)
+                    before = tracer.counters().get(
+                        "migration.tail_restores", 0)
+                    node.restore()
+                    tail_restores += tracer.counters().get(
+                        "migration.tail_restores", 0) - before
+            for p in members:
+                if p not in dead:
+                    nodes[p].tick()
+            if on:
+                rows = [dict(r, proc=p)
+                        for p in members if p not in dead
+                        for r in ctrls[p].advice()]
+                # PlacementLoop.observe takes advice rows, not a
+                # metric name  # crdtlint: disable=CL203
+                loop.observe(t, adv_chaos.mangle(t, rows))
+            # the fork guard sweep: every refused serve counts
+            # fleet.fence_rejects{op=serve}; >1 server is a fork
+            for d in docs:
+                n_serving = sum(
+                    1 for p in members
+                    if p not in dead
+                    and nodes[p].digest(d) is not None)
+                if n_serving > 1:
+                    double_serves += 1
+            owner = flood_owner()
+            burn = None
+            if owner is not None:
+                burn = nodes[owner].server.slo.report()[
+                    "tenants"].get(flooder, {}).get("burn_rate")
+            if burn is not None:
+                burn_last = burn
+            if t == flood_ticks - 1:
+                burn_flood_end = burn
+            if (recovery is None and t >= flood_ticks
+                    and burn is not None and burn <= recover_lo):
+                recovery = t - flood_ticks + 1
+        # re-warm the identity doc so the engine snapshot comparison
+        # sees a resident matrix on both legs
+        assert submit(ident, streams[ident].delta(2)) == "ok"
+        for p in members:
+            if p not in dead:
+                nodes[p].tick()
+        serving_ident = [p for p in members if p not in dead
+                         and nodes[p].digest(ident) is not None]
+        assert len(serving_ident) == 1, \
+            f"rebalance: identity doc served by {serving_ident}"
+        counters = dict(tracer.counters())
+        out = {
+            "nodes": nodes, "loop": loop, "fab": fab,
+            "adv_chaos": adv_chaos, "counters": counters,
+            "recovery": recovery, "burn_flood_end": burn_flood_end,
+            "burn_last": burn_last, "double_serves": double_serves,
+            "lost": lost, "tail_restores": tail_restores,
+            "ident_proc": serving_ident[0], "tmp": tmp,
+        }
+        set_tracer(Tracer(enabled=False))
+        return out
+
+    run(True)   # warm (compile paths, page caches) — untimed
+    on = run(True)
+    off = run(False)
+
+    def ident_state(leg):
+        srv = leg["nodes"][leg["ident_proc"]].server
+        eng = srv._docs[ident].resident
+        assert eng is not None, "rebalance: identity doc went cold"
+        return {
+            "digest": srv.digest(ident),
+            "sv": eng.state_vector(),
+            "update": eng.encode_state_as_update(),
+            "snapshot": encode_engine(eng, seq=0),
+        }
+
+    s_on, s_off = ident_state(on), ident_state(off)
+    identical = {
+        "ident_digest": s_on["digest"] == s_off["digest"],
+        "ident_sv": s_on["sv"] == s_off["sv"],
+        "ident_update": s_on["update"] == s_off["update"],
+        "ident_snapshot": s_on["snapshot"] == s_off["snapshot"],
+    }
+    for d in steady:
+        a = [on["nodes"][p].digest(d) for p in members
+             if on["nodes"][p].digest(d) is not None]
+        b = [off["nodes"][p].digest(d) for p in members
+             if off["nodes"][p].digest(d) is not None]
+        identical[f"steady_{d}"] = bool(a) and a == b
+    on["tmp"].cleanup()
+    off["tmp"].cleanup()
+
+    c_on = on["counters"]
+
+    def csum(name: str) -> int:
+        # labeled counters live under name{label=...} keys only
+        return sum(v for k, v in c_on.items()
+                   if k == name or k.startswith(name + "{"))
+
+    hops = [r for r in on["loop"].ledger.rows()
+            if r.get("action") == "migrate"]
+    return {
+        "seed": seed,
+        "flood_ticks": flood_ticks,
+        "sustain_ticks": sustain_ticks,
+        "recovery_ticks": on["recovery"],
+        "recovery_ticks_off": off["recovery"],
+        "recovery_budget_ticks": int(os.environ.get(
+            "BENCH_RB_RECOVERY_BUDGET", 20)),
+        "burn_flood_end": on["burn_flood_end"],
+        "burn_end_off": off["burn_last"],
+        "migrations": on["loop"].migrations,
+        "hops": [{"src": r["src"], "dst": r["dst"],
+                  "tick": r["tick"]} for r in hops],
+        "migrations_completed": csum("migration.completed"),
+        "migration_recoveries": csum("migration.recovery"),
+        "recoveries_by_step": {
+            k.split('step="', 1)[1].rstrip('"}'): v
+            for k, v in c_on.items()
+            if k.startswith('migration.recovery{')},
+        "fence_rejects": csum("fleet.fence_rejects"),
+        "fork_refused": csum("fleet.fork_refused"),
+        "forks": on["double_serves"] + off["double_serves"],
+        "double_serves": on["double_serves"],
+        "tail_blobs": csum("migration.tail_blobs"),
+        "tail_restores": on["tail_restores"],
+        "advice_dups": on["loop"].dup_drops,
+        "advice_injected": on["adv_chaos"].injected,
+        "ledger_rows": on["loop"].ledger.total,
+        "frames_sent": on["fab"].sent,
+        "frames_dropped": on["fab"].dropped,
+        "frames_duplicated": on["fab"].duplicated,
+        "lost_flood_updates": on["lost"][flooder],
+        "identical": identical,
+        "all_identical": all(identical.values()),
+    }
+
+
+def rebalance_child(argv) -> int:
+    """One subprocess fleet server of the ``--rebalance --smoke``
+    leg: a real ``FleetNode`` whose fabric is the round-7 sealed
+    ``UdpEndpoint`` (X25519 static identities derived from
+    deterministic seeds — every child computes every peer's public
+    key offline, no key exchange). Child "a" seeds the doc and
+    live-migrates it to "c" mid-run; the parent asserts exactly one
+    process serves afterwards and the losers' fences counted."""
+    cfg = json.loads(argv[0])
+    idx = int(cfg["idx"])
+    names = list(cfg["names"])
+    me = names[idx]
+    ports = cfg["ports"]
+    outdir = cfg["outdir"]
+    ticks = int(cfg["ticks"])
+
+    from crdt_tpu.codec import v1
+    from crdt_tpu.core.ids import DeleteSet
+    from crdt_tpu.core.records import ItemRecord
+    from crdt_tpu.fleet import FleetNode, UdpFabric
+    from crdt_tpu.net.transport import SecureBox, UdpEndpoint, keypair
+    from crdt_tpu.obs import Tracer, set_tracer
+    from crdt_tpu.storage.snapshot import SnapshotStore
+
+    tracer = set_tracer(Tracer(enabled=True))
+    keys = {n: keypair(bytes([j + 1]) * 32)
+            for j, n in enumerate(names)}
+    _pub, sec = keys[me]
+    peers = {n: ("127.0.0.1", int(ports[j]),
+                 SecureBox(sec, keys[n][0]))
+             for j, n in enumerate(names) if n != me}
+    ep = UdpEndpoint("127.0.0.1", int(ports[idx]))
+    fab = UdpFabric(me, ep, peers)
+    store = SnapshotStore(os.path.join(outdir, me))
+    node = FleetNode(me, names, fab, store=store,
+                     timeout_ticks=25, beacon_every=8,
+                     server_kw={"slo_ms": 1e9})
+
+    # start barrier: frames to an unbound port are lost, so nobody
+    # ticks until every endpoint is up
+    with open(os.path.join(outdir, f"ready_{idx}.json"), "w") as f:
+        json.dump({"port": ep.port}, f)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if all(os.path.exists(os.path.join(outdir, f"ready_{j}.json"))
+               for j in range(len(names))):
+            break
+        time.sleep(0.01)
+
+    def chain_blob(k0, n_ops=4):
+        recs = []
+        for j in range(n_ops):
+            k = k0 + j
+            recs.append(ItemRecord(
+                client=7, clock=k, parent_root="l",
+                origin=(7, k - 1) if k else None,
+                content=7000 + k,
+            ))
+        return v1.encode_update(recs, DeleteSet())
+
+    doc = "doc"           # ring arc: a
+    d0 = None
+    migrate_ok = None
+    # children tick on their own wall clocks (child "a" pays the
+    # first-submit compile inside its loop), so the run ends on a
+    # BARRIER, not a tick count: once "a" marks the handoff
+    # complete, everyone runs a grace window (covers a beacon
+    # cadence — "b" must adopt the new owner) and only then
+    # snapshots its done file
+    handoff_path = os.path.join(outdir, "handoff.json")
+    t = 0
+    grace = None
+    while True:
+        if me == "a":
+            if t < 4:
+                r, _ = node.submit(doc, chain_blob(4 * t))
+                assert r == "ok", f"seed submit: {r}"
+            if t == 4:
+                d0 = node.server.digest(doc)
+            if t == 10:
+                migrate_ok = node.migrate(doc, "c")
+            if migrate_ok and node.migrator.completed >= 1 \
+                    and not os.path.exists(handoff_path):
+                with open(handoff_path + ".tmp", "w") as f:
+                    json.dump({"tick": t}, f)
+                os.replace(handoff_path + ".tmp", handoff_path)
+        ep.poll()
+        node.tick()
+        time.sleep(0.02)
+        t += 1
+        if grace is None:
+            if t >= ticks and os.path.exists(handoff_path):
+                grace = 24
+            elif t > 40 * ticks:   # runaway guard: fail loudly
+                break
+        else:
+            grace -= 1
+            if grace <= 0:
+                break
+
+    served = node.digest(doc)   # fence-refused (+counted) on losers
+    counters = tracer.counters()
+
+    def csum(name):
+        return sum(v for k, v in counters.items()
+                   if k == name or k.startswith(name + "{"))
+    done = {
+        "proc": me,
+        "digest": served,
+        "d0": d0,
+        "lease": list(node.lease.lease(doc)),
+        "migrate_ok": migrate_ok,
+        "completed": node.migrator.completed,
+        "fence_rejects": csum("fleet.fence_rejects"),
+        "fork_refused": csum("fleet.fork_refused"),
+        "udp_failed": ep.failed,
+    }
+    tmp_path = os.path.join(outdir, f"done_{idx}.json.tmp")
+    with open(tmp_path, "w") as f:
+        json.dump(done, f)
+    os.replace(tmp_path, os.path.join(outdir, f"done_{idx}.json"))
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if os.path.exists(os.path.join(outdir, "stop")):
+            break
+        ep.poll()
+        node.drain_inbox()
+        time.sleep(0.01)
+    ep.close()
+    return 0
+
+
+def rebalance_smoke() -> int:
+    """``bench.py --rebalance --smoke``: the subprocess half of the
+    round-24 evidence — three fleet servers in separate OS processes
+    over sealed loopback UDP, one crash-safe live migration between
+    them, fencing asserted from the done files. CPU-only, stdlib +
+    the package's net/fleet layers; the CI leg."""
+    import subprocess
+    import tempfile
+
+    t_start = time.perf_counter()
+    names = ["a", "b", "c"]
+    ticks = int(os.environ.get("BENCH_RB_SMOKE_TICKS", 30))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as outdir:
+        ports = _free_ports(len(names), udp=True)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        procs = []
+        for idx in range(len(names)):
+            cfg = {"idx": idx, "names": names, "ports": ports,
+                   "outdir": outdir, "ticks": ticks}
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.join(repo, "bench.py"),
+                 "--rebalance-child", json.dumps(cfg)],
+                env=env, cwd=repo,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            ))
+        try:
+            done_paths = [os.path.join(outdir, f"done_{i}.json")
+                          for i in range(len(names))]
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                if all(os.path.exists(p) for p in done_paths):
+                    break
+                dead = [p for p in procs if p.poll() not in (None, 0)]
+                if dead:
+                    break
+                time.sleep(0.05)
+            missing = [p for p in done_paths
+                       if not os.path.exists(p)]
+            if missing:
+                for p in procs:
+                    p.kill()
+                tails = [p.communicate()[1][-800:] for p in procs]
+                raise RuntimeError(
+                    f"rebalance children incomplete: {missing} "
+                    f"stderr={tails}"
+                )
+            dones = {}
+            for i, path in enumerate(done_paths):
+                with open(path) as f:
+                    dones[names[i]] = json.load(f)
+        finally:
+            with open(os.path.join(outdir, "stop"), "w") as f:
+                f.write("done")
+            for p in procs:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    servers = [n for n in names if dones[n]["digest"] is not None]
+    leases = {n: dones[n]["lease"] for n in names}
+    ok = (
+        dones["a"]["migrate_ok"] is True
+        and dones["a"]["completed"] == 1
+        and servers == ["c"]
+        and dones["c"]["digest"] == dones["a"]["d0"]
+        and leases["a"] == [2, "c"]
+        and leases["b"] == [2, "c"]   # adopted via ownership beacon
+        and leases["c"] == [2, "c"]
+        and dones["a"]["fence_rejects"] >= 1
+        and sum(dones[n]["fork_refused"] for n in names) == 0
+    )
+    out = {
+        "metric": "rebalance_smoke",
+        "ok": ok,
+        "servers": servers,
+        "leases": leases,
+        "completed": dones["a"]["completed"],
+        "fence_rejects": {n: dones[n]["fence_rejects"]
+                          for n in names},
+        "udp_failed": {n: dones[n]["udp_failed"] for n in names},
+        "elapsed_s": round(time.perf_counter() - t_start, 2),
+    }
+    artifact = os.environ.get("BENCH_REBALANCE_ARTIFACT")
+    if artifact:
+        try:
+            with open(artifact, "w") as f:
+                json.dump({"rebalance_smoke": out,
+                           "dones": dones}, f, indent=1,
+                          sort_keys=True)
+                f.write("\n")
+        except OSError as exc:
+            log(f"{artifact} not written: {exc}")
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+def rebalance(argv=None) -> int:
+    """The ``--rebalance`` harness: run the round-24 fleet chaos A/B
+    leg, merge the gated ``rebalance`` section into BENCH_OUT.json,
+    one summary line on stdout. Non-zero when any fork guard fired
+    (a double-serve or a diverged doc), the fences never rejected
+    anything (the chaos was not exercised), the flooder's serving
+    burn failed to recover within budget under the placement loop,
+    or the migration-free oracle recovered WITHOUT it — evidence
+    that moves documents must prove it moved only the bytes it
+    claimed. ``--smoke`` runs the subprocess UDP leg instead."""
+    if "--smoke" in (argv or []) or "--smoke" in sys.argv[1:]:
+        return rebalance_smoke()
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    leg = rebalance_leg()
+    ok = bool(leg["all_identical"]) \
+        and leg["double_serves"] == 0 \
+        and leg["forks"] == 0 \
+        and leg["fence_rejects"] > 0 \
+        and leg["migrations"] >= 1 \
+        and leg["migrations_completed"] >= 2 \
+        and leg["migration_recoveries"] >= 1 \
+        and leg["advice_dups"] > 0 \
+        and leg["recovery_ticks"] is not None \
+        and leg["recovery_ticks"] <= leg["recovery_budget_ticks"] \
+        and leg["recovery_ticks_off"] is None \
+        and (leg["burn_end_off"] or 0.0) > 0.25
+    if ok:
+        try:
+            with open(BENCH_OUT) as f:
+                full = json.load(f)
+        except (OSError, ValueError):
+            full = {}
+        full["rebalance"] = leg
+        try:
+            with open(BENCH_OUT, "w") as f:
+                json.dump(full, f, indent=1, sort_keys=True)
+                f.write("\n")
+        except OSError as exc:
+            log(f"{BENCH_OUT} not written: {exc}")
+    print(json.dumps({
+        "metric": "rebalance",
+        "ok": ok,
+        "recovery_ticks": leg["recovery_ticks"],
+        "recovery_ticks_off": leg["recovery_ticks_off"],
+        "burn_end_off": leg["burn_end_off"],
+        "migrations": leg["migrations"],
+        "hops": len(leg["hops"]),
+        "fence_rejects": leg["fence_rejects"],
+        "migration_recoveries": leg["migration_recoveries"],
+        "double_serves": leg["double_serves"],
+        "all_identical": leg["all_identical"],
+        "full_results": os.path.basename(BENCH_OUT),
+    }))
+    return 0 if ok else 1
+
+
 def overload_leg(seed: int = 11) -> dict:
     """Seeded overload evidence (guard layer): flood one replica at 4x
     its inbox byte budget in a single delivery round, record the
@@ -4652,6 +5291,13 @@ if __name__ == "__main__":
         _sys_main.exit(autopilot())
     elif "--conflict" in _sys_main.argv[1:]:
         _sys_main.exit(conflict())
+    elif (
+        len(_sys_main.argv) > 1
+        and _sys_main.argv[1] == "--rebalance-child"
+    ):
+        _sys_main.exit(rebalance_child(_sys_main.argv[2:]))
+    elif "--rebalance" in _sys_main.argv[1:]:
+        _sys_main.exit(rebalance(_sys_main.argv[2:]))
     elif (
         "--smoke" in _sys_main.argv[1:]
         or os.environ.get("BENCH_SMOKE") == "1"

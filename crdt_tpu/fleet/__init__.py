@@ -1,0 +1,22 @@
+"""Cross-process fleet serving (ROADMAP item 2, round 24).
+
+Doc-sharded serving across N ``MultiDocServer`` processes:
+deterministic consistent-hash placement with epoch-fenced ownership
+leases (``placement``), crash-safe live migration over the sealed
+transport (``migration``), the node glue (``node``), deterministic
+chaos fabrics (``fabric``), and the placement loop consuming the
+federated ``rebalance_away`` advice (``loop``). README "Fleet
+serving" documents the semantics and the counter registry.
+"""
+
+from .fabric import MemFabric, UdpFabric
+from .loop import PlacementLoop
+from .migration import MIGRATION_STEPS, Migrator, adopt_doc, remove_doc
+from .node import FleetNode
+from .placement import FencingToken, HashRing, LeaseTable, stable_hash
+
+__all__ = [
+    "FencingToken", "FleetNode", "HashRing", "LeaseTable",
+    "MemFabric", "MIGRATION_STEPS", "Migrator", "PlacementLoop",
+    "UdpFabric", "adopt_doc", "remove_doc", "stable_hash",
+]

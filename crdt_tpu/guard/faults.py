@@ -334,3 +334,28 @@ def retry_with_backoff(fn, *, retries: int, backoff_s: float,
             if counter:
                 get_tracer().count(counter)
             time.sleep(backoff_s * (2 ** attempt))
+
+
+class MigrationCrashPlan:
+    """Process-kill-at-step-k for the fleet migration ladder
+    (round 24): :meth:`check` is called at every migration step
+    boundary (``fleet/migration.py``); the k-th occurrence of a
+    scheduled step raises :class:`SimulatedCrash` — the chaos
+    harness catches it and kills that node, exactly like the disk
+    matrix's ``crash_at``. Deterministic by construction: occurrence
+    counts, no clocks, no randomness."""
+
+    def __init__(self, kill_at: Optional[dict] = None):
+        # step name -> 1-based occurrence at which to die
+        self.kill_at = dict(kill_at or {})
+        self.seen: dict = {}
+        self.fired: list = []
+
+    def check(self, step: str) -> None:
+        n = self.seen.get(step, 0) + 1
+        self.seen[step] = n
+        k = self.kill_at.get(step)
+        if k is not None and n == k:
+            self.fired.append(step)
+            raise SimulatedCrash(
+                "migration step %s occurrence %d" % (step, n))

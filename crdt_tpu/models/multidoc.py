@@ -1140,7 +1140,7 @@ class MultiDocServer:
 
     # ---- checkpoint / restore (round 21) -----------------------------
 
-    def checkpoint(self, store=None) -> int:
+    def checkpoint(self, store=None, *, fence=None) -> int:
         """Snapshot the WHOLE resident set into ``store`` (default:
         the attached ``snap_store``). Per resident doc: one snapshot
         generation covering its settled ``blobs`` prefix plus a
@@ -1149,7 +1149,15 @@ class MultiDocServer:
         must), tied together by a manifest sidecar. Docs with
         un-settled in-flight state are skipped (call between ticks
         for full coverage). Returns the number of docs
-        checkpointed; counted ``tenant.checkpoint_docs``."""
+        checkpointed; counted ``tenant.checkpoint_docs``.
+
+        ``fence`` (round 24): a lease view — ``.proc`` plus
+        ``.epoch_of(doc)`` (``fleet.placement.LeaseTable`` or any
+        duck-type) — stamps the checkpoint with the fencing epochs
+        this process held per doc (a separate ``checkpoint.fence``
+        blob; the manifest shape is unchanged). ``restore(fence=)``
+        refuses docs stamped NEWER than the restoring process's
+        lease."""
         from crdt_tpu.storage.snapshot import encode_engine
 
         store = store if store is not None else self.snap_store
@@ -1178,9 +1186,17 @@ class MultiDocServer:
         store.put_blob(
             "checkpoint.manifest",
             json.dumps(manifest, sort_keys=True).encode())
+        if fence is not None:
+            store.put_blob(
+                "checkpoint.fence",
+                json.dumps({
+                    "proc": str(getattr(fence, "proc", "")),
+                    "epochs": {d: int(fence.epoch_of(d))
+                               for d in sorted(manifest)},
+                }, sort_keys=True).encode())
         return done
 
-    def restore(self, store=None) -> int:
+    def restore(self, store=None, *, fence=None) -> int:
         """Rehydrate the resident set a :meth:`checkpoint` wrote —
         the whole-server warm restart. Per manifest doc: snapshot ->
         live engine re-registered with the pool and the resident
@@ -1190,7 +1206,14 @@ class MultiDocServer:
         equivalent doc. A damaged snapshot falls back to the sidecar
         blob COLD (served correctly, promoted on its next touch);
         a missing sidecar skips the doc. Returns docs restored
-        warm."""
+        warm.
+
+        ``fence`` (round 24): a doc stamped with a NEWER fencing
+        epoch than this process holds is REFUSED, not silently
+        adopted — the checkpoint belongs to a lease this process
+        never held (a cross-wired store, a rolled-back lease
+        table), and serving it would fork the doc past the fence.
+        Counted ``snap.fallbacks{reason=stale_epoch}``."""
         from crdt_tpu.storage.snapshot import rehydrate
 
         store = store if store is not None else self.snap_store
@@ -1203,9 +1226,24 @@ class MultiDocServer:
             manifest = json.loads(raw)
         except ValueError:
             return 0
+        stamped = {}
+        if fence is not None:
+            raw_f = store.get_blob("checkpoint.fence")
+            if raw_f:
+                try:
+                    stamped = json.loads(raw_f).get("epochs") or {}
+                except (ValueError, AttributeError):
+                    stamped = {}
         tracer = get_tracer()
         warm = 0
         for d in sorted(manifest):
+            if fence is not None and \
+                    int(stamped.get(d, 0)) > int(fence.epoch_of(d)):
+                self.snap_fallback_count += 1
+                if tracer.enabled:
+                    tracer.count("snap.fallbacks",
+                                 labels={"reason": "stale_epoch"})
+                continue
             hist = store.get_blob("%s.hist" % d)
             if hist is None:
                 continue

@@ -554,3 +554,88 @@ def pump_until(routers, cond, *, timeout_s: float = 30.0,
         for r in routers:
             r.poll()
         time.sleep(sleep_s)
+
+
+# ---------------------------------------------------------------------------
+# fleet handoff chaos (round 24)
+# ---------------------------------------------------------------------------
+
+
+class HandoffFaultSchedule:
+    """Deterministic fault plan for the fleet fabric
+    (``fleet.fabric.MemFabric``): scripted windows keyed on per-link
+    FRAME COUNTS (never clocks) plus seeded background drop/dup.
+
+    ``windows`` rows are dicts: ``{"src", "dst", "kinds", "from_n",
+    "to_n", "mode"}`` — frames number ``from_n``..``to_n``
+    (1-based, inclusive) on link src->dst whose kind is in
+    ``kinds`` (empty = all) get ``mode`` ``"drop"`` (the
+    partition-during-handoff lever: drop exactly the commit/ack
+    exchange) or ``"dup"``. Background ``drop``/``duplicate``
+    probabilities hash like :class:`FaultSchedule`.
+    """
+
+    def __init__(self, seed: int = 0, *, windows=(),
+                 drop: float = 0.0, duplicate: float = 0.0):
+        self.seed = seed
+        self.windows = [dict(w) for w in windows]
+        self.drop = drop
+        self.duplicate = duplicate
+        self.window_hits = 0
+
+    def decide(self, src: str, dst: str, kind: str, n: int) -> dict:
+        d = {"drop": False, "dup": 0}
+        for w in self.windows:
+            if w.get("src") not in (None, src):
+                continue
+            if w.get("dst") not in (None, dst):
+                continue
+            kinds = w.get("kinds") or ()
+            if kinds and kind not in kinds:
+                continue
+            if not int(w.get("from_n", 1)) <= n <= \
+                    int(w.get("to_n", 1 << 30)):
+                continue
+            self.window_hits += 1
+            if w.get("mode", "drop") == "drop":
+                d["drop"] = True
+                return d
+            d["dup"] += 1
+        if self.drop and \
+                _hash01(self.seed, "fdrop", src, dst, n) < self.drop:
+            d["drop"] = True
+            return d
+        if self.duplicate and \
+                _hash01(self.seed, "fdup", src, dst, n) < self.duplicate:
+            d["dup"] += 1
+        return d
+
+
+class DuplicateAdviceSchedule:
+    """Seeded advice-row duplication/replay for the placement loop's
+    idempotence proof: ``mangle(poll, rows)`` returns the rows plus
+    seeded duplicates of this poll's rows and replays of earlier
+    polls' rows (stale seqs) — the consumer must dedup on
+    ``(proc, tenant, seq)`` or double-start migrations."""
+
+    def __init__(self, seed: int = 0, *, duplicate: float = 0.5,
+                 replay: float = 0.5):
+        self.seed = seed
+        self.duplicate = duplicate
+        self.replay = replay
+        self._history: List[dict] = []
+        self.injected = 0
+
+    def mangle(self, poll: int, rows) -> List[dict]:
+        out = [dict(r) for r in rows]
+        for i, r in enumerate(rows):
+            if _hash01(self.seed, "adv_dup", poll, i) < self.duplicate:
+                out.append(dict(r))
+                self.injected += 1
+        for i, r in enumerate(self._history):
+            if _hash01(self.seed, "adv_rep", poll, i) < self.replay:
+                out.append(dict(r))
+                self.injected += 1
+        self._history.extend(dict(r) for r in rows)
+        del self._history[:-64]
+        return out

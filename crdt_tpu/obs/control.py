@@ -56,7 +56,8 @@ from __future__ import annotations
 import json
 import threading
 from collections import deque
-from typing import Any, Dict, FrozenSet, List, NamedTuple, Optional, Tuple
+from typing import (Any, Callable, Dict, FrozenSet, List, NamedTuple,
+                    Optional, Tuple)
 
 from crdt_tpu.obs.tracer import get_tracer
 
@@ -193,6 +194,20 @@ class Controller:
         # rule state — ALL tick-indexed, never wall-clock
         self._overrides: Dict[Any, Tuple[int, int]] = {}
         self._squeezed_at: Dict[Any, int] = {}
+        # round 24: monotonic advice sequencing — every squeeze
+        # stamps its advice row with a fresh seq, so the fleet
+        # placement loop consuming federated rows can drop
+        # duplicated/reordered advice idempotently
+        self._advice_seq = 0
+        self._squeezed_seq: Dict[Any, int] = {}
+        # round 24: optional destination hint — the fleet layer
+        # wires this to ``HashRing.least_loaded_successor`` so
+        # advice rows name WHERE to move the tenant, not just away
+        # from here. Not part of config(): replay without the hook
+        # reproduces every decision (target is advisory, never an
+        # input to the rules).
+        self.placement_hint: Optional[Callable[[Any],
+                                               Optional[str]]] = None
         self._clean: Dict[Any, int] = {}
         self._last_burn: Dict[Any, float] = {}
         self._cooldown_until: Dict[Any, int] = {}
@@ -224,17 +239,26 @@ class Controller:
     def advice(self) -> List[Dict[str, Any]]:
         """Placement advice for the fleet layer: one row per tenant
         the controller is actively squeezing — ROADMAP item 2's
-        rebalance hint (a later round consumes it for cross-process
-        migration; round 22 only federates it at ``/fleet``)."""
-        return [
-            {
+        rebalance hint, consumed cross-process by
+        ``fleet.loop.PlacementLoop`` (round 24). ``seq`` is
+        monotonic per squeeze (duplicate/reordered rows dedup at
+        the consumer); ``target`` is the advised destination (the
+        least-loaded ring successor when the fleet layer wires
+        :attr:`placement_hint`, ``None`` in-process)."""
+        rows = []
+        for t in sorted(self._overrides, key=str):
+            target = None
+            if self.placement_hint is not None:
+                target = self.placement_hint(t)
+            rows.append({
                 "action": "rebalance_away",
                 "tenant": str(t),
                 "since_tick": self._squeezed_at.get(t, 0),
                 "burn": round(self._last_burn.get(t, 0.0), 4),
-            }
-            for t in sorted(self._overrides, key=str)
-        ]
+                "seq": self._squeezed_seq.get(t, 0),
+                "target": target,
+            })
+        return rows
 
     def report(self, limit: int = 128) -> Dict[str, Any]:
         """JSON-ready state: the ``/control`` endpoint payload."""
@@ -338,6 +362,8 @@ class Controller:
                     self._overrides[t] = new
                     self._squeezed_at[t] = tick
                     self._clean[t] = 0
+                    self._advice_seq += 1
+                    self._squeezed_seq[t] = self._advice_seq
                     rows.append(self._decide(
                         tick, "budget_squeeze", t, "tenant_budget",
                         [base_bytes, base_updates], list(new),

@@ -44,6 +44,9 @@ NAMESPACES = frozenset({
     # round 22 (control plane): the SLO-driven controller's
     # decision/cooldown/ledger/setpoint registry
     "control",
+    # round 24 (fleet serving): the live-migration recovery ladder
+    # (the `fleet.*` ownership counters were already listed above)
+    "migration",
 })
 
 # backticked dotted names that share a namespace but are NOT metrics
@@ -53,6 +56,7 @@ NON_METRICS = frozenset({
     "router.stats",              # router's tracer-free stats dict
     "overload.peak_inbox_bytes",  # BENCH_OUT section keys, gated by
     "overload.shed_count",        # metrics_diff directly
+    "fleet.leases",               # snapshot-store blob key (round 24)
     "overload.shed_bytes",
     "lint.findings",              # bench artifact keys (this tool's
     "lint.open_by_family",        # own gated metrics and the round-16

@@ -216,6 +216,17 @@ SECTION_KEYS: Tuple[Tuple[Tuple[str, ...], bool], ...] = (
     (("conflict", "converge", "map_rounds"), False),
     (("conflict", "converge", "subtree_cuts"), True),
     (("conflict", "converge", "map_chain_cuts"), True),
+    # fleet serving (round 24, bench --rebalance): ticks for the
+    # flooded tenant's serving burn to recover once the placement
+    # loop migrates it (tick counts — deterministic), and the fork
+    # guards — double_serves/forks must stay at their committed 0,
+    # recoveries at the seeded chaos's count (a rise means the same
+    # chaos leaned harder on the recovery ladder)
+    (("rebalance", "recovery_ticks"), False),
+    (("rebalance", "double_serves"), False),
+    (("rebalance", "forks"), False),
+    (("rebalance", "migration_recoveries"), False),
+    (("rebalance", "lost_flood_updates"), False),
 )
 SPAN_FIELDS = ("p50_s", "p99_s", "total_s")
 
@@ -262,6 +273,18 @@ GUARD_PREFIXES: Tuple[str, ...] = (
     # control.decisions / cooldown_skips are rule-mix facts and stay
     # ungated)
     "control.ledger_dropped",
+    # round 24: fleet-serving degradations — more fence rejects or
+    # fork refusals on the same chaos means more stale claims
+    # reached the serving path, more migration recoveries means the
+    # same fault schedule knocked more handoffs off the happy path
+    # (fleet.redirects / beacons_sent / migration.started are
+    # workload facts and stay ungated)
+    "fleet.fence_rejects",
+    "fleet.fork_refused",
+    "fleet.demotions",
+    "fleet.frames_malformed",
+    "migration.recovery",
+    "migration.tail_restores",
 )
 
 
